@@ -1,0 +1,174 @@
+"""UAPI-equivalent command types.
+
+Capability mirror of the reference's ioctl ABI (`kmod/nvme_strom.h:17-171`):
+ten commands, each an argument struct with in/out fields.  On TPU there is no
+kernel module — the "driver" is an in-process native engine — but the command
+vocabulary, field semantics and error model are preserved so every capability
+in SURVEY.md SS2 has a testable contract:
+
+==========================  ==========================================
+reference ioctl             here
+==========================  ==========================================
+STROM_IOCTL__CHECK_FILE     CheckFileCmd / FileInfo
+..__MAP_GPU_MEMORY          MapDeviceMemoryCmd (HBM handle, hbm.registry)
+..__UNMAP_GPU_MEMORY        UnmapDeviceMemoryCmd
+..__LIST_GPU_MEMORY         ListDeviceMemoryCmd
+..__INFO_GPU_MEMORY         InfoDeviceMemoryCmd
+..__MEMCPY_SSD2GPU          MemCopySsdToDeviceCmd  (SSD -> HBM)
+..__MEMCPY_SSD2RAM          MemCopySsdToRamCmd     (SSD -> pinned host)
+..__MEMCPY_WAIT             MemCopyWaitCmd
+..__ALLOC_DMA_BUFFER        AllocDmaBufferCmd (implemented, not vestigial)
+..__STAT_INFO               StatInfoCmd / StatInfo
+==========================  ==========================================
+
+Chunk-reordering contract (reference `kmod/nvme_strom.h:99-101`,
+`kmod/nvme_strom.c:1647-1663`): on return from a memcpy command the caller's
+``chunk_ids`` array is permuted — the first ``nr_ssd2dev`` entries were read
+by direct I/O into the destination, the trailing ``nr_ram2dev`` entries were
+found (mostly) resident in the host page cache and took the write-back path.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = [
+    "StromError", "FsKind", "FileInfo", "BufferInfo", "DmaTaskState",
+    "MemCopyResult", "StatInfo", "STAT_FIELDS",
+]
+
+
+class StromError(OSError):
+    """Engine error carrying an errno-style code (reference returns -errno)."""
+
+    def __init__(self, errno_: int, msg: str):
+        super().__init__(errno_, msg)
+
+
+class FsKind(enum.IntEnum):
+    """Filesystem classification from the eligibility check.
+
+    The reference accepts only ext4/xfs (magic + module identity check,
+    kmod/nvme_strom.c:477-486).  The TPU engine's O_DIRECT path works on any
+    filesystem that honours O_DIRECT; we still classify so policy can gate.
+    """
+
+    UNSUPPORTED = 0
+    EXT4 = 1
+    XFS = 2
+    OTHER_DIRECT = 3   # O_DIRECT probe succeeded on some other fs
+    FAKE = 4           # testing.fake loopback device
+
+
+@dataclass(frozen=True)
+class FileInfo:
+    """Result of CHECK_FILE (reference StromCmd__CheckFile, kmod/nvme_strom.h:34-46
+    filled by ioctl_check_file, kmod/nvme_strom.c:188-583)."""
+
+    path: str
+    file_size: int
+    fs_kind: FsKind
+    logical_block_size: int      # HW sector size analog (kmod/nvme_strom.c:274-295)
+    dma_max_size: int            # clamped merged-request cap (:297-314)
+    numa_node_id: int            # (:316-328)
+    support_dma64: bool          # (:330-336)
+    n_members: int = 1           # RAID-0 member count (1 = plain file)
+    stripe_chunk_size: int = 0   # RAID-0 chunk in bytes (0 = plain)
+
+    @property
+    def supported(self) -> bool:
+        return self.fs_kind != FsKind.UNSUPPORTED
+
+
+@dataclass(frozen=True)
+class BufferInfo:
+    """INFO_GPU_MEMORY analog (reference StromCmd__InfoGpuMemory,
+    kmod/nvme_strom.h:66-82): geometry of one registered destination buffer."""
+
+    handle: int
+    length: int
+    page_size: int
+    n_pages: int
+    owner_uid: int
+    refcount: int
+    kind: str            # 'hbm' | 'pinned_host' | 'user'
+    device: Optional[str] = None
+
+
+class DmaTaskState(enum.IntEnum):
+    RUNNING = 0
+    DONE = 1
+    FAILED = 2           # latched first error, retained until reaped
+    REAPED = 3
+
+
+@dataclass
+class MemCopyResult:
+    """Out-fields of MEMCPY_SSD2GPU/RAM (reference kmod/nvme_strom.h:85-117).
+
+    ``chunk_ids`` is the caller's array *after* the engine's reordering:
+    ``chunk_ids[:nr_ssd2dev]`` went through direct I/O, the tail
+    ``chunk_ids[nr_chunks-nr_ram2dev:]`` took the page-cache write-back path.
+    """
+
+    dma_task_id: int
+    nr_chunks: int
+    nr_ssd2dev: int
+    nr_ram2dev: int
+    chunk_ids: List[int]
+
+    def __post_init__(self) -> None:
+        # conservation invariant the reference asserts (kmod/nvme_strom.c:1708)
+        assert self.nr_ssd2dev + self.nr_ram2dev == self.nr_chunks, \
+            f"chunk conservation violated: {self.nr_ssd2dev}+{self.nr_ram2dev}!={self.nr_chunks}"
+
+
+# The statistics contract: count+clock pairs per stage plus gauges, mirroring
+# the reference's 26 atomic64 counters (kmod/nvme_strom.c:83-106) and the
+# STAT_INFO snapshot (:2059-2103).  Clocks are monotonic nanoseconds here
+# (the reference used rdtsc and shipped tsc_hz for conversion).
+STAT_FIELDS: Tuple[str, ...] = (
+    "nr_ioctl_memcpy_submit", "clk_ioctl_memcpy_submit",
+    "nr_ioctl_memcpy_wait",   "clk_ioctl_memcpy_wait",
+    "nr_ssd2dev",             "clk_ssd2dev",
+    "nr_setup_prps",          "clk_setup_prps",      # request-build stage
+    "nr_submit_dma",          "clk_submit_dma",
+    "nr_wait_dtask",          "clk_wait_dtask",
+    "nr_wrong_wakeup",
+    "total_dma_length",
+    "cur_dma_count",
+    "max_dma_count",
+    "nr_debug1", "clk_debug1",
+    "nr_debug2", "clk_debug2",
+    "nr_debug3", "clk_debug3",
+    "nr_debug4", "clk_debug4",
+)
+
+
+@dataclass
+class StatInfo:
+    """STAT_INFO snapshot (reference StromCmd__StatInfo, kmod/nvme_strom.h:141-171)."""
+
+    version: int = 1
+    has_debug: bool = False
+    timestamp_ns: int = 0
+    counters: dict = field(default_factory=dict)
+
+    def __getattr__(self, name: str):
+        try:
+            return self.__dict__["counters"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    @staticmethod
+    def delta(new: "StatInfo", old: "StatInfo") -> "StatInfo":
+        d = {k: new.counters.get(k, 0) - old.counters.get(k, 0) for k in new.counters}
+        # gauges are point-in-time, not deltas
+        for g in ("cur_dma_count", "max_dma_count"):
+            if g in new.counters:
+                d[g] = new.counters[g]
+        return StatInfo(version=new.version, has_debug=new.has_debug,
+                        timestamp_ns=new.timestamp_ns - old.timestamp_ns,
+                        counters=d)

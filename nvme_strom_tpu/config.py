@@ -1,0 +1,204 @@
+"""GUC-style configuration registry.
+
+Capability analog of the reference's four config tiers (SURVEY.md SS5.6):
+PostgreSQL GUCs ``nvme_strom.*`` (reference pgsql/nvme_strom.c:1561-1640),
+kernel module params ``verbose``/``stat_info`` (kmod/nvme_strom.c:76-82), CLI
+flags, and OS deploy configs.  Here the tiers are, lowest to highest
+precedence:
+
+1. built-in defaults (registered below),
+2. a config file (``strom_tpu.conf``, ``key = value`` lines; path from
+   ``$STROM_TPU_CONF`` or ``./strom_tpu.conf``),
+3. environment variables ``STROM_TPU_<NAME>`` (upper-cased),
+4. runtime ``set()`` calls.
+
+Each variable carries type, bounds and an optional cross-variable validation
+hook, matching the reference's GUC bounds + ``_PG_init`` validation (chunk
+size power-of-two, buffer a multiple of chunk; pgsql/nvme_strom.c:1637-1640).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["ConfigError", "Var", "Config", "config"]
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _parse_bool(s: str) -> bool:
+    v = s.strip().lower()
+    if v in ("1", "true", "on", "yes"):
+        return True
+    if v in ("0", "false", "off", "no"):
+        return False
+    raise ConfigError(f"invalid boolean: {s!r}")
+
+
+_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def _parse_size(s: str) -> int:
+    """Parse '256k', '16m', '1g' or a plain integer (bytes)."""
+    v = s.strip().lower()
+    if v and v[-1] in _SUFFIX:
+        return int(float(v[:-1]) * _SUFFIX[v[-1]])
+    return int(v, 0)
+
+
+@dataclass
+class Var:
+    name: str
+    default: Any
+    kind: str  # 'int' | 'size' | 'float' | 'bool' | 'str'
+    minval: Optional[float] = None
+    maxval: Optional[float] = None
+    help: str = ""
+    validate: Optional[Callable[[Any, "Config"], None]] = None
+
+    def parse(self, raw: Any) -> Any:
+        if self.kind == "bool":
+            return raw if isinstance(raw, bool) else _parse_bool(str(raw))
+        if self.kind == "int":
+            val = raw if isinstance(raw, int) and not isinstance(raw, bool) else int(str(raw), 0)
+        elif self.kind == "size":
+            val = raw if isinstance(raw, int) and not isinstance(raw, bool) else _parse_size(str(raw))
+        elif self.kind == "float":
+            val = float(raw)
+        elif self.kind == "str":
+            return str(raw)
+        else:  # pragma: no cover
+            raise ConfigError(f"unknown kind {self.kind}")
+        if self.minval is not None and val < self.minval:
+            raise ConfigError(f"{self.name}={val} below minimum {self.minval}")
+        if self.maxval is not None and val > self.maxval:
+            raise ConfigError(f"{self.name}={val} above maximum {self.maxval}")
+        return val
+
+
+def _check_pow2(val: int, _cfg: "Config") -> None:
+    if val & (val - 1):
+        raise ConfigError(f"value {val} must be a power of two")
+
+
+def _check_buffer_multiple(val: int, cfg: "Config") -> None:
+    chunk = cfg.get("chunk_size")
+    if chunk and val % chunk:
+        raise ConfigError(f"buffer_size {val} must be a multiple of chunk_size {chunk}")
+
+
+class Config:
+    """Thread-safe layered config store."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._vars: Dict[str, Var] = {}
+        self._values: Dict[str, Any] = {}
+        self._register_builtins()
+        self._load_file()
+        self._load_env()
+
+    # -- registration ------------------------------------------------------
+    def register(self, var: Var) -> None:
+        with self._lock:
+            if var.name in self._vars:
+                raise ConfigError(f"duplicate config var {var.name}")
+            self._vars[var.name] = var
+            self._values[var.name] = var.parse(var.default) if var.kind != "str" else var.default
+
+    def _register_builtins(self) -> None:
+        reg = self.register
+        # pgsql GUC analogs (reference pgsql/nvme_strom.c:1561-1635)
+        reg(Var("enabled", True, "bool", help="turn the direct-load scan path on/off"))
+        reg(Var("chunk_size", 16 << 20, "size", minval=1 << 16, maxval=1 << 30,
+                help="scan chunk size (default 16MB)", validate=_check_pow2))
+        reg(Var("buffer_size", 1 << 30, "size", minval=1 << 20,
+                help="DMA staging pool size (default 1GB)",
+                validate=_check_buffer_multiple))
+        reg(Var("numa_node_mask", -1, "int", help="bitmask of NUMA nodes usable for DMA buffers (-1 = all)"))
+        reg(Var("async_depth", 8, "int", minval=1, maxval=1024,
+                help="in-flight DMA tasks per scan ring (default 8)"))
+        reg(Var("seq_page_cost", 0.25, "float", minval=0.0,
+                help="planner cost per page for direct scan, fraction of VFS cost"))
+        reg(Var("debug_no_threshold", False, "bool",
+                help="force direct scan regardless of table size (test hook)"))
+        # kernel-module-param analogs (kmod/nvme_strom.c:76-82,139-146)
+        reg(Var("verbose", 0, "int", minval=0, maxval=2, help="debug log verbosity"))
+        reg(Var("stat_info", True, "bool", help="collect per-stage statistics"))
+        reg(Var("dma_max_size", 256 << 10, "size", minval=4 << 10, maxval=4 << 20,
+                help="max merged I/O request (default 256KB; kmod cap at nvme_strom.c:139-146)",
+                validate=_check_pow2))
+        # TPU-framework-specific knobs
+        reg(Var("io_backend", "auto", "str", help="'auto' | 'io_uring' | 'threadpool' | 'python'"))
+        reg(Var("queue_depth", 32, "int", minval=1, maxval=4096,
+                help="io_uring submission queue depth / outstanding requests"))
+        reg(Var("staging_buffers", 3, "int", minval=2, maxval=16,
+                help="pinned host staging buffers for the SSD->HBM pipeline (triple-buffered default)"))
+        reg(Var("pin_memory", True, "bool", help="mlock/hugepage-back staging buffers"))
+        reg(Var("cache_arbitration", True, "bool",
+                help="probe the page cache and route hot chunks through the write-back path "
+                     "(kmod/nvme_strom.c:1639-1663 analog)"))
+        reg(Var("cache_threshold", 0.5, "float", minval=0.0, maxval=1.0,
+                help="cached-page fraction above which a chunk takes the write-back path"))
+
+    # -- layered loading ---------------------------------------------------
+    def _load_file(self) -> None:
+        path = os.environ.get("STROM_TPU_CONF", "strom_tpu.conf")
+        if not os.path.isfile(path):
+            return
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                if "=" not in line:
+                    raise ConfigError(f"{path}:{lineno}: expected key = value")
+                key, _, raw = line.partition("=")
+                self.set(key.strip(), raw.strip())
+
+    def _load_env(self) -> None:
+        for name in list(self._vars):
+            env = os.environ.get("STROM_TPU_" + name.upper())
+            if env is not None:
+                self.set(name, env)
+
+    # -- access ------------------------------------------------------------
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name not in self._vars:
+                raise ConfigError(f"unknown config var {name}")
+            return self._values[name]
+
+    def set(self, name: str, raw: Any) -> None:
+        with self._lock:
+            if name not in self._vars:
+                raise ConfigError(f"unknown config var {name}")
+            var = self._vars[name]
+            val = var.parse(raw)
+            old = self._values[name]
+            self._values[name] = val
+            try:
+                # cross-variable invariants can be broken by *either* side
+                # changing, so every validator re-runs on any set
+                for v in self._vars.values():
+                    if v.validate is not None:
+                        v.validate(self._values[v.name], self)
+            except ConfigError:
+                self._values[name] = old
+                raise
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._values)
+
+    def describe(self) -> Dict[str, Var]:
+        return dict(self._vars)
+
+
+#: process-global config instance (import-time singleton, like GUCs)
+config = Config()

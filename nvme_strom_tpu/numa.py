@@ -1,0 +1,112 @@
+"""NUMA topology discovery and affinity binding.
+
+Capability analog of the reference's NUMA handling: the kernel module reports
+the SSD's NUMA node from the device (`kmod/nvme_strom.c:316-328`);
+``ssd2ram_test`` parses the node's sysfs cpulist and binds the process CPU
+affinity to it (`utils/ssd2ram_test.c:66-119`); the pgsql extension binds the
+backend during scans and round-robins DMA buffers across allowed nodes
+(`pgsql/nvme_strom.c:353-446,1126-1181`).
+
+Everything here degrades gracefully on machines without NUMA sysfs (returns
+node 0 / no-ops), which also covers CI containers.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional
+
+__all__ = [
+    "device_numa_node", "nodes_with_memory", "node_cpus", "bind_to_node",
+    "parse_cpulist",
+]
+
+_SYS_NODE = "/sys/devices/system/node"
+
+
+def parse_cpulist(text: str) -> List[int]:
+    """Parse sysfs cpulist syntax: '0-3,8,10-11'."""
+    cpus: List[int] = []
+    for part in text.strip().split(","):
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-")
+            cpus.extend(range(int(lo), int(hi) + 1))
+        else:
+            cpus.append(int(part))
+    return cpus
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def device_numa_node(path: str) -> int:
+    """NUMA node of the block device backing *path* (kmod/nvme_strom.c:316-328
+    analog).  Walks /sys/dev/block/<maj>:<min> up to a device with a
+    ``numa_node`` attribute.  Returns 0 when undiscoverable."""
+    try:
+        st = os.stat(path)
+        dev = st.st_dev
+        maj, minor = os.major(dev), os.minor(dev)
+    except OSError:
+        return 0
+    node = _read(f"/sys/dev/block/{maj}:{minor}/device/numa_node")
+    if node is None:
+        # partition -> parent disk
+        link = f"/sys/dev/block/{maj}:{minor}"
+        try:
+            real = os.path.realpath(link)
+            node = _read(os.path.join(os.path.dirname(real), "device", "numa_node"))
+        except OSError:
+            node = None
+    try:
+        n = int(node) if node is not None else 0
+    except ValueError:
+        n = 0
+    return max(n, 0)  # -1 (no NUMA) -> 0
+
+
+def nodes_with_memory() -> List[int]:
+    """Nodes that actually have memory (pgsql/nvme_strom.c:1126-1181 reads
+    sysfs ``has_memory``)."""
+    text = _read(os.path.join(_SYS_NODE, "has_memory")) or \
+        _read(os.path.join(_SYS_NODE, "online"))
+    if text:
+        return parse_cpulist(text)
+    return [0]
+
+
+def node_cpus(node: int) -> List[int]:
+    text = _read(os.path.join(_SYS_NODE, f"node{node}", "cpulist"))
+    if text:
+        return parse_cpulist(text)
+    return list(range(os.cpu_count() or 1))
+
+
+def bind_to_node(node: int) -> bool:
+    """Bind this process's CPU affinity to *node*'s CPUs
+    (utils/ssd2ram_test.c:66-119 analog).  Returns True on success."""
+    cpus = node_cpus(node)
+    if not cpus:
+        return False
+    try:
+        os.sched_setaffinity(0, cpus)
+        return True
+    except (OSError, AttributeError):
+        return False
+
+
+def allowed_nodes(mask: int) -> List[int]:
+    """Intersect a numa_node_mask config bitmask with nodes that have memory
+    (pgsql/nvme_strom.c:1126-1181 analog).  mask == -1 means all."""
+    nodes = nodes_with_memory()
+    if mask == -1:
+        return nodes
+    return [n for n in nodes if mask & (1 << n)] or nodes

@@ -1,0 +1,103 @@
+"""Statistics registry.
+
+Capability analog of the reference's stats engine: 26 global atomic64
+counters arranged as count+clock pairs per pipeline stage, plus DMA byte/
+in-flight gauges and four spare debug pairs (`kmod/nvme_strom.c:83-119`),
+snapshotted by ``STROM_IOCTL__STAT_INFO`` (`:2056-2103`) and rendered by
+``nvme_stat`` (`utils/nvme_stat.c`).
+
+Differences from the reference, deliberately: clocks are CLOCK_MONOTONIC
+nanoseconds instead of rdtsc (no tsc_hz shipping needed), and the registry is
+per-process with the native engine contributing its own counters which are
+merged into snapshots.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+from .api import STAT_FIELDS, StatInfo
+from .config import config
+
+__all__ = ["StatRegistry", "stats"]
+
+
+class StatRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._c = {name: 0 for name in STAT_FIELDS}
+
+    def enabled(self) -> bool:
+        return bool(config.get("stat_info"))
+
+    def add(self, name: str, delta: int = 1) -> None:
+        if not self.enabled():
+            return
+        with self._lock:
+            self._c[name] += delta
+
+    def count_clock(self, name: str, ns: int, n: int = 1) -> None:
+        """Bump an ``nr_<name>``/``clk_<name>`` pair."""
+        if not self.enabled():
+            return
+        with self._lock:
+            self._c["nr_" + name] += n
+            self._c["clk_" + name] += ns
+
+    def gauge_max(self, name: str, value: int) -> None:
+        """atomic64_max_return analog (kmod/nvme_strom.c:108-119)."""
+        with self._lock:
+            if value > self._c[name]:
+                self._c[name] = value
+
+    def gauge_set(self, name: str, value: int) -> None:
+        with self._lock:
+            self._c[name] = value
+
+    def gauge_add(self, name: str, delta: int) -> int:
+        with self._lock:
+            self._c[name] += delta
+            return self._c[name]
+
+    @contextmanager
+    def stage(self, name: str):
+        """Time a pipeline stage into its count+clock pair."""
+        if not self.enabled():
+            yield
+            return
+        t0 = time.monotonic_ns()
+        try:
+            yield
+        finally:
+            self.count_clock(name, time.monotonic_ns() - t0)
+
+    def snapshot(self, *, debug: bool = False, reset_max: bool = True) -> StatInfo:
+        """STAT_INFO: consistent snapshot; ``max_dma_count`` is read-and-reset
+        to the current in-flight count, as the reference does
+        (kmod/nvme_strom.c:2087)."""
+        with self._lock:
+            counters = dict(self._c)
+            if reset_max:
+                self._c["max_dma_count"] = self._c["cur_dma_count"]
+        if not debug:
+            counters = {k: v for k, v in counters.items() if "debug" not in k}
+        return StatInfo(version=1, has_debug=debug,
+                        timestamp_ns=time.monotonic_ns(), counters=counters)
+
+    def merge_native(self, native_counters: dict) -> None:
+        """Fold a native-engine counter snapshot delta into this registry."""
+        with self._lock:
+            for k, v in native_counters.items():
+                if k in self._c:
+                    if k in ("cur_dma_count",):
+                        self._c[k] = v
+                    elif k == "max_dma_count":
+                        self._c[k] = max(self._c[k], v)
+                    else:
+                        self._c[k] += v
+
+
+#: process-global registry (the reference's counters are module-global too)
+stats = StatRegistry()

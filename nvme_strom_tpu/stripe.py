@@ -1,0 +1,140 @@
+"""RAID-0 stripe remapping in userspace.
+
+The reference re-implements the md-RAID-0 zone math inside its kernel module
+so a logical md sector can be resolved to (member NVMe device, physical
+sector) without the md layer (`kmod/nvme_strom.c:823-910`: ``find_zone`` +
+``strom_raid0_map_sector``, with a power-of-2 chunk fast path and a generic
+path, partition-offset add, and rejection of I/O that crosses a chunk
+boundary).
+
+Here the same capability lives in userspace: a :class:`StripeMap` is built
+from member sizes + chunk size (either probed from ``/sys/block/md*/md`` for a
+real md device, or configured for a striped set of plain files) and resolves
+logical byte ranges to per-member ranges.  Zone semantics follow md raid0:
+when members differ in size, the address space is a sequence of zones, each
+striping over the members that still have capacity at that depth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+__all__ = ["StripeZone", "StripeMap", "StripeExtent"]
+
+SECTOR = 512
+
+
+@dataclass(frozen=True)
+class StripeZone:
+    zone_start: int      # first logical byte of this zone
+    zone_len: int        # logical bytes covered by this zone
+    dev_start: int       # byte offset into each member where this zone begins
+    members: Tuple[int, ...]  # member indices participating in this zone
+
+
+@dataclass(frozen=True)
+class StripeExtent:
+    """One physically-contiguous piece of a logical range."""
+
+    member: int          # member index
+    member_offset: int   # byte offset within the member
+    length: int          # bytes
+    logical_offset: int  # where this piece sits in the logical stream
+
+
+class StripeMap:
+    """Logical->member address resolution for an N-way RAID-0 stripe set."""
+
+    def __init__(self, member_sizes: Sequence[int], chunk_size: int,
+                 member_offsets: Sequence[int] | None = None):
+        if chunk_size <= 0 or chunk_size % SECTOR:
+            raise ValueError(f"chunk_size {chunk_size} must be a positive multiple of {SECTOR}")
+        if not member_sizes:
+            raise ValueError("need at least one member")
+        self.chunk_size = chunk_size
+        self.n_members = len(member_sizes)
+        # partition start offsets (reference adds these at kmod/nvme_strom.c:904-906)
+        self.member_offsets = tuple(member_offsets or [0] * self.n_members)
+        # usable size per member = whole chunks only (md rounds down to chunks)
+        usable = [size // chunk_size * chunk_size for size in member_sizes]
+        self.zones = self._build_zones(usable)
+        self.total_size = sum(z.zone_len for z in self.zones)
+        self._pow2 = (chunk_size & (chunk_size - 1)) == 0
+        self._chunk_shift = chunk_size.bit_length() - 1 if self._pow2 else 0
+
+    @staticmethod
+    def _build_zones(usable: List[int]) -> List[StripeZone]:
+        """md raid0 strip-zone construction: zone k stripes across every member
+        whose usable size exceeds the depth already consumed."""
+        zones: List[StripeZone] = []
+        consumed = 0        # per-member depth already assigned to earlier zones
+        logical = 0
+        while True:
+            members = tuple(i for i, u in enumerate(usable) if u > consumed)
+            if not members:
+                break
+            next_cut = min(usable[i] for i in members)
+            height = next_cut - consumed
+            zlen = height * len(members)
+            zones.append(StripeZone(zone_start=logical, zone_len=zlen,
+                                    dev_start=consumed, members=members))
+            logical += zlen
+            consumed = next_cut
+        return zones
+
+    # -- point resolution --------------------------------------------------
+    def _find_zone(self, offset: int) -> StripeZone:
+        for z in self.zones:
+            if z.zone_start <= offset < z.zone_start + z.zone_len:
+                return z
+        raise ValueError(f"offset {offset} beyond stripe set size {self.total_size}")
+
+    def map_offset(self, offset: int) -> Tuple[int, int, int]:
+        """Resolve one logical byte offset.
+
+        Returns ``(member, member_offset, contig)`` where ``contig`` is how
+        many bytes from ``offset`` stay contiguous on that member (i.e. the
+        distance to the next chunk boundary) — callers must split requests
+        there, the rule the reference enforces by rejecting chunk-crossing I/O
+        (kmod/nvme_strom.c:859-869).
+        """
+        z = self._find_zone(offset)
+        rel = offset - z.zone_start
+        c = self.chunk_size
+        if self._pow2:
+            chunk_idx = rel >> self._chunk_shift
+            in_chunk = rel & (c - 1)
+        else:
+            chunk_idx, in_chunk = divmod(rel, c)
+        nb = len(z.members)
+        member = z.members[chunk_idx % nb]
+        row = chunk_idx // nb
+        member_off = z.dev_start + row * c + in_chunk + self.member_offsets[member]
+        return member, member_off, c - in_chunk
+
+    # -- range resolution --------------------------------------------------
+    def map_range(self, offset: int, length: int) -> List[StripeExtent]:
+        """Split a logical byte range into per-member contiguous extents."""
+        if offset < 0 or length < 0 or offset + length > self.total_size:
+            raise ValueError(f"range [{offset}, {offset + length}) outside stripe set "
+                             f"of size {self.total_size}")
+        out: List[StripeExtent] = []
+        pos = offset
+        remaining = length
+        while remaining > 0:
+            member, moff, contig = self.map_offset(pos)
+            take = min(contig, remaining)
+            # merge with previous extent when physically adjacent on the same
+            # member (keeps request merging effective downstream)
+            if out and out[-1].member == member and \
+               out[-1].member_offset + out[-1].length == moff and \
+               out[-1].logical_offset + out[-1].length == pos:
+                prev = out.pop()
+                out.append(StripeExtent(member, prev.member_offset,
+                                        prev.length + take, prev.logical_offset))
+            else:
+                out.append(StripeExtent(member, moff, take, pos))
+            pos += take
+            remaining -= take
+        return out

@@ -1,0 +1,3 @@
+from .fake import FakeNvmeSource, FaultPlan, make_test_file
+
+__all__ = ["FakeNvmeSource", "FaultPlan", "make_test_file"]

@@ -1,0 +1,97 @@
+"""Fake backends for hardware-free CI.
+
+The reference has **no** tests or mocks (SURVEY.md SS4) — its oracles are
+baked into the runtime benchmarks.  This module supplies what it lacks: a
+loopback "NVMe" source with injected latency and fault plans so the planner,
+merging, error-retention and corruption logic are testable on any machine,
+plus helpers to build deterministic test files.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import hashlib
+import os
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Set
+
+from ..api import StromError
+from ..engine import PlainSource
+
+
+def make_test_file(path: str, size: int, *, seed: int = 0) -> None:
+    """Deterministic content: every 8-byte word encodes its own offset xor a
+    seed hash, so corruption checks can point at the exact wrong offset."""
+    h = int.from_bytes(hashlib.blake2b(str(seed).encode(), digest_size=8).digest(), "little")
+    with open(path, "wb") as f:
+        chunk = 1 << 20
+        off = 0
+        while off < size:
+            n = min(chunk, size - off)
+            nw = (n + 7) // 8
+            words = bytearray(nw * 8)
+            for i in range(nw):
+                struct.pack_into("<Q", words, i * 8, ((off + i * 8) ^ h) & (2**64 - 1))
+            f.write(bytes(words[:n]))
+            off += n
+
+
+def expected_bytes(offset: int, length: int, *, seed: int = 0) -> bytes:
+    h = int.from_bytes(hashlib.blake2b(str(seed).encode(), digest_size=8).digest(), "little")
+    start_word = offset // 8
+    end_word = (offset + length + 7) // 8
+    buf = bytearray((end_word - start_word) * 8)
+    for i, w in enumerate(range(start_word, end_word)):
+        struct.pack_into("<Q", buf, i * 8, ((w * 8) ^ h) & (2**64 - 1))
+    head = offset - start_word * 8
+    return bytes(buf[head:head + length])
+
+
+@dataclass
+class FaultPlan:
+    """Deterministic fault injection for the direct-read path."""
+
+    fail_offsets: Set[int] = field(default_factory=set)   # file_off -> EIO
+    fail_every_nth: int = 0                               # every Nth direct read fails
+    latency_s: float = 0.0                                # per-request injected delay
+    corrupt_offsets: Set[int] = field(default_factory=set)  # flip a byte at offset
+    _count: int = 0
+
+    def check(self, file_off: int, length: int) -> None:
+        self._count += 1
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        if self.fail_every_nth and self._count % self.fail_every_nth == 0:
+            raise StromError(_errno.EIO, f"injected periodic fault #{self._count}")
+        for off in self.fail_offsets:
+            if file_off <= off < file_off + length:
+                raise StromError(_errno.EIO, f"injected fault at {off}")
+
+
+class FakeNvmeSource(PlainSource):
+    """Loopback 'NVMe device': a plain file plus injected latency/faults.
+
+    Reads go through the normal O_DIRECT fds so alignment behaviour stays
+    real; latency, failures and corruption are injected at read time so
+    async error latching / retention and corruption oracles are exercised.
+    """
+
+    def __init__(self, path: str, *, fault_plan: Optional[FaultPlan] = None,
+                 block_size: int = 512, force_cached_fraction: Optional[float] = None):
+        super().__init__(path, block_size)
+        self.fault_plan = fault_plan or FaultPlan()
+        self.force_cached_fraction = force_cached_fraction
+
+    def read_member_direct(self, member: int, file_off: int, dest: memoryview) -> None:
+        self.fault_plan.check(file_off, len(dest))
+        super().read_member_direct(member, file_off, dest)
+        for off in self.fault_plan.corrupt_offsets:
+            if file_off <= off < file_off + len(dest):
+                dest[off - file_off] = dest[off - file_off] ^ 0xFF
+
+    def cached_fraction(self, offset: int, length: int) -> float:
+        if self.force_cached_fraction is not None:
+            return self.force_cached_fraction
+        return super().cached_fraction(offset, length)

@@ -1,0 +1,37 @@
+"""Test harness setup.
+
+Forces JAX onto a virtual 8-device CPU mesh (multi-chip shardings are
+validated without TPU hardware) — must run before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_data_file(tmp_path):
+    """A 4MB deterministic test file on the real filesystem (ext4 here, so
+    O_DIRECT works)."""
+    from nvme_strom_tpu.testing import make_test_file
+    path = str(tmp_path / "data.bin")
+    make_test_file(path, 4 << 20)
+    return path
+
+
+@pytest.fixture(autouse=True)
+def _reset_config():
+    """Isolate config mutations between tests."""
+    from nvme_strom_tpu.config import config
+    snap = config.snapshot()
+    yield
+    for k, v in snap.items():
+        try:
+            config.set(k, v)
+        except Exception:
+            pass

@@ -1,0 +1,71 @@
+import os
+
+import pytest
+
+from nvme_strom_tpu.config import Config, ConfigError, config
+
+
+def test_defaults_mirror_reference():
+    # pgsql GUC defaults (pgsql/nvme_strom.c:1561-1625)
+    assert config.get("chunk_size") == 16 << 20
+    assert config.get("buffer_size") == 1 << 30
+    assert config.get("async_depth") == 8
+    assert config.get("seq_page_cost") == 0.25
+    assert config.get("enabled") is True
+    assert config.get("debug_no_threshold") is False
+    # kmod cap (kmod/nvme_strom.c:139-146)
+    assert config.get("dma_max_size") == 256 << 10
+
+
+def test_size_suffix_parsing():
+    config.set("chunk_size", "8m")
+    assert config.get("chunk_size") == 8 << 20
+    config.set("dma_max_size", "128k")
+    assert config.get("dma_max_size") == 128 << 10
+
+
+def test_pow2_validation():
+    with pytest.raises(ConfigError):
+        config.set("chunk_size", (16 << 20) + 4096)
+
+
+def test_buffer_multiple_of_chunk():
+    config.set("chunk_size", "1m")
+    with pytest.raises(ConfigError):
+        config.set("buffer_size", (1 << 20) * 3 + 512)
+    config.set("buffer_size", "64m")
+
+
+def test_bounds():
+    with pytest.raises(ConfigError):
+        config.set("async_depth", 0)
+    with pytest.raises(ConfigError):
+        config.set("async_depth", 100000)
+
+
+def test_unknown_var():
+    with pytest.raises(ConfigError):
+        config.get("nope")
+    with pytest.raises(ConfigError):
+        config.set("nope", 1)
+
+
+def test_env_layer(monkeypatch):
+    monkeypatch.setenv("STROM_TPU_ASYNC_DEPTH", "16")
+    cfg = Config()
+    assert cfg.get("async_depth") == 16
+
+
+def test_file_layer(tmp_path, monkeypatch):
+    conf = tmp_path / "strom_tpu.conf"
+    conf.write_text("# comment\nchunk_size = 4m\nverbose = 1\n")
+    monkeypatch.setenv("STROM_TPU_CONF", str(conf))
+    cfg = Config()
+    assert cfg.get("chunk_size") == 4 << 20
+    assert cfg.get("verbose") == 1
+
+
+def test_bool_parsing():
+    for raw, want in [("on", True), ("off", False), ("1", True), ("no", False)]:
+        config.set("enabled", raw)
+        assert config.get("enabled") is want
