@@ -1,0 +1,15 @@
+.PHONY: all native tsan test clean
+
+all: native
+
+native:
+	$(MAKE) -C csrc
+
+tsan:
+	$(MAKE) -C csrc tsan
+
+test: native
+	python -m pytest tests/ -x -q
+
+clean:
+	$(MAKE) -C csrc clean

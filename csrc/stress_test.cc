@@ -1,0 +1,90 @@
+// stress_test.cc — standalone concurrency stress for the native engine,
+// built with -fsanitize=thread (csrc/Makefile target `stress`).  The
+// reference relied on manual lock discipline plus measured race signals
+// (nr_wrong_wakeup); this is the automated check it lacked (SURVEY.md SS5.2).
+//
+// Usage: stress_test <file> [threads] [iters]
+
+#include "strom_tpu.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <atomic>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <file> [threads] [iters]\n", argv[0]);
+    return 2;
+  }
+  const char* path = argv[1];
+  int nthreads = argc > 2 ? atoi(argv[2]) : 8;
+  int iters = argc > 3 ? atoi(argv[3]) : 20;
+  struct stat st;
+  if (stat(path, &st) != 0 || st.st_size < (1 << 20)) {
+    fprintf(stderr, "need a file >= 1MB\n");
+    return 2;
+  }
+  const uint64_t req_sz = 128 << 10;
+  const int reqs_per_task = 8;
+  uint64_t span = (uint64_t)st.st_size / req_sz;
+
+  uint64_t eng = nstpu_engine_create(NSTPU_BACKEND_AUTO, 32);
+  if (!eng) {
+    fprintf(stderr, "engine create failed\n");
+    return 1;
+  }
+  std::vector<std::thread> threads;
+  std::atomic<int> failures{0};
+  for (int t = 0; t < nthreads; t++) {
+    threads.emplace_back([&, t] {
+      int fd = open(path, O_RDONLY | O_DIRECT);
+      if (fd < 0) fd = open(path, O_RDONLY);
+      void* buf = mmap(nullptr, reqs_per_task * req_sz, PROT_READ | PROT_WRITE,
+                       MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+      std::mt19937 rng(t);
+      for (int i = 0; i < iters; i++) {
+        nstpu_req reqs[reqs_per_task];
+        for (int r = 0; r < reqs_per_task; r++) {
+          reqs[r].fd = fd;
+          reqs[r].file_off = (rng() % span) * req_sz;
+          reqs[r].len = req_sz;
+          reqs[r].dest_off = r * req_sz;
+        }
+        int64_t tid = nstpu_submit(eng, buf, reqs, reqs_per_task);
+        if (tid < 0) {
+          failures++;
+          continue;
+        }
+        if (i % 3 == 0) {
+          // sometimes don't wait: exercises retention + engine-level reap
+          continue;
+        }
+        int rc = nstpu_wait(eng, tid, 30000);
+        if (rc != 0) failures++;
+      }
+      munmap(buf, reqs_per_task * req_sz);
+      close(fd);
+    });
+  }
+  for (auto& th : threads) th.join();
+  int64_t failed[256];
+  nstpu_engine_reap(eng, failed, 256, 30000);
+  uint64_t ctr[NSTPU_CTR__COUNT];
+  nstpu_engine_stats(eng, ctr, NSTPU_CTR__COUNT);
+  printf("submits=%llu bytes=%llu wrong_wakeups=%llu max_inflight(reset)=ok "
+         "failures=%d\n",
+         (unsigned long long)ctr[NSTPU_CTR_NR_SUBMIT_DMA],
+         (unsigned long long)ctr[NSTPU_CTR_TOTAL_DMA_LENGTH],
+         (unsigned long long)ctr[NSTPU_CTR_NR_WRONG_WAKEUP], failures.load());
+  nstpu_engine_destroy(eng);
+  return failures.load() ? 1 : 0;
+}

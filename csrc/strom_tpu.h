@@ -1,0 +1,102 @@
+/* strom_tpu.h — C ABI of the native async I/O engine.
+ *
+ * Capability analog of the reference's kernel UAPI (kmod/nvme_strom.h):
+ * where the reference exposes ioctls on /proc/nvme-strom, this engine is
+ * linked in-process and driven over a flat C ABI (ctypes-friendly: only
+ * fixed-width ints and raw pointers).
+ *
+ * Ownership model (mirrors the reference's driver state):
+ *  - an ENGINE is the "loaded module": backend threads, stats registry,
+ *    512-slot task table (kmod/nvme_strom.c:639-644 analog);
+ *  - a TASK is one submitted memcpy command: per-request refcount, first
+ *    error latched, FAILED tasks retained until reaped by a wait or by
+ *    nstpu_engine_reap (the ioctl-fd-close analog; design memo
+ *    kmod/nvme_strom.c:612-626).
+ *
+ * The chunk planner (merging, cache arbitration, stripe resolution) runs in
+ * the Python layer; this engine executes planned request batches with
+ * io_uring (primary) or a pread thread pool (fallback), entirely outside
+ * the GIL.
+ */
+#ifndef STROM_TPU_H
+#define STROM_TPU_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define NSTPU_API_VERSION 1
+
+/* backends */
+#define NSTPU_BACKEND_AUTO       0
+#define NSTPU_BACKEND_IO_URING   1
+#define NSTPU_BACKEND_THREADPOOL 2
+
+/* counter indices for nstpu_engine_stats(); order is ABI.
+ * Mirrors the reference's count+clock pairs (kmod/nvme_strom.c:83-106). */
+enum {
+  NSTPU_CTR_NR_SUBMIT_DMA = 0,
+  NSTPU_CTR_CLK_SUBMIT_DMA,     /* ns spent in submission syscalls */
+  NSTPU_CTR_NR_SSD2DEV,         /* completed tasks */
+  NSTPU_CTR_CLK_SSD2DEV,        /* ns submit->last-completion per task */
+  NSTPU_CTR_NR_WAIT_DTASK,
+  NSTPU_CTR_CLK_WAIT_DTASK,
+  NSTPU_CTR_NR_WRONG_WAKEUP,
+  NSTPU_CTR_TOTAL_DMA_LENGTH,
+  NSTPU_CTR_CUR_DMA_COUNT,
+  NSTPU_CTR_MAX_DMA_COUNT,      /* read-and-reset by stats snapshot */
+  NSTPU_CTR_NR_RESUBMIT,        /* short-read continuations */
+  NSTPU_CTR_NR_SQ_FULL,         /* submission stalls on full SQ */
+  NSTPU_CTR__COUNT
+};
+
+/* One planned I/O request: read [file_off, file_off+len) from fd into
+ * dest_base + dest_off.  len <= the planner's dma_max cap. */
+typedef struct nstpu_req {
+  int32_t  fd;
+  int32_t  _pad;
+  uint64_t file_off;
+  uint64_t len;
+  uint64_t dest_off;
+} nstpu_req;
+
+/* Engine lifecycle.  Returns an opaque handle (0 on failure).
+ * queue_depth: io_uring SQ entries / thread-pool width. */
+uint64_t nstpu_engine_create(int backend, int queue_depth);
+void     nstpu_engine_destroy(uint64_t engine);
+int      nstpu_engine_backend(uint64_t engine);     /* NSTPU_BACKEND_* or -errno */
+int      nstpu_engine_version(void);
+
+/* Submit one task of nreq requests reading into dest_base.
+ * Returns task_id > 0, or -errno. */
+int64_t  nstpu_submit(uint64_t engine, void* dest_base,
+                      const nstpu_req* reqs, int32_t nreq);
+
+/* Wait for a task and reap it (MEMCPY_WAIT analog).
+ * 0 = success; -errno = the task's latched first error (task reaped);
+ * -ETIMEDOUT = still running (task NOT reaped); -ENOENT = unknown id.
+ * timeout_ms < 0 waits forever. */
+int      nstpu_wait(uint64_t engine, int64_t task_id, int64_t timeout_ms);
+
+/* List task ids still in the table (running or retained-failed).
+ * Returns count written (<= cap), or -errno. */
+int      nstpu_pending(uint64_t engine, int64_t* out, int32_t cap);
+
+/* Force-reap every completed task, returning ids of FAILED ones
+ * (the ioctl-fd-close reap, kmod/nvme_strom.c:2138-2166 analog).
+ * Blocks up to timeout_ms for running tasks.  Returns count of failed
+ * ids written (<= cap), or -errno. */
+int      nstpu_engine_reap(uint64_t engine, int64_t* failed_out, int32_t cap,
+                           int64_t timeout_ms);
+
+/* Copy the counter array (NSTPU_CTR__COUNT entries).  MAX_DMA_COUNT is
+ * read-and-reset to the current in-flight count, like the reference's
+ * STAT_INFO (kmod/nvme_strom.c:2087).  Returns entries written. */
+int      nstpu_engine_stats(uint64_t engine, uint64_t* out, int32_t cap);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* STROM_TPU_H */

@@ -1,0 +1,174 @@
+"""ctypes bindings for the native async I/O engine (csrc/strom_engine.cc).
+
+Loads ``libstrom_tpu.so`` (building it via ``make -C csrc`` on first use when
+a toolchain is present).  The native engine is the performance path: io_uring
+submission/completion entirely outside the GIL, with the same task-table
+semantics as the Python fallback in :mod:`nvme_strom_tpu.engine`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import StromError
+
+__all__ = ["NativeEngine", "native_available"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libstrom_tpu.so")
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "csrc")
+
+BACKEND_AUTO, BACKEND_IO_URING, BACKEND_THREADPOOL = 0, 1, 2
+_BACKEND_NAMES = {BACKEND_IO_URING: "io_uring", BACKEND_THREADPOOL: "threadpool"}
+
+# counter order must match enum NSTPU_CTR_* in csrc/strom_tpu.h
+NATIVE_COUNTERS = (
+    "nr_submit_dma", "clk_submit_dma",
+    "nr_ssd2dev", "clk_ssd2dev",
+    "nr_wait_dtask", "clk_wait_dtask",
+    "nr_wrong_wakeup",
+    "total_dma_length",
+    "cur_dma_count",
+    "max_dma_count",
+    "nr_resubmit",
+    "nr_sq_full",
+)
+
+
+class _Req(ctypes.Structure):
+    _fields_ = [("fd", ctypes.c_int32), ("_pad", ctypes.c_int32),
+                ("file_off", ctypes.c_uint64), ("len", ctypes.c_uint64),
+                ("dest_off", ctypes.c_uint64)]
+
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(["make", "-C", _CSRC], check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.nstpu_engine_create.restype = ctypes.c_uint64
+        lib.nstpu_engine_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.nstpu_engine_destroy.argtypes = [ctypes.c_uint64]
+        lib.nstpu_engine_backend.argtypes = [ctypes.c_uint64]
+        lib.nstpu_submit.restype = ctypes.c_int64
+        lib.nstpu_submit.argtypes = [ctypes.c_uint64, ctypes.c_void_p,
+                                     ctypes.POINTER(_Req), ctypes.c_int32]
+        lib.nstpu_wait.argtypes = [ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64]
+        lib.nstpu_pending.argtypes = [ctypes.c_uint64,
+                                      ctypes.POINTER(ctypes.c_int64), ctypes.c_int32]
+        lib.nstpu_engine_reap.argtypes = [ctypes.c_uint64,
+                                          ctypes.POINTER(ctypes.c_int64),
+                                          ctypes.c_int32, ctypes.c_int64]
+        lib.nstpu_engine_stats.argtypes = [ctypes.c_uint64,
+                                           ctypes.POINTER(ctypes.c_uint64),
+                                           ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeEngine:
+    """One native engine instance (the 'loaded kernel module' analog)."""
+
+    def __init__(self, backend: str = "auto", queue_depth: int = 32):
+        lib = _load()
+        if lib is None:
+            raise StromError(38, "native engine unavailable (libstrom_tpu.so)")  # ENOSYS
+        want = {"auto": BACKEND_AUTO, "io_uring": BACKEND_IO_URING,
+                "threadpool": BACKEND_THREADPOOL}[backend]
+        self._lib = lib
+        self._h = lib.nstpu_engine_create(want, queue_depth)
+        if not self._h:
+            raise StromError(5, f"native engine init failed (backend={backend})")
+        self.backend_name = _BACKEND_NAMES.get(
+            lib.nstpu_engine_backend(self._h), "unknown")
+        self._prev_stats: Dict[str, int] = {}
+        self._stats_lock = threading.Lock()
+
+    def submit(self, dest_addr: int,
+               reqs: Sequence[Tuple[int, int, int, int]]) -> int:
+        """Submit one task of (fd, file_off, len, dest_off) requests."""
+        arr = (_Req * len(reqs))()
+        for i, (fd, off, ln, doff) in enumerate(reqs):
+            arr[i].fd = fd
+            arr[i].file_off = off
+            arr[i].len = ln
+            arr[i].dest_off = doff
+        tid = self._lib.nstpu_submit(self._h, ctypes.c_void_p(dest_addr),
+                                     arr, len(reqs))
+        if tid < 0:
+            raise StromError(-tid, f"native submit failed ({-tid})")
+        return tid
+
+    def wait(self, task_id: int, timeout_ms: int = -1) -> None:
+        rc = self._lib.nstpu_wait(self._h, task_id, timeout_ms)
+        if rc < 0:
+            raise StromError(-rc, f"native task {task_id} failed ({-rc})")
+
+    def pending(self, cap: int = 4096) -> List[int]:
+        out = (ctypes.c_int64 * cap)()
+        n = self._lib.nstpu_pending(self._h, out, cap)
+        if n < 0:
+            raise StromError(-n, "native pending failed")
+        return list(out[:min(n, cap)])
+
+    def reap(self, timeout_ms: int = 30000, cap: int = 4096) -> List[int]:
+        out = (ctypes.c_int64 * cap)()
+        n = self._lib.nstpu_engine_reap(self._h, out, cap, timeout_ms)
+        if n < 0:
+            raise StromError(-n, "native reap failed")
+        return list(out[:min(n, cap)])
+
+    def stats(self) -> Dict[str, int]:
+        out = (ctypes.c_uint64 * len(NATIVE_COUNTERS))()
+        n = self._lib.nstpu_engine_stats(self._h, out, len(NATIVE_COUNTERS))
+        return {NATIVE_COUNTERS[i]: out[i] for i in range(max(n, 0))}
+
+    def stats_delta(self) -> Dict[str, int]:
+        """Counters since the previous call (gauges passed through).
+        Serialized: concurrent callers must not double-count a delta."""
+        with self._stats_lock:
+            cur = self.stats()
+            prev, self._prev_stats = self._prev_stats, dict(cur)
+            out = {}
+            for k, v in cur.items():
+                if k in ("cur_dma_count", "max_dma_count"):
+                    out[k] = v
+                else:
+                    out[k] = v - prev.get(k, 0)
+            return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.nstpu_engine_destroy(self._h)
+            self._h = 0
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

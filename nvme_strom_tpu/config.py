@@ -86,6 +86,11 @@ def _check_pow2(val: int, _cfg: "Config") -> None:
         raise ConfigError(f"value {val} must be a power of two")
 
 
+def _check_io_backend(val: str, _cfg: "Config") -> None:
+    if val not in ("auto", "io_uring", "threadpool", "python"):
+        raise ConfigError(f"io_backend must be auto|io_uring|threadpool|python, got {val!r}")
+
+
 def _check_buffer_multiple(val: int, cfg: "Config") -> None:
     chunk = cfg.get("chunk_size")
     if chunk and val % chunk:
@@ -134,7 +139,9 @@ class Config:
                 help="max merged I/O request (default 256KB; kmod cap at nvme_strom.c:139-146)",
                 validate=_check_pow2))
         # TPU-framework-specific knobs
-        reg(Var("io_backend", "auto", "str", help="'auto' | 'io_uring' | 'threadpool' | 'python'"))
+        reg(Var("io_backend", "auto", "str",
+                help="'auto' | 'io_uring' | 'threadpool' | 'python'",
+                validate=_check_io_backend))
         reg(Var("queue_depth", 32, "int", minval=1, maxval=4096,
                 help="io_uring submission queue depth / outstanding requests"))
         reg(Var("staging_buffers", 3, "int", minval=2, maxval=16,

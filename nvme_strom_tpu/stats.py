@@ -87,16 +87,15 @@ class StatRegistry:
                         timestamp_ns=time.monotonic_ns(), counters=counters)
 
     def merge_native(self, native_counters: dict) -> None:
-        """Fold a native-engine counter snapshot delta into this registry."""
+        """Fold a native-engine *monotonic* counter delta into this registry.
+
+        Gauges (cur/max_dma_count) are never merged here: the Python path
+        owns its own in-flight accounting and a native engine's gauge must
+        not clobber it — callers combine gauges at snapshot time instead."""
         with self._lock:
             for k, v in native_counters.items():
-                if k in self._c:
-                    if k in ("cur_dma_count",):
-                        self._c[k] = v
-                    elif k == "max_dma_count":
-                        self._c[k] = max(self._c[k], v)
-                    else:
-                        self._c[k] += v
+                if k in self._c and k not in ("cur_dma_count", "max_dma_count"):
+                    self._c[k] += v
 
 
 #: process-global registry (the reference's counters are module-global too)

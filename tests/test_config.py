@@ -69,3 +69,10 @@ def test_bool_parsing():
     for raw, want in [("on", True), ("off", False), ("1", True), ("no", False)]:
         config.set("enabled", raw)
         assert config.get("enabled") is want
+
+
+def test_io_backend_validated():
+    from nvme_strom_tpu.config import ConfigError
+    with pytest.raises(ConfigError):
+        config.set("io_backend", "nonsense")
+    config.set("io_backend", "threadpool")

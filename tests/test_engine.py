@@ -113,6 +113,7 @@ def _run_copy(source, chunk_ids, chunk_size=CHUNK, **kw):
         res = sess.memcpy_ssd2ram(source, handle, chunk_ids, chunk_size, **kw)
         sess.memcpy_wait(res.dma_task_id)
         data = bytes(buf.view()[:len(chunk_ids) * chunk_size])
+        sess.stat_info()  # fold native counters into the global registry
         return res, data
 
 

@@ -1,0 +1,218 @@
+"""Native engine (csrc/strom_engine.cc) tests: backend selection, direct ABI
+use, error latching/retention, differential correctness vs the Python
+backend, and concurrency stress."""
+
+import ctypes
+import errno
+import mmap
+import os
+import random
+import threading
+
+import pytest
+
+from nvme_strom_tpu import Session, StromError, config
+from nvme_strom_tpu._native import NativeEngine, native_available
+from nvme_strom_tpu.engine import PlainSource
+from nvme_strom_tpu.testing import make_test_file
+from nvme_strom_tpu.testing.fake import expected_bytes
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native engine not built")
+
+CHUNK = 64 << 10
+
+
+def _drop_cache(path):
+    fd = os.open(path, os.O_RDWR)
+    os.fsync(fd)
+    os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# direct ABI
+# ---------------------------------------------------------------------------
+
+def test_backend_selection():
+    eng = NativeEngine("auto", 32)
+    assert eng.backend_name in ("io_uring", "threadpool")
+    eng.close()
+    eng = NativeEngine("threadpool", 8)
+    assert eng.backend_name == "threadpool"
+    eng.close()
+
+
+@pytest.mark.parametrize("backend", ["io_uring", "threadpool"])
+def test_native_read_correct(tmp_data_file, backend):
+    try:
+        eng = NativeEngine(backend, 16)
+    except StromError:
+        pytest.skip(f"{backend} unavailable")
+    fd = os.open(tmp_data_file, os.O_RDONLY | os.O_DIRECT)
+    buf = mmap.mmap(-1, 1 << 20)
+    try:
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        # 4 requests of 256KB, shuffled dest slots
+        reqs = [(fd, i * (256 << 10), 256 << 10, ((i + 2) % 4) * (256 << 10))
+                for i in range(4)]
+        tid = eng.submit(addr, reqs)
+        eng.wait(tid, 10000)
+        for i in range(4):
+            got = buf[((i + 2) % 4) * (256 << 10):((i + 2) % 4 + 1) * (256 << 10)]
+            assert got == expected_bytes(i * (256 << 10), 256 << 10), f"req {i}"
+    finally:
+        os.close(fd)
+        eng.close()
+        buf.close()
+
+
+def test_native_error_latched_and_retained():
+    eng = NativeEngine("auto", 8)
+    buf = mmap.mmap(-1, 1 << 20)
+    try:
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        bad_fd = os.open("/dev/null", os.O_RDONLY)
+        os.close(bad_fd)  # guaranteed-invalid fd
+        tid = eng.submit(addr, [(bad_fd, 0, 4096, 0)])
+        with pytest.raises(StromError) as ei:
+            eng.wait(tid, 10000)
+        assert ei.value.errno == errno.EBADF
+        # reaped by the failed wait
+        with pytest.raises(StromError) as ei2:
+            eng.wait(tid, 1000)
+        assert ei2.value.errno == errno.ENOENT
+    finally:
+        eng.close()
+        buf.close()
+
+
+def test_native_failed_task_survives_until_reap():
+    eng = NativeEngine("auto", 8)
+    buf = mmap.mmap(-1, 1 << 20)
+    try:
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        bad_fd = 999999
+        tid = eng.submit(addr, [(bad_fd, 0, 4096, 0)])
+        # never wait; the failure must be retained in the table
+        import time
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and tid not in eng.pending():
+            time.sleep(0.01)
+        assert tid in eng.pending()
+        failed = eng.reap(timeout_ms=10000)
+        assert tid in failed
+        assert eng.pending() == []
+    finally:
+        eng.close()
+        buf.close()
+
+
+def test_native_wait_timeout_unknown():
+    eng = NativeEngine("auto", 8)
+    try:
+        with pytest.raises(StromError) as ei:
+            eng.wait(123456, 50)
+        assert ei.value.errno == errno.ENOENT
+    finally:
+        eng.close()
+
+
+def test_native_stats_counters(tmp_data_file):
+    eng = NativeEngine("auto", 16)
+    fd = os.open(tmp_data_file, os.O_RDONLY | os.O_DIRECT)
+    buf = mmap.mmap(-1, 1 << 20)
+    try:
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        tid = eng.submit(addr, [(fd, 0, 256 << 10, 0), (fd, 256 << 10, 256 << 10, 256 << 10)])
+        eng.wait(tid, 10000)
+        s = eng.stats()
+        assert s["nr_submit_dma"] == 2
+        assert s["total_dma_length"] == 512 << 10
+        assert s["nr_ssd2dev"] == 1          # one task completed
+        assert s["nr_wait_dtask"] == 1
+        assert s["cur_dma_count"] == 0
+    finally:
+        os.close(fd)
+        eng.close()
+        buf.close()
+
+
+# ---------------------------------------------------------------------------
+# differential: native session vs python session
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["io_uring", "threadpool", "python"])
+def test_differential_backends(tmp_path, backend):
+    path = str(tmp_path / "d.bin")
+    make_test_file(path, 2 << 20)
+    _drop_cache(path)
+    ids = list(range((2 << 20) // CHUNK))
+    random.Random(3).shuffle(ids)
+    try:
+        sess = Session(io_backend=backend)
+    except StromError:
+        pytest.skip(f"{backend} unavailable")
+    with PlainSource(path) as src, sess:
+        if backend != "python":
+            assert sess.backend_name == backend
+        handle, buf = sess.alloc_dma_buffer(len(ids) * CHUNK)
+        res = sess.memcpy_ssd2ram(src, handle, ids, CHUNK)
+        sess.memcpy_wait(res.dma_task_id)
+        for slot, cid in enumerate(res.chunk_ids):
+            assert bytes(buf.view()[slot * CHUNK:(slot + 1) * CHUNK]) == \
+                expected_bytes(cid * CHUNK, CHUNK), f"{backend} chunk {cid}"
+
+
+def test_native_session_misaligned_tail(tmp_path):
+    """Native path + buffered tail fallback must compose."""
+    path = str(tmp_path / "odd.bin")
+    make_test_file(path, (1 << 20) + 777)
+    _drop_cache(path)
+    n = ((1 << 20) + 777 + CHUNK - 1) // CHUNK
+    with PlainSource(path) as src, Session(io_backend="auto") as sess:
+        handle, buf = sess.alloc_dma_buffer(n * CHUNK)
+        res = sess.memcpy_ssd2ram(src, handle, list(range(n)), CHUNK)
+        sess.memcpy_wait(res.dma_task_id)
+        flat = bytes(buf.view())
+        for slot, cid in enumerate(res.chunk_ids):
+            size = min(CHUNK, (1 << 20) + 777 - cid * CHUNK)
+            assert flat[slot * CHUNK:slot * CHUNK + size] == \
+                expected_bytes(cid * CHUNK, size)
+
+
+# ---------------------------------------------------------------------------
+# stress
+# ---------------------------------------------------------------------------
+
+def test_native_concurrent_sessions_stress(tmp_path):
+    """Many threads, many tasks, shared engine registry — races here crashed
+    the reference's equivalent (its per-slot spinlock + RCU discipline,
+    SURVEY.md SS5.2)."""
+    path = str(tmp_path / "s.bin")
+    make_test_file(path, 4 << 20)
+    _drop_cache(path)
+    errors = []
+
+    def worker(seed):
+        try:
+            rng = random.Random(seed)
+            with PlainSource(path) as src, Session() as sess:
+                handle, buf = sess.alloc_dma_buffer(8 * CHUNK)
+                for _ in range(5):
+                    ids = rng.sample(range((4 << 20) // CHUNK), 8)
+                    res = sess.memcpy_ssd2ram(src, handle, ids, CHUNK)
+                    sess.memcpy_wait(res.dma_task_id, timeout=30)
+                    for slot, cid in enumerate(res.chunk_ids):
+                        if bytes(buf.view()[slot * CHUNK:(slot + 1) * CHUNK]) != \
+                                expected_bytes(cid * CHUNK, CHUNK):
+                            errors.append(f"seed {seed} chunk {cid} corrupt")
+        except Exception as e:  # pragma: no cover
+            errors.append(f"seed {seed}: {e!r}")
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
