@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""bench.py — headline benchmark: SSD→TPU-HBM sustained throughput.
+
+Mirrors BASELINE.md's metric of record: ssd2tpu GB/s (direct pipelined path)
+with ``vs_baseline`` = direct / VFS-conventional (pread + host→device copy),
+the reference's ``ssd2gpu_test`` vs ``ssd2gpu_test -f`` comparison
+(utils/ssd2gpu_test.c:282-429).
+
+Each mode runs in a fresh subprocess so PJRT/tunnel state (which throttles
+after a burst on some hosts) treats both paths identically.
+
+Prints ONE JSON line:
+  {"metric": "ssd2tpu_seq_GBps", "value": N, "unit": "GB/s", "vs_baseline": R}
+
+Env knobs: BENCH_SIZE_MB (default 512), BENCH_FILE, BENCH_SMOKE=1 (64MB).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _ensure_file(path: str, size: int) -> None:
+    if os.path.exists(path) and os.path.getsize(path) == size:
+        return
+    sys.stderr.write(f"bench: creating {size >> 20}MB test file at {path}\n")
+    subprocess.run([sys.executable, "-c",
+                    "import sys; from nvme_strom_tpu.testing import make_test_file; "
+                    f"make_test_file({path!r}, {size})"],
+                   check=True, cwd=REPO, env=_env())
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_mode(path: str, extra_args) -> float:
+    """Run ssd2tpu_test in a subprocess, return GB/s."""
+    cmd = [sys.executable, "-m", "nvme_strom_tpu.tools.ssd2tpu_test", path,
+           *extra_args]
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                         env=_env(), timeout=1800)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise SystemExit(f"bench mode failed: {' '.join(extra_args)}")
+    m = re.search(r"=> ([0-9.]+) GB/s", out.stdout)
+    if not m:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise SystemExit("bench: no throughput in output")
+    return float(m.group(1))
+
+
+def main() -> int:
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "512"))
+    path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")
+    _ensure_file(path, size_mb << 20)
+
+    direct = _run_mode(path, ["-n", "6", "-s", "16m"])
+    vfs = _run_mode(path, ["-f", "16m"])
+    print(json.dumps({
+        "metric": "ssd2tpu_seq_GBps",
+        "value": round(direct, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(direct / vfs, 3) if vfs else None,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

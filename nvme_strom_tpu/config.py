@@ -135,8 +135,10 @@ class Config:
         # kernel-module-param analogs (kmod/nvme_strom.c:76-82,139-146)
         reg(Var("verbose", 0, "int", minval=0, maxval=2, help="debug log verbosity"))
         reg(Var("stat_info", True, "bool", help="collect per-stage statistics"))
-        reg(Var("dma_max_size", 256 << 10, "size", minval=4 << 10, maxval=4 << 20,
-                help="max merged I/O request (default 256KB; kmod cap at nvme_strom.c:139-146)",
+        reg(Var("dma_max_size", 1 << 20, "size", minval=4 << 10, maxval=16 << 20,
+                help="max merged I/O request (default 1MB, tuned for modern "
+                     "NVMe; the reference capped at 256KB for 2017-era disks, "
+                     "kmod/nvme_strom.c:139-146)",
                 validate=_check_pow2))
         # TPU-framework-specific knobs
         reg(Var("io_backend", "auto", "str",
@@ -146,7 +148,10 @@ class Config:
                 help="io_uring submission queue depth / outstanding requests"))
         reg(Var("staging_buffers", 3, "int", minval=2, maxval=16,
                 help="pinned host staging buffers for the SSD->HBM pipeline (triple-buffered default)"))
-        reg(Var("pin_memory", True, "bool", help="mlock/hugepage-back staging buffers"))
+        reg(Var("pin_memory", False, "bool",
+                help="mlock/hugepage-back staging buffers; right for bare-metal "
+                     "PCIe DMA, but measurably slows both the O_DIRECT fill and "
+                     "the PJRT H2D read on virtualized/tunneled hosts"))
         reg(Var("cache_arbitration", True, "bool",
                 help="probe the page cache and route hot chunks through the write-back path "
                      "(kmod/nvme_strom.c:1639-1663 analog)"))

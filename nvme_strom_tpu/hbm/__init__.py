@@ -1,0 +1,5 @@
+from .registry import HbmBuffer, HbmRegistry, registry
+from .staging import StagingPipeline, load_file_to_device
+
+__all__ = ["HbmBuffer", "HbmRegistry", "registry", "StagingPipeline",
+           "load_file_to_device"]

@@ -1,0 +1,159 @@
+"""Device (HBM) memory registration.
+
+Capability analog of the reference's GPU memory mapper (``MAP_GPU_MEMORY``
+et al., `kmod/pmemmap.c:19-495`): pinning CUDA device memory for third-party
+DMA, a refcounted 64-slot handle table, UID ownership checks, and a
+driver-initiated revocation callback that blocks until in-flight DMA drains.
+
+On TPU there is no BAR1 to pin — device buffers live behind PJRT and XLA
+arrays are immutable.  The idiomatic equivalent is a *mutable holder* of a
+``jax.Array`` destination: registration creates (or adopts) a device array,
+hands out an integer handle, refcounts in-flight transfers against it, and
+supports revocation (``unmap``) that blocks until transfers drain — the same
+lifecycle contract, with functional array updates (donated buffers) standing
+in for writes to mapped memory.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import BufferInfo, StromError
+
+__all__ = ["HbmBuffer", "HbmRegistry", "registry"]
+
+# TPU page granularity reported in INFO; purely informational here (the
+# reference decodes 4K/64K/128K GPU page sizes, kmod/pmemmap.c:264-282)
+_DEVICE_PAGE = 4096
+
+
+class HbmBuffer:
+    """Mutable holder for a device-resident destination array."""
+
+    def __init__(self, handle: int, array: jax.Array, owner_uid: int):
+        self.handle = handle
+        self._array = array
+        self.owner_uid = owner_uid
+        self.refcount = 0
+        self._lock = threading.Lock()
+        self._revoked = False
+
+    @property
+    def array(self) -> jax.Array:
+        with self._lock:
+            if self._revoked:
+                raise StromError(_errno.ENODEV, f"buffer {self.handle} revoked")
+            return self._array
+
+    def swap(self, new_array: jax.Array) -> None:
+        """Install the successor array produced by a donated update."""
+        with self._lock:
+            if self._revoked:
+                raise StromError(_errno.ENODEV, f"buffer {self.handle} revoked")
+            self._array = new_array
+
+    @property
+    def nbytes(self) -> int:
+        return self._array.nbytes
+
+    @property
+    def device(self) -> str:
+        ds = list(self._array.devices())
+        return str(ds[0]) if ds else "?"
+
+
+class HbmRegistry:
+    """Handle table for registered device buffers (64-hash-slot analog,
+    kmod/pmemmap.c:75-78 — here a dict; the slot count was a kernel
+    implementation detail, not a capability)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._buffers: Dict[int, HbmBuffer] = {}
+        self._next = 1
+
+    # -- MAP_GPU_MEMORY ----------------------------------------------------
+    def map_device_memory(self, size_or_array, *, dtype=jnp.uint8,
+                          device: Optional[jax.Device] = None) -> int:
+        """Register a destination: either adopt an existing ``jax.Array`` or
+        allocate ``size`` elements of ``dtype`` on *device* (default: first
+        addressable device)."""
+        if isinstance(size_or_array, jax.Array):
+            arr = size_or_array
+        else:
+            n = int(size_or_array)
+            if n <= 0:
+                raise StromError(_errno.EINVAL, "buffer size must be positive")
+            dev = device or jax.devices()[0]
+            arr = jax.device_put(jnp.zeros((n,), dtype=dtype), dev)
+        with self._lock:
+            handle = self._next
+            self._next += 1
+            self._buffers[handle] = HbmBuffer(handle, arr, os.getuid())
+        return handle
+
+    def get(self, handle: int) -> HbmBuffer:
+        """Look up + ownership check (reference kmod/pmemmap.c:104-105)."""
+        with self._lock:
+            buf = self._buffers.get(handle)
+        if buf is None:
+            raise StromError(_errno.ENOENT, f"no device buffer {handle}")
+        if buf.owner_uid != os.getuid():
+            raise StromError(_errno.EPERM, "device buffer owned by another uid")
+        return buf
+
+    def acquire(self, handle: int) -> HbmBuffer:
+        buf = self.get(handle)
+        with buf._lock:
+            if buf._revoked:
+                raise StromError(_errno.ENODEV, f"buffer {handle} revoked")
+            buf.refcount += 1
+        return buf
+
+    def release(self, buf: HbmBuffer) -> None:
+        with buf._lock:
+            buf.refcount -= 1
+
+    # -- UNMAP_GPU_MEMORY (revocation) -------------------------------------
+    def unmap(self, handle: int, *, timeout: float = 30.0) -> None:
+        """Revoke a handle, blocking until in-flight transfers drain — the
+        ``callback_release_mapped_gpu_memory`` contract
+        (kmod/pmemmap.c:149-208)."""
+        buf = self.get(handle)
+        deadline = time.monotonic() + timeout
+        while True:
+            with buf._lock:
+                if buf.refcount == 0:
+                    buf._revoked = True
+                    break
+            if time.monotonic() > deadline:
+                raise StromError(_errno.ETIMEDOUT,
+                                f"buffer {handle} busy past revocation timeout")
+            time.sleep(0.001)
+        with self._lock:
+            self._buffers.pop(handle, None)
+
+    # -- LIST / INFO -------------------------------------------------------
+    def list(self) -> List[int]:
+        with self._lock:
+            return sorted(self._buffers)
+
+    def info(self, handle: int) -> BufferInfo:
+        buf = self.get(handle)
+        return BufferInfo(handle=handle, length=buf.nbytes,
+                          page_size=_DEVICE_PAGE,
+                          n_pages=(buf.nbytes + _DEVICE_PAGE - 1) // _DEVICE_PAGE,
+                          owner_uid=buf.owner_uid, refcount=buf.refcount,
+                          kind="hbm", device=buf.device)
+
+
+#: process-global registry (one per process, like the module's handle table)
+registry = HbmRegistry()

@@ -1,0 +1,269 @@
+"""SSD→pinned-host→HBM staging pipeline.
+
+The reference's headline capability is peer-to-peer DMA: the SSD's engine
+writes straight into GPU BAR1, no host staging (`kmod/nvme_strom.c:
+1518-1589`).  TPUs expose no third-party-DMA BAR, so the equivalent path is
+(SURVEY.md SS5.8): O_DIRECT/io_uring reads into **pinned hugepage-backed host
+buffers**, overlapped with pinned→HBM transfers through PJRT, so the extra
+hop GPUDirect avoided is hidden behind the SSD DMA time.
+
+The pipeline keeps ``staging_buffers`` (default 3) pinned buffers in flight:
+while buffer *k* receives SSD DMA (native engine, GIL-free), buffer *k−1*'s
+contents are in transit to the device, and buffer *k−2* is being retired.
+Before a buffer is reused, the device op consuming it is synchronized with
+``block_until_ready`` — the correctness fence the reference got from DMA
+completion IRQs.
+
+Device writes are functional and XLA-idiomatic: the destination is a
+registered :class:`~nvme_strom_tpu.hbm.registry.HbmBuffer` whose array is
+advanced by a donated jitted ``dynamic_update_slice`` — in-place on device,
+no reallocation.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import MemCopyResult, StromError
+from ..config import config
+from ..engine import Session, Source
+from ..stats import stats
+from .registry import HbmRegistry, registry as global_registry
+
+__all__ = ["StagingPipeline", "load_file_to_device"]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_slice(dest: jax.Array, chunk: jax.Array, start: jax.Array) -> jax.Array:
+    """Land one staged batch into the destination at a dynamic offset.
+    ``dest`` is donated: XLA updates the buffer in place on device.
+    Limited to int32-addressable offsets (< 2^31 elements)."""
+    return jax.lax.dynamic_update_slice(dest, chunk, (start,))
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+def _write_row(dest: jax.Array, chunk: jax.Array, row: jax.Array,
+               grid_elems: int) -> jax.Array:
+    """Row-addressed landing: view the destination as (n_rows, grid_elems)
+    and update one row.  Row indices stay tiny, so destinations beyond the
+    int32 element ceiling (>2GiB of uint8) address correctly.  Requires the
+    landing start to be grid-aligned; the chunk may be narrower than the
+    grid (final partial batch)."""
+    d2 = dest.reshape(-1, grid_elems)
+    d2 = jax.lax.dynamic_update_slice(d2, chunk.reshape(1, -1), (row, 0))
+    return d2.reshape(dest.shape)
+
+
+_INT32_MAX = (1 << 31) - 1
+
+
+def _land(hbm, dev_chunk, elem_start: int, grid_elems: int):
+    """Pick the addressing mode for one landing and install the result."""
+    if (grid_elems and hbm.array.size % grid_elems == 0
+            and elem_start % grid_elems == 0):
+        hbm.swap(_write_row(hbm.array, dev_chunk,
+                            np.int32(elem_start // grid_elems), grid_elems))
+    elif elem_start + dev_chunk.size <= _INT32_MAX:
+        hbm.swap(_write_slice(hbm.array, dev_chunk, np.int32(elem_start)))
+    else:
+        raise StromError(75,  # EOVERFLOW
+                        f"landing at element {elem_start} exceeds int32 "
+                        f"addressing and the destination is not aligned to "
+                        f"the {grid_elems}-element staging grid; size the "
+                        f"device buffer to a multiple of the staging batch")
+
+
+class StagingPipeline:
+    """Overlapped SSD→HBM chunk mover (MEMCPY_SSD2GPU analog, full path)."""
+
+    def __init__(self, session: Session, *, n_buffers: Optional[int] = None,
+                 staging_bytes: Optional[int] = None,
+                 hbm_registry: Optional[HbmRegistry] = None):
+        self.session = session
+        self.n_buffers = n_buffers or config.get("staging_buffers")
+        self.staging_bytes = staging_bytes or config.get("chunk_size")
+        self.registry = hbm_registry or global_registry
+        self._bufs = []          # [(engine_handle, DmaBuffer)]
+        self._barriers: List[Optional[jax.Array]] = [None] * self.n_buffers
+        for _ in range(self.n_buffers):
+            self._bufs.append(session.alloc_dma_buffer(self.staging_bytes))
+
+    # -- core ---------------------------------------------------------------
+    def memcpy_ssd2dev(self, source: Source, hbm_handle: int,
+                       chunk_ids: Sequence[int], chunk_size: int, *,
+                       dest_offset: int = 0,
+                       device_dtype=jnp.uint8) -> MemCopyResult:
+        """Move ``chunk_ids`` (units of ``chunk_size`` bytes in *source*) into
+        the registered device buffer, starting at byte ``dest_offset``.
+
+        Returns an aggregated :class:`MemCopyResult`: ``chunk_ids`` is the
+        concatenation of each staged batch's reordered array, so entry *i*
+        names the chunk now resident at device bytes
+        ``dest_offset + i*chunk_size`` — the same slot contract as one
+        reference ioctl, applied per batch (each batch is one engine
+        command, as each 32MB segment was in ssd2gpu_test).
+        """
+        if chunk_size > self.staging_bytes:
+            raise StromError(22, f"chunk_size {chunk_size} exceeds staging "
+                                 f"buffer {self.staging_bytes}")
+        if not chunk_ids:
+            raise StromError(22, "no chunks")
+        # every chunk must be full: staging slots are chunk_size-strided, so a
+        # partial chunk mid-batch would leave a hole in the device layout
+        # (the reference reads uniform BLCKSZ blocks for the same reason);
+        # callers stream a file tail with a separate command
+        for cid in chunk_ids:
+            if (cid + 1) * chunk_size > source.size:
+                raise StromError(22, f"chunk {cid} is partial (source size "
+                                     f"{source.size}); stream tails separately")
+        hbm = self.registry.acquire(hbm_handle)
+        try:
+            per_batch = self.staging_bytes // chunk_size
+            batches = [list(chunk_ids[i:i + per_batch])
+                       for i in range(0, len(chunk_ids), per_batch)]
+            itemsize = np.dtype(device_dtype).itemsize
+            grid_elems = per_batch * chunk_size // itemsize
+            if dest_offset % itemsize:
+                raise StromError(22, "dest_offset not aligned to device dtype")
+
+            inflight = []  # (bufidx, engine_task_id, batch, dev_elem_start)
+            out_ids: List[int] = []
+            nr_ssd = nr_ram = 0
+            elem_cursor = dest_offset // itemsize
+            total_bytes_needed = dest_offset + len(chunk_ids) * chunk_size
+            if total_bytes_needed > hbm.nbytes:
+                raise StromError(34, f"device buffer too small: need "
+                                     f"{total_bytes_needed} > {hbm.nbytes}")
+
+            def retire(slot) -> None:
+                nonlocal nr_ssd, nr_ram
+                bufidx, task_id, batch, elem_start, nbytes = slot
+                res = self.session.memcpy_wait(task_id)
+                out_ids.extend(res.chunk_ids)
+                nr_ssd += res.nr_ssd2dev
+                nr_ram += res.nr_ram2dev
+                # staged batch -> device (async H2D), landed with an async
+                # donated update; nothing here blocks
+                t0 = time.monotonic_ns()
+                _, dbuf = self._bufs[bufidx]
+                host = np.frombuffer(dbuf.view()[:nbytes], dtype=device_dtype)
+                dev_chunk = jax.device_put(host, list(hbm.array.devices())[0])
+                _land(hbm, dev_chunk, elem_start, grid_elems)
+                # the staging buffer is reusable once the H2D *read* of it
+                # completes — fence on the device chunk, not the landing
+                self._barriers[bufidx] = dev_chunk
+                stats.count_clock("debug3", time.monotonic_ns() - t0)
+
+            for batch in batches:
+                # if every staging buffer is in flight, retire the oldest
+                # first (the submit-ahead/wait-behind ring discipline of
+                # ssd2ram_test, utils/ssd2ram_test.c:139-226)
+                if len(inflight) >= self.n_buffers:
+                    retire(inflight.pop(0))
+                used = {s[0] for s in inflight}
+                bufidx = next(i for i in range(self.n_buffers) if i not in used)
+                # fence: the device op that last consumed this buffer must be
+                # done before the SSD engine overwrites it
+                if self._barriers[bufidx] is not None:
+                    self._barriers[bufidx].block_until_ready()
+                    self._barriers[bufidx] = None
+                handle, _ = self._bufs[bufidx]
+                nbytes = len(batch) * chunk_size
+                task = self.session.memcpy_ssd2ram(source, handle, batch,
+                                                   chunk_size)
+                inflight.append((bufidx, task.dma_task_id, batch,
+                                 elem_cursor, nbytes))
+                elem_cursor += nbytes // itemsize
+            while inflight:
+                retire(inflight.pop(0))
+            return MemCopyResult(dma_task_id=0, nr_chunks=len(out_ids),
+                                 nr_ssd2dev=nr_ssd, nr_ram2dev=nr_ram,
+                                 chunk_ids=out_ids)
+        finally:
+            self.registry.release(hbm)
+
+    def drain(self) -> None:
+        """Block until every outstanding device op has completed."""
+        for i, b in enumerate(self._barriers):
+            if b is not None:
+                b.block_until_ready()
+                self._barriers[i] = None
+
+    def close(self) -> None:
+        self.drain()
+        for handle, buf in self._bufs:
+            try:
+                self.session.unmap_buffer(handle)
+            except StromError:
+                pass
+            buf.close()
+        self._bufs.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def load_file_to_device(source: Source, *, chunk_size: Optional[int] = None,
+                        session: Optional[Session] = None,
+                        device: Optional[jax.Device] = None,
+                        dtype=jnp.uint8,
+                        staging_bytes: Optional[int] = None,
+                        hbm_registry: Optional[HbmRegistry] = None) -> jax.Array:
+    """One-call SSD→HBM load of an entire source (the ssd2tpu 'happy path').
+
+    Allocates a device buffer of the source's (dtype-truncated) size, streams
+    every chunk through the staging pipeline, and returns the device array.
+    """
+    chunk_size = chunk_size or min(config.get("chunk_size"), 1 << 20)
+    reg = hbm_registry or global_registry
+    itemsize = np.dtype(dtype).itemsize
+    if source.size % itemsize:
+        raise StromError(22, f"source size {source.size} not a multiple of "
+                             f"dtype itemsize {itemsize}")
+    n_elems = source.size // itemsize
+    own_session = session is None
+    sess = session or Session()
+    try:
+        handle = reg.map_device_memory(n_elems, dtype=dtype, device=device)
+        try:
+            n_full = source.size // chunk_size
+            tail = source.size - n_full * chunk_size
+            with StagingPipeline(sess, staging_bytes=staging_bytes,
+                                 hbm_registry=reg) as pipe:
+                if n_full:
+                    pipe.memcpy_ssd2dev(source, handle, list(range(n_full)),
+                                        chunk_size, device_dtype=dtype)
+            if tail:
+                # file tail: one pinned-buffer hop outside the chunk grid
+                thandle, tbuf = sess.alloc_dma_buffer(max(tail, 4096))
+                try:
+                    source.read_buffered(n_full * chunk_size,
+                                         tbuf.view()[:tail])
+                    hbm = reg.acquire(handle)
+                    try:
+                        host = np.frombuffer(tbuf.view()[:tail], dtype=dtype)
+                        dev = jax.device_put(host, list(hbm.array.devices())[0])
+                        _land(hbm, dev, n_full * chunk_size // itemsize,
+                              chunk_size // itemsize)
+                    finally:
+                        reg.release(hbm)
+                finally:
+                    sess.unmap_buffer(thandle)
+                    tbuf.close()
+            arr = reg.get(handle).array
+            arr.block_until_ready()
+            return arr
+        finally:
+            reg.unmap(handle)
+    finally:
+        if own_session:
+            sess.close()

@@ -1,0 +1,3 @@
+from .filter_xla import decode_pages, scan_filter_step
+
+__all__ = ["decode_pages", "scan_filter_step"]

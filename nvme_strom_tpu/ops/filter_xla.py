@@ -1,0 +1,76 @@
+"""XLA scan/filter kernels over HBM-resident heap pages.
+
+The compute half of the pgsql analog: where the reference's CustomScan walks
+tuples one at a time on the CPU (`pgsql/nvme_strom.c:941-979`), here a batch
+of direct-loaded pages is decoded and filtered as dense tensor ops — the
+whole page batch is one bitcast + masked reduction, which XLA fuses and the
+VPU eats.  No data-dependent control flow: invalid/invisible tuples are
+masked, not branched on (jit-safe, SURVEY.md's XLA-semantics constraint).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..scan.heap import HEADER_WORDS, PAGE_SIZE, HeapSchema
+
+__all__ = ["decode_pages", "scan_filter_step", "make_filter_fn"]
+
+_WORDS = PAGE_SIZE // 4
+
+# default demo schema: two int32 data columns + visibility
+DEFAULT_SCHEMA = HeapSchema(n_cols=2, visibility=True)
+
+
+def decode_pages(pages_u8: jax.Array, schema: HeapSchema = DEFAULT_SCHEMA):
+    """(B, 8192) uint8 pages -> dict of (B, T) int32 columns + valid mask.
+
+    Pure bitcast/slice — zero data movement beyond what XLA fuses."""
+    b = pages_u8.shape[0]
+    words = jax.lax.bitcast_convert_type(
+        pages_u8.reshape(b, _WORDS, 4), jnp.int32).reshape(b, _WORDS)
+    n_tuples = words[:, 2]
+    t = schema.tuples_per_page
+    idx = jnp.arange(t, dtype=jnp.int32)[None, :]
+    valid = idx < n_tuples[:, None]
+    cols = []
+    for c in range(schema.n_cols):
+        s, e = schema.col_word_range(c)
+        cols.append(words[:, s:e])
+    if schema.visibility:
+        s, e = schema.col_word_range(schema.n_cols)
+        visible = words[:, s:e] != 0
+        valid = valid & visible
+    return cols, valid
+
+
+@jax.jit
+def scan_filter_step(pages_u8: jax.Array, threshold: jax.Array):
+    """Flagship single-chip step: predicate col0 > threshold over a page
+    batch; returns selected count and the sum of col1 over selected rows."""
+    cols, valid = decode_pages(pages_u8)
+    sel = valid & (cols[0] > threshold)
+    count = jnp.sum(sel.astype(jnp.int32))
+    total = jnp.sum(jnp.where(sel, cols[1], 0).astype(jnp.int64)
+                    if jax.config.jax_enable_x64 else
+                    jnp.where(sel, cols[1], 0))
+    return {"count": count, "sum": total}
+
+
+def make_filter_fn(schema: HeapSchema, predicate):
+    """Build a jitted page-batch filter: ``predicate(cols) -> bool (B, T)``.
+    Returns selected count, per-column masked sums."""
+
+    @jax.jit
+    def run(pages_u8):
+        cols, valid = decode_pages(pages_u8, schema)
+        sel = valid & predicate(cols)
+        return {
+            "count": jnp.sum(sel.astype(jnp.int32)),
+            "sums": [jnp.sum(jnp.where(sel, c, 0)) for c in cols],
+        }
+
+    return run

@@ -1,0 +1,3 @@
+from .dscan import make_distributed_scan_step
+
+__all__ = ["make_distributed_scan_step"]

@@ -1,0 +1,60 @@
+"""Distributed scan: mesh-sharded page batches + collective aggregation.
+
+The reference's multi-worker scan shares an atomic block cursor over DSM and
+each PostgreSQL worker scans a disjoint page subset (`pgsql/nvme_strom.c:
+1057-1112`).  The TPU-native generalization: pages are **sharded across the
+device mesh** (data-parallel axis), every device filters its local pages with
+the same XLA kernel, and the aggregates combine with ``psum`` over ICI —
+process-parallelism replaced by SPMD + collectives (SURVEY.md SS5.8).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.filter_xla import decode_pages
+
+__all__ = ["make_distributed_scan_step", "shard_pages"]
+
+
+def make_distributed_scan_step(devices: Sequence[jax.Device]):
+    """Build the jitted distributed scan step over a 1-D ``dp`` mesh.
+
+    Returns ``(step, mesh)`` where ``step(pages_u8, threshold)`` shards the
+    page batch across the mesh (leading axis), filters locally, and reduces
+    with psum.  Page count must divide the mesh size.
+    """
+    mesh = Mesh(np.asarray(devices), axis_names=("dp",))
+    pages_spec = P("dp", None)
+
+    def _local(pages_u8, threshold):
+        cols, valid = decode_pages(pages_u8)
+        sel = valid & (cols[0] > threshold)
+        count = jnp.sum(sel.astype(jnp.int32))
+        total = jnp.sum(jnp.where(sel, cols[1], 0))
+        # combine across the mesh over ICI
+        return {"count": jax.lax.psum(count, "dp"),
+                "sum": jax.lax.psum(total, "dp")}
+
+    shard_mapped = jax.shard_map(_local, mesh=mesh,
+                                 in_specs=(pages_spec, P()),
+                                 out_specs={"count": P(), "sum": P()})
+    step = jax.jit(shard_mapped)
+
+    def run(pages_np, threshold):
+        pages = jax.device_put(pages_np,
+                               NamedSharding(mesh, pages_spec))
+        return step(pages, jnp.asarray(threshold, jnp.int32))
+
+    return run, mesh
+
+
+def shard_pages(pages_np: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Place a host page batch sharded across the mesh's dp axis."""
+    return jax.device_put(pages_np, NamedSharding(mesh, P("dp", None)))

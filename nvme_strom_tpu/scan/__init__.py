@@ -1,0 +1,5 @@
+from .heap import (HEAP_MAGIC, PAGE_SIZE, HeapSchema, build_heap_file,
+                   pages_from_bytes)
+
+__all__ = ["HEAP_MAGIC", "PAGE_SIZE", "HeapSchema", "build_heap_file",
+           "pages_from_bytes"]

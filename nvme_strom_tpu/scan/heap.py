@@ -1,0 +1,126 @@
+"""TPU-native heap page format.
+
+The reference scans PostgreSQL heap pages: 8KB blocks with line-pointer
+arrays and variable-width tuples walked one at a time
+(`pgsql/nvme_strom.c:941-979`).  That layout is pointer-chasing and
+scalar — hostile to the MXU/VPU.  This framework's table format keeps the
+8KB-block granularity (so the whole chunk/DMA machinery is shared) but lays
+tuples out **columnar within the page**, fixed width, so a batch of pages
+bitcasts to an int32 tensor and every predicate is a vectorized op:
+
+``page[8192] = header[64B] | col0[T*4B] | col1[T*4B] | ... | pad``
+
+header words (int32): [0]=magic [1]=page_id [2]=n_tuples [3]=n_cols
+[4]=visibility_mode [5..15]=reserved.
+
+Tuple *visibility* (the MVCC analog the reference arbitrates per tuple,
+pgsql/nvme_strom.c:767-811) is a per-tuple bitmask column stored as the
+LAST column when ``visibility_mode == 1``: a tuple counts only when its
+mask word is nonzero.  ``visibility_mode == 0`` means all-visible (the
+VM_ALL_VISIBLE fast path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PAGE_SIZE", "HEAP_MAGIC", "HEADER_BYTES", "HeapSchema",
+           "build_heap_file", "pages_from_bytes"]
+
+PAGE_SIZE = 8192                  # BLCKSZ, matching the reference
+HEADER_BYTES = 64
+HEADER_WORDS = HEADER_BYTES // 4
+HEAP_MAGIC = 0x53545250           # 'PRTS'
+
+
+@dataclass(frozen=True)
+class HeapSchema:
+    """Fixed-width int32/float32 column schema."""
+
+    n_cols: int
+    visibility: bool = False       # append a per-tuple visibility column
+
+    @property
+    def phys_cols(self) -> int:
+        return self.n_cols + (1 if self.visibility else 0)
+
+    @property
+    def tuples_per_page(self) -> int:
+        return (PAGE_SIZE - HEADER_BYTES) // (4 * self.phys_cols)
+
+    def col_word_range(self, c: int):
+        """(start, stop) word offsets of column *c* within a page."""
+        t = self.tuples_per_page
+        start = HEADER_WORDS + c * t
+        return start, start + t
+
+
+def build_pages(columns: Sequence[np.ndarray], schema: HeapSchema, *,
+                visibility: Optional[np.ndarray] = None,
+                start_page_id: int = 0) -> np.ndarray:
+    """Pack column arrays (each shape (n_rows,), int32/float32) into pages.
+
+    Returns a uint8 array of shape (n_pages, PAGE_SIZE)."""
+    if len(columns) != schema.n_cols:
+        raise ValueError(f"expected {schema.n_cols} columns, got {len(columns)}")
+    n_rows = len(columns[0])
+    for c in columns:
+        if len(c) != n_rows:
+            raise ValueError("ragged columns")
+        if c.dtype.itemsize != 4:
+            raise ValueError("columns must be 4-byte dtypes")
+    if schema.visibility:
+        if visibility is None:
+            visibility = np.ones(n_rows, dtype=np.int32)
+        if len(visibility) != n_rows:
+            raise ValueError("visibility length mismatch")
+    t = schema.tuples_per_page
+    n_pages = max((n_rows + t - 1) // t, 1)
+    pages = np.zeros((n_pages, PAGE_SIZE // 4), dtype=np.int32)
+    pages[:, 0] = HEAP_MAGIC
+    pages[:, 1] = np.arange(start_page_id, start_page_id + n_pages)
+    pages[:, 3] = schema.n_cols
+    pages[:, 4] = 1 if schema.visibility else 0
+    for p in range(n_pages):
+        lo, hi = p * t, min((p + 1) * t, n_rows)
+        pages[p, 2] = hi - lo
+        for ci in range(schema.n_cols):
+            s, _ = schema.col_word_range(ci)
+            pages[p, s:s + hi - lo] = columns[ci][lo:hi].view(np.int32)
+        if schema.visibility:
+            s, _ = schema.col_word_range(schema.n_cols)
+            pages[p, s:s + hi - lo] = visibility[lo:hi].astype(np.int32)
+    return pages.view(np.uint8).reshape(n_pages, PAGE_SIZE)
+
+
+def build_heap_file(path: str, columns: Sequence[np.ndarray],
+                    schema: HeapSchema, *,
+                    visibility: Optional[np.ndarray] = None) -> int:
+    """Write a heap file; returns number of pages."""
+    pages = build_pages(columns, schema, visibility=visibility)
+    with open(path, "wb") as f:
+        f.write(pages.tobytes())
+    return len(pages)
+
+
+def pages_from_bytes(raw: bytes | np.ndarray) -> np.ndarray:
+    """View raw bytes as (n_pages, PAGE_SIZE) uint8 without copying."""
+    arr = np.frombuffer(raw, dtype=np.uint8) if isinstance(raw, bytes) else raw
+    if arr.size % PAGE_SIZE:
+        raise ValueError(f"byte length {arr.size} not page-aligned")
+    return arr.reshape(-1, PAGE_SIZE)
+
+
+def read_column(pages: np.ndarray, schema: HeapSchema, c: int,
+                dtype=np.int32) -> np.ndarray:
+    """Host-side column extraction (test oracle for the XLA kernels)."""
+    words = pages.view(np.int32).reshape(pages.shape[0], PAGE_SIZE // 4)
+    s, e = schema.col_word_range(c)
+    out = []
+    for p in range(pages.shape[0]):
+        n = int(words[p, 2])
+        out.append(words[p, s:s + n].view(dtype))
+    return np.concatenate(out) if out else np.empty(0, dtype)

@@ -14,6 +14,9 @@ merged into snapshots.
 
 from __future__ import annotations
 
+import json
+import os
+import tempfile
 import threading
 import time
 from contextlib import contextmanager
@@ -21,7 +24,14 @@ from contextlib import contextmanager
 from .api import STAT_FIELDS, StatInfo
 from .config import config
 
-__all__ = ["StatRegistry", "stats"]
+__all__ = ["StatRegistry", "stats", "DEFAULT_STAT_EXPORT"]
+
+#: cross-process observability: the reference exposes counters through
+#: /proc/nvme-strom readable by nvme_stat from any process; here an exporter
+#: thread publishes JSON snapshots to a well-known path for tpu_stat
+DEFAULT_STAT_EXPORT = os.environ.get(
+    "STROM_TPU_STAT_EXPORT",
+    os.path.join(tempfile.gettempdir(), f"strom_tpu_stat.{os.getuid()}.json"))
 
 
 class StatRegistry:
@@ -85,6 +95,50 @@ class StatRegistry:
             counters = {k: v for k, v in counters.items() if "debug" not in k}
         return StatInfo(version=1, has_debug=debug,
                         timestamp_ns=time.monotonic_ns(), counters=counters)
+
+    def start_export(self, path: str = None, interval: float = 0.5) -> None:
+        """Start the background exporter (idempotent).  Tools call this so a
+        concurrently-running ``tpu_stat`` can watch, like ``nvme_stat``
+        watching the kernel counters."""
+        path = path or DEFAULT_STAT_EXPORT
+        if getattr(self, "_exporter", None):
+            return
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval):
+                self.export(path)
+            self.export(path)
+
+        t = threading.Thread(target=loop, daemon=True, name="strom-stat-export")
+        self._exporter = (t, stop)
+        t.start()
+
+    def stop_export(self) -> None:
+        exp = getattr(self, "_exporter", None)
+        if exp:
+            exp[1].set()
+            self._exporter = None
+
+    def export(self, path: str = None) -> None:
+        path = path or DEFAULT_STAT_EXPORT
+        snap = self.snapshot(debug=True, reset_max=False)
+        payload = {"timestamp_ns": snap.timestamp_ns, "pid": os.getpid(),
+                   "version": snap.version, "counters": snap.counters}
+        try:
+            # mkstemp: O_EXCL private temp (no symlink following in shared
+            # /tmp), then atomic replace
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                       prefix=os.path.basename(path) + ".")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(payload, f)
+                os.replace(tmp, path)
+            except BaseException:
+                os.unlink(tmp)
+                raise
+        except OSError:
+            self._export_errors = getattr(self, "_export_errors", 0) + 1
 
     def merge_native(self, native_counters: dict) -> None:
         """Fold a native-engine *monotonic* counter delta into this registry.

@@ -1,0 +1,131 @@
+"""ssd2ram_test — SSD→pinned-host-RAM throughput benchmark.
+
+Capability mirror of the reference tool (`utils/ssd2ram_test.c`): CHECK_FILE
+first (reporting the SSD's NUMA node and DMA64 support, `:42-61`), CPU
+affinity bound to that node (`:66-119`), a pinned destination buffer split
+into ring units driven submit-ahead / wait-behind (`:139-226`), and a
+throughput + wait-time report.
+
+Usage: ssd2ram_test [-c] [-n LOOPS] [-p DEPTH] [-s UNIT_SZ] [--chunk SZ] FILE
+  -c            CHECK_FILE smoke test only (prints NUMA node + DMA64)
+  -n LOOPS      read the file LOOPS times (default 1)
+  -p DEPTH      ring depth = in-flight units (default config async_depth)
+  -s UNIT_SZ    ring unit size, e.g. 32m (default 32MB, the reference's)
+  --chunk SZ    chunk size within a unit (default 1m)
+  --backend B   io_uring | threadpool | python (default config)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..config import config
+from ..engine import PAGE_SIZE, Session, check_file, open_source
+from ..numa import bind_to_node
+from ..stats import stats
+from .common import drop_page_cache, parse_size
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ssd2ram_test", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("file")
+    ap.add_argument("-c", "--check", action="store_true",
+                    help="CHECK_FILE smoke test only")
+    ap.add_argument("-n", "--loops", type=int, default=1)
+    ap.add_argument("-p", "--depth", type=int, default=None)
+    ap.add_argument("-s", "--unit", type=parse_size, default=32 << 20)
+    ap.add_argument("--chunk", type=parse_size, default=1 << 20)
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--no-drop-cache", action="store_true")
+    args = ap.parse_args(argv)
+
+    info = check_file(args.file)
+    print(f"file: {args.file} ({info.file_size / (1 << 20):.1f} MB, "
+          f"{info.fs_kind.name})")
+    print(f"numa node: {info.numa_node_id}   dma64: "
+          f"{'supported' if info.support_dma64 else 'unsupported'}   "
+          f"block: {info.logical_block_size}   dma max: "
+          f"{info.dma_max_size >> 10}KB")
+    if not info.supported:
+        print("NOT supported for direct load", file=sys.stderr)
+        return 1
+    if args.check:
+        return 0
+
+    # NUMA affinity to the SSD's node (utils/ssd2ram_test.c:66-119)
+    if bind_to_node(info.numa_node_id):
+        print(f"bound CPU affinity to node {info.numa_node_id}")
+    if args.backend:
+        config.set("io_backend", args.backend)
+    if not args.no_drop_cache:
+        drop_page_cache(args.file)
+
+    depth = args.depth or config.get("async_depth")
+    unit = min(args.unit, info.file_size)
+    chunks_per_unit = max(unit // args.chunk, 1)
+    n_units_total = info.file_size // unit
+    if n_units_total == 0:
+        print("file smaller than one unit", file=sys.stderr)
+        return 1
+
+    stats.start_export()
+    t0 = time.monotonic()
+    total = 0
+    wait_ns = 0
+    with open_source(args.file) as src, Session() as sess:
+        ring = [sess.alloc_dma_buffer(unit) for _ in range(depth)]
+        print(f"backend: {sess.backend_name}   ring: {depth} x "
+              f"{unit >> 20}MB units   chunk: {args.chunk >> 10}KB")
+        inflight = []  # (task_id, ring_idx)
+        gu = 0  # monotonic across loops: ring slot gu % depth is only reused
+                # after the wait below retires the task that last owned it
+        for loop in range(args.loops):
+            for u in range(n_units_total):
+                if len(inflight) >= depth:
+                    tid, _ = inflight.pop(0)
+                    tw = time.monotonic_ns()
+                    sess.memcpy_wait(tid)
+                    wait_ns += time.monotonic_ns() - tw
+                ridx = gu % depth
+                gu += 1
+                handle, _buf = ring[ridx]
+                base_chunk = u * unit // args.chunk
+                ids = list(range(base_chunk, base_chunk + chunks_per_unit))
+                res = sess.memcpy_ssd2ram(src, handle, ids, args.chunk)
+                inflight.append((res.dma_task_id, ridx))
+                total += chunks_per_unit * args.chunk
+        while inflight:
+            tid, _ = inflight.pop(0)
+            tw = time.monotonic_ns()
+            sess.memcpy_wait(tid)
+            wait_ns += time.monotonic_ns() - tw
+        elapsed = time.monotonic() - t0
+        snap = sess.stat_info(debug=True)
+    c = snap.counters
+    nsub = max(c.get("nr_submit_dma", 0), 1)
+    print(f"read: {total / (1 << 30):.2f} GB in {elapsed:.2f}s  "
+          f"=> {total / elapsed / (1 << 30):.2f} GB/s")
+    print(f"avg dma size: {c.get('total_dma_length', 0) / nsub / 1024:.0f}KB  "
+          f"requests: {c.get('nr_submit_dma', 0)}  "
+          f"direct: {c.get('nr_ssd2dev', 0)} tasks  "
+          f"wait time: {wait_ns / 1e6:.0f}ms  "
+          f"wrong wakeups: {c.get('nr_wrong_wakeup', 0)}")
+    stats.stop_export()
+    return 0
+
+
+def cli() -> int:
+    from ..api import StromError
+    try:
+        return main()
+    except (StromError, OSError) as e:
+        print(f"{e.__class__.__name__.lower().replace('stromerror', 'error')}: "
+              f"{e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(cli())

@@ -1,0 +1,120 @@
+"""tpu_stat — iostat-style monitor over the engine's STAT_INFO counters.
+
+Capability mirror of the reference's `utils/nvme_stat.c`: one-shot dump or
+interval mode printing per-stage **average latencies** with adaptive units
+(ns→us→ms→s, `:28-50`), average DMA size, wrong wakeups and current/max
+in-flight DMA; ``-v`` adds the request-build/submit stages and the four
+debug counters (`:116-166`).
+
+The counter source is the JSON snapshot exported by running tools/sessions
+(``stats.start_export()``), standing in for the reference's /proc reads.
+
+Usage: tpu_stat [-v] [-f STAT_FILE] [interval]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def show_avg(clk_ns: float, count: float) -> str:
+    """Adaptive-unit average latency (reference show_avg8, nvme_stat.c:28-50)."""
+    if count <= 0:
+        return "   --  "
+    avg = clk_ns / count
+    if avg < 1_000:
+        return f"{avg:5.0f}ns"
+    if avg < 1_000_000:
+        return f"{avg / 1_000:5.1f}us"
+    if avg < 1_000_000_000:
+        return f"{avg / 1_000_000:5.1f}ms"
+    return f"{avg / 1e9:5.2f}s "
+
+
+def _read(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _row(cur: dict, prev: dict, verbose: bool) -> str:
+    d = {k: cur.get(k, 0) - prev.get(k, 0) for k in cur}
+    g = cur  # gauges are point-in-time
+    nsub = d.get("nr_submit_dma", 0)
+    avg_sz = (d.get("total_dma_length", 0) / nsub / 1024) if nsub else 0
+    cols = [
+        show_avg(d.get("clk_ioctl_memcpy_submit", 0), d.get("nr_ioctl_memcpy_submit", 0)),
+        show_avg(d.get("clk_ioctl_memcpy_wait", 0), d.get("nr_ioctl_memcpy_wait", 0)),
+        show_avg(d.get("clk_ssd2dev", 0), d.get("nr_ssd2dev", 0)),
+        f"{avg_sz:7.0f}K",
+        f"{d.get('nr_wrong_wakeup', 0):6d}",
+        f"{g.get('cur_dma_count', 0):5d}",
+        f"{g.get('max_dma_count', 0):5d}",
+    ]
+    if verbose:
+        cols += [
+            show_avg(d.get("clk_setup_prps", 0), d.get("nr_setup_prps", 0)),
+            show_avg(d.get("clk_submit_dma", 0), d.get("nr_submit_dma", 0)),
+            f"{d.get('nr_debug1', 0):6d}",
+            f"{d.get('nr_debug2', 0):6d}",
+            f"{d.get('nr_debug3', 0):6d}",
+            f"{d.get('nr_debug4', 0):6d}",
+        ]
+    return " ".join(cols)
+
+
+def _header(verbose: bool) -> str:
+    cols = ["submit ", "wait   ", "dma-lat", " avg-sz", " wrong", "  cur", "  max"]
+    if verbose:
+        cols += ["plan   ", "sq-sub ", "resub ", "sqfull", "h2d   ", "dbg4  "]
+    return " ".join(cols)
+
+
+def main(argv=None) -> int:
+    from ..stats import DEFAULT_STAT_EXPORT
+    ap = argparse.ArgumentParser(prog="tpu_stat", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("interval", nargs="?", type=float, default=None)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("-f", "--file", default=DEFAULT_STAT_EXPORT,
+                    help="stat export file to watch")
+    args = ap.parse_args(argv)
+
+    snap = _read(args.file)
+    if snap is None:
+        print(f"no stats at {args.file} — is a tool/session running with "
+              f"stats export on?", file=sys.stderr)
+        return 1
+
+    if args.interval is None:
+        c = snap["counters"]
+        print(f"pid {snap['pid']}  version {snap['version']}")
+        width = max(len(k) for k in c)
+        for k in sorted(c):
+            print(f"  {k:<{width}} {c[k]}")
+        return 0
+
+    prev = snap["counters"]
+    n = 0
+    try:
+        while True:
+            time.sleep(args.interval)
+            snap = _read(args.file)
+            if snap is None:
+                continue
+            if n % 20 == 0:
+                print(_header(args.verbose), flush=True)
+            print(_row(snap["counters"], prev, args.verbose), flush=True)
+            prev = snap["counters"]
+            n += 1
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

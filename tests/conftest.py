@@ -6,10 +6,16 @@ validated without TPU hardware) — must run before any jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before any backend init.  NB: this image's axon sitecustomize
+# force-registers the TPU platform and overrides JAX_PLATFORMS from the
+# environment, so the config.update below is the authoritative switch.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -13,8 +13,9 @@ def test_defaults_mirror_reference():
     assert config.get("seq_page_cost") == 0.25
     assert config.get("enabled") is True
     assert config.get("debug_no_threshold") is False
-    # kmod cap (kmod/nvme_strom.c:139-146)
-    assert config.get("dma_max_size") == 256 << 10
+    # our default raises the reference's 256KB cap (2017-era heuristic,
+    # kmod/nvme_strom.c:139-146) to 1MB for modern NVMe
+    assert config.get("dma_max_size") == 1 << 20
 
 
 def test_size_suffix_parsing():

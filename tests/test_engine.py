@@ -52,8 +52,9 @@ def test_check_file_missing():
 
 def test_plan_merges_contiguous_chunks(tmp_data_file):
     with PlainSource(tmp_data_file) as src:
-        # 8 contiguous 64KB chunks -> 2 x 256KB requests at the default cap
-        reqs = plan_requests(src, [(i, i) for i in range(8)], CHUNK, 0)
+        # 8 contiguous 64KB chunks -> 2 x 256KB requests at a 256KB cap
+        reqs = plan_requests(src, [(i, i) for i in range(8)], CHUNK, 0,
+                             dma_max_size=256 << 10)
         assert [r.length for r in reqs] == [256 << 10, 256 << 10]
         assert reqs[0].file_off == 0 and reqs[1].file_off == 256 << 10
 
@@ -377,6 +378,7 @@ def test_stats_counters_move(tmp_data_file):
 def test_avg_dma_size_reflects_merging(tmp_data_file):
     """8 contiguous 64KB chunks with a 256KB cap must average 256KB/request."""
     config.set("cache_arbitration", False)
+    config.set("dma_max_size", "256k")
     before = stats.snapshot()
     with PlainSource(tmp_data_file) as src:
         _run_copy(src, list(range(8)))
@@ -391,7 +393,8 @@ def test_plan_splits_oversized_chunk(tmp_data_file):
     """A chunk larger than dma_max_size must split into cap-sized requests
     (the reference never issues a DMA above the 256KB cap)."""
     with PlainSource(tmp_data_file) as src:
-        reqs = plan_requests(src, [(0, 0)], 1 << 20, 0)  # 1MB chunk
+        reqs = plan_requests(src, [(0, 0)], 1 << 20, 0,
+                             dma_max_size=256 << 10)  # 1MB chunk, 256KB cap
         assert all(r.length <= 256 << 10 for r in reqs)
         assert sum(r.length for r in reqs) == 1 << 20
         # contiguity preserved

@@ -1,0 +1,177 @@
+"""HBM bridge tests: device memory registry lifecycle (map/info/list/unmap,
+revocation, ownership), staging pipeline correctness + overlap, and the
+one-call loader.  Runs on the virtual CPU device mesh (conftest)."""
+
+import errno
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nvme_strom_tpu import Session, StromError
+from nvme_strom_tpu.engine import PlainSource
+from nvme_strom_tpu.hbm import HbmRegistry, StagingPipeline, load_file_to_device
+from nvme_strom_tpu.testing import make_test_file
+from nvme_strom_tpu.testing.fake import expected_bytes
+
+CHUNK = 64 << 10
+
+
+@pytest.fixture()
+def reg():
+    return HbmRegistry()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_map_info_list_unmap(reg):
+    h = reg.map_device_memory(1 << 20)
+    info = reg.info(h)
+    assert info.length == 1 << 20
+    assert info.kind == "hbm"
+    assert info.refcount == 0
+    assert reg.list() == [h]
+    reg.unmap(h)
+    assert reg.list() == []
+    with pytest.raises(StromError) as ei:
+        reg.info(h)
+    assert ei.value.errno == errno.ENOENT
+
+
+def test_adopt_existing_array(reg):
+    arr = jnp.arange(128, dtype=jnp.int32)
+    h = reg.map_device_memory(arr)
+    assert reg.info(h).length == 128 * 4
+    reg.unmap(h)
+
+
+def test_unmap_blocks_on_refcount(reg):
+    h = reg.map_device_memory(4096)
+    buf = reg.acquire(h)
+    with pytest.raises(StromError) as ei:
+        reg.unmap(h, timeout=0.05)
+    assert ei.value.errno == errno.ETIMEDOUT
+    reg.release(buf)
+    reg.unmap(h)
+
+
+def test_revoked_buffer_rejects_use(reg):
+    h = reg.map_device_memory(4096)
+    buf = reg.get(h)
+    reg.unmap(h)
+    with pytest.raises(StromError) as ei:
+        _ = buf.array
+    assert ei.value.errno == errno.ENODEV
+    with pytest.raises(StromError):
+        reg.acquire(h)
+
+
+# ---------------------------------------------------------------------------
+# staging pipeline
+# ---------------------------------------------------------------------------
+
+def test_pipeline_end_to_end(tmp_path, reg):
+    path = str(tmp_path / "p.bin")
+    make_test_file(path, 4 << 20)
+    with PlainSource(path) as src, Session() as sess:
+        h = reg.map_device_memory(4 << 20)
+        with StagingPipeline(sess, staging_bytes=512 << 10, hbm_registry=reg) as pipe:
+            res = pipe.memcpy_ssd2dev(src, h, list(range(64)), CHUNK)
+        assert res.nr_chunks == 64
+        arr = np.asarray(reg.get(h).array)
+        for slot, cid in enumerate(res.chunk_ids):
+            got = arr[slot * CHUNK:(slot + 1) * CHUNK].tobytes()
+            assert got == expected_bytes(cid * CHUNK, CHUNK), f"chunk {cid}"
+        reg.unmap(h)
+
+
+def test_pipeline_out_of_order_and_offset(tmp_path, reg):
+    path = str(tmp_path / "p2.bin")
+    make_test_file(path, 1 << 20)
+    ids = [7, 1, 12, 3]
+    with PlainSource(path) as src, Session() as sess:
+        h = reg.map_device_memory((len(ids) + 2) * CHUNK)
+        with StagingPipeline(sess, staging_bytes=2 * CHUNK, hbm_registry=reg) as pipe:
+            res = pipe.memcpy_ssd2dev(src, h, ids, CHUNK, dest_offset=2 * CHUNK)
+        arr = np.asarray(reg.get(h).array)
+        assert not arr[:2 * CHUNK].any()  # untouched region stays zero
+        for slot, cid in enumerate(res.chunk_ids):
+            start = 2 * CHUNK + slot * CHUNK
+            assert arr[start:start + CHUNK].tobytes() == \
+                expected_bytes(cid * CHUNK, CHUNK)
+        reg.unmap(h)
+
+
+def test_pipeline_rejects_partial_chunk(tmp_path, reg):
+    path = str(tmp_path / "p3.bin")
+    make_test_file(path, CHUNK + 512)
+    with PlainSource(path) as src, Session() as sess:
+        h = reg.map_device_memory(4 * CHUNK)
+        with StagingPipeline(sess, staging_bytes=2 * CHUNK, hbm_registry=reg) as pipe:
+            with pytest.raises(StromError) as ei:
+                pipe.memcpy_ssd2dev(src, h, [0, 1], CHUNK)
+            assert ei.value.errno == errno.EINVAL
+        reg.unmap(h)
+
+
+def test_pipeline_device_buffer_too_small(tmp_path, reg):
+    path = str(tmp_path / "p4.bin")
+    make_test_file(path, 1 << 20)
+    with PlainSource(path) as src, Session() as sess:
+        h = reg.map_device_memory(CHUNK)
+        with StagingPipeline(sess, staging_bytes=2 * CHUNK, hbm_registry=reg) as pipe:
+            with pytest.raises(StromError) as ei:
+                pipe.memcpy_ssd2dev(src, h, [0, 1, 2], CHUNK)
+            assert ei.value.errno == errno.ERANGE
+        reg.unmap(h)
+
+
+def test_pipeline_refcount_during_copy(tmp_path, reg):
+    path = str(tmp_path / "p5.bin")
+    make_test_file(path, 1 << 20)
+    with PlainSource(path) as src, Session() as sess:
+        h = reg.map_device_memory(1 << 20)
+        with StagingPipeline(sess, staging_bytes=512 << 10, hbm_registry=reg) as pipe:
+            pipe.memcpy_ssd2dev(src, h, list(range(16)), CHUNK)
+        assert reg.info(h).refcount == 0  # released after the command
+        reg.unmap(h)
+
+
+# ---------------------------------------------------------------------------
+# loader
+# ---------------------------------------------------------------------------
+
+def test_load_file_to_device(tmp_path, reg):
+    path = str(tmp_path / "f.bin")
+    make_test_file(path, 2 << 20)
+    with PlainSource(path) as src:
+        arr = load_file_to_device(src, chunk_size=256 << 10,
+                                  staging_bytes=512 << 10, hbm_registry=reg)
+    assert arr.shape == (2 << 20,)
+    assert bytes(np.asarray(arr).tobytes()) == expected_bytes(0, 2 << 20)
+
+
+def test_load_file_with_tail(tmp_path, reg):
+    size = (1 << 20) + 24 * 1024  # tail of 24KB beyond the chunk grid
+    path = str(tmp_path / "t.bin")
+    make_test_file(path, size)
+    with PlainSource(path) as src:
+        arr = load_file_to_device(src, chunk_size=256 << 10,
+                                  staging_bytes=512 << 10, hbm_registry=reg)
+    assert arr.shape == (size,)
+    assert np.asarray(arr).tobytes() == expected_bytes(0, size)
+
+
+def test_load_as_int32(tmp_path, reg):
+    path = str(tmp_path / "i.bin")
+    make_test_file(path, 1 << 20)
+    with PlainSource(path) as src:
+        arr = load_file_to_device(src, chunk_size=256 << 10, dtype=jnp.int32,
+                                  staging_bytes=512 << 10, hbm_registry=reg)
+    assert arr.dtype == jnp.int32
+    assert arr.shape == ((1 << 20) // 4,)
+    want = np.frombuffer(expected_bytes(0, 1 << 20), dtype=np.int32)
+    np.testing.assert_array_equal(np.asarray(arr), want)

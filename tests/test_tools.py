@@ -1,0 +1,83 @@
+"""CLI tools drive-through: ssd2ram_test, ssd2tpu_test, tpu_stat."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(mod, *args, env_extra=None, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, "-m", mod, *args],
+                          capture_output=True, text=True, cwd=REPO, env=env,
+                          timeout=timeout)
+
+
+@pytest.fixture(scope="module")
+def data_file(tmp_path_factory):
+    from nvme_strom_tpu.testing import make_test_file
+    p = str(tmp_path_factory.mktemp("tools") / "data.bin")
+    make_test_file(p, 32 << 20)
+    return p
+
+
+def test_ssd2ram_check_mode(data_file):
+    out = _run("nvme_strom_tpu.tools.ssd2ram_test", data_file, "-c")
+    assert out.returncode == 0, out.stderr
+    assert "numa node:" in out.stdout
+    assert "dma64: supported" in out.stdout
+
+
+def test_ssd2ram_full_run(data_file):
+    out = _run("nvme_strom_tpu.tools.ssd2ram_test", data_file,
+               "-s", "8m", "--chunk", "512k", "-p", "4")
+    assert out.returncode == 0, out.stderr
+    assert "GB/s" in out.stdout
+    assert "avg dma size:" in out.stdout
+
+
+def test_ssd2tpu_direct_with_check(data_file):
+    out = _run("nvme_strom_tpu.tools.ssd2tpu_test", data_file,
+               "-c", "-n", "2", "-s", "4m", "--chunk", "512k")
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "corruption check: all" in out.stdout
+
+
+def test_ssd2tpu_vfs_baseline(data_file):
+    out = _run("nvme_strom_tpu.tools.ssd2tpu_test", data_file, "-f", "4m", "-c")
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "vfs baseline" in out.stdout
+    assert "corruption check: all" in out.stdout
+
+
+def test_ssd2tpu_rejects_unsupported(tmp_path):
+    small = tmp_path / "small.bin"
+    small.write_bytes(b"x" * 100)
+    out = _run("nvme_strom_tpu.tools.ssd2tpu_test", str(small))
+    assert out.returncode == 1
+    assert "not supported" in out.stderr
+
+
+def test_tpu_stat_oneshot(data_file, tmp_path):
+    stat_file = str(tmp_path / "stat.json")
+    # generate a stats export by running a copy with the export path set
+    out = _run("nvme_strom_tpu.tools.ssd2ram_test", data_file,
+               "-s", "8m", env_extra={"STROM_TPU_STAT_EXPORT": stat_file})
+    assert out.returncode == 0, out.stderr
+    assert os.path.exists(stat_file)
+    snap = json.load(open(stat_file))
+    assert snap["counters"]["nr_ioctl_memcpy_submit"] > 0
+    out = _run("nvme_strom_tpu.tools.tpu_stat", "-f", stat_file)
+    assert out.returncode == 0, out.stderr
+    assert "nr_ioctl_memcpy_submit" in out.stdout
+
+
+def test_tpu_stat_missing_file(tmp_path):
+    out = _run("nvme_strom_tpu.tools.tpu_stat", "-f", str(tmp_path / "nope"))
+    assert out.returncode == 1
