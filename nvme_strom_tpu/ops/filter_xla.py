@@ -10,8 +10,6 @@ masked, not branched on (jit-safe, SURVEY.md's XLA-semantics constraint).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

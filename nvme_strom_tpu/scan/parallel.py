@@ -1,0 +1,130 @@
+"""Multi-worker parallel scan: shared cursor across processes.
+
+Capability analog of the pgsql Gather integration (`pgsql/nvme_strom.c:
+1057-1112`): a DSM segment carries the scan descriptor (relation id, total
+blocks, a shared atomic cursor, shared DMA counters) and every worker claims
+disjoint block ranges from it.  Here the descriptor lives in
+``multiprocessing.shared_memory`` and workers are processes running their
+own :class:`~nvme_strom_tpu.scan.executor.TableScanner` against the shared
+cursor — the same data-parallel shape, minus the PostgreSQL executor.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import struct
+from multiprocessing import shared_memory
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .executor import TableScanner
+from .heap import HeapSchema
+
+__all__ = ["SharedCursor", "ParallelScanDesc", "parallel_scan"]
+
+_HDR = struct.Struct("<qq")  # next_chunk, n_chunks
+
+
+class SharedCursor:
+    """Cross-process atomic chunk cursor (the DSM ``nsp_cblock`` analog).
+
+    Safe under the ``spawn`` start method: workers re-attach by name and
+    share the externally-provided lock (fork is unusable once a PJRT
+    backend has initialized in the parent)."""
+
+    def __init__(self, n_chunks: int, *, name: Optional[str] = None,
+                 create: bool = True, lock=None):
+        if create:
+            self._shm = shared_memory.SharedMemory(create=True, size=_HDR.size)
+            _HDR.pack_into(self._shm.buf, 0, 0, n_chunks)
+        else:
+            assert name is not None
+            self._shm = shared_memory.SharedMemory(name=name)
+        self._lock = lock if lock is not None else mp.Lock()
+        self.name = self._shm.name
+
+    @property
+    def n_chunks(self) -> int:
+        return _HDR.unpack_from(self._shm.buf, 0)[1]
+
+    def claim(self, count: int) -> Tuple[int, int]:
+        with self._lock:
+            nxt, total = _HDR.unpack_from(self._shm.buf, 0)
+            n = min(count, total - nxt)
+            if n <= 0:
+                return nxt, 0
+            _HDR.pack_into(self._shm.buf, 0, nxt + n, total)
+            return nxt, n
+
+    def close(self, *, unlink: bool = False) -> None:
+        self._shm.close()
+        if unlink:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+def _worker(path: str, cursor_name: str, lock, chunk_size: int,
+            threshold: int, out_q) -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from ..ops.filter_xla import scan_filter_step
+    import jax.numpy as jnp
+    cursor = SharedCursor(0, name=cursor_name, create=False, lock=lock)
+    try:
+        with TableScanner(path, chunk_size=chunk_size, cursor=cursor,
+                          numa_bind=False) as scanner:
+            acc = {"count": 0, "sum": 0, "pages": 0, "nr_ssd": 0, "nr_wb": 0}
+            for batch in scanner.batches():
+                out = scan_filter_step(batch.pages,
+                                       jnp.asarray(threshold, jnp.int32))
+                acc["count"] += int(out["count"])
+                acc["sum"] += int(out["sum"])
+                acc["pages"] += batch.pages.shape[0]
+                acc["nr_ssd"] += batch.nr_ssd
+                acc["nr_wb"] += batch.nr_wb
+        out_q.put(("ok", acc))
+    except BaseException as e:  # noqa: BLE001 — worker must always report
+        out_q.put(("err", repr(e)))
+    finally:
+        cursor.close()
+
+
+def parallel_scan(path: str, *, n_workers: int = 2,
+                  chunk_size: int = 1 << 20,
+                  threshold: int = 0) -> dict:
+    """Scan *path* with ``n_workers`` processes sharing one cursor; returns
+    summed aggregates (count/sum over the demo schema's filter)."""
+    import os
+    size = os.path.getsize(path)
+    n_chunks = size // chunk_size
+    ctx = mp.get_context("spawn")
+    lock = ctx.Lock()
+    cursor = SharedCursor(n_chunks, lock=lock)
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker,
+                         args=(path, cursor.name, lock, chunk_size,
+                               threshold, q))
+             for _ in range(n_workers)]
+    try:
+        for p in procs:
+            p.start()
+        results: List[dict] = []
+        errors: List[str] = []
+        for _ in procs:
+            kind, payload = q.get(timeout=300)
+            (results if kind == "ok" else errors).append(payload)
+        for p in procs:
+            p.join(timeout=60)
+        if errors:
+            raise RuntimeError(f"parallel scan worker failed: {errors[0]}")
+        total = {k: sum(r[k] for r in results) for k in results[0]}
+        total["workers"] = len(results)
+        return total
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        cursor.close(unlink=True)

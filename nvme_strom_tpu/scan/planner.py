@@ -1,0 +1,160 @@
+"""Scan path planning: eligibility cache, size threshold, cost model.
+
+Capability analog of the pgsql extension's planner integration
+(`pgsql/nvme_strom.c:217-633`):
+
+* **capability cache** — per-directory CHECK_FILE *capability* probes
+  (can this filesystem do direct load, which NUMA node, DMA64) cached with
+  a TTL and an explicit ``invalidate()`` (the reference caches per
+  tablespace with a syscache callback + 1-entry MRU, `:217-348`).
+  Per-file facts (size) are always read fresh.
+* **size threshold** — the direct path only pays off when the table cannot
+  live in the host page cache; the reference gates on
+  ``(RAM − shared_buffers)·⅔ + shared_buffers`` (`:1544-1559`), overridable
+  by ``debug_no_threshold``.  Here RAM comes from MemAvailable and the
+  "shared_buffers" analog is the configured staging pool size.
+* **cost model** — per-page cost with the reduced ``seq_page_cost`` GUC
+  (default ¼ of the conventional cost, `:1614-1625`) and a parallel divisor
+  capped at 4 for the disk component (`:491-517`) so I/O cost does not
+  shrink linearly with workers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from ..api import FileInfo
+from ..config import config
+from ..engine import check_file
+
+__all__ = ["CapabilityCache", "capability_cache", "direct_scan_threshold",
+           "should_use_direct_scan", "ScanCost", "cost_direct_scan",
+           "cost_vfs_scan"]
+
+# conventional-path reference cost per 8KB page (PG's seq_page_cost = 1.0)
+VFS_PAGE_COST = 1.0
+CPU_TUPLE_COST = 0.01
+_MAX_PARALLEL_DISK_DIVISOR = 4.0   # reference caps at 4 (:491-517)
+
+
+class CapabilityCache:
+    """Directory-level capability cache (TTL + explicit invalidation).
+
+    Caches only directory-scoped facts — fs capability, DMA64 support, NUMA
+    node, request cap.  File size is stat'ed fresh on every probe so one
+    file's geometry is never attributed to another in the same directory."""
+
+    def __init__(self, ttl_s: float = 60.0):
+        self._lock = threading.Lock()
+        self._cache: Dict[str, Tuple[FileInfo, float]] = {}
+        self._mru: Optional[Tuple[str, FileInfo, float]] = None  # 1-entry MRU (:233)
+        self.ttl_s = ttl_s
+
+    def _fresh(self, path: str, cap: FileInfo) -> FileInfo:
+        size = os.stat(path).st_size
+        kind = cap.fs_kind if size >= 4096 else type(cap.fs_kind)(0)
+        return FileInfo(path=path, file_size=size, fs_kind=kind,
+                        logical_block_size=cap.logical_block_size,
+                        dma_max_size=cap.dma_max_size,
+                        numa_node_id=cap.numa_node_id,
+                        support_dma64=cap.support_dma64)
+
+    def probe(self, path: str) -> FileInfo:
+        d = os.path.dirname(os.path.abspath(path)) or "/"
+        now = time.monotonic()
+        with self._lock:
+            if self._mru is not None and self._mru[0] == d                     and now - self._mru[2] < self.ttl_s:
+                return self._fresh(path, self._mru[1])
+            hit = self._cache.get(d)
+            if hit is not None and now - hit[1] < self.ttl_s:
+                self._mru = (d, hit[0], hit[1])
+                return self._fresh(path, hit[0])
+        cap = check_file(path)
+        with self._lock:
+            self._cache[d] = (cap, now)
+            self._mru = (d, cap, now)
+        return self._fresh(path, cap)
+
+    def invalidate(self, directory: Optional[str] = None) -> None:
+        """Syscache-callback analog (`pgsql/nvme_strom.c:340-348`)."""
+        with self._lock:
+            if directory is None:
+                self._cache.clear()
+            else:
+                self._cache.pop(os.path.abspath(directory), None)
+            self._mru = None
+
+
+capability_cache = CapabilityCache()
+
+
+def _mem_total_bytes() -> int:
+    """Physical RAM (the reference's threshold uses total RAM,
+    pgsql/nvme_strom.c:1544-1559)."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) << 10
+    except OSError:
+        pass
+    return 8 << 30
+
+
+def direct_scan_threshold() -> int:
+    """Table size above which the direct path is planned
+    (reference `(RAM − shared_buffers)·⅔ + shared_buffers`, :1544-1559)."""
+    ram = _mem_total_bytes()
+    shared = config.get("buffer_size")
+    return int((max(ram - shared, 0) * 2) // 3 + shared)
+
+
+def should_use_direct_scan(path: str, *, table_size: Optional[int] = None) -> bool:
+    """The add-path gate (`nvmestrom_add_scan_path`, :555-596)."""
+    if not config.get("enabled"):
+        return False
+    info = capability_cache.probe(path)
+    if not info.supported or not info.support_dma64:
+        return False
+    size = table_size if table_size is not None else info.file_size
+    if config.get("debug_no_threshold"):
+        return True
+    return size >= direct_scan_threshold()
+
+
+@dataclass(frozen=True)
+class ScanCost:
+    startup: float
+    total: float
+    pages: int
+    workers: int
+
+
+def _parallel_divisor(workers: int) -> float:
+    """PG's parallel divisor incl. leader contribution."""
+    d = float(max(workers, 1))
+    if workers >= 1:
+        d += 0.3 * min(workers, 4) / 4  # leader does some work too
+    return d
+
+
+def cost_direct_scan(n_pages: int, n_tuples: int, *, workers: int = 0) -> ScanCost:
+    """`cost_nvmestrom_scan` analog (:451-520): reduced per-page cost, disk
+    component divided by at most 4 regardless of worker count."""
+    page_cost = config.get("seq_page_cost") * VFS_PAGE_COST
+    disk_div = min(_parallel_divisor(workers), _MAX_PARALLEL_DISK_DIVISOR)
+    cpu_div = _parallel_divisor(workers)
+    disk = n_pages * page_cost / disk_div
+    cpu = n_tuples * CPU_TUPLE_COST / cpu_div
+    return ScanCost(startup=0.0, total=disk + cpu, pages=n_pages, workers=workers)
+
+
+def cost_vfs_scan(n_pages: int, n_tuples: int, *, workers: int = 0) -> ScanCost:
+    disk = n_pages * VFS_PAGE_COST / min(_parallel_divisor(workers),
+                                         _MAX_PARALLEL_DISK_DIVISOR)
+    cpu = n_tuples * CPU_TUPLE_COST / _parallel_divisor(workers)
+    return ScanCost(startup=0.0, total=disk + cpu, pages=n_pages, workers=workers)

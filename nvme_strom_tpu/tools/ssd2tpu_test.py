@@ -110,6 +110,8 @@ def main(argv=None) -> int:
         # the comparison isolates the read path (utils/ssd2gpu_test.c:377-429)
         from ..hbm.staging import _land
         handle = registry.map_device_memory(nbytes, device=dev)
+        registry.get(handle).array.block_until_ready()
+        t0 = time.monotonic()  # setup (device alloc) excluded, as in direct mode
         hbm = registry.acquire(handle)
         try:
             with open(args.file, "rb", buffering=0) as f:

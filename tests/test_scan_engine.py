@@ -1,0 +1,254 @@
+"""Scan engine: pool + resource owner, planner gates/costs, executor ring,
+device-filter pipeline, multi-process parallel scan."""
+
+import errno
+import os
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu import StromError, config
+from nvme_strom_tpu.scan.executor import Batch, LocalCursor, TableScanner
+from nvme_strom_tpu.scan.heap import PAGE_SIZE, HeapSchema, build_heap_file
+from nvme_strom_tpu.scan.planner import (capability_cache, cost_direct_scan,
+                                         cost_vfs_scan, direct_scan_threshold,
+                                         should_use_direct_scan)
+from nvme_strom_tpu.scan.pool import DmaBufferPool, ResourceOwner
+
+CHUNK = 256 << 10  # small chunks for tests
+
+
+@pytest.fixture()
+def heap_file(tmp_path):
+    rng = np.random.default_rng(7)
+    schema = HeapSchema(n_cols=2, visibility=True)
+    n = 40_000
+    c0 = rng.integers(-1000, 1000, n).astype(np.int32)
+    c1 = rng.integers(0, 100, n).astype(np.int32)
+    path = str(tmp_path / "table.heap")
+    build_heap_file(path, [c0, c1], schema)
+    return path, schema, c0, c1
+
+
+# ---------------------------------------------------------------------------
+# pool
+# ---------------------------------------------------------------------------
+
+def test_pool_alloc_free_cycle():
+    with DmaBufferPool(chunk_size=64 << 10, total_size=256 << 10) as pool:
+        assert pool.n_chunks == 4
+        chunks = [pool.alloc() for _ in range(4)]
+        with pytest.raises(StromError) as ei:
+            pool.alloc(blocking=False)
+        assert ei.value.errno == errno.ENOMEM
+        chunks[0].release()
+        c = pool.alloc(blocking=False)
+        c.release()
+        for ch in chunks[1:]:
+            ch.release()
+        assert pool.outstanding == 0
+
+
+def test_pool_blocking_alloc_wakes():
+    pool = DmaBufferPool(chunk_size=64 << 10, total_size=64 << 10)
+    held = pool.alloc()
+    got = []
+
+    def taker():
+        got.append(pool.alloc(timeout=5.0))
+
+    t = threading.Thread(target=taker)
+    t.start()
+    held.release()
+    t.join(timeout=5)
+    assert got and got[0] is not None
+    got[0].release()
+    pool.close()
+
+
+def test_resource_owner_recovers_on_abort():
+    pool = DmaBufferPool(chunk_size=64 << 10, total_size=128 << 10)
+    try:
+        with pytest.raises(RuntimeError):
+            with ResourceOwner("t") as owner:
+                pool.alloc(owner=owner)
+                pool.alloc(owner=owner)
+                raise RuntimeError("abort")
+        assert pool.outstanding == 0  # abort path returned both chunks
+    finally:
+        pool.close()
+
+
+def test_resource_owner_warns_on_clean_leak():
+    pool = DmaBufferPool(chunk_size=64 << 10, total_size=64 << 10)
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with ResourceOwner("t") as owner:
+                pool.alloc(owner=owner)  # leaked on purpose
+        assert any("leaked" in str(x.message) for x in w)
+        assert pool.outstanding == 0
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+def test_threshold_gate(heap_file):
+    path, *_ = heap_file
+    config.set("debug_no_threshold", False)
+    # a small file is far below (RAM - pool)*2/3 + pool
+    assert not should_use_direct_scan(path)
+    config.set("debug_no_threshold", True)
+    assert should_use_direct_scan(path)
+
+
+def test_enabled_gate(heap_file):
+    path, *_ = heap_file
+    config.set("debug_no_threshold", True)
+    config.set("enabled", False)
+    assert not should_use_direct_scan(path)
+
+
+def test_threshold_formula_shape():
+    th = direct_scan_threshold()
+    assert th >= config.get("buffer_size")
+
+
+def test_cost_model_favours_direct():
+    d = cost_direct_scan(100_000, 1_000_000)
+    v = cost_vfs_scan(100_000, 1_000_000)
+    assert d.total < v.total
+    # disk component parallel divisor caps at 4
+    d4 = cost_direct_scan(100_000, 1_000_000, workers=4)
+    d16 = cost_direct_scan(100_000, 1_000_000, workers=16)
+    assert d16.total < d4.total  # cpu part still shrinks
+    disk_only4 = cost_direct_scan(100_000, 0, workers=4).total
+    disk_only16 = cost_direct_scan(100_000, 0, workers=16).total
+    assert disk_only16 == pytest.approx(disk_only4)  # capped
+
+
+def test_capability_cache_invalidation(heap_file, tmp_path):
+    path, *_ = heap_file
+    capability_cache.invalidate()
+    info1 = capability_cache.probe(path)
+    # capability facts are cached per directory; file size is always fresh
+    info2 = capability_cache.probe(path)
+    assert info2.fs_kind == info1.fs_kind
+    assert info2.file_size == os.path.getsize(path)
+    # a different file in the same directory must get ITS size, not path's
+    other = tmp_path / "other.heap"
+    other.write_bytes(b"\0" * 16384)
+    info3 = capability_cache.probe(str(other))
+    assert info3.file_size == 16384
+    capability_cache.invalidate()  # syscache-callback analog clears state
+    assert capability_cache.probe(path).supported == info1.supported
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+def test_scanner_covers_every_page(heap_file):
+    path, schema, c0, c1 = heap_file
+    seen_pages = 0
+    seen_ids = []
+    with TableScanner(path, schema, chunk_size=CHUNK, numa_bind=False) as sc:
+        for batch in sc.batches():
+            seen_pages += batch.pages.shape[0]
+            seen_ids.extend(batch.chunk_ids)
+            assert batch.pages.shape[1] == PAGE_SIZE
+    n_pages_total = os.path.getsize(path) // PAGE_SIZE
+    assert seen_pages == n_pages_total
+
+
+def test_scanner_filter_matches_numpy(heap_file):
+    import jax.numpy as jnp
+    from nvme_strom_tpu.ops.filter_xla import scan_filter_step
+    path, schema, c0, c1 = heap_file
+    with TableScanner(path, schema, chunk_size=CHUNK, numa_bind=False) as sc:
+        out = sc.scan_filter(lambda pages: scan_filter_step(
+            pages, jnp.asarray(100, jnp.int32)))
+    sel = c0 > 100
+    assert int(out["count"]) == int(sel.sum())
+    assert int(out["sum"]) == int(c1[sel].sum())
+
+
+def test_scanner_ring_keeps_depth(heap_file):
+    path, schema, *_ = heap_file
+    with TableScanner(path, schema, chunk_size=CHUNK, async_depth=4,
+                      numa_bind=False) as sc:
+        it = sc.batches()
+        next(it)
+        # after the first yield the ring should still be pipelining
+        assert sc.pool.outstanding >= 2
+        for _ in it:
+            pass
+    # implicitly: no leaks — pool closed clean (no ResourceWarning)
+
+
+def test_scanner_tail_pages(tmp_path):
+    """A file that is not a chunk multiple but is a page multiple must still
+    be fully scanned."""
+    schema = HeapSchema(n_cols=2, visibility=True)
+    rng = np.random.default_rng(1)
+    t = schema.tuples_per_page
+    n = t * 37  # 37 pages; chunk of 32 pages -> 1 full chunk + 5-page tail
+    c0 = rng.integers(0, 10, n).astype(np.int32)
+    c1 = np.ones(n, dtype=np.int32)
+    path = str(tmp_path / "t.heap")
+    build_heap_file(path, [c0, c1], schema)
+    with TableScanner(path, schema, chunk_size=32 * PAGE_SIZE,
+                      numa_bind=False) as sc:
+        total = sum(b.pages.shape[0] for b in sc.batches())
+    assert total == 37
+
+
+def test_local_cursor_exhaustion():
+    cur = LocalCursor(3)
+    assert cur.claim(2) == (0, 2)
+    assert cur.claim(2) == (2, 1)
+    assert cur.claim(1)[1] == 0
+
+
+# ---------------------------------------------------------------------------
+# parallel
+# ---------------------------------------------------------------------------
+
+def test_parallel_scan_two_workers(heap_file):
+    from nvme_strom_tpu.scan.parallel import parallel_scan
+    path, schema, c0, c1 = heap_file
+    out = parallel_scan(path, n_workers=2, chunk_size=CHUNK, threshold=100)
+    sel = c0 > 100
+    # workers split the chunk grid; the sub-chunk tail is not scanned in
+    # parallel mode, so compare against the chunk-aligned prefix
+    n_chunks = os.path.getsize(path) // CHUNK
+    rows_per_page = schema.tuples_per_page
+    pages_covered = n_chunks * (CHUNK // PAGE_SIZE)
+    rows_covered = min(pages_covered * rows_per_page, len(c0))
+    sel_cov = sel[:rows_covered]
+    assert out["workers"] == 2
+    assert out["count"] == int(sel_cov.sum())
+    assert out["sum"] == int(c1[:rows_covered][sel_cov].sum())
+
+
+def test_scanner_steady_state_many_chunks(tmp_path):
+    """More chunks than ring depth + pool: the recycle-before-submit order
+    must prevent the steady-state pool deadlock (found by driving a 24MB
+    table on hardware; small fixtures never reach steady state)."""
+    schema = HeapSchema(n_cols=2, visibility=True)
+    t_pp = schema.tuples_per_page
+    n = t_pp * 64  # 64 pages
+    c0 = np.arange(n, dtype=np.int32)
+    c1 = np.ones(n, dtype=np.int32)
+    path = str(tmp_path / "many.heap")
+    build_heap_file(path, [c0, c1], schema)
+    with TableScanner(path, schema, chunk_size=4 * PAGE_SIZE, async_depth=3,
+                      numa_bind=False) as sc:
+        assert sc.n_chunks == 16  # well beyond depth+1
+        total = sum(b.pages.shape[0] for b in sc.batches())
+    assert total == 64
