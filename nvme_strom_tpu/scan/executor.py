@@ -17,6 +17,7 @@ and the *filter runs as an XLA kernel overlapped with the next batch's DMA*
 from __future__ import annotations
 
 import errno as _errno
+import os
 import threading
 from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple, Union
@@ -87,6 +88,11 @@ class TableScanner:
         if self.chunk_size % PAGE_SIZE:
             raise StromError(_errno.EINVAL,
                             f"chunk_size must be a multiple of {PAGE_SIZE}")
+        if self.chunk_size & (self.chunk_size - 1):
+            # the engine rejects non-pow2 chunks at submit time; fail at
+            # construction instead of on the first batch
+            raise StromError(_errno.EINVAL,
+                            f"chunk_size {self.chunk_size} must be a power of 2")
         self.pages_per_chunk = self.chunk_size // PAGE_SIZE
         self.async_depth = async_depth or config.get("async_depth")
         self._own_session = session is None
@@ -111,13 +117,18 @@ class TableScanner:
                                           total_size=self.chunk_size *
                                           max(self.async_depth + 1, 2))
         self._numa_bound = False
+        self._prev_affinity = None
         if numa_bind:
-            # bind to the storage's NUMA node for the scan (pgsql :716)
+            # bind to the storage's NUMA node for the scan (pgsql :716);
+            # the previous affinity is restored by close()
             try:
+                prev = os.sched_getaffinity(0)
                 info = capability_cache.probe(
                     getattr(self.source, "path", None) or ".")
                 self._numa_bound = bind_to_node(info.numa_node_id)
-            except (StromError, OSError):
+                if self._numa_bound:
+                    self._prev_affinity = prev
+            except (StromError, OSError, AttributeError):
                 pass
 
     # -- core ring ----------------------------------------------------------
@@ -126,7 +137,9 @@ class TableScanner:
 
         The previous batch's pool chunk is recycled when the next batch is
         requested."""
-        ring: List[Tuple[int, DmaChunk, int, int]] = []  # (task, chunk, first, n)
+        # ring entries: (task_id, chunk, handle, first_chunk, MemCopyResult);
+        # task_id == 0 marks the buffered tail read (real ids start at 1)
+        ring: List[Tuple[int, DmaChunk, int, int, object]] = []
         prev: Optional[Batch] = None
 
         def submit_next() -> bool:
@@ -134,18 +147,28 @@ class TableScanner:
             if n == 0:
                 return False
             chunk = self.pool.alloc(owner=owner)
-            handle = self.session.map_buffer(chunk.view, kind="pinned_host")
-            if first < self.n_chunks:
-                ids = [first]
-                res = self.session.memcpy_ssd2ram(self.source, handle,
-                                                  ids, self.chunk_size)
-                ring.append((res.dma_task_id, chunk, handle, first, res))
-            else:
-                # tail: whole pages past the chunk grid, read buffered
-                nbytes = self._tail_pages * PAGE_SIZE
-                self.source.read_buffered(self.n_chunks * self.chunk_size,
-                                          chunk.view[:nbytes])
-                ring.append((0, chunk, handle, first, None))
+            handle = None
+            try:
+                handle = self.session.map_buffer(chunk.view, kind="pinned_host")
+                if first < self.n_chunks:
+                    ids = [first]
+                    res = self.session.memcpy_ssd2ram(self.source, handle,
+                                                      ids, self.chunk_size)
+                    ring.append((res.dma_task_id, chunk, handle, first, res))
+                else:
+                    # tail: whole pages past the chunk grid, read buffered
+                    nbytes = self._tail_pages * PAGE_SIZE
+                    self.source.read_buffered(self.n_chunks * self.chunk_size,
+                                              chunk.view[:nbytes])
+                    ring.append((0, chunk, handle, first, None))
+            except BaseException:
+                # failed submissions must not strand the chunk/handle
+                # (memcpy_ssd2ram has already waited out its own in-flight
+                # work before raising, so the buffer is idle here)
+                if handle is not None:
+                    self.session.unmap_buffer(handle)
+                chunk.release()
+                raise
             return True
 
         try:
@@ -233,6 +256,12 @@ class TableScanner:
                 (acc.items() if isinstance(acc, dict) else acc)}
 
     def close(self) -> None:
+        if self._prev_affinity is not None:
+            try:
+                os.sched_setaffinity(0, self._prev_affinity)
+            except OSError:
+                pass
+            self._prev_affinity = None
         if self._own_pool:
             self.pool.close()
         if self._own_session:

@@ -11,7 +11,7 @@ Capability analog of the pgsql extension's planner integration
 * **size threshold** — the direct path only pays off when the table cannot
   live in the host page cache; the reference gates on
   ``(RAM − shared_buffers)·⅔ + shared_buffers`` (`:1544-1559`), overridable
-  by ``debug_no_threshold``.  Here RAM comes from MemAvailable and the
+  by ``debug_no_threshold``.  Here RAM comes from /proc MemTotal and the
   "shared_buffers" analog is the configured staging pool size.
 * **cost model** — per-page cost with the reduced ``seq_page_cost`` GUC
   (default ¼ of the conventional cost, `:1614-1625`) and a parallel divisor

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import errno as _errno
 import threading
+import time
 import warnings
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
@@ -36,6 +37,7 @@ class DmaChunk:
     index: int
     view: memoryview
     owner: Optional["ResourceOwner"] = None
+    allocated: bool = False
 
     def release(self) -> None:
         self.pool.free(self)
@@ -127,6 +129,7 @@ class DmaBufferPool:
         if preferred_node in self._free:
             order.remove(preferred_node)
             order.insert(0, preferred_node)
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
                 if self._closed:
@@ -134,19 +137,30 @@ class DmaBufferPool:
                 for node in order:
                     if self._free[node]:
                         chunk = self._free[node].pop()
+                        chunk.allocated = True
                         self._outstanding += 1
                         if owner is not None:
                             owner._attach(chunk)
                         return chunk
                 if not blocking:
                     raise StromError(_errno.ENOMEM, "pool exhausted")
-                if not self._lock.wait(timeout):
+                remain = None if deadline is None else deadline - time.monotonic()
+                if remain is not None and remain <= 0:
+                    raise StromError(_errno.ETIMEDOUT, "pool alloc timeout")
+                if not self._lock.wait(remain):
                     raise StromError(_errno.ETIMEDOUT, "pool alloc timeout")
 
     def free(self, chunk: DmaChunk) -> None:
+        """Return a chunk to the freelist.  Idempotent: abort paths can race
+        the owner's cleanup with the consumer's (e.g. a ResourceOwner exit
+        and a generator finally both releasing the same chunk) — the second
+        release is a no-op rather than a freelist double-insert."""
         if chunk.owner is not None:
             chunk.owner._detach(chunk)
         with self._lock:
+            if not chunk.allocated:
+                return
+            chunk.allocated = False
             self._free[chunk.node].append(chunk)
             self._outstanding -= 1
             self._lock.notify()

@@ -252,3 +252,92 @@ def test_scanner_steady_state_many_chunks(tmp_path):
         assert sc.n_chunks == 16  # well beyond depth+1
         total = sum(b.pages.shape[0] for b in sc.batches())
     assert total == 64
+
+
+def test_pool_double_free_is_idempotent():
+    """Abort paths can release the same chunk from both the ResourceOwner
+    exit and a generator finally — the freelist must not double-insert."""
+    with DmaBufferPool(chunk_size=64 << 10, total_size=256 << 10) as pool:
+        c = pool.alloc()
+        c.release()
+        c.release()  # no-op
+        assert pool.outstanding == 0
+        seen = {id(pool.alloc(blocking=False)) for _ in range(0)}
+        chunks = [pool.alloc(blocking=False) for _ in range(4)]
+        assert len({ch.index for ch in chunks}) == 4  # no duplicate handout
+        for ch in chunks:
+            ch.release()
+
+
+def test_scan_filter_exception_does_not_poison_pool(heap_file):
+    """A filter_fn raising mid-scan must leave the pool balanced so a
+    follow-up scan on the same scanner works."""
+    path, schema, c0, c1 = heap_file
+
+    class Boom(RuntimeError):
+        pass
+
+    with TableScanner(path, schema, chunk_size=CHUNK, async_depth=2,
+                      numa_bind=False) as sc:
+        calls = {"n": 0}
+
+        def bad_filter(pages):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise Boom()
+            return {"count": np.int32(0)}
+
+        with pytest.raises(Boom):
+            sc.scan_filter(bad_filter)
+        assert sc.pool.outstanding == 0
+        # pool must still hand out every chunk exactly once
+        chunks = [sc.pool.alloc(blocking=False) for _ in range(sc.pool.n_chunks)]
+        assert len({(ch.node, ch.index) for ch in chunks}) == sc.pool.n_chunks
+        for ch in chunks:
+            ch.release()
+
+
+def test_scanner_rejects_non_pow2_chunk_size(heap_file):
+    path, schema, *_ = heap_file
+    with pytest.raises(StromError) as ei:
+        TableScanner(path, schema, chunk_size=3 * PAGE_SIZE, numa_bind=False)
+    assert ei.value.errno == errno.EINVAL
+
+
+def test_pool_alloc_timeout_is_a_deadline():
+    """The alloc timeout must be a deadline: spurious wakeups while the pool
+    stays empty must not re-arm the full wait."""
+    import time
+
+    with DmaBufferPool(chunk_size=64 << 10, total_size=128 << 10) as pool:
+        held = [pool.alloc(), pool.alloc()]
+        stop = threading.Event()
+
+        def poker():
+            while not stop.is_set():
+                with pool._lock:
+                    pool._lock.notify_all()
+                time.sleep(0.02)
+
+        t = threading.Thread(target=poker, daemon=True)
+        t.start()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(StromError) as ei:
+                pool.alloc(timeout=0.4)
+            elapsed = time.monotonic() - t0
+            assert ei.value.errno == errno.ETIMEDOUT
+            assert elapsed < 2.0, f"timeout re-armed: waited {elapsed:.1f}s"
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            held[0].release()
+            held[1].release()
+
+
+def test_numa_affinity_restored_on_close(heap_file):
+    path, schema, *_ = heap_file
+    before = os.sched_getaffinity(0)
+    sc = TableScanner(path, schema, chunk_size=CHUNK, numa_bind=True)
+    sc.close()
+    assert os.sched_getaffinity(0) == before
