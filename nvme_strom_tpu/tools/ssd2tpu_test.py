@@ -111,9 +111,14 @@ def main(argv=None) -> int:
         from ..hbm.staging import _land
         handle = registry.map_device_memory(nbytes, device=dev)
         registry.get(handle).array.block_until_ready()
-        t0 = time.monotonic()  # setup (device alloc) excluded, as in direct mode
         hbm = registry.acquire(handle)
         try:
+            # warmup: compile the landing kernels + first-touch the H2D path
+            # with the run's real shapes, outside the timed region
+            warm = jax.device_put(np.zeros(min(args.vfs, nbytes), np.uint8), dev)
+            _land(hbm, warm, 0, args.vfs)
+            registry.get(handle).array.block_until_ready()
+            t0 = time.monotonic()
             with open(args.file, "rb", buffering=0) as f:
                 off = 0
                 while off < nbytes:
@@ -132,6 +137,19 @@ def main(argv=None) -> int:
             handle = registry.map_device_memory(nbytes, device=dev)
             with StagingPipeline(sess, n_buffers=args.segments,
                                  staging_bytes=args.segment_size) as pipe:
+                # warmup: one full staged batch compiles the landing kernels
+                # and first-touches the H2D path with the run's real shapes,
+                # outside the timed region
+                per_batch = args.segment_size // chunk
+                warm_chunks = min(per_batch, n_chunks)
+                pipe.memcpy_ssd2dev(src, handle, list(range(warm_chunks)), chunk)
+                rem = n_chunks % per_batch
+                if rem and rem != warm_chunks:
+                    # the run's final partial batch lands with its own shape
+                    pipe.memcpy_ssd2dev(src, handle, list(range(rem)), chunk)
+                registry.get(handle).array.block_until_ready()
+                if not args.no_drop_cache:
+                    drop_page_cache(args.file)
                 for loop in range(args.loops):
                     if loop and not args.no_drop_cache:
                         drop_page_cache(args.file)
