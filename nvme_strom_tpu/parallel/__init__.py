@@ -1,3 +1,8 @@
-from .dscan import make_distributed_scan_step
+from .dscan import make_distributed_scan_step, shard_pages
+from .mesh import make_scan_mesh, pages_sharding
+from .ring import make_ring_multi_query_scan
+from .stream import load_pages_sharded
 
-__all__ = ["make_distributed_scan_step"]
+__all__ = ["make_distributed_scan_step", "shard_pages", "make_scan_mesh",
+           "pages_sharding", "make_ring_multi_query_scan",
+           "load_pages_sharded"]

@@ -1,0 +1,61 @@
+"""Mesh construction and sharding rules for the distributed scan engine.
+
+The reference's scale-out story is storage-side: RAID-0 striping across
+NVMe devices (`kmod/nvme_strom.c:823-910`) and process-parallel scans over
+a shared cursor (`pgsql/nvme_strom.c:1057-1112`).  The TPU rebuild scales
+compute-side with one idiom: pick a `jax.sharding.Mesh`, annotate shardings,
+let XLA insert the collectives (SURVEY.md SS5.8).
+
+Axes used by this framework:
+
+* ``dp`` — data parallel: page batches are split along their leading axis
+  (the atomic-cursor analog; each device scans a disjoint page subset).
+* ``sp`` — schema/column parallel: wide schemas split their column set so
+  each lane decodes and aggregates only its columns (the tensor-parallel
+  analog for tabular scans).
+
+``dp`` is laid out on the fastest-varying (innermost, ICI-contiguous)
+device dimension so page streaming collectives ride ICI; ``sp`` lanes see
+replicated pages, so their only collective is the tiny aggregate psum.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_scan_mesh", "pages_sharding", "replicated"]
+
+
+def make_scan_mesh(devices: Optional[Sequence[jax.Device]] = None, *,
+                   sp: int = 1) -> Mesh:
+    """Build a ``(dp, sp)`` mesh over *devices* (default: all devices).
+
+    ``sp`` must divide the device count; ``dp`` is the remainder of the
+    factorization.  ``sp == 1`` gives the pure data-parallel mesh.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if sp <= 0 or n % sp:
+        raise ValueError(f"sp={sp} must divide the device count {n}")
+    grid = np.asarray(devs).reshape(sp, n // sp)
+    # dp innermost: adjacent devices (ICI neighbours on TPU) differ in dp
+    return Mesh(grid, axis_names=("sp", "dp"))
+
+
+def pages_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a page batch (B, PAGE_SIZE): split over dp, replicated
+    over sp lanes."""
+    return NamedSharding(mesh, P("dp", None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(pages_np: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Place a host page batch across the mesh's dp axis (sp-replicated)."""
+    return jax.device_put(pages_np, pages_sharding(mesh))

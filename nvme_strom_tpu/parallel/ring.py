@@ -1,0 +1,97 @@
+"""Ring-streaming scan: rotate page blocks around the mesh with ppermute.
+
+The long-sequence scaling substrate (SURVEY.md SS5.7 maps the reference's
+chunked/bounded-depth streaming onto the TPU).  For a *single* commutative
+aggregate, sharding + psum (:mod:`.dscan`) is optimal.  The ring earns its
+keep when every device needs to see the **whole** stream but no device can
+hold it — the same access pattern as ring attention (each query block
+visits every KV block): here, N *different* scan queries each need the
+full table, and each device holds only 1/N of the pages.
+
+Topology: each device starts with its local page shard and its own query
+(threshold).  At every step it aggregates its query over the resident
+block, then forwards the block to its ring neighbour with
+``jax.lax.ppermute`` — the collective rides ICI, communication overlaps
+the next block's compute (XLA schedules the ppermute DMA concurrently),
+and after ``dp`` steps every query has seen every page with per-device
+memory = one shard + one in-flight block.
+
+Peak per-device memory stays O(B/dp) regardless of table size, which is
+exactly the property ring attention buys for sequence length.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.filter_xla import DEFAULT_SCHEMA, decode_pages
+from ..scan.heap import HeapSchema
+from .mesh import make_scan_mesh
+
+__all__ = ["make_ring_multi_query_scan"]
+
+
+def make_ring_multi_query_scan(devices: Optional[Sequence[jax.Device]] = None,
+                               *, schema: HeapSchema = DEFAULT_SCHEMA,
+                               predicate=None):
+    """Build the jitted ring scan over a 1-D dp mesh.
+
+    Returns ``(run, mesh)``.  ``run(pages_np, thresholds_np)`` takes a page
+    batch (leading axis divisible by the ring size) and one threshold per
+    device; result ``{"count": (dp,), "sums": (dp, n_cols)}`` holds, for
+    each query *q*, the aggregate over the ENTIRE page batch.
+
+    *predicate* as in :func:`..parallel.dscan.make_distributed_scan_step`.
+    """
+    mesh = make_scan_mesh(devices, sp=1)
+    ring = mesh.shape["dp"]
+    pred = predicate or (lambda cols, th: cols[0] > th)
+    n_cols = schema.n_cols
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def _local(pages_u8, threshold):
+        # threshold: (1,) — this device's own query
+        th = threshold[0]
+
+        def body(carry, _):
+            block, count, sums = carry
+            cols, valid = decode_pages(block, schema)
+            sel = valid & pred(cols, th)
+            count = count + jnp.sum(sel.astype(jnp.int32))
+            sums = sums + jnp.stack([jnp.sum(jnp.where(sel, c, 0))
+                                     for c in cols])
+            # forward the resident block to the next ring member; the
+            # rotation is what lets every query visit every page
+            block = jax.lax.ppermute(block, "dp", perm)
+            return (block, count, sums), None
+
+        # accumulators are per-device state: mark them dp-varying so the
+        # scan carry types match the rotating (varying) block
+        init = (pages_u8,
+                jax.lax.pvary(jnp.int32(0), "dp"),
+                jax.lax.pvary(jnp.zeros((n_cols,), jnp.int32), "dp"))
+        (block, count, sums), _ = jax.lax.scan(body, init, None, length=ring)
+        # leading axis 1: shard_map concatenates over the mesh into (dp,...)
+        return {"count": count[None], "sums": sums[None]}
+
+    shard_mapped = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P("dp", None), P("dp")),
+        out_specs={"count": P("dp"), "sums": P("dp", None)})
+    step = jax.jit(shard_mapped)
+
+    def run(pages_np: np.ndarray, thresholds_np: np.ndarray):
+        if len(thresholds_np) != ring:
+            raise ValueError(f"need {ring} thresholds (one per ring member), "
+                             f"got {len(thresholds_np)}")
+        pages = jax.device_put(pages_np, NamedSharding(mesh, P("dp", None)))
+        ths = jax.device_put(np.asarray(thresholds_np, np.int32),
+                             NamedSharding(mesh, P("dp")))
+        return step(pages, ths)
+
+    return run, mesh

@@ -1,0 +1,83 @@
+"""Sharded direct loading: stripe a source across the device mesh.
+
+The RAID-0 fan-out analog over the mesh (SURVEY.md SS5.8c): where the
+reference stripes one logical stream across NVMe members in-kernel
+(`kmod/nvme_strom.c:823-910`), here the *destination* is striped — every
+device owns a disjoint page range of the global array, and each process
+direct-loads only the ranges of its **addressable** devices, so the loader
+is multi-host correct by construction (each host reads its own shard from
+its own storage; no cross-host data moves at load time — the collectives
+that later consume the array ride ICI/DCN).
+
+The global array is assembled with
+``jax.make_array_from_single_device_arrays`` — no host ever materializes
+the full table.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..api import StromError
+from ..engine import Session, Source
+from ..scan.heap import PAGE_SIZE
+
+__all__ = ["load_pages_sharded"]
+
+
+def load_pages_sharded(source: Source, mesh: Mesh, *,
+                       session: Optional[Session] = None,
+                       axis: str = "dp") -> jax.Array:
+    """Direct-load a page-formatted source into a (n_pages, PAGE_SIZE)
+    global array sharded over *axis* of *mesh*.
+
+    Each addressable device's row range is read through the engine's
+    direct path (page-granular chunks) into a pinned buffer and placed on
+    that device; the returned global array is sharded ``P(axis, None)``.
+    ``n_pages`` must divide evenly by the axis size.
+    """
+    if source.size % PAGE_SIZE:
+        raise StromError(22, f"source size {source.size} not page-aligned")
+    n_pages = source.size // PAGE_SIZE
+    n_shards = mesh.shape[axis]
+    if n_pages % n_shards:
+        raise StromError(22, f"{n_pages} pages not divisible by {n_shards} "
+                             f"'{axis}' shards; pad the source")
+    sharding = NamedSharding(mesh, P(axis, None))
+    global_shape = (n_pages, PAGE_SIZE)
+    idx_map = sharding.addressable_devices_indices_map(global_shape)
+
+    own_session = session is None
+    sess = session or Session()
+    shards = []
+    try:
+        for dev, idx in idx_map.items():
+            rows = idx[0]
+            r0 = rows.start or 0
+            r1 = rows.stop if rows.stop is not None else n_pages
+            nbytes = (r1 - r0) * PAGE_SIZE
+            handle, buf = sess.alloc_dma_buffer(nbytes)
+            try:
+                res = sess.memcpy_ssd2ram(source, handle,
+                                          list(range(r0, r1)), PAGE_SIZE)
+                sess.memcpy_wait(res.dma_task_id)
+                # chunk granularity == page, so reordering cannot occur
+                # across pages; still, land pages at their true slots
+                host = np.frombuffer(buf.view()[:nbytes], np.uint8).reshape(
+                    r1 - r0, PAGE_SIZE)
+                if res.chunk_ids != list(range(r0, r1)):
+                    order = np.argsort(np.asarray(res.chunk_ids))
+                    host = host[order]
+                shards.append(jax.device_put(np.ascontiguousarray(host), dev))
+            finally:
+                sess.unmap_buffer(handle)
+                buf.close()
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, shards)
+    finally:
+        if own_session:
+            sess.close()
