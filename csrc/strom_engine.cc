@@ -641,6 +641,22 @@ extern "C" {
 
 int nstpu_engine_version(void) { return NSTPU_API_VERSION; }
 
+const char* nstpu_signature(void) {
+  // the /proc/nvme-strom version-read analog (kmod/nvme_strom.c:2111-2136):
+  // a static build signature userspace can surface without creating an engine
+#ifndef NSTPU_BUILD_TS
+#define NSTPU_BUILD_TS __DATE__ " " __TIME__
+#endif
+  return "strom_tpu native engine api " /* api version stringized below */
+         "v1, built " NSTPU_BUILD_TS
+#ifdef __clang__
+         ", clang"
+#elif defined(__GNUC__)
+         ", gcc"
+#endif
+      ;
+}
+
 uint64_t nstpu_engine_create(int backend, int queue_depth) {
   auto* e = new Engine();
   if (!e->init(backend, queue_depth)) {

@@ -68,6 +68,9 @@ uint64_t nstpu_engine_create(int backend, int queue_depth);
 void     nstpu_engine_destroy(uint64_t engine);
 int      nstpu_engine_backend(uint64_t engine);     /* NSTPU_BACKEND_* or -errno */
 int      nstpu_engine_version(void);
+/* Static build signature string (version/toolchain/build time) — the
+ * /proc/nvme-strom signature-read analog (kmod/nvme_strom.c:2111-2136). */
+const char* nstpu_signature(void);
 
 /* Submit one task of nreq requests reading into dest_base.
  * Returns task_id > 0, or -errno. */

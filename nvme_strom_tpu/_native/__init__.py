@@ -83,12 +83,28 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.nstpu_engine_stats.argtypes = [ctypes.c_uint64,
                                            ctypes.POINTER(ctypes.c_uint64),
                                            ctypes.c_int32]
+        try:
+            lib.nstpu_signature.restype = ctypes.c_char_p
+        except AttributeError:  # pragma: no cover - older .so
+            pass
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def native_signature() -> Optional[str]:
+    """Build signature of the loaded .so (the /proc/nvme-strom
+    version-read analog), or None when the native engine is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    try:
+        return lib.nstpu_signature().decode()
+    except AttributeError:
+        return f"strom_tpu native engine api v{lib.nstpu_engine_version()}"
 
 
 class NativeEngine:

@@ -1,0 +1,169 @@
+"""strom_check — environment doctor for the direct-load stack.
+
+Capability analog of the reference's ops tooling: where
+`utils/rhel7-kernel-check.sh` diffs vendored kernel headers against the
+running kernel and the `/proc/nvme-strom` read exposes the module's build
+signature (`kmod/nvme_strom.c:2111-2136`), this tool probes every runtime
+capability the TPU framework depends on and reports drift with fix advice
+(the sysctl/limits provisioning in `deploy/` mirrors
+`kmod/sysctl-nvmestrom.conf` and `kmod/limits-nvmestrom.conf`).
+
+Checks: kernel + io_uring availability, O_DIRECT on a target path, hugepage
+provisioning, memlock limits, NUMA topology, JAX backend/devices, native
+engine build signature.
+
+Usage: strom_check [-v] [--path DIR] [--jax]
+Exit code: 0 all required checks pass, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+import resource
+import sys
+import tempfile
+
+OK, WARN, FAIL = "ok", "warn", "FAIL"
+
+
+def _report(name: str, status: str, detail: str, advice: str = "") -> bool:
+    mark = {OK: " ok ", WARN: "warn", FAIL: "FAIL"}[status]
+    print(f"[{mark}] {name:<22} {detail}")
+    if advice and status != OK:
+        print(f"       -> {advice}")
+    return status != FAIL
+
+
+def check_kernel() -> bool:
+    rel = platform.release()
+    try:
+        major, minor = (int(x) for x in rel.split(".")[:2])
+        has_uring = (major, minor) >= (5, 1)
+    except ValueError:
+        has_uring = False
+    return _report("kernel", OK if has_uring else WARN, rel,
+                   "io_uring needs Linux >= 5.1; the threadpool backend "
+                   "will be used instead")
+
+
+def check_io_uring() -> bool:
+    from .. import _native
+    if not _native.native_available():
+        return _report("native engine", FAIL, "libstrom_tpu.so not loadable",
+                       "build it: make -C csrc (needs g++)")
+    try:
+        eng = _native.NativeEngine("io_uring", 8)
+        eng.close()
+        return _report("io_uring", OK, "available")
+    except Exception as e:
+        return _report("io_uring", WARN, f"unavailable ({e})",
+                       "check /proc/sys/kernel/io_uring_disabled; the "
+                       "threadpool backend will be used instead")
+
+
+def check_odirect(path: str) -> bool:
+    try:
+        fd, tmp = tempfile.mkstemp(dir=path)
+        os.write(fd, b"\0" * 4096)
+        os.close(fd)
+        try:
+            d = os.open(tmp, os.O_RDONLY | os.O_DIRECT)
+            os.close(d)
+            return _report("O_DIRECT", OK, path)
+        finally:
+            os.unlink(tmp)
+    except OSError as e:
+        return _report("O_DIRECT", FAIL, f"{path}: {e}",
+                       "direct loads need an O_DIRECT-capable filesystem "
+                       "(ext4/xfs; tmpfs does not qualify)")
+
+
+def check_hugepages() -> bool:
+    total = free = size_kb = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("HugePages_Total"):
+                    total = int(line.split()[1])
+                elif line.startswith("HugePages_Free"):
+                    free = int(line.split()[1])
+                elif line.startswith("Hugepagesize"):
+                    size_kb = int(line.split()[1])
+    except OSError:
+        pass
+    if total:
+        return _report("hugepages", OK,
+                       f"{free}/{total} free x {size_kb >> 10}MB")
+    return _report("hugepages", WARN, "none provisioned",
+                   "sysctl vm.nr_hugepages=2048 (see deploy/sysctl-strom-"
+                   "tpu.conf); pinned buffers fall back to 4KB pages")
+
+
+def check_memlock() -> bool:
+    soft, hard = resource.getrlimit(resource.RLIMIT_MEMLOCK)
+    inf = resource.RLIM_INFINITY
+
+    def fmt(v):
+        return "unlimited" if v == inf else f"{v >> 20}MB"
+    need = 4 << 30
+    status = OK if (soft == inf or soft >= need) else WARN
+    return _report("memlock rlimit", status, f"soft {fmt(soft)} hard {fmt(hard)}",
+                   "raise to >= 4GB (see deploy/limits-strom-tpu.conf); "
+                   "mlock of staging buffers will silently degrade")
+
+
+def check_numa() -> bool:
+    from ..numa import nodes_with_memory
+    nodes = nodes_with_memory()
+    return _report("numa", OK, f"nodes with memory: {nodes}")
+
+
+def check_native_signature() -> bool:
+    from .. import __version__, _native
+    sig = _native.native_signature()
+    if sig is None:
+        return _report("signature", WARN, f"python {__version__}, no native .so",
+                       "make -C csrc")
+    return _report("signature", OK, f"python {__version__}; {sig}")
+
+
+def check_jax() -> bool:
+    try:
+        import jax
+        devs = jax.devices()
+        kinds = {d.platform for d in devs}
+        status = OK if any(k != "cpu" for k in kinds) else WARN
+        return _report("jax", status,
+                       f"{jax.__version__}, {len(devs)} device(s) {sorted(kinds)}",
+                       "no accelerator visible; HBM loads will target CPU "
+                       "buffers")
+    except Exception as e:
+        return _report("jax", FAIL, f"import failed: {e}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="strom_check", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--path", default=".",
+                    help="directory to probe for O_DIRECT (default: cwd)")
+    ap.add_argument("--jax", action="store_true",
+                    help="also probe the JAX backend (initializes a device)")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for fn in (check_kernel, check_io_uring,
+               lambda: check_odirect(args.path),
+               check_hugepages, check_memlock, check_numa,
+               check_native_signature):
+        ok = fn() and ok
+    if args.jax:
+        ok = check_jax() and ok
+    print("all required checks passed" if ok else "REQUIRED CHECKS FAILED",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""Environment doctor + native build signature (the L5 ops tier)."""
+
+import subprocess
+import sys
+
+from nvme_strom_tpu._native import native_available, native_signature
+
+
+def test_native_signature_present():
+    if not native_available():
+        assert native_signature() is None
+        return
+    sig = native_signature()
+    assert sig and "strom_tpu native engine" in sig
+
+
+def test_strom_check_runs_clean(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "nvme_strom_tpu.tools.strom_check",
+         "--path", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "signature" in out.stdout
+    assert "O_DIRECT" in out.stdout
+
+
+def test_strom_check_fails_on_bad_path(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "nvme_strom_tpu.tools.strom_check",
+         "--path", str(tmp_path / "nope")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
