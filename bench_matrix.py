@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""bench_matrix.py — run every BASELINE.json config; write BENCH_MATRIX.json.
+
+The five configs (BASELINE.md "Rebuild targets"):
+
+1. ssd2ram  : sequential O_DIRECT SSD→pinned host RAM (CPU-only baseline)
+2. ssd2tpu  : single-file sequential SSD→TPU HBM (the headline, = bench.py)
+3. ssd2tpu32: async multi-queue (32 outstanding requests)
+4. raid0    : 4-member striped source → single HBM region
+5. scan     : heap SeqScan direct-to-HBM + device filter kernel (pgsql analog)
+
+Each config runs in a fresh subprocess (PJRT/tunnel state isolation) with a
+cooldown between runs (the tunnel's H2D limiter is a token bucket — see
+BENCH notes).  Prints one human line per config and writes the JSON matrix.
+
+Env: BENCH_SIZE_MB (default 512), BENCH_COOLDOWN_S (default 30),
+BENCH_SMOKE=1 (64MB, no cooldown).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _run(code: str, extra_env=None) -> float:
+    """Run a python snippet in a subprocess; it must print GBPS=<float>."""
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, cwd=REPO, env=_env(extra_env), timeout=3600)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise SystemExit("bench config failed")
+    m = re.search(r"GBPS=([0-9.]+)", out.stdout)
+    if not m:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise SystemExit("no GBPS in output")
+    return float(m.group(1))
+
+
+_COMMON = """
+import os, time, numpy as np
+from nvme_strom_tpu.testing import make_test_file
+from nvme_strom_tpu.tools.common import drop_page_cache
+size = {size}
+"""
+
+_SSD2RAM = _COMMON + """
+from nvme_strom_tpu import open_source, Session
+path = {path!r}
+make_test_file(path, size) if not (os.path.exists(path) and os.path.getsize(path) == size) else None
+drop_page_cache(path)
+with open_source(path) as src, Session() as s:
+    h, buf = s.alloc_dma_buffer(size)
+    t0 = time.monotonic()
+    res = s.memcpy_ssd2ram(src, h, list(range(size >> 20)), 1 << 20)
+    s.memcpy_wait(res.dma_task_id)
+    dt = time.monotonic() - t0
+print(f"GBPS={{size/dt/(1<<30):.3f}}")
+"""
+
+_SSD2TPU = _COMMON + """
+import subprocess, sys, re
+path = {path!r}
+make_test_file(path, size) if not (os.path.exists(path) and os.path.getsize(path) == size) else None
+out = subprocess.run([sys.executable, "-m", "nvme_strom_tpu.tools.ssd2tpu_test",
+                      path, "-n", "{segs}", "-s", "16m"],
+                     capture_output=True, text=True, timeout=1800)
+if out.returncode != 0:
+    sys.stderr.write(out.stdout + out.stderr); raise SystemExit(1)
+m = re.search(r"=> ([0-9.]+) GB/s", out.stdout)
+print(f"GBPS={{float(m.group(1)):.3f}}")
+"""
+
+_RAID0 = _COMMON + """
+from nvme_strom_tpu.engine import StripedSource, Session
+members = []
+per = size // 4
+for i in range(4):
+    p = {path!r} + f".m{{i}}"
+    if not (os.path.exists(p) and os.path.getsize(p) == per):
+        make_test_file(p, per, seed=i)
+    drop_page_cache(p)
+    members.append(p)
+src = StripedSource(members, stripe_chunk_size=512 << 10)
+with Session() as s:
+    h, buf = s.alloc_dma_buffer(size)
+    t0 = time.monotonic()
+    res = s.memcpy_ssd2ram(src, h, list(range(size >> 20)), 1 << 20)
+    s.memcpy_wait(res.dma_task_id)
+    dt = time.monotonic() - t0
+src.close()
+print(f"GBPS={{size/dt/(1<<30):.3f}}")
+"""
+
+_SCAN = _COMMON + """
+import jax
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file, PAGE_SIZE
+from nvme_strom_tpu.scan.executor import TableScanner
+from nvme_strom_tpu.ops.filter_pallas import scan_filter_step_pallas
+path = {path!r} + ".heap"
+schema = HeapSchema(n_cols=2, visibility=True)
+t = schema.tuples_per_page
+n_pages = size // PAGE_SIZE
+if not (os.path.exists(path) and os.path.getsize(path) == n_pages * PAGE_SIZE):
+    rng = np.random.default_rng(0)
+    n = t * n_pages
+    build_heap_file(path, [rng.integers(-1000, 1000, n).astype(np.int32),
+                           rng.integers(0, 100, n).astype(np.int32)], schema)
+drop_page_cache(path)
+th = jax.device_put(np.int32(100))
+fn = lambda pages: scan_filter_step_pallas(pages, th)
+# warm the kernel with one batch-shaped input outside the timed region
+warm = np.zeros((min(2048, n_pages), PAGE_SIZE), np.uint8)
+jax.block_until_ready(fn(jax.device_put(warm)))
+with TableScanner(path, schema, numa_bind=False) as sc:
+    t0 = time.monotonic()
+    out = sc.scan_filter(fn)
+    dt = time.monotonic() - t0
+nbytes = n_pages * PAGE_SIZE
+print("result:", {{k: int(v) for k, v in out.items()}})
+print(f"GBPS={{nbytes/dt/(1<<30):.3f}}")
+"""
+
+
+def main() -> int:
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "512"))
+    cooldown = 0 if smoke else int(os.environ.get("BENCH_COOLDOWN_S", "30"))
+    size = size_mb << 20
+    base = f"/tmp/strom_matrix_{size_mb}"
+
+    configs = [
+        ("ssd2ram_seq", "SSD->pinned RAM, O_DIRECT seq",
+         _SSD2RAM.format(size=size, path=base + ".bin"), None),
+        ("ssd2tpu_seq", "SSD->TPU HBM, single file",
+         _SSD2TPU.format(size=size, path=base + ".bin", segs=6), None),
+        ("ssd2tpu_mq32", "SSD->TPU HBM, 32 outstanding",
+         _SSD2TPU.format(size=size, path=base + ".bin", segs=8),
+         {"STROM_TPU_QUEUE_DEPTH": "32"}),
+        ("raid0_4x", "4-member RAID-0 -> pinned RAM",
+         _RAID0.format(size=size, path=base), None),
+        ("scan_filter", "heap scan -> HBM + pallas filter",
+         _SCAN.format(size=size, path=base), None),
+    ]
+    results = {}
+    for i, (key, desc, code, env) in enumerate(configs):
+        if i and cooldown:
+            time.sleep(cooldown)
+        gbps = _run(code, env)
+        results[key] = gbps
+        print(f"{key:<14} {desc:<34} {gbps:7.3f} GB/s")
+    path = os.path.join(REPO, "BENCH_MATRIX.json")
+    with open(path, "w") as f:
+        json.dump({"size_mb": size_mb, "unit": "GB/s", "results": results}, f,
+                  indent=2)
+        f.write("\n")
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

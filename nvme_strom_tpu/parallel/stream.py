@@ -16,7 +16,7 @@ the full table.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 import jax
 import numpy as np
@@ -26,7 +26,7 @@ from ..api import StromError
 from ..engine import Session, Source
 from ..scan.heap import PAGE_SIZE
 
-__all__ = ["load_pages_sharded"]
+__all__ = ["load_pages_sharded", "ShardedBatchStream", "distributed_scan_filter"]
 
 
 def load_pages_sharded(source: Source, mesh: Mesh, *,
@@ -81,3 +81,134 @@ def load_pages_sharded(source: Source, mesh: Mesh, *,
     finally:
         if own_session:
             sess.close()
+
+
+class ShardedBatchStream:
+    """Stream fixed-size page batches to the mesh with submit-ahead DMA.
+
+    The distributed form of the executor's async ring (`pgsql/nvme_strom.c:
+    862-936`): while the consumer's step runs on batch *b*, batch *b+1*'s
+    SSD DMAs are already in flight into a second set of pinned buffers
+    (one double-buffer pair per addressable device).  Buffer reuse is
+    fenced on the previous batch's device arrays being ready — the H2D
+    read must complete before the SSD engine overwrites the pinned pages.
+
+    Yields ``(first_page, global_array)`` with the array sharded
+    ``P(axis, None)`` over *mesh* — ready for a shard_map'ed step.
+    ``batch_pages`` must divide by the axis size; the final partial batch
+    is dropped if it cannot fill every shard evenly (callers scan tails
+    separately, as with the executor's tail path).
+    """
+
+    def __init__(self, source: Source, mesh: Mesh, *, batch_pages: int,
+                 session: Optional[Session] = None, axis: str = "dp"):
+        n_shards = mesh.shape[axis]
+        if batch_pages <= 0 or batch_pages % n_shards:
+            raise StromError(22, f"batch_pages {batch_pages} must divide by "
+                                 f"{n_shards} '{axis}' shards")
+        if source.size % PAGE_SIZE:
+            raise StromError(22, "source size not page-aligned")
+        self.source = source
+        self.mesh = mesh
+        self.axis = axis
+        self.batch_pages = batch_pages
+        self.n_pages = source.size // PAGE_SIZE
+        self.n_batches = self.n_pages // batch_pages
+        self.sharding = NamedSharding(mesh, P(axis, None))
+        self._own_session = session is None
+        self.session = session or Session()
+        self._shape = (batch_pages, PAGE_SIZE)
+        self._idx = list(self.sharding.addressable_devices_indices_map(
+            self._shape).items())
+        per_shard = batch_pages // n_shards * PAGE_SIZE
+        # double buffering: ring of 2 pinned buffers per addressable shard
+        self._bufs = [[self.session.alloc_dma_buffer(per_shard)
+                       for _ in range(2)] for _ in self._idx]
+        self._fence: List[Optional[jax.Array]] = [None, None]
+
+    def _submit(self, b: int):
+        ring = b % 2
+        if self._fence[ring] is not None:
+            self._fence[ring].block_until_ready()
+            self._fence[ring] = None
+        tasks = []
+        base = b * self.batch_pages
+        for k, (dev, idx) in enumerate(self._idx):
+            rows = idx[0]
+            r0 = base + (rows.start or 0)
+            r1 = base + (rows.stop if rows.stop is not None else self.batch_pages)
+            handle, _buf = self._bufs[k][ring]
+            res = self.session.memcpy_ssd2ram(
+                self.source, handle, list(range(r0, r1)), PAGE_SIZE)
+            tasks.append((dev, res))
+        return ring, tasks
+
+    def _collect(self, ring, tasks) -> jax.Array:
+        shards = []
+        for k, (dev, res) in enumerate(tasks):
+            self.session.memcpy_wait(res.dma_task_id)
+            _handle, buf = self._bufs[k][ring]
+            host = np.frombuffer(buf.view(), np.uint8).reshape(-1, PAGE_SIZE)
+            shards.append(jax.device_put(host, dev))
+        arr = jax.make_array_from_single_device_arrays(
+            self._shape, self.sharding, shards)
+        self._fence[ring] = arr
+        return arr
+
+    def __iter__(self):
+        if self.n_batches == 0:
+            return
+        pending = self._submit(0)
+        for b in range(self.n_batches):
+            nxt = self._submit(b + 1) if b + 1 < self.n_batches else None
+            arr = self._collect(*pending)
+            yield b * self.batch_pages, arr
+            pending = nxt
+
+    def close(self) -> None:
+        for ring in self._bufs:
+            for handle, buf in ring:
+                try:
+                    self.session.unmap_buffer(handle)
+                except StromError:
+                    pass
+                buf.close()
+        self._bufs = []
+        if self._own_session:
+            self.session.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def distributed_scan_filter(source: Source, mesh: Mesh, step, *,
+                            batch_pages: int,
+                            session: Optional[Session] = None,
+                            combine=None) -> dict:
+    """Fold a shard_map'ed *step* over the source, streamed batch-wise.
+
+    ``step(global_pages, ...)``-style callables from
+    :func:`..parallel.dscan.make_distributed_scan_step` take the threshold
+    positionally; here *step* is ``step(global_pages) -> dict`` (bind any
+    parameters with a lambda).  Results are summed per key (or folded with
+    *combine*).  This is the pgsql parallel SeqScan shape at mesh scale:
+    bounded memory (2 pinned buffers per shard + 1 resident batch per
+    device), SSD DMA / H2D / device compute all overlapped.
+    """
+    import jax as _jax
+
+    acc = None
+    with ShardedBatchStream(source, mesh, batch_pages=batch_pages,
+                            session=session) as stream:
+        for _first, arr in stream:
+            out = step(arr)
+            if acc is None:
+                acc = out
+            elif combine is not None:
+                acc = combine(acc, out)
+            else:
+                acc = _jax.tree.map(lambda a, b: a + b, acc, out)
+    return {} if acc is None else {k: np.asarray(v) for k, v in acc.items()}
