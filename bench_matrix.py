@@ -144,8 +144,12 @@ def main() -> int:
     configs = [
         ("ssd2ram_seq", "SSD->pinned RAM, O_DIRECT seq",
          _SSD2RAM.format(size=size, path=base + ".bin"), None),
+        # seq vs mq32 isolates async depth: the engine queue is capped at 4
+        # outstanding NVMe requests for the "seq" row and opened to the
+        # 32-deep multi-queue default for the mq32 row (BASELINE.md row 3)
         ("ssd2tpu_seq", "SSD->TPU HBM, single file",
-         _SSD2TPU.format(size=size, path=base + ".bin", segs=6), None),
+         _SSD2TPU.format(size=size, path=base + ".bin", segs=6),
+         {"STROM_TPU_QUEUE_DEPTH": "4"}),
         ("ssd2tpu_mq32", "SSD->TPU HBM, 32 outstanding",
          _SSD2TPU.format(size=size, path=base + ".bin", segs=8),
          {"STROM_TPU_QUEUE_DEPTH": "32"}),

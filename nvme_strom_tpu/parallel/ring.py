@@ -72,9 +72,15 @@ def make_ring_multi_query_scan(devices: Optional[Sequence[jax.Device]] = None,
 
         # accumulators are per-device state: mark them dp-varying so the
         # scan carry types match the rotating (varying) block
+        if hasattr(jax.lax, "pcast"):
+            def mark(x):
+                return jax.lax.pcast(x, "dp", to="varying")
+        else:  # older jax
+            def mark(x):
+                return jax.lax.pvary(x, "dp")
         init = (pages_u8,
-                jax.lax.pvary(jnp.int32(0), "dp"),
-                jax.lax.pvary(jnp.zeros((n_cols,), jnp.int32), "dp"))
+                mark(jnp.int32(0)),
+                mark(jnp.zeros((n_cols,), jnp.int32)))
         (block, count, sums), _ = jax.lax.scan(body, init, None, length=ring)
         # leading axis 1: shard_map concatenates over the mesh into (dp,...)
         return {"count": count[None], "sums": sums[None]}

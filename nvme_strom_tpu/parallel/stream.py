@@ -146,9 +146,15 @@ class ShardedBatchStream:
     def _collect(self, ring, tasks) -> jax.Array:
         shards = []
         for k, (dev, res) in enumerate(tasks):
-            self.session.memcpy_wait(res.dma_task_id)
+            done = self.session.memcpy_wait(res.dma_task_id)
             _handle, buf = self._bufs[k][ring]
             host = np.frombuffer(buf.view(), np.uint8).reshape(-1, PAGE_SIZE)
+            # slot i holds chunk chunk_ids[i]: with a partially cached
+            # source the engine fronts direct-I/O chunks and tails
+            # write-back chunks, so restore file order before placement
+            ids = np.asarray(done.chunk_ids)
+            if not np.array_equal(ids, np.sort(ids)):
+                host = np.ascontiguousarray(host[np.argsort(ids)])
             shards.append(jax.device_put(host, dev))
         arr = jax.make_array_from_single_device_arrays(
             self._shape, self.sharding, shards)
@@ -198,17 +204,11 @@ def distributed_scan_filter(source: Source, mesh: Mesh, step, *,
     bounded memory (2 pinned buffers per shard + 1 resident batch per
     device), SSD DMA / H2D / device compute all overlapped.
     """
-    import jax as _jax
+    from ..scan.executor import fold_results
 
     acc = None
     with ShardedBatchStream(source, mesh, batch_pages=batch_pages,
                             session=session) as stream:
         for _first, arr in stream:
-            out = step(arr)
-            if acc is None:
-                acc = out
-            elif combine is not None:
-                acc = combine(acc, out)
-            else:
-                acc = _jax.tree.map(lambda a, b: a + b, acc, out)
+            acc = fold_results(acc, step(arr), combine)
     return {} if acc is None else {k: np.asarray(v) for k, v in acc.items()}

@@ -32,7 +32,20 @@ from .heap import PAGE_SIZE, HeapSchema
 from .planner import capability_cache
 from .pool import DmaBufferPool, DmaChunk, ResourceOwner
 
-__all__ = ["LocalCursor", "Batch", "TableScanner"]
+__all__ = ["LocalCursor", "Batch", "TableScanner", "fold_results"]
+
+
+def fold_results(acc, out, combine: Optional[Callable] = None):
+    """Fold one batch result into the accumulator (sum per key by default).
+
+    Shared by :meth:`TableScanner.scan_filter` and the distributed
+    streaming fold in :func:`..parallel.stream.distributed_scan_filter`."""
+    if acc is None:
+        return out
+    if combine is not None:
+        return combine(acc, out)
+    import jax
+    return jax.tree.map(lambda a, b: a + b, acc, out)
 
 
 class LocalCursor:
@@ -243,13 +256,7 @@ class TableScanner:
                 # complete first.  The DMA ring keeps progressing in native
                 # threads while we wait, so overlap is preserved.
                 dev_pages.block_until_ready()
-                out = filter_fn(dev_pages)
-                if acc is None:
-                    acc = out
-                elif combine is not None:
-                    acc = combine(acc, out)
-                else:
-                    acc = jax.tree.map(lambda a, b: a + b, acc, out)
+                acc = fold_results(acc, filter_fn(dev_pages), combine)
         if acc is None:
             return {}
         return {k: np.asarray(v) for k, v in

@@ -77,6 +77,8 @@ def main(argv=None) -> int:
                     help="repeat the transfer; per-loop GB/s is printed and "
                          "the best loop reported (loop 1 pays jit compile)")
     args = ap.parse_args(argv)
+    if args.loops < 1:
+        ap.error("--loops must be >= 1")
 
     import jax
     import jax.numpy as jnp
@@ -118,15 +120,24 @@ def main(argv=None) -> int:
             warm = jax.device_put(np.zeros(min(args.vfs, nbytes), np.uint8), dev)
             _land(hbm, warm, 0, args.vfs)
             registry.get(handle).array.block_until_ready()
-            t0 = time.monotonic()
-            with open(args.file, "rb", buffering=0) as f:
-                off = 0
-                while off < nbytes:
-                    n = min(args.vfs, nbytes - off)
-                    part = jax.device_put(
-                        np.frombuffer(f.read(n), dtype=np.uint8), dev)
-                    _land(hbm, part, off, args.vfs)
-                    off += n
+            for loop in range(args.loops):
+                if not args.no_drop_cache:
+                    drop_page_cache(args.file)
+                tl = time.monotonic()
+                with open(args.file, "rb", buffering=0) as f:
+                    off = 0
+                    while off < nbytes:
+                        n = min(args.vfs, nbytes - off)
+                        part = jax.device_put(
+                            np.frombuffer(f.read(n), dtype=np.uint8), dev)
+                        _land(hbm, part, off, args.vfs)
+                        off += n
+                registry.get(handle).array.block_until_ready()
+                dt = time.monotonic() - tl
+                if args.loops > 1:
+                    print(f"  loop {loop + 1}: "
+                          f"{nbytes / dt / (1 << 30):.2f} GB/s")
+                best = dt if best is None else min(best, dt)
         finally:
             registry.release(hbm)
         arr = registry.get(handle).array
