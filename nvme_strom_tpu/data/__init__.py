@@ -1,0 +1,19 @@
+"""Data-consumer tier: record datasets, device loaders, checkpoint restore.
+
+The reference stops at "bytes land in device memory" (its consumer is the
+pgsql scan executor).  This tier supplies the two consumers a TPU user
+actually runs: a shuffled training-input pipeline (`DeviceLoader`) and
+direct-to-HBM checkpoint restore — both built on the same engine primitives
+(chunk-granular async DMA + merge planning + pinned staging) as the scan
+path, so they inherit the corruption oracles, stats, and error-retention
+semantics.
+"""
+
+from .records import RecordDataset, RecordWriter, write_records
+from .loader import DeviceLoader
+from .checkpoint import save_checkpoint, restore_checkpoint, checkpoint_info
+
+__all__ = [
+    "RecordDataset", "RecordWriter", "write_records", "DeviceLoader",
+    "save_checkpoint", "restore_checkpoint", "checkpoint_info",
+]

@@ -1,0 +1,309 @@
+"""Direct-to-HBM checkpoint restore (and the matching writer).
+
+The reference has no checkpoint subsystem (SURVEY.md SS5.4: stateless data
+path) — but restoring model state from NVMe into device memory is the
+flagship *use* of an SSD→HBM direct path on TPU, so this tier exceeds the
+reference rather than mirroring it.  Restore streams every tensor through
+the same pinned-staging/merge-planned DMA engine as the scan path; a
+sharded restore reads only the byte ranges owned by this process's
+addressable devices (the multi-host posture of `parallel/stream.py`).
+
+On-disk layout (single file)::
+
+    [ header: magic u64 | json_len u64 | header json, padded to 4096 ]
+    [ leaf 0 bytes, padded to 4096 ]
+    [ leaf 1 bytes, padded to 4096 ] ...
+
+Header json: ``{version, leaves: [{key, dtype, shape, offset, nbytes}]}``.
+Leaf offsets are 4096-aligned so restores ride the O_DIRECT path with a
+4KB chunk grid that the planner merges into ``dma_max_size`` requests
+(`engine.plan_requests`).
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import json
+import os
+import struct
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..api import StromError
+from ..engine import Session, open_source
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "checkpoint_info"]
+
+_MAGIC = 0x53544B50_54505531  # "STKP" "TPU1"
+_ALIGN = 4096
+_CHUNK = 4096          # restore chunk grid; contiguous ids merge to dma_max
+_VERSION = 1
+
+
+def _pad(n: int, align: int = _ALIGN) -> int:
+    return (n + align - 1) // align * align
+
+
+def _flatten(tree) -> List:
+    import jax
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in leaves]
+
+
+# -- save --------------------------------------------------------------------
+
+def save_checkpoint(path: str, tree: Any) -> Dict:
+    """Serialize a pytree of (fully addressable) arrays.
+
+    The writer is ordinary buffered I/O + fsync — the framework's job is
+    the *restore* direction; saving needs durability, not DMA.
+    """
+    import jax
+
+    flat = _flatten(tree)
+    entries = []
+    off = 0  # relative to data region start; offsets derive from sizes only
+    for key, leaf in flat:
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            raise StromError(_errno.EINVAL,
+                             f"leaf {key} is not fully addressable from this "
+                             f"process; gather before saving")
+        dtype = np.dtype(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
+        shape = tuple(int(s) for s in np.shape(leaf))
+        nbytes = int(dtype.itemsize * np.prod(shape, dtype=np.int64)) \
+            if shape else dtype.itemsize
+        entries.append({"key": key, "dtype": dtype.str, "shape": list(shape),
+                        "offset": off, "nbytes": nbytes})
+        off = _pad(off + nbytes)
+    header = json.dumps({"version": _VERSION, "leaves": entries}).encode()
+    header_len = _pad(16 + len(header))
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQ", _MAGIC, len(header)))
+        f.write(header)
+        f.write(b"\0" * (header_len - 16 - len(header)))
+        # stream one leaf at a time: peak extra host memory = one leaf,
+        # not the whole checkpoint
+        for e, (key, leaf) in zip(entries, flat):
+            f.seek(header_len + e["offset"])
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            if arr.dtype.str != e["dtype"]:
+                arr = arr.astype(np.dtype(e["dtype"]))
+            f.write(arr.data if arr.shape else arr.tobytes())
+        end = header_len + off
+        f.truncate(_pad(end))
+        f.flush()
+        os.fsync(f.fileno())
+    return {"path": path, "leaves": len(entries), "bytes": _pad(end)}
+
+
+# -- inspect -----------------------------------------------------------------
+
+def checkpoint_info(path: str) -> Dict:
+    """Read the header (magic check + leaf table) without touching data."""
+    with open(path, "rb") as f:
+        magic, jlen = struct.unpack("<QQ", f.read(16))
+        if magic != _MAGIC:
+            raise StromError(_errno.EINVAL, f"{path}: not a strom checkpoint")
+        meta = json.loads(f.read(jlen))
+    if meta.get("version") != _VERSION:
+        raise StromError(_errno.EINVAL, f"checkpoint version {meta.get('version')}")
+    meta["data_offset"] = _pad(16 + jlen)
+    return meta
+
+
+# -- restore -----------------------------------------------------------------
+
+def _leaf_sharding(shardings, key: str):
+    if shardings is None:
+        return None
+    if isinstance(shardings, dict):
+        return shardings.get(key)
+    return shardings  # one sharding for every leaf
+
+
+class _PinnedRing:
+    """Two alternating pinned buffers + H2D fencing for checkpoint restore."""
+
+    def __init__(self, sess: Session, staging_bytes: int):
+        self.sess = sess
+        self.bufs = [sess.alloc_dma_buffer(staging_bytes) for _ in range(2)]
+        self.fences: List[list] = [[], []]
+        self.cur = 0
+
+    def next_buf(self):
+        """Rotate to the other pinned buffer; fence its previous H2D reads."""
+        self.cur ^= 1
+        for f in self.fences[self.cur]:
+            f.block_until_ready()
+        self.fences[self.cur] = []
+        return self.bufs[self.cur]
+
+    def put(self, host: np.ndarray, dev):
+        """device_put that records a fence on the current buffer (several
+        puts may read the same staged bytes — e.g. replicated shards)."""
+        import jax
+        from ..hbm.staging import owned_if_cpu
+        arr = jax.device_put(owned_if_cpu(host, dev), dev)
+        self.fences[self.cur].append(arr)
+        return arr
+
+    def close(self):
+        for fl in self.fences:
+            for f in fl:
+                f.block_until_ready()
+        for handle, buf in self.bufs:
+            try:
+                self.sess.unmap_buffer(handle)
+            except StromError:
+                pass
+            buf.close()
+        self.bufs = []
+
+
+def _read_span(sess, source, file_off: int, nbytes: int,
+               ring: _PinnedRing) -> np.ndarray:
+    """Read one byte span through the direct path.
+
+    Returns a view into the ring's current pinned buffer (consume with
+    ``ring.put`` before the next ``_read_span``), or an owned array when
+    the span exceeds one staging buffer."""
+    if nbytes == 0:
+        return np.empty(0, np.uint8)
+    handle, buf = ring.next_buf()
+    cap = len(buf.view())
+    out = np.empty(nbytes, np.uint8) if nbytes > cap else None
+    done = 0
+    view = None
+    while done < nbytes:
+        take = min(cap, nbytes - done)
+        start = file_off + done
+        c0 = start // _CHUNK
+        c1 = (start + take + _CHUNK - 1) // _CHUNK
+        if start % _CHUNK == 0 and c1 * _CHUNK <= source.size:
+            ids = list(range(c0, c1))
+            res = sess.memcpy_ssd2ram(source, handle, ids, _CHUNK)
+            sess.memcpy_wait(res.dma_task_id)
+            if list(res.chunk_ids) != ids:
+                blocks = np.frombuffer(
+                    buf.view()[:len(ids) * _CHUNK], np.uint8).reshape(
+                        len(ids), _CHUNK)
+                view = np.ascontiguousarray(
+                    blocks[np.argsort(res.chunk_ids)]).ravel()[:take]
+            else:
+                view = np.frombuffer(buf.view()[:take], np.uint8)
+        else:
+            # unaligned head or grid running past EOF: buffered leg
+            source.read_buffered(start, buf.view()[:take])
+            view = np.frombuffer(buf.view()[:take], np.uint8)
+        if out is not None:
+            out[done:done + take] = view
+        done += take
+    return out if out is not None else view[:nbytes]
+
+
+def restore_checkpoint(path: str, *, shardings=None, like=None,
+                       session: Optional[Session] = None,
+                       device=None, staging_bytes: int = 64 << 20):
+    """Load a checkpoint into device arrays through the direct path.
+
+    ``shardings`` — None (single device, see *device*), one
+    ``jax.sharding.Sharding`` for all leaves, or a dict ``{key: Sharding}``
+    (keys as printed by ``jax.tree_util.keystr``).  With a sharding, each
+    addressable device's row-range of the leaf is read individually, so a
+    multi-host restore only touches local shards.  ``like`` — optional
+    pytree with the same structure used to rebuild the tree shape (by
+    default a flat ``{key: array}`` dict is returned).
+    """
+    import jax
+
+    meta = checkpoint_info(path)
+    data0 = meta["data_offset"]
+    own = session is None
+    sess = session or Session()
+    out: Dict[str, jax.Array] = {}
+    try:
+        with open_source(path) as source:
+            # two pinned buffers, alternated per transfer: device_put is
+            # async and the host view points into the pinned buffer, so the
+            # buffer being refilled is never the one still feeding an H2D
+            # read — reuse is fenced in _PinnedRing (staging.py discipline)
+            ring = _PinnedRing(sess, staging_bytes)
+            try:
+                for e in meta["leaves"]:
+                    key = e["key"]
+                    dtype = np.dtype(e["dtype"])
+                    shape = tuple(e["shape"])
+                    base = data0 + e["offset"]
+                    sh = _leaf_sharding(shardings, key)
+                    if sh is None:
+                        dev = device or _default_device()
+                        host = _read_span(sess, source, base, e["nbytes"],
+                                          ring).view(dtype).reshape(shape)
+                        out[key] = ring.put(host, dev)
+                    else:
+                        out[key] = _restore_sharded(sess, source, base, dtype,
+                                                    shape, sh, ring)
+            finally:
+                ring.close()
+    finally:
+        if own:
+            sess.close()
+    if like is not None:
+        leaves = [out[k] for k, _ in _flatten(like)]
+        treedef = jax.tree_util.tree_structure(like)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    return out
+
+
+def _default_device():
+    import jax
+    devs = jax.devices()
+    accel = [d for d in devs if d.platform != "cpu"]
+    return (accel or devs)[0]
+
+
+def _restore_sharded(sess, source, base, dtype, shape, sharding,
+                     ring: _PinnedRing):
+    """Assemble a sharded leaf from per-device shard reads.
+
+    Shards that are contiguous in the row-major leaf (sharding split only
+    on the leading axis) read exactly their byte range; other layouts read
+    the covering row range and slice host-side — still only the rows this
+    process's devices own."""
+    import jax
+
+    idx_map = sharding.addressable_devices_indices_map(shape)
+    rowbytes = int(dtype.itemsize * np.prod(shape[1:], dtype=np.int64)) \
+        if len(shape) > 1 else dtype.itemsize
+
+    # one SSD read per unique row range: replicated / column-sharded specs
+    # would otherwise re-read the same bytes once per device
+    by_range: Dict[tuple, List] = {}
+    for dev, idx in idx_map.items():
+        if not shape:
+            rkey = (0, 1)
+        else:
+            rows = idx[0] if idx else slice(None)
+            rkey = (rows.start or 0,
+                    rows.stop if rows.stop is not None else shape[0])
+        by_range.setdefault(rkey, []).append((dev, idx))
+
+    arrays = []
+    for (r0, r1), members in by_range.items():
+        if not shape:  # scalar leaf: replicate
+            host = _read_span(sess, source, base, dtype.itemsize,
+                              ring).view(dtype).reshape(())
+            arrays.extend(ring.put(host, dev) for dev, _ in members)
+            continue
+        host = _read_span(sess, source, base + r0 * rowbytes,
+                          (r1 - r0) * rowbytes, ring)
+        block = host.view(dtype).reshape((r1 - r0,) + shape[1:])
+        for dev, idx in members:
+            sub = idx[1:]
+            if any(s != slice(None, None, None) for s in sub):
+                shard = np.ascontiguousarray(block[(slice(None),) + tuple(sub)])
+            else:
+                shard = block
+            arrays.append(ring.put(shard, dev))
+    return jax.make_array_from_single_device_arrays(shape, sharding, arrays)

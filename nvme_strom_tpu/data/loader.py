@@ -1,0 +1,230 @@
+"""DeviceLoader: shuffled, double-buffered, direct-to-device record batches.
+
+The training-input generalization of the benchmark's segment streaming
+(`utils/ssd2gpu_test.c:282-375`): worker threads there claim sequential
+file offsets; here each *batch* claims a set of engine chunks — and
+because the engine's command vocabulary takes arbitrary ``chunk_ids``,
+a shuffled epoch is just a permuted id list riding the exact same
+merge-planned async DMA path.  Chunk-granular shuffling is the standard
+high-throughput trade (shuffle buckets = chunks), with per-epoch
+reshuffle.
+
+Overlap discipline matches the staging pipeline: while the consumer holds
+batch *b* on device, batch *b+1*'s SSD DMA is in flight into the second
+pinned buffer; buffer reuse is fenced on the device transfer that last
+read it (`hbm/staging.py` contract).
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..api import StromError
+from ..config import config
+from ..engine import Session, Source, open_source
+from .records import RecordDataset
+
+__all__ = ["DeviceLoader"]
+
+
+class DeviceLoader:
+    """Iterate device-resident record batches from a :class:`RecordDataset`.
+
+    Parameters
+    ----------
+    dataset : RecordDataset (or path string)
+    batch_records : records per yielded batch; must be a whole number of
+        engine chunks (``batch_records % records_per_chunk == 0``)
+    shuffle : None for file order, or an int seed for per-epoch chunk
+        shuffling (epoch *e* uses ``seed + e``)
+    mesh/axis : optional ``jax.sharding.Mesh`` — batches are placed sharded
+        ``P(axis, None, ...)`` (leading record axis split across devices);
+        otherwise ``device`` (default: first accelerator) gets full batches
+    drop_remainder : trailing records that do not fill a batch (or a chunk)
+        are skipped, as with every fixed-geometry input pipeline
+    """
+
+    def __init__(self, dataset, batch_records: int, *,
+                 shuffle: Optional[int] = None,
+                 chunk_size: Optional[int] = None,
+                 mesh=None, axis: str = "dp", device=None,
+                 session: Optional[Session] = None,
+                 source: Optional[Source] = None,
+                 drop_remainder: bool = True):
+        if isinstance(dataset, str):
+            dataset = RecordDataset(dataset)
+        self.ds = dataset
+        if not drop_remainder:
+            raise StromError(_errno.EINVAL,
+                             "drop_remainder=False is not supported: batches "
+                             "are fixed-geometry device arrays")
+        if chunk_size is None:
+            # largest chunk that (a) holds whole records, (b) divides the
+            # batch evenly, (c) stays within the configured chunk budget —
+            # so any batch_records geometry works out of the box
+            cap = max(self.ds.stride, min(config.get("chunk_size"), 1 << 20))
+            p = batch_records & -batch_records if batch_records > 0 else 1
+            chunk_size = self.ds.stride * p
+            while chunk_size > cap and p > 1:
+                p //= 2
+                chunk_size = self.ds.stride * p
+        self.chunk_size = chunk_size
+        self.rpc = self.ds.records_per_chunk(chunk_size)
+        if batch_records <= 0 or batch_records % self.rpc:
+            raise StromError(
+                _errno.EINVAL,
+                f"batch_records {batch_records} must be a positive multiple "
+                f"of records-per-chunk {self.rpc} (chunk {chunk_size}, "
+                f"stride {self.ds.stride})")
+        self.batch_records = batch_records
+        self.chunks_per_batch = batch_records // self.rpc
+        file_bytes = self.ds.count * self.ds.stride
+        self.n_chunks = file_bytes // chunk_size
+        self.batches_per_epoch = self.n_chunks // self.chunks_per_batch
+        self.shuffle = shuffle
+        self.mesh = mesh
+        self.axis = axis
+        self._device = device
+        if mesh is not None and batch_records % mesh.shape[axis]:
+            raise StromError(_errno.EINVAL,
+                             f"batch_records {batch_records} not divisible "
+                             f"by mesh axis '{axis}' ({mesh.shape[axis]})")
+        self._own_source = source is None
+        self.source = source or open_source(dataset.path)
+        self._own_session = session is None
+        self.session = session or Session()
+        nbytes = self.chunks_per_batch * chunk_size
+        self._bufs = [self.session.alloc_dma_buffer(nbytes) for _ in range(2)]
+        self._fence = [None, None]
+        self._epoch = 0
+        self._closed = False
+
+    # -- iteration -----------------------------------------------------------
+    def _placement(self):
+        import jax
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            spec = P(self.axis, *([None] * len(self.ds.shape)))
+            return NamedSharding(self.mesh, spec)
+        if self._device is not None:
+            return self._device
+        devs = jax.devices()
+        accel = [d for d in devs if d.platform != "cpu"]
+        return (accel or devs)[0]
+
+    def _epoch_ids(self, epoch: int) -> np.ndarray:
+        ids = np.arange(self.n_chunks, dtype=np.int64)
+        if self.shuffle is not None:
+            rng = np.random.default_rng(self.shuffle + epoch)
+            rng.shuffle(ids)
+        return ids
+
+    def _submit(self, ring: int, ids: Sequence[int]):
+        if self._fence[ring] is not None:
+            self._fence[ring].block_until_ready()
+            self._fence[ring] = None
+        handle, _ = self._bufs[ring]
+        # plain ints: np.int64 ids would reach ctypes in the cache probe
+        req = [int(c) for c in ids]
+        return req, self.session.memcpy_ssd2ram(self.source, handle, req,
+                                                self.chunk_size)
+
+    def _collect(self, ring: int, req, res):
+        import jax
+        from ..hbm.staging import owned_if_cpu
+
+        self.session.memcpy_wait(res.dma_task_id)
+        _, buf = self._bufs[ring]
+        nbytes = self.chunks_per_batch * self.chunk_size
+        raw = np.frombuffer(buf.view()[:nbytes], np.uint8)
+        if list(res.chunk_ids) != req:
+            # restore the *requested* order: the engine fronts direct-I/O
+            # chunks and tails write-back chunks, and which chunks are
+            # cache-resident varies run to run — without this, a seeded
+            # shuffle would not be reproducible
+            pos = {cid: j for j, cid in enumerate(req)}
+            blocks = raw.reshape(self.chunks_per_batch, self.chunk_size)
+            ordered = np.empty_like(blocks)
+            ordered[[pos[c] for c in res.chunk_ids]] = blocks
+            raw = ordered.ravel()
+        batch = self.ds.decode(raw)
+        placement = self._placement()
+        # decode() usually copies, but the stride==record_bytes fast path
+        # hands device_put a zero-copy view of the pinned buffer — which
+        # the CPU backend would alias (accelerators always copy)
+        arr = jax.device_put(owned_if_cpu(batch, placement), placement)
+        # pinned reuse is fenced on the device array (H2D read completion)
+        self._fence[ring] = arr
+        return arr
+
+    def epoch(self, epoch: Optional[int] = None) -> Iterator:
+        """Yield one epoch of device batches (len == batches_per_epoch)."""
+        if self._closed:
+            raise StromError(_errno.EBADF, "loader closed")
+        e = self._epoch if epoch is None else epoch
+        if epoch is None:
+            self._epoch += 1
+        ids = self._epoch_ids(e)
+        k = self.chunks_per_batch
+        n = self.batches_per_epoch
+        if n == 0:
+            return
+        pending = (0, *self._submit(0, ids[0:k]))
+        try:
+            for b in range(n):
+                nxt = None
+                if b + 1 < n:
+                    ring = (b + 1) % 2
+                    nxt = (ring,
+                           *self._submit(ring, ids[(b + 1) * k:(b + 2) * k]))
+                arr = self._collect(*pending)
+                # hand off before yielding: if the consumer abandons the
+                # generator here, the finally below reaps the prefetch
+                pending = nxt
+                yield arr
+        finally:
+            # an abandoned epoch (break / exception) must reap the
+            # prefetched task: done/failed tasks are retained in the
+            # session table until waited (engine error-retention contract)
+            if pending is not None:
+                try:
+                    self.session.memcpy_wait(pending[2].dma_task_id,
+                                             timeout=30.0)
+                except StromError:
+                    pass
+
+    def __iter__(self):
+        return self.epoch()
+
+    def __len__(self) -> int:
+        return self.batches_per_epoch
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for f in self._fence:
+            if f is not None:
+                f.block_until_ready()
+        self._fence = [None, None]
+        for handle, buf in self._bufs:
+            try:
+                self.session.unmap_buffer(handle)
+            except StromError:
+                pass
+            buf.close()
+        self._bufs = []
+        if self._own_session:
+            self.session.close()
+        if self._own_source:
+            self.source.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

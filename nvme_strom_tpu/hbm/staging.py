@@ -63,6 +63,20 @@ def _write_row(dest: jax.Array, chunk: jax.Array, row: jax.Array,
 _INT32_MAX = (1 << 31) - 1
 
 
+def owned_if_cpu(host: np.ndarray, devlike) -> np.ndarray:
+    """Copy a pinned-buffer view before device_put on the CPU backend.
+
+    CPU-backend device_put zero-copies aligned numpy views, so the "device"
+    array would alias pinned memory the next SSD DMA overwrites (and
+    close() unmaps).  Accelerator backends always copy host->HBM, so this
+    is free where throughput matters."""
+    platform = (devlike.platform if hasattr(devlike, "platform")
+                else next(iter(devlike.device_set)).platform)
+    if platform == "cpu":
+        return np.array(host)
+    return host
+
+
 def _land(hbm, dev_chunk, elem_start: int, grid_elems: int):
     """Pick the addressing mode for one landing and install the result."""
     if (grid_elems and hbm.array.size % grid_elems == 0
@@ -152,11 +166,13 @@ class StagingPipeline:
                 # donated update; nothing here blocks
                 t0 = time.monotonic_ns()
                 _, dbuf = self._bufs[bufidx]
+                dev = list(hbm.array.devices())[0]
                 host = np.frombuffer(dbuf.view()[:nbytes], dtype=device_dtype)
-                dev_chunk = jax.device_put(host, list(hbm.array.devices())[0])
+                dev_chunk = jax.device_put(owned_if_cpu(host, dev), dev)
                 _land(hbm, dev_chunk, elem_start, grid_elems)
                 # the staging buffer is reusable once the H2D *read* of it
                 # completes — fence on the device chunk, not the landing
+                # (on CPU the chunk is an owned copy, so this stays safe)
                 self._barriers[bufidx] = dev_chunk
                 stats.count_clock("debug3", time.monotonic_ns() - t0)
 
@@ -250,8 +266,9 @@ def load_file_to_device(source: Source, *, chunk_size: Optional[int] = None,
                                          tbuf.view()[:tail])
                     hbm = reg.acquire(handle)
                     try:
+                        tdev = list(hbm.array.devices())[0]
                         host = np.frombuffer(tbuf.view()[:tail], dtype=dtype)
-                        dev = jax.device_put(host, list(hbm.array.devices())[0])
+                        dev = jax.device_put(owned_if_cpu(host, tdev), tdev)
                         _land(hbm, dev, n_full * chunk_size // itemsize,
                               chunk_size // itemsize)
                     finally:

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..api import StromError
 from ..engine import Session, Source
+from ..hbm.staging import owned_if_cpu
 from ..scan.heap import PAGE_SIZE
 
 __all__ = ["load_pages_sharded", "ShardedBatchStream", "distributed_scan_filter"]
@@ -72,7 +73,8 @@ def load_pages_sharded(source: Source, mesh: Mesh, *,
                 if res.chunk_ids != list(range(r0, r1)):
                     order = np.argsort(np.asarray(res.chunk_ids))
                     host = host[order]
-                shards.append(jax.device_put(np.ascontiguousarray(host), dev))
+                shards.append(jax.device_put(
+                    owned_if_cpu(np.ascontiguousarray(host), dev), dev))
             finally:
                 sess.unmap_buffer(handle)
                 buf.close()
@@ -155,7 +157,7 @@ class ShardedBatchStream:
             ids = np.asarray(done.chunk_ids)
             if not np.array_equal(ids, np.sort(ids)):
                 host = np.ascontiguousarray(host[np.argsort(ids)])
-            shards.append(jax.device_put(host, dev))
+            shards.append(jax.device_put(owned_if_cpu(host, dev), dev))
         arr = jax.make_array_from_single_device_arrays(
             self._shape, self.sharding, shards)
         self._fence[ring] = arr

@@ -1,0 +1,256 @@
+"""Data tier: record files, DeviceLoader, checkpoint save/restore."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.api import StromError
+from nvme_strom_tpu.data import (DeviceLoader, RecordDataset, RecordWriter,
+                                 checkpoint_info, restore_checkpoint,
+                                 save_checkpoint, write_records)
+from nvme_strom_tpu.data.records import next_pow2
+
+
+# -- records -----------------------------------------------------------------
+
+def test_record_roundtrip_padded_stride(tmp_path):
+    """Non-pow2 records are padded to a pow2 stride and decode exactly."""
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((17, 3, 25)).astype(np.float32)  # 300B records
+    path = str(tmp_path / "r.rec")
+    ds = write_records(path, a)
+    assert ds.record_bytes == 300
+    assert ds.stride == 512  # pow2 floor for O_DIRECT
+    assert len(ds) == 17
+    with open(path, "rb") as f:
+        raw = np.frombuffer(f.read(), np.uint8)
+    np.testing.assert_array_equal(ds.decode(raw), a)
+
+
+def test_record_pow2_records_have_no_padding(tmp_path):
+    a = np.arange(16 * 256, dtype=np.int32).reshape(16, 256)  # 1024B records
+    ds = write_records(str(tmp_path / "p.rec"), a)
+    assert ds.stride == ds.record_bytes == 1024
+
+
+def test_record_writer_shape_mismatch(tmp_path):
+    w = RecordWriter(str(tmp_path / "x.rec"), np.float32, (4,))
+    with pytest.raises(StromError):
+        w.write(np.zeros((5,), np.float32))
+    w.close()
+
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (1, 2, 3, 300, 512, 513)] == \
+        [2, 2, 4, 512, 512, 1024]
+
+
+# -- loader ------------------------------------------------------------------
+
+def _make_ds(tmp_path, n=64, rec_shape=(128,), dtype=np.int32, name="d.rec"):
+    rng = np.random.default_rng(7)
+    a = rng.integers(-1000, 1000, (n,) + rec_shape).astype(dtype)
+    return a, write_records(str(tmp_path / name), a)
+
+
+def test_loader_sequential_matches_file_order(tmp_path):
+    a, ds = _make_ds(tmp_path)
+    # stride = 512B -> chunk 4096 holds 8 records
+    with DeviceLoader(ds, batch_records=16, chunk_size=4096) as dl:
+        assert dl.rpc == 8 and dl.batches_per_epoch == 4
+        got = np.concatenate([np.asarray(b) for b in dl])
+    np.testing.assert_array_equal(got, a)
+
+
+def test_loader_shuffle_covers_every_record_once(tmp_path):
+    a, ds = _make_ds(tmp_path)
+    with DeviceLoader(ds, batch_records=16, chunk_size=4096, shuffle=3) as dl:
+        e0 = np.concatenate([np.asarray(b) for b in dl.epoch(0)])
+        e1 = np.concatenate([np.asarray(b) for b in dl.epoch(1)])
+    # every record exactly once per epoch, different order across epochs
+    key = lambda arr: {r.tobytes() for r in arr}
+    assert key(e0) == key(e1) == key(a)
+    assert not np.array_equal(e0, e1)
+    assert not np.array_equal(e0, a)
+
+
+def test_loader_epoch_reshuffle_is_deterministic(tmp_path):
+    _, ds = _make_ds(tmp_path)
+    with DeviceLoader(ds, batch_records=16, chunk_size=4096, shuffle=3) as dl:
+        x = [np.asarray(b) for b in dl.epoch(5)]
+        y = [np.asarray(b) for b in dl.epoch(5)]
+    for bx, by in zip(x, y):
+        np.testing.assert_array_equal(bx, by)
+
+
+def test_loader_sharded_over_mesh(tmp_path):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+
+    a, ds = _make_ds(tmp_path)
+    mesh = make_scan_mesh(jax.devices()[:8], sp=1)
+    with DeviceLoader(ds, batch_records=16, chunk_size=4096,
+                      mesh=mesh) as dl:
+        batches = list(dl)
+        for b in batches:
+            assert b.sharding.spec == P("dp", None)
+            assert len(b.addressable_shards) == 8
+        got = np.concatenate([np.asarray(b) for b in batches])
+    np.testing.assert_array_equal(got, a)
+
+
+def test_loader_rejects_bad_geometry(tmp_path):
+    _, ds = _make_ds(tmp_path)
+    with pytest.raises(StromError):
+        DeviceLoader(ds, batch_records=12, chunk_size=4096)  # not mult of 8
+    with pytest.raises(StromError):
+        DeviceLoader(ds, batch_records=16, chunk_size=4096,
+                     drop_remainder=False)
+
+
+def test_loader_drops_partial_tail_chunk(tmp_path):
+    a, ds = _make_ds(tmp_path, n=20)  # 20 recs = 2.5 chunks of 8
+    with DeviceLoader(ds, batch_records=8, chunk_size=4096) as dl:
+        assert dl.n_chunks == 2 and dl.batches_per_epoch == 2
+        got = np.concatenate([np.asarray(b) for b in dl])
+    np.testing.assert_array_equal(got, a[:16])
+
+
+# -- checkpoint --------------------------------------------------------------
+
+def _tree():
+    rng = np.random.default_rng(11)
+    return {
+        "w": rng.standard_normal((64, 48)).astype(np.float32),
+        "b": rng.standard_normal((91,)).astype(np.float32),  # odd bytes
+        "emb": {"table": rng.integers(0, 127, (33, 7)).astype(np.int8)},
+        "step": np.int32(1234),
+    }
+
+
+def test_checkpoint_roundtrip_flat(tmp_path):
+    import jax
+    tree = _tree()
+    path = str(tmp_path / "ck.strom")
+    info = save_checkpoint(path, tree)
+    assert info["leaves"] == 4
+    meta = checkpoint_info(path)
+    assert {e["key"] for e in meta["leaves"]} == \
+        {"['w']", "['b']", "['emb']['table']", "['step']"}
+    out = restore_checkpoint(path)
+    for e in meta["leaves"]:
+        assert e["offset"] % 4096 == 0
+    np.testing.assert_array_equal(np.asarray(out["['w']"]), tree["w"])
+    np.testing.assert_array_equal(np.asarray(out["['b']"]), tree["b"])
+    np.testing.assert_array_equal(np.asarray(out["['emb']['table']"]),
+                                  tree["emb"]["table"])
+    assert int(np.asarray(out["['step']"])) == 1234
+    assert all(isinstance(v, jax.Array) for v in out.values())
+
+
+def test_checkpoint_restore_like_tree(tmp_path):
+    tree = _tree()
+    path = str(tmp_path / "ck2.strom")
+    save_checkpoint(path, tree)
+    out = restore_checkpoint(path, like=tree)
+    assert set(out) == set(tree)
+    np.testing.assert_array_equal(np.asarray(out["emb"]["table"]),
+                                  tree["emb"]["table"])
+
+
+def test_checkpoint_sharded_restore(tmp_path):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+
+    rng = np.random.default_rng(5)
+    tree = {"w": rng.standard_normal((32, 96)).astype(np.float32),
+            "v": rng.standard_normal((16, 64)).astype(np.float32)}
+    path = str(tmp_path / "ck3.strom")
+    save_checkpoint(path, tree)
+    mesh = make_scan_mesh(jax.devices()[:8], sp=1)
+    sh = NamedSharding(mesh, P("dp", None))
+    out = restore_checkpoint(path, shardings={"['w']": sh, "['v']": sh})
+    for k, want in (("['w']", tree["w"]), ("['v']", tree["v"])):
+        arr = out[k]
+        assert arr.sharding == sh
+        np.testing.assert_array_equal(np.asarray(arr), want)
+        # each device holds only its row slice
+        assert len(arr.addressable_shards) == 8
+
+
+def test_checkpoint_sharded_second_axis(tmp_path):
+    """Sharding on a non-leading axis reads the covering rows and slices."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+
+    rng = np.random.default_rng(6)
+    tree = {"w": rng.standard_normal((8, 32)).astype(np.float32)}
+    path = str(tmp_path / "ck4.strom")
+    save_checkpoint(path, tree)
+    mesh = make_scan_mesh(jax.devices()[:8], sp=4)
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    out = restore_checkpoint(path, shardings=sh)
+    arr = out["['w']"]
+    assert arr.sharding == sh
+    np.testing.assert_array_equal(np.asarray(arr), tree["w"])
+
+
+def test_checkpoint_small_staging_windows(tmp_path):
+    """Leaves larger than the staging buffer stream through windows."""
+    rng = np.random.default_rng(8)
+    tree = {"big": rng.standard_normal((3000, 40)).astype(np.float32)}  # 480KB
+    path = str(tmp_path / "ck5.strom")
+    save_checkpoint(path, tree)
+    out = restore_checkpoint(path, staging_bytes=64 << 10)
+    np.testing.assert_array_equal(np.asarray(out["['big']"]), tree["big"])
+
+
+def test_checkpoint_bad_magic(tmp_path):
+    p = tmp_path / "junk.bin"
+    p.write_bytes(b"\0" * 64)
+    with pytest.raises(StromError):
+        checkpoint_info(str(p))
+
+
+def test_loader_mixed_cache_order_deterministic(tmp_path):
+    """Chunk reordering (direct-first/wb-tail) must not leak into batch
+    order: the same seed yields identical batches whatever the cache
+    state claims."""
+    from nvme_strom_tpu.engine import PlainSource
+
+    a, ds = _make_ds(tmp_path, name="m.rec")
+
+    class MixedSource(PlainSource):
+        def cached_fraction(self, offset, length):
+            return 1.0 if (offset // 4096) % 2 else 0.0
+
+    def run(source_cls):
+        src = source_cls(str(tmp_path / "m.rec"))
+        try:
+            with DeviceLoader(ds, batch_records=16, chunk_size=4096,
+                              shuffle=9, source=src) as dl:
+                return [np.asarray(b) for b in dl.epoch(0)]
+        finally:
+            src.close()
+
+    mixed = run(MixedSource)
+    plain = run(PlainSource)
+    for bm, bp in zip(mixed, plain):
+        np.testing.assert_array_equal(bm, bp)
+
+
+def test_loader_abandoned_epoch_reaps_prefetch(tmp_path):
+    """Breaking out of an epoch must not leave the prefetched DMA task
+    unreaped in a caller-owned session."""
+    from nvme_strom_tpu.engine import Session
+
+    _, ds = _make_ds(tmp_path, name="ab.rec")
+    with Session() as sess:
+        with DeviceLoader(ds, batch_records=16, chunk_size=4096,
+                          session=sess) as dl:
+            for _ in dl:
+                break  # abandon with a prefetch in flight
+            # session slot table must be empty again (no retained tasks)
+            assert sum(len(s) for s in sess._slots) == 0

@@ -219,3 +219,36 @@ def test_sharded_batch_stream_covers_and_matches(tmp_path):
     sel = c0 > 50
     assert int(out["count"]) == int(sel.sum())
     assert int(out["sums"][1]) == int(c1[sel].sum())
+
+
+def test_sharded_batch_stream_mixed_cache_preserves_order(tmp_path):
+    """Regression: with a partially cached source the engine fronts
+    direct-I/O chunks and tails write-back chunks; the stream must restore
+    file order before placing shards."""
+    import jax
+    from nvme_strom_tpu.engine import open_source
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    from nvme_strom_tpu.parallel.stream import ShardedBatchStream
+    from nvme_strom_tpu.testing.fake import FakeNvmeSource, make_test_file
+
+    n_pages = 16
+    path = str(tmp_path / "mixed.bin")
+    make_test_file(path, n_pages * PAGE_SIZE)
+    with open(path, "rb") as f:
+        want = np.frombuffer(f.read(), np.uint8).reshape(n_pages, PAGE_SIZE)
+
+    class MixedSource(FakeNvmeSource):
+        # odd pages report fully cached -> write-back path; even -> direct
+        def cached_fraction(self, offset, length):
+            return 1.0 if (offset // PAGE_SIZE) % 2 else 0.0
+
+    devs = jax.devices()[:2]
+    mesh = make_scan_mesh(devs, sp=1)
+    src = MixedSource(path)
+    try:
+        with ShardedBatchStream(src, mesh, batch_pages=8) as stream:
+            for first, arr in stream:
+                np.testing.assert_array_equal(np.asarray(arr),
+                                              want[first:first + 8])
+    finally:
+        src.close()
