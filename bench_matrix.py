@@ -134,6 +134,36 @@ print(f"GBPS={{nbytes/dt/(1<<30):.3f}}")
 """
 
 
+_CKPT = _COMMON + """
+import jax
+from nvme_strom_tpu.data import save_checkpoint, restore_checkpoint
+path = {path!r} + ".strom"
+n = size // 4 // 1024
+ok = False
+if os.path.exists(path):
+    try:
+        from nvme_strom_tpu.data.checkpoint import checkpoint_info
+        meta = checkpoint_info(path)
+        e = meta["leaves"][0]
+        ok = (e["nbytes"] == n * 4096 and os.path.getsize(path)
+              >= meta["data_offset"] + e["offset"] + e["nbytes"])
+    except Exception:
+        ok = False
+if not ok:
+    rng = np.random.default_rng(0)
+    save_checkpoint(path, {{"w": rng.standard_normal((n, 1024)).astype(np.float32)}})
+drop_page_cache(path)
+# warm the device path (first H2D pays backend init) outside the timed region
+jax.device_put(np.zeros(1 << 20, np.uint8)).block_until_ready()
+t0 = time.monotonic()
+out = restore_checkpoint(path)
+jax.block_until_ready(list(out.values()))
+dt = time.monotonic() - t0
+nbytes = n * 1024 * 4
+print(f"GBPS={{nbytes/dt/(1<<30):.3f}}")
+"""
+
+
 def main() -> int:
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "512"))
@@ -157,6 +187,8 @@ def main() -> int:
          _RAID0.format(size=size, path=base), None),
         ("scan_filter", "heap scan -> HBM + pallas filter",
          _SCAN.format(size=size, path=base), None),
+        ("ckpt_restore", "checkpoint -> HBM direct restore",
+         _CKPT.format(size=size, path=base), None),
     ]
     results = {}
     for i, (key, desc, code, env) in enumerate(configs):

@@ -1,0 +1,104 @@
+"""Grouped aggregation over HBM-resident heap pages.
+
+Extends the scan-compute tier (the pgsql per-tuple walk redesigned as
+tensor ops, `pgsql/nvme_strom.c:941-979`) from flat filter/sum to
+GROUP BY: per-group count/sum/min/max in one pass over a page batch.
+
+TPU-first shape: the group reduction is a **one-hot contraction** —
+``(B·T, G) one-hot  x  (B·T, V) values -> (G, V)`` — which XLA lowers to
+an MXU matmul for the sum path (integer-exact via
+``preferred_element_type``), instead of the scatter-add a CUDA port
+would reach for (scatters serialize on TPU; matmuls do not).  Min/max
+ride masked segment reductions on the VPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..scan.heap import HeapSchema
+from .filter_xla import DEFAULT_SCHEMA, decode_pages
+
+__all__ = ["make_groupby_fn", "scan_groupby_step", "combine_groupby"]
+
+
+def combine_groupby(acc: dict, out: dict) -> dict:
+    """Batch-fold combiner for grouped results (pass as
+    ``TableScanner.scan_filter(..., combine=combine_groupby)`` or to
+    ``distributed_scan_filter``): counts/sums add, mins/maxs meet."""
+    return {"count": acc["count"] + out["count"],
+            "sums": acc["sums"] + out["sums"],
+            "mins": jnp.minimum(acc["mins"], out["mins"]),
+            "maxs": jnp.maximum(acc["maxs"], out["maxs"])}
+
+_I32_MIN = jnp.int32(-(1 << 31))
+_I32_MAX = jnp.int32((1 << 31) - 1)
+
+
+def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
+                    agg_cols: Optional[Sequence[int]] = None,
+                    predicate: Optional[Callable] = None):
+    """Build a jitted ``run(pages_u8, *params) -> dict`` grouped aggregate.
+
+    ``key_fn(cols, *params) -> (B, T) int32`` group ids in ``[0, n_groups)``
+    (out-of-range ids fall into no group); ``predicate(cols, *params)`` an
+    optional row filter.  ``agg_cols`` — column indices to aggregate
+    (default: all).  Returns per group: ``count (G,)``, and ``sums / mins /
+    maxs`` of shape ``(len(agg_cols), G)``; empty groups report 0 count,
+    0 sum, int32 max/min sentinels.
+    """
+    cols_idx = list(agg_cols) if agg_cols is not None else \
+        list(range(schema.n_cols))
+    G = int(n_groups)
+
+    @jax.jit
+    def run(pages_u8, *params):
+        cols, valid = decode_pages(pages_u8, schema)
+        keys = key_fn(cols, *params)
+        sel = valid & (keys >= 0) & (keys < G)
+        if predicate is not None:
+            sel = sel & predicate(cols, *params)
+        keys = jnp.where(sel, keys, G)  # overflow bucket, sliced off below
+        flat_keys = keys.reshape(-1)
+        onehot = jax.nn.one_hot(flat_keys, G + 1, dtype=jnp.int32)[:, :G]
+        vals = jnp.stack([c.reshape(-1) for c in (cols[i] for i in cols_idx)],
+                         axis=-1)                       # (N, V)
+        count = jnp.sum(onehot, axis=0)                 # (G,)
+        # the MXU path: integer contraction (N,G)x(N,V)->(G,V).  Exact per
+        # batch within int32; under x64 the accumulator (and the cross-batch
+        # fold) widens to int64, matching scan_filter_step's convention —
+        # without x64, sums past 2^31 wrap (as any int32 engine would)
+        acc_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        sums = jax.lax.dot_general(
+            onehot, vals, (((0,), (0,)), ((), ())),
+            preferred_element_type=acc_t).T             # (V, G)
+        flat_sel = sel.reshape(-1)
+        mins = jnp.stack([
+            jax.ops.segment_min(jnp.where(flat_sel, v, _I32_MAX), flat_keys,
+                                num_segments=G + 1)[:G]
+            for v in vals.T])
+        maxs = jnp.stack([
+            jax.ops.segment_max(jnp.where(flat_sel, v, _I32_MIN), flat_keys,
+                                num_segments=G + 1)[:G]
+            for v in vals.T])
+        return {"count": count, "sums": sums, "mins": mins, "maxs": maxs}
+
+    return run
+
+
+@partial(jax.jit, static_argnums=(2,))
+def scan_groupby_step(pages_u8: jax.Array, threshold: jax.Array,
+                      n_groups: int = 16):
+    """Demo step over the default schema: GROUP BY (col1 mod n_groups)
+    WHERE col0 > threshold, aggregating col0."""
+    fn = make_groupby_fn(
+        DEFAULT_SCHEMA,
+        lambda cols, th: jnp.abs(cols[1]) % n_groups,
+        n_groups,
+        agg_cols=[0],
+        predicate=lambda cols, th: cols[0] > th)
+    return fn(pages_u8, threshold)
