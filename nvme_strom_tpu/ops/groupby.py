@@ -19,6 +19,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..scan.heap import HeapSchema
 from .filter_xla import DEFAULT_SCHEMA, decode_pages
@@ -35,8 +36,11 @@ def combine_groupby(acc: dict, out: dict) -> dict:
             "mins": jnp.minimum(acc["mins"], out["mins"]),
             "maxs": jnp.maximum(acc["maxs"], out["maxs"])}
 
-_I32_MIN = jnp.int32(-(1 << 31))
-_I32_MAX = jnp.int32((1 << 31) - 1)
+# np, not jnp: a module-level jnp constant would initialize the JAX
+# backend at import time, pinning the platform before jax_platforms /
+# XLA_FLAGS virtual-mesh configuration can take effect
+_I32_MIN = np.int32(-(1 << 31))
+_I32_MAX = np.int32((1 << 31) - 1)
 
 
 def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
