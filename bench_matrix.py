@@ -134,6 +134,22 @@ print(f"GBPS={{nbytes/dt/(1<<30):.3f}}")
 """
 
 
+_H2D = _COMMON + """
+import jax
+# transport ceiling: pinned-host->HBM device_put alone, no SSD at all.
+# ssd2tpu_* rows approaching this number mean the SSD DMA leg is fully
+# hidden behind the host->device hop (the overlap goal, SURVEY SS5.8b);
+# the ceiling itself is host/tunnel property, not framework overhead.
+a = np.random.randint(0, 255, size, dtype=np.uint8)
+jax.device_put(a[: 1 << 20]).block_until_ready()
+t0 = time.monotonic()
+step = 16 << 20
+for off in range(0, size, step):
+    jax.device_put(a[off:off + step]).block_until_ready()
+dt = time.monotonic() - t0
+print(f"GBPS={{size/dt/(1<<30):.3f}}")
+"""
+
 _CKPT = _COMMON + """
 import jax
 from nvme_strom_tpu.data import save_checkpoint, restore_checkpoint
@@ -172,6 +188,8 @@ def main() -> int:
     base = f"/tmp/strom_matrix_{size_mb}"
 
     configs = [
+        ("h2d_peak", "host->HBM device_put (transport ceiling)",
+         _H2D.format(size=size), None),
         ("ssd2ram_seq", "SSD->pinned RAM, O_DIRECT seq",
          _SSD2RAM.format(size=size, path=base + ".bin"), None),
         # seq vs mq32 isolates async depth: the engine queue is capped at 4
@@ -199,7 +217,13 @@ def main() -> int:
         print(f"{key:<14} {desc:<34} {gbps:7.3f} GB/s")
     path = os.path.join(REPO, "BENCH_MATRIX.json")
     with open(path, "w") as f:
-        json.dump({"size_mb": size_mb, "unit": "GB/s", "results": results}, f,
+        json.dump({"size_mb": size_mb, "unit": "GB/s",
+                   "note": "h2d_peak is the host->HBM transport ceiling on "
+                           "this host (device transfers are rate-limited "
+                           "after a burst); TPU-destination rows are bounded "
+                           "by it, CPU-destination rows (ssd2ram/raid0) show "
+                           "the engine's own throughput",
+                   "results": results}, f,
                   indent=2)
         f.write("\n")
     print(f"wrote {path}")
