@@ -1,0 +1,83 @@
+"""End-to-end training loop: the full framework in one pass.
+
+SSD record file -> shuffled dp-sharded DeviceLoader batches -> jitted
+SPMD train step (psum gradients over the mesh) -> direct checkpoint
+save -> sharded restore -> bit-identical resume.  This is the usage
+story the reference never had (its consumer stops at the pgsql scan);
+every leg rides the engine's direct path.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def mesh8():
+    import jax
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    return make_scan_mesh(jax.devices()[:8], sp=1)
+
+
+def test_train_loop_end_to_end(tmp_path, mesh8):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nvme_strom_tpu.data import (DeviceLoader, restore_checkpoint,
+                                     save_checkpoint, write_records)
+
+    # dataset: y = sign(x @ w_true), 1024 samples of 32 features + label
+    rng = np.random.default_rng(0)
+    n, d = 1024, 31
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = rng.standard_normal(d).astype(np.float32)
+    y = (x @ w_true > 0).astype(np.float32)
+    samples = np.concatenate([x, y[:, None]], axis=1)  # (n, 32)
+    ds = write_records(str(tmp_path / "train.rec"), samples)
+
+    mesh = mesh8
+    repl = NamedSharding(mesh, P())
+
+    def loss_fn(w, batch):
+        xb, yb = batch[:, :d], batch[:, d]
+        logits = xb @ w
+        p = jax.nn.sigmoid(logits)
+        return -jnp.mean(yb * jnp.log(p + 1e-7)
+                         + (1 - yb) * jnp.log(1 - p + 1e-7))
+
+    @jax.jit
+    def train_step(w, batch):
+        # batch is dp-sharded on axis 0; jit partitions the grad reduce
+        # into a psum over the mesh automatically
+        loss, g = jax.value_and_grad(loss_fn)(w, batch)
+        return w - 0.5 * g, loss
+
+    w = jax.device_put(jnp.zeros(d, jnp.float32), repl)
+    losses = []
+    with DeviceLoader(ds, batch_records=128, shuffle=42, mesh=mesh) as dl:
+        for epoch in range(3):
+            for batch in dl.epoch(epoch):
+                w, loss = train_step(w, batch)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+    acc = float(np.mean((x @ np.asarray(w) > 0) == (y > 0)))
+    assert acc > 0.9, f"accuracy {acc}"
+
+    # checkpoint the state, restore sharded, resume bit-identically
+    ck = str(tmp_path / "state.strom")
+    save_checkpoint(ck, {"w": w, "epoch": np.int32(3)})
+    out = restore_checkpoint(ck, shardings={"['w']": repl})
+    w2 = out["['w']"]
+    np.testing.assert_array_equal(np.asarray(w2), np.asarray(w))
+    assert int(np.asarray(out["['epoch']"])) == 3
+
+    # one more deterministic epoch from each copy -> identical weights
+    with DeviceLoader(ds, batch_records=128, shuffle=42, mesh=mesh) as dl:
+        wa = w
+        for batch in dl.epoch(7):
+            wa, _ = train_step(wa, batch)
+    with DeviceLoader(ds, batch_records=128, shuffle=42, mesh=mesh) as dl:
+        wb = jax.device_put(w2, repl)
+        for batch in dl.epoch(7):
+            wb, _ = train_step(wb, batch)
+    np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
