@@ -7,7 +7,8 @@ byte-exact corruption check against the VFS (`-c`, `:342-372` with the
 mode (`-f`, pread + host→device copy, `:377-429`), and a mapped-region dump
 (`-p`, `:432-513`).  Reports GB/s and average DMA request size.
 
-Usage: ssd2tpu_test [-c] [-f [IOSIZE]] [-p] [-n SEGS] [-s SEG_SZ] [-d DEV] FILE
+Usage: ssd2tpu_test [-c] [-f [IOSIZE]] [-p] [-n SEGS] [-s SEG_SZ] [-d DEV]
+                    FILE [FILE ...]        (several FILEs = RAID-0 stripe set)
 """
 
 from __future__ import annotations
@@ -56,7 +57,12 @@ def _pick_device(index):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ssd2tpu_test", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("file")
+    ap.add_argument("file", nargs="+",
+                    help="source file; several files form a RAID-0-style "
+                         "striped set (see --stripe-chunk)")
+    ap.add_argument("--stripe-chunk", type=parse_size, default=512 << 10,
+                    help="stripe chunk size for multi-file sources "
+                         "(default 512KB, the md-raid0 shape)")
     ap.add_argument("-d", "--device", type=int, default=0)
     ap.add_argument("-n", "--segments", type=int, default=6,
                     help="pipeline depth (reference default: 6 worker segments)")
@@ -84,20 +90,42 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     from ..hbm import StagingPipeline, registry
 
-    info = check_file(args.file)
-    if not info.supported:
-        print(f"{args.file}: not supported for direct load", file=sys.stderr)
-        return 1
+    paths = args.file
+    striped = len(paths) > 1
+    infos = [check_file(p) for p in paths]
+    for p, i in zip(paths, infos):
+        if not i.supported:
+            print(f"{p}: not supported for direct load", file=sys.stderr)
+            return 1
+    info = infos[0]
+    # O_DIRECT alignment must honor the largest member block size, exactly
+    # as the single-file path does via check_file
+    block = max(i.logical_block_size for i in infos)
+
+    def _open():
+        if striped:
+            return open_source(paths, stripe_chunk_size=args.stripe_chunk,
+                               block_size=block)
+        return open_source(paths[0], block_size=block)
+
+    def _drop():
+        if not args.no_drop_cache:
+            for p in paths:
+                drop_page_cache(p)
+
+    with _open() as sized:
+        total_size = sized.size
     dev = _pick_device(args.device)
-    print(f"file: {args.file} ({info.file_size / (1 << 20):.1f} MB)  "
+    label = paths[0] if not striped else \
+        f"{len(paths)}-way stripe ({args.stripe_chunk >> 10}KB chunks)"
+    print(f"file: {label} ({total_size / (1 << 20):.1f} MB)  "
           f"device: {dev}  numa: {info.numa_node_id}")
     if args.backend:
         config.set("io_backend", args.backend)
-    if not args.no_drop_cache:
-        drop_page_cache(args.file)
+    _drop()
 
     chunk = args.chunk
-    n_chunks = info.file_size // chunk
+    n_chunks = total_size // chunk
     if n_chunks == 0:
         print("file smaller than one chunk", file=sys.stderr)
         return 1
@@ -121,15 +149,18 @@ def main(argv=None) -> int:
             _land(hbm, warm, 0, args.vfs)
             registry.get(handle).array.block_until_ready()
             for loop in range(args.loops):
-                if not args.no_drop_cache:
-                    drop_page_cache(args.file)
+                _drop()
                 tl = time.monotonic()
-                with open(args.file, "rb", buffering=0) as f:
+                with _open() as src:
                     off = 0
                     while off < nbytes:
                         n = min(args.vfs, nbytes - off)
+                        # fresh buffer per piece: device_put is async and
+                        # must never read a buffer we are about to refill
+                        data = bytearray(n)
+                        src.read_buffered(off, memoryview(data))
                         part = jax.device_put(
-                            np.frombuffer(f.read(n), dtype=np.uint8), dev)
+                            np.frombuffer(data, dtype=np.uint8), dev)
                         _land(hbm, part, off, args.vfs)
                         off += n
                 registry.get(handle).array.block_until_ready()
@@ -144,7 +175,7 @@ def main(argv=None) -> int:
         arr.block_until_ready()
         mode = f"vfs baseline (iosize {args.vfs >> 10}KB)"
     else:
-        with open_source(args.file) as src, Session() as sess:
+        with _open() as src, Session() as sess:
             handle = registry.map_device_memory(nbytes, device=dev)
             with StagingPipeline(sess, n_buffers=args.segments,
                                  staging_bytes=args.segment_size) as pipe:
@@ -159,11 +190,10 @@ def main(argv=None) -> int:
                     # the run's final partial batch lands with its own shape
                     pipe.memcpy_ssd2dev(src, handle, list(range(rem)), chunk)
                 registry.get(handle).array.block_until_ready()
-                if not args.no_drop_cache:
-                    drop_page_cache(args.file)
+                _drop()
                 for loop in range(args.loops):
-                    if loop and not args.no_drop_cache:
-                        drop_page_cache(args.file)
+                    if loop:
+                        _drop()
                     tl = time.monotonic()
                     res = pipe.memcpy_ssd2dev(src, handle,
                                               list(range(n_chunks)), chunk)
@@ -195,8 +225,10 @@ def main(argv=None) -> int:
     rc = 0
     if args.check:
         host = np.asarray(arr)
-        with open(args.file, "rb") as f:
-            want = f.read(nbytes)
+        wantbuf = bytearray(nbytes)
+        with _open() as src:
+            src.read_buffered(0, memoryview(wantbuf))
+        want = bytes(wantbuf)
         if args.vfs is None:
             # undo the chunk reordering: slot i holds chunk res.chunk_ids[i]
             order = res.chunk_ids
