@@ -254,3 +254,41 @@ def test_loader_abandoned_epoch_reaps_prefetch(tmp_path):
                 break  # abandon with a prefetch in flight
             # session slot table must be empty again (no retained tasks)
             assert sum(len(s) for s in sess._slots) == 0
+
+
+def test_loader_surfaces_injected_dma_errors(tmp_path):
+    """A failing SSD read latches into the task and surfaces as StromError
+    from the iterator — never silent data loss."""
+    from nvme_strom_tpu.testing import FakeNvmeSource, FaultPlan
+
+    _, ds = _make_ds(tmp_path, name="f.rec")
+    src = FakeNvmeSource(str(tmp_path / "f.rec"),
+                         fault_plan=FaultPlan(fail_offsets={8192}),
+                         force_cached_fraction=0.0)  # force the direct path
+    try:
+        with DeviceLoader(ds, batch_records=16, chunk_size=4096,
+                          source=src) as dl:
+            with pytest.raises(StromError):
+                for _ in dl:
+                    pass
+    finally:
+        src.close()
+
+
+def test_checkpoint_restore_detects_corruption(tmp_path):
+    """A flipped bit in a leaf segment yields different bytes (restore has
+    no checksum — the corruption oracle is the caller's comparison, as in
+    the reference's -c mode)."""
+    rng = np.random.default_rng(13)
+    tree = {"w": rng.standard_normal((64, 64)).astype(np.float32)}
+    path = str(tmp_path / "c.strom")
+    save_checkpoint(path, tree)
+    meta = checkpoint_info(path)
+    off = meta["data_offset"] + meta["leaves"][0]["offset"] + 100
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    out = restore_checkpoint(path)
+    assert not np.array_equal(np.asarray(out["['w']"]), tree["w"])
