@@ -33,3 +33,20 @@ def elog(msg: str) -> None:
     """Die with a message (the reference's ELOG macro, utils/utils_common.h)."""
     print(f"error: {msg}", file=sys.stderr)
     raise SystemExit(1)
+
+
+def apply_platform_env() -> None:
+    """Honor STROM_JAX_PLATFORMS before the first device query.
+
+    This image's TPU plugin registers itself from sitecustomize and wins
+    platform resolution over the JAX_PLATFORMS environment variable, so
+    tests (and users on a broken tunnel) need an authoritative switch:
+    ``jax.config.update`` is applied after import, which does take effect.
+    """
+    plat = os.environ.get("STROM_JAX_PLATFORMS")
+    if plat:
+        import jax
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass

@@ -86,6 +86,8 @@ def main(argv=None) -> int:
     if args.loops < 1:
         ap.error("--loops must be >= 1")
 
+    from .common import apply_platform_env
+    apply_platform_env()
     import jax
     import jax.numpy as jnp
     from ..hbm import StagingPipeline, registry

@@ -13,6 +13,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(mod, *args, env_extra=None, timeout=300):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # keep tool subprocesses off the TPU tunnel: tests must not depend on
+    # accelerator health (the sitecustomize ignores JAX_PLATFORMS, so the
+    # tools apply this via jax.config — see tools/common.apply_platform_env)
+    env["STROM_JAX_PLATFORMS"] = "cpu"
     env.update(env_extra or {})
     return subprocess.run([sys.executable, "-m", mod, *args],
                           capture_output=True, text=True, cwd=REPO, env=env,
