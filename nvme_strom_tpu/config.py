@@ -208,6 +208,24 @@ class Config:
         with self._lock:
             return dict(self._values)
 
+    def restore(self, snapshot: Dict[str, Any]) -> None:
+        """Atomically restore a snapshot().
+
+        Per-key set() can fail spuriously when cross-variable invariants
+        (chunk/buffer multiples) are violated mid-restore by key order;
+        this applies the whole snapshot, then validates once."""
+        with self._lock:
+            old = dict(self._values)
+            self._values.update({k: v for k, v in snapshot.items()
+                                 if k in self._vars})
+            try:
+                for v in self._vars.values():
+                    if v.validate is not None:
+                        v.validate(self._values[v.name], self)
+            except ConfigError:
+                self._values = old
+                raise
+
     def describe(self) -> Dict[str, Var]:
         return dict(self._vars)
 

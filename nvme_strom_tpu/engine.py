@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from .api import BufferInfo, DmaTaskState, FileInfo, FsKind, MemCopyResult, StromError
 from .config import config
+from .log import pr_info, pr_warn
 from .numa import device_numa_node
 from .stats import stats
 from .stripe import StripeMap
@@ -601,6 +602,8 @@ class Session:
                                 f"io_backend={want} requires the native engine")
         self.backend_name = (self._native.backend_name if self._native
                              else "python")
+        pr_info("session open: backend=%s workers=%d",
+                self.backend_name, nworkers)
 
     # -- buffer registry (MAP/UNMAP/LIST/INFO analogs) ---------------------
     def alloc_dma_buffer(self, length: int, *, numa_node: int = -1) -> Tuple[int, DmaBuffer]:
@@ -699,11 +702,13 @@ class Session:
 
     def _task_put(self, task: DmaTask, err: Optional[StromError] = None) -> None:
         s = self._slot_of(task.task_id)
+        latched = None
         with self._slot_cv[s]:
             if err is not None and task.errno_ == 0:
                 # first error wins (reference strom_put_dma_task, :770-776)
                 task.errno_ = err.errno
                 task.errmsg = str(err)
+                latched = err
             task.pending -= 1
             done = task.pending == 0
             if done:
@@ -711,6 +716,9 @@ class Session:
                               else DmaTaskState.DONE)
                 stats.count_clock("ssd2dev", time.monotonic_ns() - task.t_submit)
                 self._slot_cv[s].notify_all()
+        if latched is not None:
+            # outside the lock: a slow stderr must not stall completions
+            pr_warn("dma task %d latched error: %s", task.task_id, latched)
         if done and task.buf_handle is not None:
             self._put_buffer(task.buf_handle)
 

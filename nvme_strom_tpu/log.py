@@ -1,0 +1,65 @@
+"""Leveled logging gated by the runtime ``verbose`` config.
+
+The reference's printk wrappers prDebug/prInfo/prNotice/prWarn/prError
+with a two-level verbosity module param writable at runtime
+(`kmod/nvme_strom.c:75-78,122-137`).  Here: thin wrappers over the stdlib
+logger, gated by ``config.get("verbose")`` so ``config.set("verbose", 2)``
+(or the STROM_TPU_VERBOSE env tier) switches tracing on live, matching
+the sysfs-0644 semantics of the reference's param.
+
+Levels: 0 = warnings/errors only (default), 1 = info/notice, 2 = debug.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from .config import config
+
+__all__ = ["pr_debug", "pr_info", "pr_notice", "pr_warn", "pr_error", "logger"]
+
+class _StderrHandler(logging.StreamHandler):
+    """Resolve sys.stderr at emit time: redirection/capture (pytest capsys,
+    shell 2>) must see output no matter when this module was imported."""
+
+    def __init__(self):
+        super().__init__()
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):  # StreamHandler.__init__ assigns; ignore
+        pass
+
+
+logger = logging.getLogger("nvme_strom_tpu")
+if not logger.handlers:
+    _h = _StderrHandler()
+    _h.setFormatter(logging.Formatter("strom_tpu: %(levelname)s: %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(logging.DEBUG)   # gating happens per-call via config
+    logger.propagate = False
+
+
+def pr_debug(msg: str, *args) -> None:
+    if config.get("verbose") >= 2:
+        logger.debug(msg, *args)
+
+
+def pr_info(msg: str, *args) -> None:
+    if config.get("verbose") >= 1:
+        logger.info(msg, *args)
+
+
+pr_notice = pr_info
+
+
+def pr_warn(msg: str, *args) -> None:
+    logger.warning(msg, *args)
+
+
+def pr_error(msg: str, *args) -> None:
+    logger.error(msg, *args)

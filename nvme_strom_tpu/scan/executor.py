@@ -54,6 +54,7 @@ class LocalCursor:
 
     def __init__(self, n_chunks: int, start: int = 0):
         self.n_chunks = n_chunks
+        self._start = start
         self._next = start
         self._lock = threading.Lock()
 
@@ -66,6 +67,11 @@ class LocalCursor:
                 return first, 0
             self._next += n
             return first, n
+
+    def reset(self) -> None:
+        """Rewind for a rescan (ExecReScanNVMEStrom, pgsql/nvme_strom.c)."""
+        with self._lock:
+            self._next = self._start
 
 
 @dataclass
@@ -233,6 +239,12 @@ class TableScanner:
     def _recycle(self, batch: Batch) -> None:
         self.session.unmap_buffer(batch._handle)
         batch._chunk.release()
+
+    def rescan(self) -> None:
+        """Rewind the cursor so the table can be scanned again from page 0
+        (ExecReScanNVMEStrom, `pgsql/nvme_strom.c:1047-1055`).  Only valid
+        between scans — not while a batches() iterator is live."""
+        self.cursor.reset()
 
     # -- device-filter pipeline --------------------------------------------
     def scan_filter(self, filter_fn: Callable, *, device=None,

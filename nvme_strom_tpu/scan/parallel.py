@@ -57,6 +57,13 @@ class SharedCursor:
             _HDR.pack_into(self._shm.buf, 0, nxt + n, total)
             return nxt, n
 
+    def reset(self) -> None:
+        """Rewind the shared cursor for a rescan (ExecReScan in parallel
+        mode reinitializes the DSM block counter)."""
+        with self._lock:
+            _, total = _HDR.unpack_from(self._shm.buf, 0)
+            _HDR.pack_into(self._shm.buf, 0, 0, total)
+
     def close(self, *, unlink: bool = False) -> None:
         self._shm.close()
         if unlink:

@@ -32,12 +32,9 @@ def tmp_data_file(tmp_path):
 
 @pytest.fixture(autouse=True)
 def _reset_config():
-    """Isolate config mutations between tests."""
+    """Isolate config mutations between tests (atomic restore: per-key
+    set() can trip cross-variable invariants depending on key order)."""
     from nvme_strom_tpu.config import config
     snap = config.snapshot()
     yield
-    for k, v in snap.items():
-        try:
-            config.set(k, v)
-        except Exception:
-            pass
+    config.restore(snap)

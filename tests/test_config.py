@@ -77,3 +77,23 @@ def test_io_backend_validated():
     with pytest.raises(ConfigError):
         config.set("io_backend", "nonsense")
     config.set("io_backend", "threadpool")
+
+
+def test_leveled_logging_gated_by_verbose(capsys):
+    """pr_* wrappers honor the runtime verbose config (the reference's
+    writable module param, kmod/nvme_strom.c:76-82)."""
+    from nvme_strom_tpu.config import config
+    from nvme_strom_tpu.log import pr_debug, pr_info, pr_warn
+
+    config.set("verbose", 0)
+    pr_debug("dbg %d", 1)
+    pr_info("inf")
+    pr_warn("wrn")
+    err = capsys.readouterr().err
+    assert "wrn" in err and "dbg" not in err and "inf" not in err
+
+    config.set("verbose", 2)
+    pr_debug("dbg2")
+    pr_info("inf2")
+    err = capsys.readouterr().err
+    assert "dbg2" in err and "inf2" in err
