@@ -40,6 +40,21 @@ def _env():
     return env
 
 
+def _probe_backend(timeout_s: int = 180) -> bool:
+    """Can a subprocess initialize the accelerator at all?  The TPU tunnel
+    on some hosts wedges; a bounded probe keeps bench from hanging for the
+    full per-mode timeout on every run."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, cwd=REPO, env=_env(),
+            timeout=timeout_s)
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _run_mode(path: str, extra_args) -> float:
     """Run ssd2tpu_test in a subprocess, return GB/s."""
     cmd = [sys.executable, "-m", "nvme_strom_tpu.tools.ssd2tpu_test", path,
@@ -61,6 +76,17 @@ def main() -> int:
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")
     _ensure_file(path, size_mb << 20)
+
+    if not _probe_backend():
+        sys.stderr.write("bench: device backend failed to initialize "
+                         "(wedged tunnel?) — retrying once in 60s\n")
+        import time as _t
+        _t.sleep(60)
+        if not _probe_backend():
+            print(json.dumps({"metric": "ssd2tpu_seq_GBps", "value": 0.0,
+                              "unit": "GB/s", "vs_baseline": None,
+                              "error": "device backend unavailable"}))
+            return 1
 
     # Alternate modes across fresh subprocesses and keep the best of each:
     # some hosts rate-limit device transfers after a burst, so a fixed

@@ -218,3 +218,43 @@ def test_plan_requests_striped_source_bytes(chunks_per_member, data):
     finally:
         for p in paths:
             os.unlink(p)
+
+
+# -- heap format: random schemas round-trip through the XLA decoder ----------
+
+@settings(max_examples=25, deadline=None)
+@given(n_cols=st.integers(1, 6),
+       visibility=st.booleans(),
+       n_rows=st.integers(1, 4000),
+       data=st.data())
+def test_heap_roundtrip_and_xla_decode(n_cols, visibility, n_rows, data):
+    """build_pages -> read_column (numpy) and decode_pages (XLA) agree for
+    arbitrary schema geometry, including partial last pages and random
+    visibility masks."""
+    from nvme_strom_tpu.ops.filter_xla import decode_pages
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_pages, read_column
+
+    schema = HeapSchema(n_cols=n_cols, visibility=visibility)
+    rng = np.random.default_rng(data.draw(st.integers(0, 2 ** 31)))
+    cols = [rng.integers(-10**6, 10**6, n_rows).astype(np.int32)
+            for _ in range(n_cols)]
+    vis = (rng.random(n_rows) > 0.25).astype(np.int32) if visibility else None
+    pages = build_pages(cols, schema, visibility=vis)
+
+    for c in range(n_cols):
+        np.testing.assert_array_equal(read_column(pages, schema, c), cols[c])
+
+    dec_cols, valid = decode_pages(pages, schema)
+    t = schema.tuples_per_page
+    n_pages = pages.shape[0]
+    want_valid = np.zeros((n_pages, t), bool)
+    for r in range(n_rows):
+        want_valid[r // t, r % t] = True if vis is None else bool(vis[r])
+    np.testing.assert_array_equal(np.asarray(valid), want_valid)
+    for c in range(n_cols):
+        got = np.asarray(dec_cols[c]).reshape(-1)[:n_pages * t]
+        flat_rows = np.zeros(n_pages * t, np.int32)
+        for r in range(n_rows):
+            flat_rows[(r // t) * t + r % t] = cols[c][r]
+        sel = want_valid.reshape(-1)
+        np.testing.assert_array_equal(got[sel], flat_rows[sel])
