@@ -33,7 +33,7 @@ from ..ops.filter_xla import DEFAULT_SCHEMA, decode_pages
 from ..scan.heap import HeapSchema
 from .mesh import make_scan_mesh
 
-__all__ = ["make_ring_multi_query_scan"]
+__all__ = ["make_ring_multi_query_scan", "ring_scan_source"]
 
 
 def make_ring_multi_query_scan(devices: Optional[Sequence[jax.Device]] = None,
@@ -100,4 +100,59 @@ def make_ring_multi_query_scan(devices: Optional[Sequence[jax.Device]] = None,
                              NamedSharding(mesh, P("dp")))
         return step(pages, ths)
 
+    run.step = step
     return run, mesh
+
+
+def ring_scan_source(source, thresholds_np: np.ndarray, *,
+                     batch_pages: int,
+                     devices: Optional[Sequence[jax.Device]] = None,
+                     schema: HeapSchema = DEFAULT_SCHEMA,
+                     predicate=None, session=None) -> dict:
+    """Stream a source through the ring scan: the long-sequence shape.
+
+    The table can exceed total HBM: each batch is direct-loaded dp-sharded
+    (submit-ahead double buffering, `.stream.ShardedBatchStream`), rotated
+    around the ring so every query aggregates over every page, and folded.
+    Peak per-device memory stays O(batch/dp) however long the source is —
+    ring attention's memory property applied to the scan.
+
+    Returns ``{"count": (dp,), "sums": (dp, n_cols)}`` over the whole
+    source (tail pages that do not fill a batch are scanned via a final
+    padded batch, so nothing is dropped).
+    """
+    from .stream import ShardedBatchStream
+    from ..scan.heap import PAGE_SIZE
+
+    run, mesh = make_ring_multi_query_scan(devices, schema=schema,
+                                           predicate=predicate)
+    dp = mesh.shape["dp"]
+    if batch_pages % dp:
+        raise ValueError(f"batch_pages {batch_pages} must divide by dp {dp}")
+    acc = None
+    step = run.step
+    ths = jax.device_put(np.asarray(thresholds_np, np.int32),
+                         NamedSharding(mesh, P("dp")))
+
+    def fold(pages_global):
+        nonlocal acc
+        out = step(pages_global, ths)
+        acc = out if acc is None else jax.tree.map(lambda a, b: a + b,
+                                                   acc, out)
+
+    n_pages = source.size // PAGE_SIZE
+    covered = 0
+    with ShardedBatchStream(source, mesh, batch_pages=batch_pages,
+                            session=session) as stream:
+        for first, arr in stream:
+            fold(arr)
+            covered = first + batch_pages
+    if covered < n_pages:
+        # tail: pad with zero pages (n_tuples == 0 contributes nothing)
+        tail = np.zeros((batch_pages, PAGE_SIZE), np.uint8)
+        nbytes = (n_pages - covered) * PAGE_SIZE
+        view = np.empty(nbytes, np.uint8)
+        source.read_buffered(covered * PAGE_SIZE, memoryview(view))
+        tail[:n_pages - covered] = view.reshape(-1, PAGE_SIZE)
+        fold(jax.device_put(tail, NamedSharding(mesh, P("dp", None))))
+    return {} if acc is None else {k: np.asarray(v) for k, v in acc.items()}
