@@ -31,7 +31,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..api import StromError
-from ..engine import Session, open_source
+from ..engine import Session, open_source, reorder_chunks
+from ..hbm.staging import default_device, safe_device_put
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "checkpoint_info"]
 
@@ -142,9 +143,7 @@ class _PinnedRing:
     def put(self, host: np.ndarray, dev):
         """device_put that records a fence on the current buffer (several
         puts may read the same staged bytes — e.g. replicated shards)."""
-        import jax
-        from ..hbm.staging import owned_if_cpu
-        arr = jax.device_put(owned_if_cpu(host, dev), dev)
+        arr = safe_device_put(host, dev)
         self.fences[self.cur].append(arr)
         return arr
 
@@ -184,14 +183,9 @@ def _read_span(sess, source, file_off: int, nbytes: int,
             ids = list(range(c0, c1))
             res = sess.memcpy_ssd2ram(source, handle, ids, _CHUNK)
             sess.memcpy_wait(res.dma_task_id)
-            if list(res.chunk_ids) != ids:
-                blocks = np.frombuffer(
-                    buf.view()[:len(ids) * _CHUNK], np.uint8).reshape(
-                        len(ids), _CHUNK)
-                view = np.ascontiguousarray(
-                    blocks[np.argsort(res.chunk_ids)]).ravel()[:take]
-            else:
-                view = np.frombuffer(buf.view()[:take], np.uint8)
+            view = reorder_chunks(
+                np.frombuffer(buf.view()[:len(ids) * _CHUNK], np.uint8),
+                _CHUNK, res.chunk_ids, ids)[:take]
         else:
             # unaligned head or grid running past EOF: buffered leg
             source.read_buffered(start, buf.view()[:take])
@@ -237,7 +231,7 @@ def restore_checkpoint(path: str, *, shardings=None, like=None,
                     base = data0 + e["offset"]
                     sh = _leaf_sharding(shardings, key)
                     if sh is None:
-                        dev = device or _default_device()
+                        dev = device or default_device()
                         host = _read_span(sess, source, base, e["nbytes"],
                                           ring).view(dtype).reshape(shape)
                         out[key] = ring.put(host, dev)
@@ -254,13 +248,6 @@ def restore_checkpoint(path: str, *, shardings=None, like=None,
         treedef = jax.tree_util.tree_structure(like)
         return jax.tree_util.tree_unflatten(treedef, leaves)
     return out
-
-
-def _default_device():
-    import jax
-    devs = jax.devices()
-    accel = [d for d in devs if d.platform != "cpu"]
-    return (accel or devs)[0]
 
 
 def _restore_sharded(sess, source, base, dtype, shape, sharding,

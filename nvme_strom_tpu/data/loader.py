@@ -24,7 +24,7 @@ import numpy as np
 
 from ..api import StromError
 from ..config import config
-from ..engine import Session, Source, open_source
+from ..engine import Session, Source, open_source, reorder_chunks
 from .records import RecordDataset
 
 __all__ = ["DeviceLoader"]
@@ -101,19 +101,21 @@ class DeviceLoader:
         self._fence = [None, None]
         self._epoch = 0
         self._closed = False
+        self._placement_cache = None
 
     # -- iteration -----------------------------------------------------------
     def _placement(self):
-        import jax
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            spec = P(self.axis, *([None] * len(self.ds.shape)))
-            return NamedSharding(self.mesh, spec)
-        if self._device is not None:
-            return self._device
-        devs = jax.devices()
-        accel = [d for d in devs if d.platform != "cpu"]
-        return (accel or devs)[0]
+        if self._placement_cache is None:
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                spec = P(self.axis, *([None] * len(self.ds.shape)))
+                self._placement_cache = NamedSharding(self.mesh, spec)
+            elif self._device is not None:
+                self._placement_cache = self._device
+            else:
+                from ..hbm.staging import default_device
+                self._placement_cache = default_device()
+        return self._placement_cache
 
     def _epoch_ids(self, epoch: int) -> np.ndarray:
         ids = np.arange(self.n_chunks, dtype=np.int64)
@@ -133,29 +135,21 @@ class DeviceLoader:
                                                 self.chunk_size)
 
     def _collect(self, ring: int, req, res):
-        import jax
-        from ..hbm.staging import owned_if_cpu
+        from ..hbm.staging import safe_device_put
 
         self.session.memcpy_wait(res.dma_task_id)
         _, buf = self._bufs[ring]
         nbytes = self.chunks_per_batch * self.chunk_size
         raw = np.frombuffer(buf.view()[:nbytes], np.uint8)
-        if list(res.chunk_ids) != req:
-            # restore the *requested* order: the engine fronts direct-I/O
-            # chunks and tails write-back chunks, and which chunks are
-            # cache-resident varies run to run — without this, a seeded
-            # shuffle would not be reproducible
-            pos = {cid: j for j, cid in enumerate(req)}
-            blocks = raw.reshape(self.chunks_per_batch, self.chunk_size)
-            ordered = np.empty_like(blocks)
-            ordered[[pos[c] for c in res.chunk_ids]] = blocks
-            raw = ordered.ravel()
+        # restore the *requested* order: which chunks are cache-resident
+        # (and therefore engine-reordered) varies run to run — without
+        # this, a seeded shuffle would not be reproducible
+        raw = reorder_chunks(raw, self.chunk_size, res.chunk_ids, req)
         batch = self.ds.decode(raw)
-        placement = self._placement()
         # decode() usually copies, but the stride==record_bytes fast path
         # hands device_put a zero-copy view of the pinned buffer — which
-        # the CPU backend would alias (accelerators always copy)
-        arr = jax.device_put(owned_if_cpu(batch, placement), placement)
+        # the CPU backend would alias; safe_device_put copies there
+        arr = safe_device_put(batch, self._placement())
         # pinned reuse is fenced on the device array (H2D read completion)
         self._fence[ring] = arr
         return arr

@@ -539,6 +539,26 @@ def plan_requests(source: Source, chunk_entries: Sequence[Tuple[int, int]],
     return out
 
 
+def reorder_chunks(raw: "np.ndarray", chunk_size: int,
+                   got_ids: Sequence[int],
+                   want_ids: Sequence[int]) -> "np.ndarray":
+    """Rearrange a chunk-strided buffer from the engine's completion order
+    (direct-I/O chunks fronted, write-back chunks tailed — the reference's
+    chunk_ids contract, kmod/nvme_strom.h:99-101) back to the caller's
+    requested order.  Returns *raw* unchanged when the orders already
+    match, else an owned copy."""
+    import numpy as np
+    got = list(got_ids)
+    want = list(want_ids)
+    if got == want:
+        return raw
+    pos = {cid: j for j, cid in enumerate(want)}
+    blocks = raw.reshape(len(got), chunk_size)
+    ordered = np.empty_like(blocks)
+    ordered[[pos[c] for c in got]] = blocks
+    return ordered.reshape(raw.shape)
+
+
 # ---------------------------------------------------------------------------
 # Async task table
 # ---------------------------------------------------------------------------

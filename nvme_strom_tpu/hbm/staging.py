@@ -77,6 +77,20 @@ def owned_if_cpu(host: np.ndarray, devlike) -> np.ndarray:
     return host
 
 
+def safe_device_put(host: np.ndarray, devlike) -> jax.Array:
+    """device_put that never aliases the source buffer (owned_if_cpu)."""
+    return jax.device_put(owned_if_cpu(host, devlike), devlike)
+
+
+def default_device(index: int = 0) -> jax.Device:
+    """Prefer an accelerator, like the reference preferring Tesla/Quadro
+    (`utils/ssd2gpu_test.c:632-656`); fall back to CPU."""
+    devs = jax.devices()
+    accel = [d for d in devs if d.platform != "cpu"]
+    pool = accel or devs
+    return pool[index if index < len(pool) else 0]
+
+
 def _land(hbm, dev_chunk, elem_start: int, grid_elems: int):
     """Pick the addressing mode for one landing and install the result."""
     if (grid_elems and hbm.array.size % grid_elems == 0
@@ -168,7 +182,7 @@ class StagingPipeline:
                 _, dbuf = self._bufs[bufidx]
                 dev = list(hbm.array.devices())[0]
                 host = np.frombuffer(dbuf.view()[:nbytes], dtype=device_dtype)
-                dev_chunk = jax.device_put(owned_if_cpu(host, dev), dev)
+                dev_chunk = safe_device_put(host, dev)
                 _land(hbm, dev_chunk, elem_start, grid_elems)
                 # the staging buffer is reusable once the H2D *read* of it
                 # completes — fence on the device chunk, not the landing
@@ -268,7 +282,7 @@ def load_file_to_device(source: Source, *, chunk_size: Optional[int] = None,
                     try:
                         tdev = list(hbm.array.devices())[0]
                         host = np.frombuffer(tbuf.view()[:tail], dtype=dtype)
-                        dev = jax.device_put(owned_if_cpu(host, tdev), tdev)
+                        dev = safe_device_put(host, tdev)
                         _land(hbm, dev, n_full * chunk_size // itemsize,
                               chunk_size // itemsize)
                     finally:

@@ -23,8 +23,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..api import StromError
-from ..engine import Session, Source
-from ..hbm.staging import owned_if_cpu
+from ..engine import Session, Source, reorder_chunks
+from ..hbm.staging import safe_device_put
 from ..scan.heap import PAGE_SIZE
 
 __all__ = ["load_pages_sharded", "ShardedBatchStream", "distributed_scan_filter"]
@@ -63,18 +63,14 @@ def load_pages_sharded(source: Source, mesh: Mesh, *,
             nbytes = (r1 - r0) * PAGE_SIZE
             handle, buf = sess.alloc_dma_buffer(nbytes)
             try:
-                res = sess.memcpy_ssd2ram(source, handle,
-                                          list(range(r0, r1)), PAGE_SIZE)
+                want = list(range(r0, r1))
+                res = sess.memcpy_ssd2ram(source, handle, want, PAGE_SIZE)
                 sess.memcpy_wait(res.dma_task_id)
-                # chunk granularity == page, so reordering cannot occur
-                # across pages; still, land pages at their true slots
-                host = np.frombuffer(buf.view()[:nbytes], np.uint8).reshape(
-                    r1 - r0, PAGE_SIZE)
-                if res.chunk_ids != list(range(r0, r1)):
-                    order = np.argsort(np.asarray(res.chunk_ids))
-                    host = host[order]
-                shards.append(jax.device_put(
-                    owned_if_cpu(np.ascontiguousarray(host), dev), dev))
+                host = reorder_chunks(
+                    np.frombuffer(buf.view()[:nbytes], np.uint8),
+                    PAGE_SIZE, res.chunk_ids, want).reshape(r1 - r0,
+                                                            PAGE_SIZE)
+                shards.append(safe_device_put(host, dev))
             finally:
                 sess.unmap_buffer(handle)
                 buf.close()
@@ -150,14 +146,13 @@ class ShardedBatchStream:
         for k, (dev, res) in enumerate(tasks):
             done = self.session.memcpy_wait(res.dma_task_id)
             _handle, buf = self._bufs[k][ring]
-            host = np.frombuffer(buf.view(), np.uint8).reshape(-1, PAGE_SIZE)
             # slot i holds chunk chunk_ids[i]: with a partially cached
             # source the engine fronts direct-I/O chunks and tails
             # write-back chunks, so restore file order before placement
-            ids = np.asarray(done.chunk_ids)
-            if not np.array_equal(ids, np.sort(ids)):
-                host = np.ascontiguousarray(host[np.argsort(ids)])
-            shards.append(jax.device_put(owned_if_cpu(host, dev), dev))
+            host = reorder_chunks(np.frombuffer(buf.view(), np.uint8),
+                                  PAGE_SIZE, done.chunk_ids,
+                                  sorted(done.chunk_ids)).reshape(-1, PAGE_SIZE)
+            shards.append(safe_device_put(host, dev))
         arr = jax.make_array_from_single_device_arrays(
             self._shape, self.sharding, shards)
         self._fence[ring] = arr

@@ -45,13 +45,8 @@ def memdump_on_corruption(got: np.ndarray, want: bytes, base: int) -> None:
 
 
 def _pick_device(index):
-    import jax
-    devs = jax.devices()
-    # prefer an accelerator, like the reference preferring Tesla/Quadro
-    # (utils/ssd2gpu_test.c:632-656)
-    accel = [d for d in devs if d.platform != "cpu"]
-    pool = accel or devs
-    return pool[index if index < len(pool) else 0]
+    from ..hbm.staging import default_device
+    return default_device(index)
 
 
 def main(argv=None) -> int:
