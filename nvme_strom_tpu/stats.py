@@ -96,6 +96,16 @@ class StatRegistry:
         return StatInfo(version=1, has_debug=debug,
                         timestamp_ns=time.monotonic_ns(), counters=counters)
 
+    def as_arrays(self, *, debug: bool = False):
+        """Snapshot as (names, np.int64 values) — JAX-visible counters
+        (SURVEY.md SS5.1): feed the values array straight into jitted
+        monitoring/regression code via device_put."""
+        import numpy as np
+        snap = self.snapshot(debug=debug, reset_max=False)
+        names = sorted(snap.counters)
+        return names, np.asarray([snap.counters[n] for n in names],
+                                 dtype=np.int64)
+
     def start_export(self, path: str = None, interval: float = 0.5) -> None:
         """Start the background exporter (idempotent).  Tools call this so a
         concurrently-running ``tpu_stat`` can watch, like ``nvme_stat``

@@ -97,3 +97,14 @@ def test_leveled_logging_gated_by_verbose(capsys):
     pr_info("inf2")
     err = capsys.readouterr().err
     assert "dbg2" in err and "inf2" in err
+
+
+def test_stats_as_arrays():
+    """Counters export as a JAX-ingestible int64 vector (SURVEY §5.1)."""
+    import numpy as np
+    from nvme_strom_tpu.stats import stats
+
+    stats.add("nr_ssd2dev", 3)
+    names, vals = stats.as_arrays()
+    assert vals.dtype == np.int64 and len(names) == len(vals)
+    assert vals[names.index("nr_ssd2dev")] >= 3

@@ -308,3 +308,32 @@ def test_strom_ckpt_cli(tmp_path, capsys):
     # NB: verify is a direct-vs-buffered consistency oracle (the reference
     # -c pattern) — it catches DMA-path corruption, not file tampering,
     # which both paths would read identically.
+
+
+def test_loader_over_segmented_source(tmp_path):
+    """Record files split into fixed-size segments (the RELSEG_SIZE analog,
+    utils/utils_common.h:26-27) load through the same DeviceLoader."""
+    from nvme_strom_tpu.engine import open_source
+
+    rng = np.random.default_rng(51)
+    a = rng.integers(-1000, 1000, (64, 128)).astype(np.int32)  # 512B strides
+    whole = str(tmp_path / "seg.rec")
+    ds = write_records(whole, a)
+    # split the payload into 8KB segment files
+    raw = open(whole, "rb").read()
+    seg = 8192
+    paths = []
+    for i in range(0, len(raw), seg):
+        p = str(tmp_path / f"seg.rec.{i // seg}")
+        with open(p, "wb") as f:
+            f.write(raw[i:i + seg])
+        paths.append(p)
+
+    src = open_source(paths, segment_size=seg)
+    try:
+        with DeviceLoader(ds, batch_records=16, chunk_size=4096,
+                          source=src) as dl:
+            got = np.concatenate([np.asarray(b) for b in dl])
+        np.testing.assert_array_equal(got, a)
+    finally:
+        src.close()
