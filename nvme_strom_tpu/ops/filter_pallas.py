@@ -57,8 +57,17 @@ def _decode_block(w, schema: HeapSchema):
     return cols, valid
 
 
+def _check_int_schema(schema: HeapSchema) -> None:
+    if schema.dtypes is not None and any(
+            schema.col_dtype(c).kind != "i" for c in range(schema.n_cols)):
+        raise ValueError("the pallas kernel aggregates int32 schemas only "
+                         "(SMEM int accumulators); use the XLA path "
+                         "(ops.filter_xla) for typed columns")
+
+
 def _make_kernel(schema: HeapSchema, predicate):
     n_cols = schema.n_cols
+    _check_int_schema(schema)
 
     def kernel(thresh_ref, w_ref, count_ref, sums_ref):
         i = pl.program_id(0)
@@ -136,6 +145,7 @@ def make_filter_fn_pallas(schema: HeapSchema, predicate, *,
     ``predicate(cols, threshold) -> bool (B, T)`` must be built from jnp ops
     (it is traced inside the kernel).  Returns a jitted
     ``run(pages_u8, threshold) -> {"count", "sums"}``."""
+    _check_int_schema(schema)
 
     @jax.jit
     def run(pages_u8, threshold=jnp.int32(0)):

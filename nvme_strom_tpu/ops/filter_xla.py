@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..scan.heap import HEADER_WORDS, PAGE_SIZE, HeapSchema
 
@@ -37,7 +38,12 @@ def decode_pages(pages_u8: jax.Array, schema: HeapSchema = DEFAULT_SCHEMA):
     cols = []
     for c in range(schema.n_cols):
         s, e = schema.col_word_range(c)
-        cols.append(words[:, s:e])
+        col = words[:, s:e]
+        dt = schema.col_dtype(c)
+        if dt != np.dtype(np.int32):
+            # typed columns are a bitcast — layout is dtype-independent
+            col = jax.lax.bitcast_convert_type(col, jnp.dtype(dt))
+        cols.append(col)
     if schema.visibility:
         s, e = schema.col_word_range(schema.n_cols)
         visible = words[:, s:e] != 0

@@ -57,6 +57,11 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
     """
     cols_idx = list(agg_cols) if agg_cols is not None else \
         list(range(schema.n_cols))
+    for ci in cols_idx:
+        if schema.col_dtype(ci) != np.dtype(np.int32):
+            raise ValueError(f"groupby aggregates int32 columns only "
+                             f"(col {ci} is {schema.col_dtype(ci)}); "
+                             f"filter float columns via make_filter_fn")
     G = int(n_groups)
 
     @jax.jit

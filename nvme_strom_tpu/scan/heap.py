@@ -38,10 +38,27 @@ HEAP_MAGIC = 0x53545250           # 'PRTS'
 
 @dataclass(frozen=True)
 class HeapSchema:
-    """Fixed-width int32/float32 column schema."""
+    """Fixed-width 4-byte column schema (int32 / float32 / uint32).
+
+    ``dtypes`` — optional per-column dtype strings (default: all int32).
+    Every dtype occupies one word, so layout is dtype-independent; typed
+    decode is a bitcast in the XLA path."""
 
     n_cols: int
     visibility: bool = False       # append a per-tuple visibility column
+    dtypes: Optional[tuple] = None
+
+    def __post_init__(self):
+        if self.dtypes is not None:
+            if len(self.dtypes) != self.n_cols:
+                raise ValueError(f"{len(self.dtypes)} dtypes for "
+                                 f"{self.n_cols} columns")
+            for d in self.dtypes:
+                if np.dtype(d).itemsize != 4:
+                    raise ValueError(f"column dtype {d} is not 4-byte")
+
+    def col_dtype(self, c: int) -> np.dtype:
+        return np.dtype(self.dtypes[c]) if self.dtypes else np.dtype(np.int32)
 
     @property
     def phys_cols(self) -> int:
@@ -67,11 +84,14 @@ def build_pages(columns: Sequence[np.ndarray], schema: HeapSchema, *,
     if len(columns) != schema.n_cols:
         raise ValueError(f"expected {schema.n_cols} columns, got {len(columns)}")
     n_rows = len(columns[0])
-    for c in columns:
+    for ci, c in enumerate(columns):
         if len(c) != n_rows:
             raise ValueError("ragged columns")
         if c.dtype.itemsize != 4:
             raise ValueError("columns must be 4-byte dtypes")
+        if schema.dtypes is not None and c.dtype != schema.col_dtype(ci):
+            raise ValueError(f"column {ci} dtype {c.dtype} != schema "
+                             f"{schema.col_dtype(ci)}")
     if schema.visibility:
         if visibility is None:
             visibility = np.ones(n_rows, dtype=np.int32)
@@ -115,8 +135,9 @@ def pages_from_bytes(raw: bytes | np.ndarray) -> np.ndarray:
 
 
 def read_column(pages: np.ndarray, schema: HeapSchema, c: int,
-                dtype=np.int32) -> np.ndarray:
+                dtype=None) -> np.ndarray:
     """Host-side column extraction (test oracle for the XLA kernels)."""
+    dtype = dtype if dtype is not None else schema.col_dtype(c)
     words = pages.view(np.int32).reshape(pages.shape[0], PAGE_SIZE // 4)
     s, e = schema.col_word_range(c)
     out = []

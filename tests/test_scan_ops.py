@@ -421,3 +421,44 @@ def test_ring_scan_source_streams_whole_table(tmp_path):
         assert int(out["count"][q]) == int(sel.sum()), f"query {q}"
         assert int(out["sums"][q, 0]) == int(c0[sel].sum())
         assert int(out["sums"][q, 1]) == int(c1[sel].sum())
+
+
+def test_typed_float_columns_roundtrip_and_filter(tmp_path):
+    """float32 columns: layout-identical storage, bitcast decode, float
+    predicates and aggregates through make_filter_fn."""
+    import jax.numpy as jnp
+    from nvme_strom_tpu.ops.filter_xla import decode_pages, make_filter_fn
+    from nvme_strom_tpu.scan.heap import build_pages, read_column
+
+    rng = np.random.default_rng(61)
+    schema = HeapSchema(n_cols=2, visibility=True,
+                        dtypes=("float32", "int32"))
+    n = schema.tuples_per_page * 3 + 7
+    f = rng.standard_normal(n).astype(np.float32)
+    i = rng.integers(0, 100, n).astype(np.int32)
+    pages = build_pages([f, i], schema)
+
+    np.testing.assert_array_equal(read_column(pages, schema, 0), f)
+    assert read_column(pages, schema, 0).dtype == np.float32
+
+    cols, valid = decode_pages(pages, schema)
+    assert cols[0].dtype == jnp.float32
+
+    fn = make_filter_fn(schema, lambda cols: cols[0] > 0.5)
+    out = fn(pages)
+    sel = f > 0.5
+    assert int(out["count"]) == int(sel.sum())
+
+    # schema validation
+    with pytest.raises(ValueError):
+        HeapSchema(n_cols=2, dtypes=("float64", "int32"))
+    with pytest.raises(ValueError):
+        build_pages([i, i], schema)  # col0 dtype mismatch
+
+    # pallas + groupby refuse float schemas explicitly
+    from nvme_strom_tpu.ops.filter_pallas import make_filter_fn_pallas
+    from nvme_strom_tpu.ops.groupby import make_groupby_fn
+    with pytest.raises(ValueError):
+        make_filter_fn_pallas(schema, lambda cols, th: cols[1] > th)
+    with pytest.raises(ValueError):
+        make_groupby_fn(schema, lambda cols: cols[1], 4, agg_cols=[0])
