@@ -53,6 +53,7 @@ class DeviceLoader:
                  mesh=None, axis: str = "dp", device=None,
                  session: Optional[Session] = None,
                  source: Optional[Source] = None,
+                 prefetch: int = 2,
                  drop_remainder: bool = True):
         if isinstance(dataset, str):
             dataset = RecordDataset(dataset)
@@ -96,9 +97,16 @@ class DeviceLoader:
         self.source = source or open_source(dataset.path)
         self._own_session = session is None
         self.session = session or Session()
+        if prefetch < 1:
+            raise StromError(_errno.EINVAL, "prefetch must be >= 1")
+        # prefetch = number of pinned batch buffers = batches in flight
+        # (the async_depth ring of the scan executor, applied to training
+        # input; 2 = classic double buffering)
+        self.prefetch = prefetch
         nbytes = self.chunks_per_batch * chunk_size
-        self._bufs = [self.session.alloc_dma_buffer(nbytes) for _ in range(2)]
-        self._fence = [None, None]
+        self._bufs = [self.session.alloc_dma_buffer(nbytes)
+                      for _ in range(prefetch)]
+        self._fence = [None] * prefetch
         self._epoch = 0
         self._closed = False
         self._placement_cache = None
@@ -166,26 +174,33 @@ class DeviceLoader:
         n = self.batches_per_epoch
         if n == 0:
             return
-        pending = (0, *self._submit(0, ids[0:k]))
+        from collections import deque
+        pending = deque()
+        next_b = 0
+
+        def submit_batch(b):
+            ring = b % self.prefetch
+            return (ring, *self._submit(ring, ids[b * k:(b + 1) * k]))
+
         try:
-            for b in range(n):
-                nxt = None
-                if b + 1 < n:
-                    ring = (b + 1) % 2
-                    nxt = (ring,
-                           *self._submit(ring, ids[(b + 1) * k:(b + 2) * k]))
-                arr = self._collect(*pending)
-                # hand off before yielding: if the consumer abandons the
-                # generator here, the finally below reaps the prefetch
-                pending = nxt
+            while next_b < n and len(pending) < self.prefetch:
+                pending.append(submit_batch(next_b))
+                next_b += 1
+            while pending:
+                arr = self._collect(*pending.popleft())
+                if next_b < n:
+                    # refill before yielding: if the consumer abandons the
+                    # generator mid-yield, the finally below reaps it
+                    pending.append(submit_batch(next_b))
+                    next_b += 1
                 yield arr
         finally:
-            # an abandoned epoch (break / exception) must reap the
-            # prefetched task: done/failed tasks are retained in the
-            # session table until waited (engine error-retention contract)
-            if pending is not None:
+            # an abandoned epoch (break / exception) must reap prefetched
+            # tasks: done/failed tasks are retained in the session table
+            # until waited (engine error-retention contract)
+            for item in pending:
                 try:
-                    self.session.memcpy_wait(pending[2].dma_task_id,
+                    self.session.memcpy_wait(item[2].dma_task_id,
                                              timeout=30.0)
                 except StromError:
                     pass
@@ -204,7 +219,7 @@ class DeviceLoader:
         for f in self._fence:
             if f is not None:
                 f.block_until_ready()
-        self._fence = [None, None]
+        self._fence = [None] * self.prefetch
         for handle, buf in self._bufs:
             try:
                 self.session.unmap_buffer(handle)

@@ -337,3 +337,17 @@ def test_loader_over_segmented_source(tmp_path):
         np.testing.assert_array_equal(got, a)
     finally:
         src.close()
+
+
+def test_loader_prefetch_depths(tmp_path):
+    """Any prefetch depth yields identical data (ring discipline holds)."""
+    a, ds = _make_ds(tmp_path, name="pf.rec")
+    outs = []
+    for depth in (1, 3, 4):
+        with DeviceLoader(ds, batch_records=16, chunk_size=4096,
+                          prefetch=depth, shuffle=2) as dl:
+            outs.append(np.concatenate([np.asarray(b) for b in dl.epoch(0)]))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[0], outs[2])
+    with pytest.raises(StromError):
+        DeviceLoader(ds, batch_records=16, chunk_size=4096, prefetch=0)
