@@ -10,9 +10,10 @@ high-throughput trade (shuffle buckets = chunks), with per-epoch
 reshuffle.
 
 Overlap discipline matches the staging pipeline: while the consumer holds
-batch *b* on device, batch *b+1*'s SSD DMA is in flight into the second
-pinned buffer; buffer reuse is fenced on the device transfer that last
-read it (`hbm/staging.py` contract).
+batch *b* on device, the next ``prefetch - 1`` batches' SSD DMAs are in
+flight into the other pinned buffers of the ring (default 2 = classic
+double buffering); buffer reuse is fenced on the device transfer that
+last read it (`hbm/staging.py` contract).
 """
 
 from __future__ import annotations
@@ -43,6 +44,8 @@ class DeviceLoader:
     mesh/axis : optional ``jax.sharding.Mesh`` — batches are placed sharded
         ``P(axis, None, ...)`` (leading record axis split across devices);
         otherwise ``device`` (default: first accelerator) gets full batches
+    prefetch : pinned batch buffers / batches kept in flight (default 2 =
+        double buffering; the scan executor's async_depth analog)
     drop_remainder : trailing records that do not fill a batch (or a chunk)
         are skipped, as with every fixed-geometry input pipeline
     """

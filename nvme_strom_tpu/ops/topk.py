@@ -1,0 +1,125 @@
+"""Top-k over HBM-resident heap pages (ORDER BY col LIMIT k).
+
+Completes the scan-compute tier's SQL-analog set (filter, aggregate,
+GROUP BY): per-batch ``jax.lax.top_k`` on the VPU plus a fold that merges
+batch winners, so the scan streams arbitrarily large tables while device
+memory holds only ``k`` candidates — the reference's per-tuple CPU walk
+could only ever do this by sorting on the host.
+
+Row identity travels with the values: ``positions`` are global row
+numbers (``page_id * tuples_per_page + slot``), taken from the page
+header's page_id so chunk reordering cannot misattribute rows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..scan.heap import HeapSchema, PAGE_SIZE
+from .filter_xla import DEFAULT_SCHEMA, decode_pages
+
+__all__ = ["make_topk_fn", "combine_topk", "scan_topk_step"]
+
+_WORDS = PAGE_SIZE // 4
+
+
+def make_topk_fn(schema: HeapSchema, col: int, k: int, *,
+                 largest: bool = True,
+                 predicate: Optional[Callable] = None):
+    """Build a jitted ``run(pages_u8, *params) -> {"values", "positions"}``.
+
+    Returns the *k* largest (or smallest) values of column ``col`` among
+    valid (and predicate-passing) rows of the batch, with their global row
+    numbers.  Fewer than ``k`` qualifying rows pad with the dtype's worst
+    sentinel and position -1.
+    """
+    dt = schema.col_dtype(col)
+    if dt.kind == "f":
+        worst = np.array(-np.inf if largest else np.inf, dt)
+    else:
+        info = np.iinfo(dt)
+        worst = np.array(info.min if largest else info.max, dt)
+    t = schema.tuples_per_page
+
+    def key_of(v):
+        # order-reversing key for smallest-k that cannot overflow: unary
+        # minus wraps for uint32 and INT32_MIN, bitwise NOT (~v = -v-1 /
+        # MAX-v) reverses order safely for both int kinds
+        if largest:
+            return v
+        return -v if dt.kind == "f" else ~v
+
+    @jax.jit
+    def run(pages_u8, *params):
+        cols, valid = decode_pages(pages_u8, schema)
+        sel = valid if predicate is None else \
+            valid & predicate(cols, *params)
+        v = cols[col]
+        # global row ids from the page header, not the batch position
+        words = jax.lax.bitcast_convert_type(
+            pages_u8.reshape(pages_u8.shape[0], _WORDS, 4),
+            jnp.int32).reshape(pages_u8.shape[0], _WORDS)
+        page_ids = words[:, 1]
+        pos = page_ids[:, None] * t + jnp.arange(t, dtype=jnp.int32)[None, :]
+        flat_v = jnp.where(sel, v, worst).reshape(-1)
+        flat_p = jnp.where(sel, pos, -1).reshape(-1)
+        kk = min(k, flat_v.size)
+        _, idx = jax.lax.top_k(key_of(flat_v), kk)
+        vals = flat_v[idx]
+        positions = flat_p[idx]
+        if kk < k:  # tiny batch: pad to the k contract
+            vals = jnp.concatenate([vals, jnp.full((k - kk,), worst, dt)])
+            positions = jnp.concatenate(
+                [positions, jnp.full((k - kk,), -1, positions.dtype)])
+        # slots filled only by sentinels read position -1 (NB a real row
+        # whose value equals the sentinel is indistinguishable from one)
+        positions = jnp.where(vals == worst, -1, positions)
+        return {"values": vals, "positions": positions}
+
+    run.k = k
+    run.largest = largest
+    run.worst = worst
+    # the matching fold, with the ordering baked in — pass this as
+    # scan_filter(..., combine=run.combine) so largest/smallest agree
+    run.combine = lambda a, b: combine_topk(a, b, largest=largest,
+                                            key_of=key_of)
+    return run
+
+
+def combine_topk(acc: dict, out: dict, *, largest: bool = True,
+                 key_of=None) -> dict:
+    """Batch-fold combiner: merge two top-k candidate sets into one.
+
+    Prefer the fn-bound form ``combine=fn.combine`` (it carries the
+    ordering); calling this directly requires passing the same *largest*
+    the step was built with."""
+    vals = jnp.concatenate([acc["values"], out["values"]])
+    poss = jnp.concatenate([acc["positions"], out["positions"]])
+    k = acc["values"].shape[0]
+    if key_of is not None:
+        key = key_of(vals)
+    elif largest:
+        key = vals
+    else:
+        key = -vals if vals.dtype.kind == "f" else ~vals
+    _, idx = jax.lax.top_k(key, k)
+    return {"values": vals[idx], "positions": poss[idx]}
+
+
+_DEMO_CACHE = {}
+
+
+def scan_topk_step(pages_u8, threshold, k: int = 8):
+    """Demo step: top-k of col0 among rows with col0 > threshold.
+    The jitted kernel is cached per k (one compile per shape, not per
+    batch — scan_filter calls the step once per streamed batch)."""
+    fn = _DEMO_CACHE.get(k)
+    if fn is None:
+        fn = _DEMO_CACHE[k] = make_topk_fn(
+            DEFAULT_SCHEMA, 0, k,
+            predicate=lambda cols, th: cols[0] > th)
+    return fn(pages_u8, threshold)

@@ -462,3 +462,79 @@ def test_typed_float_columns_roundtrip_and_filter(tmp_path):
         make_filter_fn_pallas(schema, lambda cols, th: cols[1] > th)
     with pytest.raises(ValueError):
         make_groupby_fn(schema, lambda cols: cols[1], 4, agg_cols=[0])
+
+
+def test_topk_matches_numpy_and_folds_across_batches(tmp_path):
+    """Top-k over a scanned table == numpy argsort oracle, with positions
+    naming the right global rows across batch folds."""
+    from nvme_strom_tpu.ops.topk import combine_topk, scan_topk_step
+    from nvme_strom_tpu.scan.executor import TableScanner
+    from nvme_strom_tpu.scan.heap import build_heap_file
+
+    rng = np.random.default_rng(71)
+    schema = HeapSchema(n_cols=2, visibility=True)
+    t = schema.tuples_per_page
+    n_pages = 12
+    n = t * n_pages
+    c0 = rng.permutation(np.arange(n)).astype(np.int32)  # unique values
+    c1 = rng.integers(0, 100, n).astype(np.int32)
+    path = str(tmp_path / "tk.heap")
+    build_heap_file(path, [c0, c1], schema)
+
+    k, th = 8, 50
+    with TableScanner(path, schema, numa_bind=False) as sc:
+        out = sc.scan_filter(lambda p: scan_topk_step(p, np.int32(th), k),
+                             combine=combine_topk)
+    vals = np.asarray(out["values"])
+    poss = np.asarray(out["positions"])
+    sel = np.nonzero(c0 > th)[0]
+    want = sel[np.argsort(-c0[sel])][:k]
+    np.testing.assert_array_equal(vals, c0[want])
+    np.testing.assert_array_equal(poss, want)
+
+
+def test_topk_pads_when_fewer_rows_qualify():
+    from nvme_strom_tpu.ops.topk import make_topk_fn
+    from nvme_strom_tpu.ops.filter_xla import DEFAULT_SCHEMA
+    from nvme_strom_tpu.scan.heap import build_pages
+
+    schema = DEFAULT_SCHEMA
+    c0 = np.array([5, -3, 7], np.int32)
+    c1 = np.zeros(3, np.int32)
+    pages = build_pages([c0, c1], schema)
+    fn = make_topk_fn(schema, 0, 6,
+                      predicate=lambda cols, th: cols[0] > th)
+    out = fn(pages, np.int32(0))
+    vals = np.asarray(out["values"])
+    poss = np.asarray(out["positions"])
+    assert list(vals[:2]) == [7, 5]
+    assert list(poss[:2]) == [2, 0]
+    assert (poss[2:] == -1).all()
+
+
+def test_topk_smallest_handles_extreme_values():
+    """smallest-k must rank INT32_MIN first (unary minus would wrap) and
+    work on uint32 columns containing 0."""
+    from nvme_strom_tpu.ops.topk import make_topk_fn
+    from nvme_strom_tpu.scan.heap import build_pages
+
+    imin = -(1 << 31)
+    schema = HeapSchema(n_cols=1)
+    c = np.array([5, imin, -7, 100], np.int32)
+    fn = make_topk_fn(schema, 0, 2, largest=False)
+    out = fn(build_pages([c], schema))
+    assert list(np.asarray(out["values"])) == [imin, -7]
+    assert list(np.asarray(out["positions"])) == [1, 2]
+
+    uschema = HeapSchema(n_cols=1, dtypes=("uint32",))
+    u = np.array([3, 0, (1 << 32) - 1, 9], np.uint32)
+    ufn = make_topk_fn(uschema, 0, 2, largest=False)
+    uout = ufn(build_pages([u], uschema))
+    assert list(np.asarray(uout["values"])) == [0, 3]
+    assert list(np.asarray(uout["positions"])) == [1, 0]
+
+    # the fn-bound combine keeps the smallest ordering across folds
+    merged = ufn.combine(uout, ufn(build_pages([np.array([1, 2, 8, 4],
+                                                         np.uint32)],
+                                               uschema)))
+    assert list(np.asarray(merged["values"])) == [0, 1]
