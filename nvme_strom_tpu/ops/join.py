@@ -1,0 +1,73 @@
+"""Broadcast join over HBM-resident heap pages.
+
+The last relational op of the scan-compute tier (filter, aggregate,
+GROUP BY, top-k — and now join): a small *build side* (dimension table)
+is broadcast to the device, and each scanned batch probes it.
+
+TPU-first shape: no hash table — the build keys are **sorted once** and
+probes are ``jnp.searchsorted`` (vectorized binary search, log2(M) steps
+of pure VPU compare/select), which XLA pipelines across the whole batch.
+A CUDA port would build a hash table; on TPU sorted-probe beats scattered
+loads.  Payload gather rides the same indices.
+
+The step form aggregates joined rows (count + per-column sums + payload
+sum), so it folds across streamed batches like every other scan op;
+row-materializing joins compose from the same mask via
+:mod:`..parallel.exchange` when the output must move to its key's owner.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..scan.heap import HeapSchema
+from .filter_xla import DEFAULT_SCHEMA, decode_pages
+
+__all__ = ["make_join_fn"]
+
+
+def make_join_fn(schema: HeapSchema, probe_col: int,
+                 build_keys: np.ndarray, build_values: np.ndarray, *,
+                 predicate: Optional[Callable] = None):
+    """Build a jitted ``run(pages_u8, *params) -> dict`` inner-join step.
+
+    ``build_keys``/``build_values`` — the dimension table (int32, unique
+    keys; sorted internally).  A scanned row joins when column
+    ``probe_col`` equals some build key (and *predicate* passes).
+
+    Returns per batch: ``matched`` (row count), ``sums`` (over joined
+    rows, for the int32 fact columns listed in ``run.sum_cols``),
+    ``payload_sum`` (sum of the matched build values).
+    """
+    order = np.argsort(build_keys, kind="stable")
+    keys = jnp.asarray(np.asarray(build_keys, np.int32)[order])
+    vals = jnp.asarray(np.asarray(build_values, np.int32)[order])
+    if len(np.unique(build_keys)) != len(build_keys):
+        raise ValueError("build_keys must be unique (inner join on a "
+                         "dimension key)")
+    if schema.col_dtype(probe_col) != np.dtype(np.int32):
+        raise ValueError("probe column must be int32")
+
+    sum_cols = [c for c in range(schema.n_cols)
+                if schema.col_dtype(c) == np.dtype(np.int32)]
+
+    @jax.jit
+    def run(pages_u8, *params):
+        cols, valid = decode_pages(pages_u8, schema)
+        sel = valid if predicate is None else valid & predicate(cols, *params)
+        probe = cols[probe_col]
+        idx = jnp.searchsorted(keys, probe)
+        idx = jnp.clip(idx, 0, keys.shape[0] - 1)
+        hit = sel & (keys[idx] == probe)
+        matched = jnp.sum(hit.astype(jnp.int32))
+        sums = jnp.stack([jnp.sum(jnp.where(hit, cols[c], 0))
+                          for c in sum_cols])
+        payload = jnp.sum(jnp.where(hit, vals[idx], 0))
+        return {"matched": matched, "sums": sums, "payload_sum": payload}
+
+    run.sum_cols = sum_cols
+    return run

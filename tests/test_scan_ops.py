@@ -538,3 +538,36 @@ def test_topk_smallest_handles_extreme_values():
                                                          np.uint32)],
                                                uschema)))
     assert list(np.asarray(merged["values"])) == [0, 1]
+
+
+def test_join_matches_numpy_oracle(tmp_path):
+    """Broadcast inner join over a scanned table == numpy oracle, folded
+    across streamed batches."""
+    from nvme_strom_tpu.ops.join import make_join_fn
+    from nvme_strom_tpu.scan.executor import TableScanner
+    from nvme_strom_tpu.scan.heap import build_heap_file
+
+    rng = np.random.default_rng(81)
+    schema = HeapSchema(n_cols=2, visibility=True)
+    t = schema.tuples_per_page
+    n = t * 10
+    fk = rng.integers(0, 50, n).astype(np.int32)    # foreign key column
+    amt = rng.integers(1, 100, n).astype(np.int32)
+    path = str(tmp_path / "join.heap")
+    build_heap_file(path, [fk, amt], schema)
+
+    dim_keys = np.array([3, 7, 11, 42], np.int32)
+    dim_vals = np.array([100, 200, 300, 400], np.int32)
+    fn = make_join_fn(schema, 0, dim_keys, dim_vals)
+    with TableScanner(path, schema, numa_bind=False) as sc:
+        out = sc.scan_filter(fn)
+
+    hit = np.isin(fk, dim_keys)
+    assert int(out["matched"]) == int(hit.sum())
+    assert int(out["sums"][1]) == int(amt[hit].sum())
+    lut = dict(zip(dim_keys.tolist(), dim_vals.tolist()))
+    assert int(out["payload_sum"]) == sum(lut[k] for k in fk[hit].tolist())
+
+    with pytest.raises(ValueError):
+        make_join_fn(schema, 0, np.array([1, 1], np.int32),
+                     np.array([2, 3], np.int32))  # duplicate keys
