@@ -150,10 +150,16 @@ class Extent:
 
 
 class Source:
-    """A readable logical byte stream resolvable to physical extents."""
+    """A logical byte stream resolvable to physical extents.
+
+    Read-oriented by default; opened with ``writable=True`` it also
+    carries the RAM→SSD write legs (a capability the read-only reference
+    lacks — its engine only builds NVMe READ commands,
+    kmod/nvme_strom.c:1136-1224)."""
 
     size: int
     block_size: int
+    writable: bool = False
 
     def extents(self, offset: int, length: int) -> List[Extent]:
         raise NotImplementedError
@@ -191,6 +197,40 @@ class Source:
                 raise StromError(_errno.EIO, f"short direct read at {file_off + done}")
             done += n
 
+    # -- write legs (RAM→SSD; requires writable=True) ----------------------
+    def member_buffered_fds(self) -> List[int]:
+        raise NotImplementedError
+
+    def _check_writable(self) -> None:
+        if not self.writable:
+            raise StromError(_errno.EBADF, "source opened read-only; "
+                             "open_source(..., writable=True)")
+
+    def write_member_direct(self, member: int, file_off: int, src: memoryview) -> None:
+        """O_DIRECT write of one planned request (the async write leg)."""
+        self._check_writable()
+        fd = self.member_fds()[member]
+        if fd < 0:
+            raise StromError(_errno.EINVAL, "member has no O_DIRECT fd")
+        done, length = 0, len(src)
+        while done < length:
+            n = os.pwritev(fd, [src[done:length]], file_off + done)
+            if n <= 0:
+                raise StromError(_errno.EIO, f"short direct write at {file_off + done}")
+            done += n
+
+    def write_member_buffered(self, member: int, file_off: int, src: memoryview) -> None:
+        """Buffered write — misaligned pieces O_DIRECT cannot express."""
+        self._check_writable()
+        n = os.pwritev(self.member_buffered_fds()[member], [src], file_off)
+        if n != len(src):
+            raise StromError(_errno.EIO, "short buffered write")
+
+    def sync(self) -> None:
+        """fsync every member (durability for the buffered write legs)."""
+        for fd in self.member_buffered_fds():
+            os.fsync(fd)
+
     def close(self) -> None:
         pass
 
@@ -204,14 +244,16 @@ class Source:
 class _FileMember:
     """One underlying file: direct fd + buffered fd + mmap for cache probe."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, writable: bool = False):
         self.path = path
         self.size = os.stat(path).st_size
+        self.writable = writable
+        mode = os.O_RDWR if writable else os.O_RDONLY
         try:
-            self.fd_direct = os.open(path, os.O_RDONLY | os.O_DIRECT)
+            self.fd_direct = os.open(path, mode | os.O_DIRECT)
         except OSError:
             self.fd_direct = -1
-        self.fd_buffered = os.open(path, os.O_RDONLY)
+        self.fd_buffered = os.open(path, mode)
         self._mm: Optional[mmap.mmap] = None
         self._mm_addr = 0
 
@@ -258,11 +300,13 @@ class _FileMember:
 class PlainSource(Source):
     """A single regular file."""
 
-    def __init__(self, path: str, block_size: int = 512):
-        self._m = _FileMember(path)
+    def __init__(self, path: str, block_size: int = 512,
+                 writable: bool = False):
+        self._m = _FileMember(path, writable)
         self.path = path
         self.size = self._m.size
         self.block_size = block_size
+        self.writable = writable
 
     def extents(self, offset: int, length: int) -> List[Extent]:
         if offset < 0 or offset + length > self.size:
@@ -272,6 +316,9 @@ class PlainSource(Source):
 
     def member_fds(self) -> List[int]:
         return [self._m.fd_direct]
+
+    def member_buffered_fds(self) -> List[int]:
+        return [self._m.fd_buffered]
 
     def cached_fraction(self, offset: int, length: int) -> float:
         return self._m.cached_fraction(offset, length)
@@ -295,10 +342,11 @@ class SegmentedSource(Source):
     fixed-size segment files (reference mirrors md.c's MdfdVec per-segment fd
     table, pgsql/nvme_strom.c:124-130,692-714)."""
 
-    def __init__(self, paths: Sequence[str], segment_size: int, block_size: int = 512):
+    def __init__(self, paths: Sequence[str], segment_size: int, block_size: int = 512,
+                 writable: bool = False):
         if segment_size <= 0:
             raise StromError(_errno.EINVAL, "segment_size must be positive")
-        self.members = [_FileMember(p) for p in paths]
+        self.members = [_FileMember(p, writable) for p in paths]
         for m in self.members[:-1]:
             if m.size != segment_size:
                 raise StromError(_errno.EINVAL,
@@ -306,6 +354,7 @@ class SegmentedSource(Source):
         self.segment_size = segment_size
         self.size = sum(m.size for m in self.members)
         self.block_size = block_size
+        self.writable = writable
 
     def extents(self, offset: int, length: int) -> List[Extent]:
         if offset < 0 or offset + length > self.size:
@@ -322,6 +371,9 @@ class SegmentedSource(Source):
 
     def member_fds(self) -> List[int]:
         return [m.fd_direct for m in self.members]
+
+    def member_buffered_fds(self) -> List[int]:
+        return [m.fd_buffered for m in self.members]
 
     def cached_fraction(self, offset: int, length: int) -> float:
         total, weight = 0.0, 0
@@ -353,12 +405,13 @@ class StripedSource(Source):
     """RAID-0 striped member set resolved with :class:`StripeMap`."""
 
     def __init__(self, paths: Sequence[str], stripe_chunk_size: int,
-                 block_size: int = 512):
-        self.members = [_FileMember(p) for p in paths]
+                 block_size: int = 512, writable: bool = False):
+        self.members = [_FileMember(p, writable) for p in paths]
         self.map = StripeMap([m.size for m in self.members], stripe_chunk_size)
         self.size = self.map.total_size
         self.block_size = block_size
         self.stripe_chunk_size = stripe_chunk_size
+        self.writable = writable
 
     def extents(self, offset: int, length: int) -> List[Extent]:
         return [Extent(e.member, e.member_offset, e.length, e.logical_offset)
@@ -366,6 +419,9 @@ class StripedSource(Source):
 
     def member_fds(self) -> List[int]:
         return [m.fd_direct for m in self.members]
+
+    def member_buffered_fds(self) -> List[int]:
+        return [m.fd_buffered for m in self.members]
 
     def cached_fraction(self, offset: int, length: int) -> float:
         total, weight = 0.0, 0
@@ -395,16 +451,20 @@ class StripedSource(Source):
 def open_source(spec: Union[str, Sequence[str]], *,
                 stripe_chunk_size: Optional[int] = None,
                 segment_size: Optional[int] = None,
-                block_size: Optional[int] = None) -> Source:
+                block_size: Optional[int] = None,
+                writable: bool = False) -> Source:
     """Open a plain, striped, or segmented source from a path spec."""
     if isinstance(spec, str):
         info = check_file(spec)
-        return PlainSource(spec, block_size or info.logical_block_size)
+        return PlainSource(spec, block_size or info.logical_block_size,
+                           writable)
     paths = list(spec)
     if stripe_chunk_size:
-        return StripedSource(paths, stripe_chunk_size, block_size or 512)
+        return StripedSource(paths, stripe_chunk_size, block_size or 512,
+                             writable)
     if segment_size:
-        return SegmentedSource(paths, segment_size, block_size or 512)
+        return SegmentedSource(paths, segment_size, block_size or 512,
+                               writable)
     raise StromError(_errno.EINVAL,
                     "multi-path source needs stripe_chunk_size or segment_size")
 
@@ -905,6 +965,85 @@ class Session:
 
     # SSD->device is the same submit path; the HBM leg lives in hbm.staging.
     memcpy_ssd2dev = memcpy_ssd2ram
+
+    def memcpy_ram2ssd(self, sink: Source, buf_handle: int,
+                       chunk_ids: Sequence[int], chunk_size: int, *,
+                       src_offset: int = 0) -> MemCopyResult:
+        """RAM→SSD write submit path (exceeds the read-only reference).
+
+        Buffer slot *i* (``src_offset + i*chunk_size``) is written to sink
+        chunk ``chunk_ids[i]``.  Planning reuses the read-side merge logic
+        (same extents, same ≤dma_max requests, buffered legs for
+        misaligned pieces); writes are always direct — there is no cache
+        to arbitrate against — and run on the thread pool (the native
+        engine's queues are read-only for now).  Durability of buffered
+        legs needs a ``sink.sync()`` after the wait."""
+        t0 = time.monotonic_ns()
+        if self._closed:
+            raise StromError(_errno.EBADF, "session closed")
+        if chunk_size <= 0 or (chunk_size & (chunk_size - 1)):
+            raise StromError(_errno.EINVAL, f"chunk_size {chunk_size} must be pow2")
+        sink._check_writable()
+        chunk_ids = list(chunk_ids)
+        n = len(chunk_ids)
+        if n == 0:
+            raise StromError(_errno.EINVAL, "no chunks")
+        src = self._get_buffer(buf_handle, need=src_offset + n * chunk_size)
+        task = self._create_task()
+        try:
+            with stats.stage("setup_prps"):
+                reqs = plan_requests(sink, [(cid, i) for i, cid in enumerate(chunk_ids)],
+                                     chunk_size, src_offset)
+            for r in reqs:
+                self._task_get(task)
+                cur = stats.gauge_add("cur_dma_count", 1)
+                stats.gauge_max("max_dma_count", cur)
+                stats.count_clock("submit_dma", 0)
+                stats.add("total_dma_length", r.length)
+                try:
+                    self._pool.submit(self._do_write_request, task, sink, r, src)
+                except BaseException as e:
+                    stats.gauge_add("cur_dma_count", -1)
+                    self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
+                    raise
+        except BaseException:
+            self._task_put(task, StromError(_errno.ECANCELED, "submit aborted"))
+            try:
+                self.memcpy_wait(task.task_id, timeout=30.0)
+            except StromError:
+                pass
+            self._put_buffer(buf_handle)
+            raise
+        result = MemCopyResult(dma_task_id=task.task_id, nr_chunks=n,
+                               nr_ssd2dev=n, nr_ram2dev=0,
+                               chunk_ids=chunk_ids)
+        task.result = result
+        sidx = self._slot_of(task.task_id)
+        with self._slot_cv[sidx]:
+            task.frozen = True
+        task.buf_handle = buf_handle
+        self._task_put(task)
+        stats.count_clock("ioctl_memcpy_submit", time.monotonic_ns() - t0)
+        return result
+
+    def _do_write_request(self, task: DmaTask, sink: Source,
+                          r: Request, src: memoryview) -> None:
+        err: Optional[StromError] = None
+        try:
+            piece = src[r.dest_off:r.dest_off + r.length]
+            if r.buffered:
+                sink.write_member_buffered(r.member, r.file_off, piece)
+            else:
+                sink.write_member_direct(r.member, r.file_off, piece)
+        except StromError as e:
+            err = e
+        except OSError as e:
+            err = StromError(e.errno or _errno.EIO, str(e))
+        except BaseException as e:
+            err = StromError(_errno.EIO, f"unexpected write failure: {e!r}")
+        finally:
+            stats.gauge_add("cur_dma_count", -1)
+            self._task_put(task, err)
 
     def _do_request(self, task: DmaTask, source: Source,
                     r: Request, dest: memoryview) -> None:

@@ -7,6 +7,7 @@ import errno
 import os
 import time
 
+import numpy as np
 import pytest
 
 from nvme_strom_tpu import (DmaTaskState, FsKind, Session, StromError,
@@ -435,3 +436,92 @@ def test_config_cross_validation_on_either_side():
     with _pytest.raises(ConfigError):
         config.set("chunk_size", "2m")  # would break buffer multiple invariant
     assert config.get("chunk_size") == 1 << 20  # rolled back
+
+
+# -- write path (RAM->SSD; exceeds the read-only reference) ------------------
+
+def test_ram2ssd_roundtrip_plain(tmp_path):
+    from nvme_strom_tpu.engine import Session, open_source
+
+    path = str(tmp_path / "w.bin")
+    with open(path, "wb") as f:
+        f.write(b"\0" * (8 << 20))
+    rng = np.random.default_rng(91)
+    payload = rng.integers(0, 255, 8 << 20, dtype=np.uint8)
+
+    with open_source(path, writable=True) as sink, Session() as sess:
+        handle, buf = sess.alloc_dma_buffer(8 << 20)
+        buf.view()[:] = payload.tobytes()
+        # scatter: write chunks in a shuffled order
+        ids = list(rng.permutation(8))
+        res = sess.memcpy_ram2ssd(sink, handle, ids, 1 << 20)
+        sess.memcpy_wait(res.dma_task_id)
+        sink.sync()
+        assert res.nr_ssd2dev == 8 and res.chunk_ids == ids
+
+    with open(path, "rb") as f:
+        got = np.frombuffer(f.read(), np.uint8)
+    for slot, cid in enumerate(ids):
+        np.testing.assert_array_equal(
+            got[cid << 20:(cid + 1) << 20],
+            payload[slot << 20:(slot + 1) << 20])
+
+
+def test_ram2ssd_striped_and_readback(tmp_path):
+    """Write through the stripe map, read back through the direct path."""
+    from nvme_strom_tpu.engine import Session, open_source
+
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"m{i}.bin")
+        with open(p, "wb") as f:
+            f.write(b"\0" * (1 << 20))
+        paths.append(p)
+    rng = np.random.default_rng(92)
+    payload = rng.integers(0, 255, 3 << 20, dtype=np.uint8)
+
+    with open_source(paths, stripe_chunk_size=256 << 10,
+                     writable=True) as sink, Session() as sess:
+        handle, buf = sess.alloc_dma_buffer(3 << 20)
+        buf.view()[:] = payload.tobytes()
+        res = sess.memcpy_ram2ssd(sink, handle, list(range(12)), 256 << 10)
+        sess.memcpy_wait(res.dma_task_id)
+        sink.sync()
+
+    with open_source(paths, stripe_chunk_size=256 << 10) as src, \
+            Session() as sess:
+        handle, buf = sess.alloc_dma_buffer(3 << 20)
+        res = sess.memcpy_ssd2ram(src, handle, list(range(12)), 256 << 10)
+        sess.memcpy_wait(res.dma_task_id)
+        got = np.frombuffer(buf.view(), np.uint8).reshape(12, 256 << 10)
+        order = np.argsort(res.chunk_ids)
+        np.testing.assert_array_equal(
+            np.ascontiguousarray(got[order]).ravel(), payload)
+
+
+def test_ram2ssd_requires_writable(tmp_path):
+    from nvme_strom_tpu.engine import Session, open_source
+
+    path = str(tmp_path / "ro.bin")
+    with open(path, "wb") as f:
+        f.write(b"\0" * 8192)
+    with open_source(path) as sink, Session() as sess:
+        handle, buf = sess.alloc_dma_buffer(8192)
+        with pytest.raises(StromError):
+            sess.memcpy_ram2ssd(sink, handle, [0], 8192)
+
+
+def test_ram2ssd_misaligned_src_offset_uses_buffered_leg(tmp_path):
+    from nvme_strom_tpu.engine import Session, open_source
+
+    path = str(tmp_path / "mis.bin")
+    with open(path, "wb") as f:
+        f.write(b"\0" * 8192)
+    data = bytes(range(256)) * 32  # 8192 bytes
+    with open_source(path, writable=True) as sink, Session() as sess:
+        handle, buf = sess.alloc_dma_buffer(8192 + 256)
+        buf.view()[256:256 + 8192] = data
+        res = sess.memcpy_ram2ssd(sink, handle, [0], 8192, src_offset=256)
+        sess.memcpy_wait(res.dma_task_id)
+        sink.sync()
+    assert open(path, "rb").read() == data
