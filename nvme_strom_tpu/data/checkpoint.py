@@ -316,6 +316,11 @@ def _restore_sharded(sess, source, base, dtype, shape, sharding,
             rkey = (0, 1)
         else:
             rows = idx[0] if idx else slice(None)
+            if not isinstance(rows, slice) or rows.step not in (None, 1):
+                raise StromError(
+                    _errno.EINVAL,
+                    f"unsupported leading-axis index {rows!r} for device "
+                    f"{dev}: sharded restore needs a unit-step slice")
             rkey = (rows.start or 0,
                     rows.stop if rows.stop is not None else shape[0])
         by_range.setdefault(rkey, []).append((dev, idx))

@@ -222,9 +222,14 @@ class Source:
     def write_member_buffered(self, member: int, file_off: int, src: memoryview) -> None:
         """Buffered write — misaligned pieces O_DIRECT cannot express."""
         self._check_writable()
-        n = os.pwritev(self.member_buffered_fds()[member], [src], file_off)
-        if n != len(src):
-            raise StromError(_errno.EIO, "short buffered write")
+        fd = self.member_buffered_fds()[member]
+        done, length = 0, len(src)
+        while done < length:  # partial buffered writes are legal; loop
+            n = os.pwritev(fd, [src[done:length]], file_off + done)
+            if n <= 0:
+                raise StromError(_errno.EIO,
+                                 f"short buffered write at {file_off + done}")
+            done += n
 
     def sync(self) -> None:
         """fsync every member (durability for the buffered write legs)."""
@@ -653,7 +658,10 @@ class Session:
     def __init__(self, *, max_workers: Optional[int] = None,
                  io_backend: Optional[str] = None):
         self._buffers: Dict[int, Tuple[object, BufferInfo]] = {}
-        self._buf_lock = threading.Lock()
+        # Condition, not bare Lock: unmap_buffer waits on it and _put_buffer
+        # signals, mirroring the refcount+wakeup drain of the driver
+        # revocation callback (kmod/pmemmap.c:149-208) with no sleep-poll
+        self._buf_lock = threading.Condition(threading.Lock())
         self._next_handle = 1
         self._next_task = 1
         self._slots: List[Dict[int, DmaTask]] = [dict() for _ in range(_N_TASK_SLOTS)]
@@ -727,16 +735,19 @@ class Session:
         with self._buf_lock:
             if handle in self._buffers:
                 (vb, info) = self._buffers[handle]
-                self._buffers[handle] = (vb, BufferInfo(**{**info.__dict__,
-                                                           "refcount": info.refcount - 1}))
+                info = BufferInfo(**{**info.__dict__,
+                                     "refcount": info.refcount - 1})
+                self._buffers[handle] = (vb, info)
+                if info.refcount == 0:
+                    self._buf_lock.notify_all()
 
     def unmap_buffer(self, handle: int, *, wait: bool = True,
                      timeout: float = 30.0) -> None:
         """Blocks until in-flight DMA drains, like the driver revocation
         callback (kmod/pmemmap.c:149-208)."""
         deadline = time.monotonic() + timeout
-        while True:
-            with self._buf_lock:
+        with self._buf_lock:
+            while True:
                 if handle not in self._buffers:
                     raise StromError(_errno.ENOENT, f"no mapped buffer {handle}")
                 _, info = self._buffers[handle]
@@ -745,9 +756,10 @@ class Session:
                     return
                 if not wait:
                     raise StromError(_errno.EBUSY, f"buffer {handle} has in-flight DMA")
-            if time.monotonic() > deadline:
-                raise StromError(_errno.ETIMEDOUT, f"buffer {handle} busy")
-            time.sleep(0.001)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise StromError(_errno.ETIMEDOUT, f"buffer {handle} busy")
+                self._buf_lock.wait(remaining)
 
     def list_buffers(self) -> List[int]:
         with self._buf_lock:

@@ -9,6 +9,8 @@ could only ever do this by sorting on the host.
 Row identity travels with the values: ``positions`` are global row
 numbers (``page_id * tuples_per_page + slot``), taken from the page
 header's page_id so chunk reordering cannot misattribute rows.
+Positions are int64 under ``jax_enable_x64``; without it they are int32
+and tables past 2^31 rows would wrap (as any int32 engine would).
 """
 
 from __future__ import annotations
@@ -64,7 +66,12 @@ def make_topk_fn(schema: HeapSchema, col: int, k: int, *,
             pages_u8.reshape(pages_u8.shape[0], _WORDS, 4),
             jnp.int32).reshape(pages_u8.shape[0], _WORDS)
         page_ids = words[:, 1]
-        pos = page_ids[:, None] * t + jnp.arange(t, dtype=jnp.int32)[None, :]
+        # int32 positions wrap past 2^31 rows; under x64 widen to int64
+        # (same convention as groupby's sum accumulator) so streaming
+        # arbitrarily large tables keeps row identity exact
+        pos_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        pos = (page_ids[:, None].astype(pos_t) * t
+               + jnp.arange(t, dtype=pos_t)[None, :])
         flat_v = jnp.where(sel, v, worst).reshape(-1)
         flat_p = jnp.where(sel, pos, -1).reshape(-1)
         kk = min(k, flat_v.size)

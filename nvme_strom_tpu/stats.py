@@ -118,17 +118,26 @@ class StatRegistry:
         def loop():
             while not stop.wait(interval):
                 self.export(path)
-            self.export(path)
 
         t = threading.Thread(target=loop, daemon=True, name="strom-stat-export")
-        self._exporter = (t, stop)
+        self._exporter = (t, stop, path)
         t.start()
 
     def stop_export(self) -> None:
+        """Stop the exporter and write one final *synchronous* snapshot.
+
+        The final export happens on the caller's thread, not the daemon
+        thread: a daemon thread racing process exit can die before its
+        last write, leaving the export file stale or absent (the round-1
+        flake).  Joining then exporting inline makes the file's final
+        content a postcondition of stop_export()."""
         exp = getattr(self, "_exporter", None)
         if exp:
-            exp[1].set()
+            t, stop, path = exp
+            stop.set()
+            t.join(timeout=5.0)
             self._exporter = None
+            self.export(path)
 
     def export(self, path: str = None) -> None:
         path = path or DEFAULT_STAT_EXPORT

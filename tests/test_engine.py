@@ -361,6 +361,31 @@ def test_unmap_waits_for_inflight_dma(tmp_data_file):
         src.close()
 
 
+def test_unmap_drain_wakes_on_release():
+    """Drain is condition-variable based (kmod/pmemmap.c:149-208 analog):
+    _put_buffer signals the waiter instead of the waiter sleep-polling.
+    The mechanism is asserted directly (Condition + notify on last ref)
+    rather than via a wall-clock latency threshold, which would be both
+    flaky under load and satisfiable by a 1ms poll."""
+    import threading
+    with Session() as sess:
+        handle, _ = sess.alloc_dma_buffer(1 << 16)
+        assert isinstance(sess._buf_lock, threading.Condition)
+        sess._get_buffer(handle)  # simulate one in-flight DMA ref
+        notified = threading.Event()
+        orig_notify = sess._buf_lock.notify_all
+        sess._buf_lock.notify_all = lambda: (notified.set(), orig_notify())
+
+        def release():
+            sess._put_buffer(handle)
+
+        th = threading.Thread(target=release)
+        th.start()
+        sess.unmap_buffer(handle, wait=True, timeout=5.0)
+        th.join()
+        assert notified.is_set(), "_put_buffer must signal the drain waiter"
+
+
 # ---------------------------------------------------------------------------
 # stats
 # ---------------------------------------------------------------------------

@@ -4,6 +4,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -74,8 +75,16 @@ def test_tpu_stat_oneshot(data_file, tmp_path):
     out = _run("nvme_strom_tpu.tools.ssd2ram_test", data_file,
                "-s", "8m", env_extra={"STROM_TPU_STAT_EXPORT": stat_file})
     assert out.returncode == 0, out.stderr
-    assert os.path.exists(stat_file)
-    snap = json.load(open(stat_file))
+    # wait on *content*, not existence: stop_export() writes the final
+    # snapshot synchronously, but be robust to any exporter stragglers
+    snap = None
+    for _ in range(50):
+        try:
+            snap = json.load(open(stat_file))
+            break
+        except (FileNotFoundError, json.JSONDecodeError):
+            time.sleep(0.1)
+    assert snap is not None, "stat export never became readable"
     assert snap["counters"]["nr_ioctl_memcpy_submit"] > 0
     out = _run("nvme_strom_tpu.tools.tpu_stat", "-f", stat_file)
     assert out.returncode == 0, out.stderr
