@@ -73,13 +73,27 @@ class FileInfo:
     logical_block_size: int      # HW sector size analog (kmod/nvme_strom.c:274-295)
     dma_max_size: int            # clamped merged-request cap (:297-314)
     numa_node_id: int            # (:316-328)
-    support_dma64: bool          # (:330-336)
+    support_dma64: bool          # probed from the device chain (:330-336)
     n_members: int = 1           # RAID-0 member count (1 = plain file)
     stripe_chunk_size: int = 0   # RAID-0 chunk in bytes (0 = plain)
+    backing_kind: str = ""       # "nvme" | "md-raid0" | "md" (failed RAID-0
+                                 # validation) | "other" | "none"
+    backing_supported: bool = False  # raw-NVMe-or-RAID0 verified (:229-438)
+    backing_reason: str = ""     # why-not, for strom_check / planner logs
+    policy_rejected: bool = False    # strict eligibility said no (policy,
+                                     # distinct from the fs_kind fact)
 
     @property
     def supported(self) -> bool:
-        return self.fs_kind != FsKind.UNSUPPORTED
+        return self.fs_kind != FsKind.UNSUPPORTED and not self.policy_rejected
+
+    @property
+    def strict_eligible(self) -> bool:
+        """THE strict-eligibility predicate (verified NVMe backing + 64-bit
+        DMA, the reference's hard gate kmod/nvme_strom.c:229-438 + pgsql
+        :313-318).  check_file's policy_rejected and the planner's live
+        gate both derive from this so they can never disagree."""
+        return self.backing_supported and self.support_dma64
 
 
 @dataclass(frozen=True)

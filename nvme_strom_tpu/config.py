@@ -152,6 +152,12 @@ class Config:
                 help="mlock/hugepage-back staging buffers; right for bare-metal "
                      "PCIe DMA, but measurably slows both the O_DIRECT fill and "
                      "the PJRT H2D read on virtualized/tunneled hosts"))
+        reg(Var("require_nvme_backing", False, "bool",
+                help="strict eligibility: CHECK_FILE reports UNSUPPORTED "
+                     "unless the file sits on raw NVMe or md-RAID0-of-NVMe "
+                     "(the reference's hard requirement, kmod/nvme_strom.c:"
+                     "229-438); off by default because the engine can drive "
+                     "any O_DIRECT file, at uncharacterized speed"))
         reg(Var("cache_arbitration", True, "bool",
                 help="probe the page cache and route hot chunks through the write-back path "
                      "(kmod/nvme_strom.c:1639-1663 analog)"))

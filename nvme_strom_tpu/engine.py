@@ -44,7 +44,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 from .api import BufferInfo, DmaTaskState, FileInfo, FsKind, MemCopyResult, StromError
 from .config import config
 from .log import pr_info, pr_warn
-from .numa import device_numa_node
+from .eligibility import probe_backing
 from .stats import stats
 from .stripe import StripeMap
 
@@ -72,23 +72,6 @@ def _fs_magic(path: str) -> int:
     return buf.f_type & 0xFFFFFFFF
 
 
-def _sysfs_block_attr(path: str, attr: str) -> Optional[str]:
-    try:
-        st = os.stat(path)
-        maj, minor = os.major(st.st_dev), os.minor(st.st_dev)
-        base = f"/sys/dev/block/{maj}:{minor}"
-        for candidate in (os.path.join(base, attr),
-                          os.path.join(os.path.dirname(os.path.realpath(base)), attr)):
-            try:
-                with open(candidate) as f:
-                    return f.read().strip()
-            except OSError:
-                continue
-    except OSError:
-        pass
-    return None
-
-
 def _probe_odirect(path: str) -> bool:
     try:
         fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
@@ -98,14 +81,22 @@ def _probe_odirect(path: str) -> bool:
     return True
 
 
-def check_file(path: str, *, dma_max_size: Optional[int] = None) -> FileInfo:
+def check_file(path: str, *, dma_max_size: Optional[int] = None,
+               strict: Optional[bool] = None,
+               sysfs_root: str = "/sys") -> FileInfo:
     """CHECK_FILE: classify *path* for the direct-load path.
 
     Reference semantics (`kmod/nvme_strom.c:188-583`): read permission, fs
     identity, blocksize <= PAGE_SIZE, file at least one page (inline files
-    excluded), raw-NVMe-or-RAID0 backing, NUMA node, DMA64, request cap.  The
-    TPU engine's hard requirement is an O_DIRECT-capable regular file; fs
-    kind and geometry are reported for policy."""
+    excluded), raw-NVMe-or-RAID0 backing, NUMA node, DMA64, request cap.
+
+    The TPU engine's hard requirement is an O_DIRECT-capable regular file;
+    the backing-device verdict (``backing_supported`` / ``backing_reason``,
+    from :229-438's raw-NVMe/md-RAID0 walk redone over sysfs) is always
+    reported, and with ``strict=True`` (or config ``require_nvme_backing``)
+    an unverified backing makes the file UNSUPPORTED outright — the
+    reference's behavior, where a SATA or network fs could never be
+    green-lit for the fast path."""
     st = os.stat(path)
     if not os.access(path, os.R_OK):
         raise StromError(_errno.EACCES, f"no read permission: {path}")
@@ -120,19 +111,38 @@ def check_file(path: str, *, dma_max_size: Optional[int] = None) -> FileInfo:
         kind = FsKind.UNSUPPORTED
     if kind in (FsKind.EXT4, FsKind.XFS) and not _probe_odirect(path):
         kind = FsKind.UNSUPPORTED
-    lbs_text = _sysfs_block_attr(path, "queue/logical_block_size")
-    lbs = int(lbs_text) if lbs_text else 512
+    backing = probe_backing(path, sysfs_root=sysfs_root)
+    if strict is None:
+        strict = config.get("require_nvme_backing")
+    # strict policy is a separate verdict, NOT an fs_kind clobber: fs_kind
+    # stays an honest fact so cached probes + a live policy check compose.
+    # The predicate itself lives in FileInfo.strict_eligible (backing
+    # verified AND dma64) so tools and planner share one definition.
+    policy_rejected = bool(strict and not (backing.supported
+                                           and backing.support_dma64))
     # reference excludes files smaller than one page (inline data risk,
     # kmod/nvme_strom.c:503-518)
     if st.st_size < PAGE_SIZE:
         kind = FsKind.UNSUPPORTED
     cap = dma_max_size or config.get("dma_max_size")
-    max_hw = _sysfs_block_attr(path, "queue/max_sectors_kb")
-    if max_hw:
-        cap = min(cap, int(max_hw) << 10)
+    if backing.dma_max_size:
+        # min(hw ceiling, admin soft limit), resolved by the classifier
+        # (:297-314 analog) — no second walk of the real /sys here, so
+        # fake-tree probes stay hermetic
+        cap = min(cap, backing.dma_max_size)
+    # numa -1 is a *verdict* for RAID0 spanning nodes (kmod :322-326) and
+    # honest "unknown" otherwise; consumers guard negative nodes
     return FileInfo(path=path, file_size=st.st_size, fs_kind=kind,
-                    logical_block_size=lbs, dma_max_size=cap,
-                    numa_node_id=device_numa_node(path), support_dma64=True)
+                    logical_block_size=backing.logical_block_size or 512,
+                    dma_max_size=cap,
+                    numa_node_id=backing.numa_node_id,
+                    support_dma64=backing.support_dma64,
+                    n_members=max(1, len(backing.members)),
+                    stripe_chunk_size=backing.stripe_chunk_size,
+                    backing_kind=backing.kind,
+                    backing_supported=backing.supported,
+                    backing_reason=backing.reason,
+                    policy_rejected=policy_rejected)
 
 
 # ---------------------------------------------------------------------------

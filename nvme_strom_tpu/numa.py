@@ -49,28 +49,11 @@ def _read(path: str) -> Optional[str]:
 
 def device_numa_node(path: str) -> int:
     """NUMA node of the block device backing *path* (kmod/nvme_strom.c:316-328
-    analog).  Walks /sys/dev/block/<maj>:<min> up to a device with a
-    ``numa_node`` attribute.  Returns 0 when undiscoverable."""
-    try:
-        st = os.stat(path)
-        dev = st.st_dev
-        maj, minor = os.major(dev), os.minor(dev)
-    except OSError:
-        return 0
-    node = _read(f"/sys/dev/block/{maj}:{minor}/device/numa_node")
-    if node is None:
-        # partition -> parent disk
-        link = f"/sys/dev/block/{maj}:{minor}"
-        try:
-            real = os.path.realpath(link)
-            node = _read(os.path.join(os.path.dirname(real), "device", "numa_node"))
-        except OSError:
-            node = None
-    try:
-        n = int(node) if node is not None else 0
-    except ValueError:
-        n = 0
-    return max(n, 0)  # -1 (no NUMA) -> 0
+    analog), via the eligibility classifier's sysfs walk.  Returns -1 for
+    unknown or spans-nodes — callers must never bind to a negative node
+    (bind_to_node guards this)."""
+    from .eligibility import probe_backing
+    return probe_backing(path).numa_node_id
 
 
 def nodes_with_memory() -> List[int]:
@@ -92,7 +75,12 @@ def node_cpus(node: int) -> List[int]:
 
 def bind_to_node(node: int) -> bool:
     """Bind this process's CPU affinity to *node*'s CPUs
-    (utils/ssd2ram_test.c:66-119 analog).  Returns True on success."""
+    (utils/ssd2ram_test.c:66-119 analog).  Returns True on success.
+
+    node < 0 means unknown or spans-nodes (RAID0 across sockets,
+    kmod/nvme_strom.c:322-326): never touch affinity for those."""
+    if node < 0:
+        return False
     cpus = node_cpus(node)
     if not cpus:
         return False

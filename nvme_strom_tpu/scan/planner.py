@@ -24,6 +24,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import dataclasses
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
@@ -57,11 +58,11 @@ class CapabilityCache:
     def _fresh(self, path: str, cap: FileInfo) -> FileInfo:
         size = os.stat(path).st_size
         kind = cap.fs_kind if size >= 4096 else type(cap.fs_kind)(0)
-        return FileInfo(path=path, file_size=size, fs_kind=kind,
-                        logical_block_size=cap.logical_block_size,
-                        dma_max_size=cap.dma_max_size,
-                        numa_node_id=cap.numa_node_id,
-                        support_dma64=cap.support_dma64)
+        # replace(), not a field-by-field copy: a FileInfo field added
+        # later must flow through the cache unchanged, not silently
+        # reset to its default
+        return dataclasses.replace(cap, path=path, file_size=size,
+                                   fs_kind=kind)
 
     def probe(self, path: str) -> FileInfo:
         d = os.path.dirname(os.path.abspath(path)) or "/"
@@ -73,7 +74,10 @@ class CapabilityCache:
             if hit is not None and now - hit[1] < self.ttl_s:
                 self._mru = (d, hit[0], hit[1])
                 return self._fresh(path, hit[0])
-        cap = check_file(path)
+        # honest facts only (strict=False): policy is applied live by
+        # should_use_direct_scan, so toggling require_nvme_backing takes
+        # effect immediately instead of after cache TTL
+        cap = check_file(path, strict=False)
         with self._lock:
             self._cache[d] = (cap, now)
             self._mru = (d, cap, now)
@@ -118,7 +122,14 @@ def should_use_direct_scan(path: str, *, table_size: Optional[int] = None) -> bo
     if not config.get("enabled"):
         return False
     info = capability_cache.probe(path)
-    if not info.supported or not info.support_dma64:
+    if not info.supported:
+        return False
+    # DMA64 was the reference's hard requirement for P2P BAR addressing
+    # (pgsql/nvme_strom.c:313-318); on the pinned-host path the host
+    # kernel owns addressing, so the shared strict predicate only gates
+    # when backing verification is authoritative (live policy read —
+    # cache holds honest facts)
+    if config.get("require_nvme_backing") and not info.strict_eligible:
         return False
     size = table_size if table_size is not None else info.file_size
     if config.get("debug_no_threshold"):

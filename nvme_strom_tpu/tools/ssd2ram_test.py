@@ -49,6 +49,8 @@ def main(argv=None) -> int:
           f"{'supported' if info.support_dma64 else 'unsupported'}   "
           f"block: {info.logical_block_size}   dma max: "
           f"{info.dma_max_size >> 10}KB")
+    print(f"backing: {info.backing_kind or 'unknown'}"
+          + (f" ({info.backing_reason})" if info.backing_reason else ""))
     if not info.supported:
         print("NOT supported for direct load", file=sys.stderr)
         return 1

@@ -143,6 +143,32 @@ def check_jax() -> bool:
         return _report("jax", FAIL, f"import failed: {e}")
 
 
+def check_backing(path: str) -> bool:
+    """Backing-device eligibility (kmod/nvme_strom.c:229-438 analog):
+    reports whether *path* sits on raw NVMe / md-RAID0-of-NVMe, with the
+    classifier's reason when not — informational unless config
+    ``require_nvme_backing`` is on, in which case drift here disables the
+    direct path outright."""
+    from ..config import config
+    from ..eligibility import probe_backing
+    b = probe_backing(path)
+    strict = config.get("require_nvme_backing")
+    detail = f"kind={b.kind or '?'} name={b.name or '?'}"
+    if b.supported:
+        extra = (f" members={','.join(b.members)}" if b.members else "")
+        return _report("backing", OK,
+                       f"{detail}{extra} numa={b.numa_node_id} "
+                       f"dma64={b.support_dma64} "
+                       f"dma_max={b.dma_max_size or 'n/a'}")
+    status = FAIL if strict else WARN
+    return _report("backing", status, f"{detail}: {b.reason}",
+                   advice="direct-load perf model assumes NVMe; set "
+                          "require_nvme_backing=off (default) to run "
+                          "anyway on this backing" if strict else
+                          "numbers on this backing are not NVMe-class; "
+                          "set require_nvme_backing=on to hard-gate")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="strom_check", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -155,6 +181,7 @@ def main(argv=None) -> int:
     ok = True
     for fn in (check_kernel, check_io_uring,
                lambda: check_odirect(args.path),
+               lambda: check_backing(args.path),
                check_hugepages, check_memlock, check_numa,
                check_native_signature):
         ok = fn() and ok

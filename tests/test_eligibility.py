@@ -1,0 +1,238 @@
+"""Backing-device eligibility classifier against fake sysfs trees.
+
+Covers the reference's raw-NVMe / md-RAID-0 verification semantics
+(kmod/nvme_strom.c:229-438) hardware-free: every tree below is what
+/sys would show for the given topology.
+"""
+
+import os
+
+import pytest
+
+from nvme_strom_tpu.eligibility import probe_backing, probe_backing_dev
+from nvme_strom_tpu.engine import check_file
+
+
+def _write(path, text):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text + "\n")
+
+
+def make_disk(root, name, devno, *, rotational="0", lbs="512",
+              max_hw_kb="2048", numa="1", dma_bits=None, controller=True):
+    """Fake /sys for one whole disk; returns its directory."""
+    disk = os.path.join(root, "devices", "fake", name)
+    _write(os.path.join(disk, "queue", "rotational"), rotational)
+    _write(os.path.join(disk, "queue", "logical_block_size"), lbs)
+    _write(os.path.join(disk, "queue", "max_hw_sectors_kb"), max_hw_kb)
+    if controller:
+        _write(os.path.join(disk, "device", "numa_node"), numa)
+        if dma_bits is not None:
+            _write(os.path.join(disk, "device", "dma_mask_bits"), dma_bits)
+    os.makedirs(os.path.join(root, "dev", "block"), exist_ok=True)
+    link = os.path.join(root, "dev", "block", devno)
+    if not os.path.islink(link):
+        os.symlink(disk, link)
+    return disk
+
+
+def make_md(root, name, devno, member_dirs, *, level="raid0",
+            chunk="65536"):
+    disk = os.path.join(root, "devices", "fake", name)
+    _write(os.path.join(disk, "md", "level"), level)
+    _write(os.path.join(disk, "md", "raid_disks"), str(len(member_dirs)))
+    _write(os.path.join(disk, "md", "chunk_size"), chunk)
+    for i, mdir in enumerate(member_dirs):
+        rd = os.path.join(disk, "md", f"rd{i}")
+        os.makedirs(rd, exist_ok=True)
+        os.symlink(mdir, os.path.join(rd, "block"))
+    os.makedirs(os.path.join(root, "dev", "block"), exist_ok=True)
+    os.symlink(disk, os.path.join(root, "dev", "block", devno))
+    return disk
+
+
+def test_nvme_disk_supported(tmp_path):
+    root = str(tmp_path)
+    make_disk(root, "nvme0n1", "259:0")
+    b = probe_backing_dev(259, 0, sysfs_root=root)
+    assert b.supported and b.kind == "nvme" and b.name == "nvme0n1"
+    assert b.numa_node_id == 1
+    assert b.logical_block_size == 512
+    assert b.dma_max_size == 2048 << 10
+    assert b.support_dma64  # NVMe default when dma_mask_bits absent
+
+
+def test_rotational_rejected(tmp_path):
+    root = str(tmp_path)
+    make_disk(root, "nvme0n1", "259:0", rotational="1")
+    b = probe_backing_dev(259, 0, sysfs_root=root)
+    assert not b.supported and "rotational" in b.reason
+
+
+def test_non_nvme_name_rejected(tmp_path):
+    root = str(tmp_path)
+    make_disk(root, "vda", "254:0")
+    b = probe_backing_dev(254, 0, sysfs_root=root)
+    assert not b.supported and b.kind == "other"
+    assert "not an NVMe namespace" in b.reason
+
+
+def test_sata_style_name_rejected(tmp_path):
+    root = str(tmp_path)
+    make_disk(root, "sda", "8:0", rotational="1")
+    b = probe_backing_dev(8, 0, sysfs_root=root)
+    assert not b.supported and "rotational" in b.reason
+
+
+def test_unbound_namespace_rejected(tmp_path):
+    # NVME_IOCTL_ID ping analog (kmod/nvme_strom.c:259-272): a namespace
+    # with no bound controller cannot do I/O
+    root = str(tmp_path)
+    make_disk(root, "nvme0n1", "259:0", controller=False)
+    b = probe_backing_dev(259, 0, sysfs_root=root)
+    assert not b.supported and "controller" in b.reason
+
+
+def test_partition_resolves_to_parent_disk(tmp_path):
+    root = str(tmp_path)
+    disk = make_disk(root, "nvme0n1", "259:0")
+    part = os.path.join(disk, "nvme0n1p1")
+    _write(os.path.join(part, "partition"), "1")
+    os.symlink(part, os.path.join(root, "dev", "block", "259:1"))
+    b = probe_backing_dev(259, 1, sysfs_root=root)
+    assert b.supported and b.name == "nvme0n1"
+
+
+def test_dma_mask_bits_32_rejects_dma64(tmp_path):
+    root = str(tmp_path)
+    make_disk(root, "nvme0n1", "259:0", dma_bits="32")
+    b = probe_backing_dev(259, 0, sysfs_root=root)
+    assert b.supported and not b.support_dma64
+
+
+def test_no_sysfs_node_tmpfs(tmp_path):
+    b = probe_backing_dev(0, 44, sysfs_root=str(tmp_path))
+    assert not b.supported and b.kind == "none"
+    assert "no block device" in b.reason
+
+
+def test_md_raid0_all_nvme_supported(tmp_path):
+    root = str(tmp_path)
+    m0 = make_disk(root, "nvme0n1", "259:0", numa="0", max_hw_kb="2048")
+    m1 = make_disk(root, "nvme1n1", "259:1", numa="0", max_hw_kb="1024")
+    make_md(root, "md0", "9:0", [m0, m1])
+    b = probe_backing_dev(9, 0, sysfs_root=root)
+    assert b.supported and b.kind == "md-raid0"
+    assert b.members == ("nvme0n1", "nvme1n1")
+    assert b.stripe_chunk_size == 65536
+    assert b.dma_max_size == 1024 << 10  # min across members
+    assert b.numa_node_id == 0
+
+
+def test_md_numa_mismatch_reports_minus_one(tmp_path):
+    root = str(tmp_path)
+    m0 = make_disk(root, "nvme0n1", "259:0", numa="0")
+    m1 = make_disk(root, "nvme1n1", "259:1", numa="1")
+    make_md(root, "md0", "9:0", [m0, m1])
+    b = probe_backing_dev(9, 0, sysfs_root=root)
+    assert b.supported and b.numa_node_id == -1  # spans nodes (:322-326)
+
+
+def test_md_raid1_rejected(tmp_path):
+    root = str(tmp_path)
+    m0 = make_disk(root, "nvme0n1", "259:0")
+    make_md(root, "md0", "9:0", [m0], level="raid1")
+    b = probe_backing_dev(9, 0, sysfs_root=root)
+    assert not b.supported and "not RAID-0" in b.reason
+
+
+def test_md_bad_chunk_rejected(tmp_path):
+    root = str(tmp_path)
+    m0 = make_disk(root, "nvme0n1", "259:0")
+    make_md(root, "md0", "9:0", [m0], chunk="2048")  # < PAGE_SIZE
+    b = probe_backing_dev(9, 0, sysfs_root=root)
+    assert not b.supported and "stripe" in b.reason
+
+
+def test_md_non_nvme_member_rejected(tmp_path):
+    root = str(tmp_path)
+    m0 = make_disk(root, "nvme0n1", "259:0")
+    m1 = make_disk(root, "sdb", "8:16")
+    make_md(root, "md0", "9:0", [m0, m1])
+    b = probe_backing_dev(9, 0, sysfs_root=root)
+    assert not b.supported and "rd1" in b.reason
+
+
+def test_md_member_blocksize_mismatch_rejected(tmp_path):
+    root = str(tmp_path)
+    m0 = make_disk(root, "nvme0n1", "259:0", lbs="512")
+    m1 = make_disk(root, "nvme1n1", "259:1", lbs="4096")
+    make_md(root, "md0", "9:0", [m0, m1])
+    b = probe_backing_dev(9, 0, sysfs_root=root)
+    assert not b.supported and "block size mismatch" in b.reason
+
+
+# -- check_file integration --------------------------------------------------
+
+def _fake_tree_for(path, tmp_path, make=True):
+    """Fake sysfs whose dev/block node for *path*'s real device points at
+    a fake NVMe disk, so check_file's backing walk lands on it."""
+    root = str(tmp_path / "sys")
+    st = os.stat(path)
+    devno = f"{os.major(st.st_dev)}:{os.minor(st.st_dev)}"
+    if make:
+        make_disk(root, "nvme0n1", devno, numa="0", max_hw_kb="512")
+    else:
+        os.makedirs(os.path.join(root, "dev", "block"), exist_ok=True)
+    return root
+
+
+def test_check_file_strict_rejects_unverified_backing(tmp_path):
+    p = tmp_path / "data.bin"
+    p.write_bytes(b"x" * 8192)
+    root = _fake_tree_for(str(p), tmp_path, make=False)
+    info = check_file(str(p), strict=True, sysfs_root=root)
+    assert not info.supported
+    assert not info.backing_supported
+    assert "no block device" in info.backing_reason
+
+
+def test_check_file_nonstrict_reports_but_allows(tmp_path):
+    p = tmp_path / "data.bin"
+    p.write_bytes(b"x" * 8192)
+    root = _fake_tree_for(str(p), tmp_path, make=False)
+    info = check_file(str(p), strict=False, sysfs_root=root)
+    assert info.supported  # engine can still drive it...
+    assert not info.backing_supported  # ...but the verdict is honest
+    assert info.backing_reason
+    assert not info.support_dma64  # no longer hardcoded True
+
+
+def test_check_file_preserves_md_spans_nodes_verdict(tmp_path):
+    # a RAID0 spanning NUMA nodes must surface -1 (kmod :322-326), not a
+    # fabricated concrete node that affinity code would pin to
+    p = tmp_path / "data.bin"
+    p.write_bytes(b"x" * 8192)
+    root = str(tmp_path / "sys")
+    st = os.stat(str(p))
+    devno = f"{os.major(st.st_dev)}:{os.minor(st.st_dev)}"
+    m0 = make_disk(root, "nvme0n1", "259:0", numa="0")
+    m1 = make_disk(root, "nvme1n1", "259:1", numa="1")
+    make_md(root, "md0", devno, [m0, m1])
+    info = check_file(str(p), strict=True, sysfs_root=root)
+    assert info.backing_kind == "md-raid0" and info.backing_supported
+    assert info.numa_node_id == -1
+    assert info.n_members == 2
+
+
+def test_check_file_nvme_backing_passes_strict(tmp_path):
+    p = tmp_path / "data.bin"
+    p.write_bytes(b"x" * 8192)
+    root = _fake_tree_for(str(p), tmp_path, make=True)
+    info = check_file(str(p), strict=True, sysfs_root=root)
+    assert info.supported and info.backing_supported
+    assert info.backing_kind == "nvme"
+    assert info.support_dma64
+    assert info.dma_max_size <= 512 << 10  # clamped by fake max_hw_sectors_kb
+    assert info.numa_node_id == 0

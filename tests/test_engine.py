@@ -30,7 +30,10 @@ def test_check_file_supported(tmp_data_file):
     assert info.file_size == 4 << 20
     assert info.fs_kind in (FsKind.EXT4, FsKind.XFS, FsKind.OTHER_DIRECT)
     assert info.dma_max_size >= 4 << 10
-    assert info.support_dma64
+    # dma64 is probed from the real device chain now, not hardcoded;
+    # on a non-NVMe CI host it is honestly False
+    assert isinstance(info.support_dma64, bool)
+    assert info.backing_kind  # classifier always renders a verdict
 
 
 def test_check_file_rejects_tiny_file(tmp_path):

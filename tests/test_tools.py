@@ -36,7 +36,8 @@ def test_ssd2ram_check_mode(data_file):
     out = _run("nvme_strom_tpu.tools.ssd2ram_test", data_file, "-c")
     assert out.returncode == 0, out.stderr
     assert "numa node:" in out.stdout
-    assert "dma64: supported" in out.stdout
+    assert "dma64:" in out.stdout  # probed honestly, not hardcoded
+    assert "backing:" in out.stdout
 
 
 def test_ssd2ram_full_run(data_file):
