@@ -9,10 +9,18 @@ the reference's ``ssd2gpu_test`` vs ``ssd2gpu_test -f`` comparison
 Each mode runs in a fresh subprocess so PJRT/tunnel state (which throttles
 after a burst on some hosts) treats both paths identically.
 
-Prints ONE JSON line:
+The TPU tunnel on this host can wedge outright (round-1 bench recorded 0.0
+rc=1).  Hardening (VERDICT r1 #1): several probe attempts with backoff and a
+warm-up transfer to unstick it; if the device never appears, the bench still
+exits 0 with the CPU-pinned engine row (SSD→pinned-RAM direct vs buffered
+VFS baseline) as the metric of record and the device failure scoped to an
+"error_device" field — the driver always captures something measurable.
+
+Prints ONE JSON line, e.g.:
   {"metric": "ssd2tpu_seq_GBps", "value": N, "unit": "GB/s", "vs_baseline": R}
 
-Env knobs: BENCH_SIZE_MB (default 128), BENCH_FILE, BENCH_SMOKE=1 (64MB).
+Env knobs: BENCH_SIZE_MB (default 128), BENCH_FILE, BENCH_SMOKE=1 (64MB),
+BENCH_PROBE_ATTEMPTS (default 5).
 """
 
 import json
@@ -20,6 +28,7 @@ import os
 import re
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -40,35 +49,127 @@ def _env():
     return env
 
 
-def _probe_backend(timeout_s: int = 180) -> bool:
-    """Can a subprocess initialize the accelerator at all?  The TPU tunnel
-    on some hosts wedges; a bounded probe keeps bench from hanging for the
-    full per-mode timeout on every run."""
+_PROBE_CODE = """
+import jax
+d = jax.devices()[0]
+print("platform:", d.platform)
+# warm-up transfer: a small H2D burst can unstick the tunnel's limiter
+import numpy as np
+jax.device_put(np.ones(1 << 20, np.uint8), d).block_until_ready()
+jax.device_put(np.ones(8 << 20, np.uint8), d).block_until_ready()
+print("warmup ok")
+"""
+
+
+def _probe_backend_once(timeout_s: int) -> bool:
     try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, cwd=REPO, env=_env(),
-            timeout=timeout_s)
-        return out.returncode == 0
+        out = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                             capture_output=True, text=True, cwd=REPO,
+                             env=_env(), timeout=timeout_s)
+        return out.returncode == 0 and "warmup ok" in out.stdout
     except subprocess.TimeoutExpired:
         return False
 
 
-def _run_mode(path: str, extra_args) -> float:
+def _probe_backend() -> bool:
+    """Up to N attempts with growing timeouts + backoff (~10 min worst
+    case).  Each attempt includes a warm-up transfer; a wedged tunnel
+    sometimes recovers after idle + a fresh process."""
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "5"))
+    timeouts = [60, 90, 120, 150, 180]
+    sleeps = [15, 30, 60, 120]
+    for i in range(attempts):
+        t = timeouts[min(i, len(timeouts) - 1)]
+        sys.stderr.write(f"bench: device probe attempt {i + 1}/{attempts} "
+                         f"(timeout {t}s)\n")
+        if _probe_backend_once(t):
+            return True
+        if i + 1 < attempts:
+            s = sleeps[min(i, len(sleeps) - 1)]
+            sys.stderr.write(f"bench: probe failed; retrying in {s}s\n")
+            time.sleep(s)
+    return False
+
+
+def _run_mode(path: str, extra_args, timeout: int = 1800) -> float:
     """Run ssd2tpu_test in a subprocess, return GB/s."""
     cmd = [sys.executable, "-m", "nvme_strom_tpu.tools.ssd2tpu_test", path,
            *extra_args]
     out = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
-                         env=_env(), timeout=1800)
+                         env=_env(), timeout=timeout)
     if out.returncode != 0:
         sys.stderr.write(out.stdout + out.stderr)
-        raise SystemExit(f"bench mode failed: {' '.join(extra_args)}")
+        raise RuntimeError(f"bench mode failed: {' '.join(extra_args)}")
     m = re.search(r"=> ([0-9.]+) GB/s", out.stdout)
     if not m:
         sys.stderr.write(out.stdout + out.stderr)
-        raise SystemExit("bench: no throughput in output")
+        raise RuntimeError("bench: no throughput in output")
     return float(m.group(1))
+
+
+_CPU_ROW_CODE = """
+import json, os, time
+import numpy as np
+from nvme_strom_tpu import open_source, Session
+from nvme_strom_tpu.tools.common import drop_page_cache
+path = {path!r}
+size = os.path.getsize(path)
+chunk = 1 << 20
+# best-of-3: the shared host's disk throughput is noisy, and a one-off
+# stall must not become the round's official number
+direct = vfs = 0.0
+for _ in range(3):
+    drop_page_cache(path)
+    with open_source(path) as src, Session() as s:
+        h, buf = s.alloc_dma_buffer(size)
+        t0 = time.monotonic()
+        res = s.memcpy_ssd2ram(src, h, list(range(size // chunk)), chunk)
+        s.memcpy_wait(res.dma_task_id)
+        direct = max(direct, size / (time.monotonic() - t0) / (1 << 30))
+    drop_page_cache(path)
+    t0 = time.monotonic()
+    with open(path, "rb", buffering=0) as f:
+        dst = bytearray(1 << 22)
+        while f.readinto(dst) > 0:
+            pass
+    vfs = max(vfs, size / (time.monotonic() - t0) / (1 << 30))
+print("ROW=" + json.dumps({{"direct": round(direct, 3), "vfs": round(vfs, 3)}}))
+"""
+
+
+def _cpu_row(path: str) -> dict:
+    """SSD→pinned-RAM engine row (direct vs buffered VFS), no device."""
+    out = subprocess.run([sys.executable, "-c", _CPU_ROW_CODE.format(path=path)],
+                         capture_output=True, text=True, cwd=REPO,
+                         env=_env(), timeout=1800)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise RuntimeError("cpu row failed")
+    m = re.search(r"ROW=(\{.*\})", out.stdout)
+    return json.loads(m.group(1))
+
+
+def _emit_cpu_fallback(path: str, device_error: str) -> int:
+    """Device never came up: record the CPU-pinned engine row as the
+    metric of record, error scoped to the device rows only, rc 0."""
+    try:
+        row = _cpu_row(path)
+    except Exception as e:  # noqa: BLE001 - last resort reporting
+        print(json.dumps({"metric": "ssd2tpu_seq_GBps", "value": 0.0,
+                          "unit": "GB/s", "vs_baseline": None,
+                          "error": f"{device_error}; cpu row also failed: {e}"}))
+        return 1
+    print(json.dumps({
+        "metric": "ssd2ram_seq_GBps",
+        "value": row["direct"],
+        "unit": "GB/s",
+        "vs_baseline": round(row["direct"] / row["vfs"], 3) if row["vfs"] else None,
+        "error_device": device_error,
+        "note": "TPU tunnel unavailable after probe+backoff; reporting the "
+                "CPU-pinned SSD->RAM engine row (direct vs buffered VFS). "
+                "ssd2tpu rows require the device.",
+    }))
+    return 0
 
 
 def main() -> int:
@@ -78,27 +179,22 @@ def main() -> int:
     _ensure_file(path, size_mb << 20)
 
     if not _probe_backend():
-        sys.stderr.write("bench: device backend failed to initialize "
-                         "(wedged tunnel?) — retrying once in 60s\n")
-        import time as _t
-        _t.sleep(60)
-        if not _probe_backend():
-            print(json.dumps({"metric": "ssd2tpu_seq_GBps", "value": 0.0,
-                              "unit": "GB/s", "vs_baseline": None,
-                              "error": "device backend unavailable"}))
-            return 1
+        sys.stderr.write("bench: device backend unavailable after all "
+                         "probe attempts — falling back to CPU rows\n")
+        return _emit_cpu_fallback(path, "device backend unavailable "
+                                        "(wedged tunnel)")
 
     # Alternate modes across fresh subprocesses and keep the best of each:
     # some hosts rate-limit device transfers after a burst, so a fixed
     # direct-then-baseline order hands the throttle to whichever runs
     # second.  Alternation + cooldown (subprocess startup is itself several
     # seconds of idle) measures the framework, not the rate limiter.
-    import time as _time
     rounds = 1 if smoke else 2
     cooldown = 0 if smoke else 15
     direct_args = ["-n", "6", "-s", "16m"]
     vfs_args = ["-f", "16m"]
     direct = vfs = 0.0
+    failures = []
     for r in range(rounds):
         # true alternation: round 0 runs direct first, round 1 runs vfs
         # first, so neither mode always inherits the other's burst debt
@@ -107,18 +203,34 @@ def main() -> int:
             order.reverse()
         for i, (tag, margs) in enumerate(order):
             if r or i:
-                _time.sleep(cooldown)
-            got = _run_mode(path, margs)
+                time.sleep(cooldown)
+            try:
+                got = _run_mode(path, margs)
+            except (RuntimeError, subprocess.TimeoutExpired) as e:
+                # a mid-run wedge must not zero the whole bench: keep
+                # whatever completed, note the failure
+                failures.append(f"{tag}: {e}")
+                continue
             if tag == "d":
                 direct = max(direct, got)
             else:
                 vfs = max(vfs, got)
-    print(json.dumps({
+    if direct <= 0.0:
+        # direct mode never completed: fall back to the CPU row so the
+        # record is still a real measurement
+        sys.stderr.write("bench: all direct-mode runs failed: "
+                         + "; ".join(failures) + "\n")
+        return _emit_cpu_fallback(path, "device present but ssd2tpu runs "
+                                        "failed: " + "; ".join(failures))
+    out = {
         "metric": "ssd2tpu_seq_GBps",
         "value": round(direct, 3),
         "unit": "GB/s",
         "vs_baseline": round(direct / vfs, 3) if vfs else None,
-    }))
+    }
+    if failures:
+        out["partial_failures"] = failures
+    print(json.dumps(out))
     return 0
 
 

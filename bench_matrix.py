@@ -134,6 +134,49 @@ print(f"GBPS={{nbytes/dt/(1<<30):.3f}}")
 """
 
 
+_RAW = _COMMON + """
+# fio-style raw denominator: sequential O_DIRECT pread, no framework at
+# all — the "raw NVMe bandwidth" every BASELINE target is a percentage of
+path = {path!r}
+make_test_file(path, size) if not (os.path.exists(path) and os.path.getsize(path) == size) else None
+drop_page_cache(path)
+try:
+    fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
+except OSError:  # tmpfs etc. reject O_DIRECT; measure buffered-cold instead
+    fd = os.open(path, os.O_RDONLY)
+import mmap
+blk = 4 << 20
+buf = mmap.mmap(-1, blk)
+t0 = time.monotonic()
+off = 0
+while off < size:
+    n = os.preadv(fd, [buf], off)
+    assert n > 0
+    off += n
+dt = time.monotonic() - t0
+os.close(fd)
+print(f"GBPS={{size/dt/(1<<30):.3f}}")
+"""
+
+_RAM2SSD = _COMMON + """
+from nvme_strom_tpu import Session
+from nvme_strom_tpu.engine import open_source
+path = {path!r} + ".wr"
+with open(path, "wb") as f:
+    f.truncate(size)
+with open_source(path, writable=True) as sink, Session() as s:
+    h, buf = s.alloc_dma_buffer(size)
+    buf.view()[:] = np.random.default_rng(3).integers(
+        0, 255, size, dtype=np.uint8).tobytes()
+    t0 = time.monotonic()
+    res = s.memcpy_ram2ssd(sink, h, list(range(size >> 20)), 1 << 20)
+    s.memcpy_wait(res.dma_task_id)
+    sink.sync()
+    dt = time.monotonic() - t0
+os.unlink(path)
+print(f"GBPS={{size/dt/(1<<30):.3f}}")
+"""
+
 _H2D = _COMMON + """
 import jax
 # transport ceiling: pinned-host->HBM device_put alone, no SSD at all.
@@ -188,10 +231,14 @@ def main() -> int:
     base = f"/tmp/strom_matrix_{size_mb}"
 
     configs = [
+        ("raw_seq_read", "raw O_DIRECT pread (no framework; denominator)",
+         _RAW.format(size=size, path=base + ".bin"), None),
         ("h2d_peak", "host->HBM device_put (transport ceiling)",
          _H2D.format(size=size), None),
         ("ssd2ram_seq", "SSD->pinned RAM, O_DIRECT seq",
          _SSD2RAM.format(size=size, path=base + ".bin"), None),
+        ("ram2ssd_seq", "pinned RAM->SSD write (native write queue)",
+         _RAM2SSD.format(size=size, path=base), None),
         # seq vs mq32 isolates async depth: the engine queue is capped at 4
         # outstanding NVMe requests for the "seq" row and opened to the
         # 32-deep multi-queue default for the mq32 row (BASELINE.md row 3)
@@ -215,6 +262,17 @@ def main() -> int:
         gbps = _run(code, env)
         results[key] = gbps
         print(f"{key:<14} {desc:<34} {gbps:7.3f} GB/s")
+    # derived ratios (VERDICT r1 #2): every BASELINE ">=90% of raw" target
+    # becomes checkable from this one JSON
+    raw = results.get("raw_seq_read", 0.0)
+    h2d = results.get("h2d_peak", 0.0)
+    pct_of_raw = {k: round(v / raw, 3) for k, v in results.items()
+                  if raw and k != "raw_seq_read"}
+    ceiling = min(raw, h2d) if raw and h2d else 0.0
+    overlap_efficiency = {
+        k: round(results[k] / ceiling, 3)
+        for k in ("ssd2tpu_seq", "ssd2tpu_mq32")
+        if ceiling and k in results}
     path = os.path.join(REPO, "BENCH_MATRIX.json")
     with open(path, "w") as f:
         json.dump({"size_mb": size_mb, "unit": "GB/s",
@@ -222,8 +280,13 @@ def main() -> int:
                            "this host (device transfers are rate-limited "
                            "after a burst); TPU-destination rows are bounded "
                            "by it, CPU-destination rows (ssd2ram/raid0) show "
-                           "the engine's own throughput",
-                   "results": results}, f,
+                           "the engine's own throughput. pct_of_raw anchors "
+                           "each row to raw_seq_read; overlap_efficiency = "
+                           "achieved / min(raw ssd, h2d ceiling) isolates "
+                           "pipeline overlap quality from transport limits",
+                   "results": results,
+                   "pct_of_raw": pct_of_raw,
+                   "overlap_efficiency": overlap_efficiency}, f,
                   indent=2)
         f.write("\n")
     print(f"wrote {path}")

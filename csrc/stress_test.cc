@@ -48,14 +48,22 @@ int main(int argc, char** argv) {
     threads.emplace_back([&, t] {
       int fd = open(path, O_RDONLY | O_DIRECT);
       if (fd < 0) fd = open(path, O_RDONLY);
+      // per-thread scratch file so mixed write tasks race only on the
+      // engine's shared state, never on each other's data
+      char wpath[256];
+      snprintf(wpath, sizeof wpath, "%s.stress_w%d", path, t);
+      int wfd = open(wpath, O_RDWR | O_CREAT | O_TRUNC, 0600);
       void* buf = mmap(nullptr, reqs_per_task * req_sz, PROT_READ | PROT_WRITE,
                        MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
       std::mt19937 rng(t);
       for (int i = 0; i < iters; i++) {
+        bool is_write = wfd >= 0 && (i % 4 == 2);  // ~25% write tasks
         nstpu_req reqs[reqs_per_task];
         for (int r = 0; r < reqs_per_task; r++) {
-          reqs[r].fd = fd;
-          reqs[r].file_off = (rng() % span) * req_sz;
+          reqs[r].fd = is_write ? wfd : fd;
+          reqs[r].flags = is_write ? NSTPU_REQ_WRITE : 0;
+          reqs[r].file_off =
+              is_write ? r * req_sz : (rng() % span) * req_sz;
           reqs[r].len = req_sz;
           reqs[r].dest_off = r * req_sz;
         }
@@ -73,6 +81,10 @@ int main(int argc, char** argv) {
       }
       munmap(buf, reqs_per_task * req_sz);
       close(fd);
+      if (wfd >= 0) {
+        close(wfd);
+        unlink(wpath);
+      }
     });
   }
   for (auto& th : threads) th.join();
@@ -80,10 +92,12 @@ int main(int argc, char** argv) {
   nstpu_engine_reap(eng, failed, 256, 30000);
   uint64_t ctr[NSTPU_CTR__COUNT];
   nstpu_engine_stats(eng, ctr, NSTPU_CTR__COUNT);
-  printf("submits=%llu bytes=%llu wrong_wakeups=%llu max_inflight(reset)=ok "
-         "failures=%d\n",
+  printf("submits=%llu bytes=%llu writes=%llu write_bytes=%llu "
+         "wrong_wakeups=%llu max_inflight(reset)=ok failures=%d\n",
          (unsigned long long)ctr[NSTPU_CTR_NR_SUBMIT_DMA],
          (unsigned long long)ctr[NSTPU_CTR_TOTAL_DMA_LENGTH],
+         (unsigned long long)ctr[NSTPU_CTR_NR_WRITE_DMA],
+         (unsigned long long)ctr[NSTPU_CTR_TOTAL_WRITE_LENGTH],
          (unsigned long long)ctr[NSTPU_CTR_NR_WRONG_WAKEUP], failures.load());
   nstpu_engine_destroy(eng);
   return failures.load() ? 1 : 0;

@@ -176,7 +176,11 @@ struct ReqCtx {
   int fd;
   uint64_t file_off;
   uint64_t remaining;
-  char* dest;  // advances as short reads are continued
+  char* dest;  // advances as short reads/writes are continued
+  bool write;  // NSTPU_REQ_WRITE: dest is the SOURCE, fd the destination
+  uint8_t member;     // stripe member index for per-member accounting
+  uint64_t orig_len;  // full request length (remaining shrinks on resubmit)
+  uint64_t t_start;   // submit timestamp for per-member busy time
   // publication fence: submitter->reaper handoff otherwise flows through the
   // kernel ring, which TSAN cannot see; store-release before queueing, and
   // load-acquire on pickup, makes the happens-before edge explicit
@@ -191,6 +195,9 @@ struct Engine {
   int backend = NSTPU_BACKEND_THREADPOOL;
   unsigned depth = 32;
   std::atomic<uint64_t> ctr[NSTPU_CTR__COUNT];
+  // per-member request/byte/busy-ns counters (part_stat_add analog,
+  // kmod/nvme_strom.c:1101-1123)
+  std::atomic<uint64_t> member_ctr[NSTPU_MAX_MEMBERS][3];
   Slot slots[kTaskSlots];
   std::atomic<int64_t> next_task{1};
   std::atomic<bool> stopping{false};
@@ -213,19 +220,19 @@ struct Engine {
 
   Slot& slot_of(int64_t id) { return slots[id % kTaskSlots]; }
 
-  // verify IORING_OP_READ actually works (io_uring_setup succeeds on
-  // 5.1-5.5 kernels where OP_READ does not exist); run before the reaper
-  // starts, so we can consume the CQE synchronously
-  bool probe_op_read() {
-    int fd = open("/dev/null", O_RDONLY);
+  // verify IORING_OP_READ / IORING_OP_WRITE actually work (io_uring_setup
+  // succeeds on 5.1-5.5 kernels where these opcodes do not exist); run
+  // before the reaper starts, so we can consume the CQEs synchronously
+  bool probe_one_op(uint8_t opcode) {
+    int fd = open("/dev/null", O_RDWR);
     if (fd < 0) return false;
-    char byte;
+    char byte = 0;
     io_uring_sqe* sqe = ring.get_sqe();
     if (!sqe) {
       close(fd);
       return false;
     }
-    sqe->opcode = IORING_OP_READ;
+    sqe->opcode = opcode;
     sqe->fd = fd;
     sqe->addr = (uint64_t)&byte;
     sqe->len = 1;
@@ -242,15 +249,20 @@ struct Engine {
     __atomic_store_n(ring.cq_head, head + 1, __ATOMIC_RELEASE);
     return res != -EINVAL && res != -EOPNOTSUPP;
   }
+  bool probe_ops() {
+    return probe_one_op(IORING_OP_READ) && probe_one_op(IORING_OP_WRITE);
+  }
 
   ~Engine() { shutdown(); }
 
   bool init(int want_backend, int queue_depth) {
     for (auto& c : ctr) c.store(0);
+    for (auto& row : member_ctr)
+      for (auto& c : row) c.store(0);
     depth = queue_depth > 0 ? (unsigned)queue_depth : 32u;
     if (want_backend == NSTPU_BACKEND_AUTO ||
         want_backend == NSTPU_BACKEND_IO_URING) {
-      if (ring.init(depth) && probe_op_read()) {
+      if (ring.init(depth) && probe_ops()) {
         backend = NSTPU_BACKEND_IO_URING;
         depth = ring.sq_entries;
         reaper = std::thread([this] { reap_loop(); });
@@ -330,6 +342,12 @@ struct Engine {
   // ---- request completion (shared by both backends) ----------------------
 
   void finish_req(ReqCtx* rc, int err) {
+    // per-member accounting at completion: requests, bytes, busy ns
+    member_ctr[rc->member][0].fetch_add(1, std::memory_order_relaxed);
+    member_ctr[rc->member][1].fetch_add(rc->orig_len,
+                                        std::memory_order_relaxed);
+    member_ctr[rc->member][2].fetch_add(now_ns() - rc->t_start,
+                                        std::memory_order_relaxed);
     // drop the in-flight slot before waking the task's waiter, so a
     // post-wait stats snapshot never sees a stale cur_dma_count
     {
@@ -344,11 +362,11 @@ struct Engine {
 
   // ---- io_uring backend --------------------------------------------------
 
-  // hold sq_m; queue one read sqe for rc
+  // hold sq_m; queue one read/write sqe for rc
   bool queue_sqe_locked(ReqCtx* rc) {
     io_uring_sqe* sqe = ring.get_sqe();
     if (!sqe) return false;
-    sqe->opcode = IORING_OP_READ;
+    sqe->opcode = rc->write ? IORING_OP_WRITE : IORING_OP_READ;
     sqe->fd = rc->fd;
     sqe->addr = (uint64_t)rc->dest;
     sqe->len = (uint32_t)rc->remaining;
@@ -382,7 +400,7 @@ struct Engine {
         if (res < 0) {
           finish_req(rc, -res);
         } else if ((uint64_t)res < rc->remaining && res > 0) {
-          // short read: continue from where it stopped
+          // short read/write: continue from where it stopped
           rc->dest += res;
           rc->file_off += res;
           rc->remaining -= res;
@@ -394,7 +412,8 @@ struct Engine {
             finish_req(rc, EIO);  // defensive: SQ full / ring broken
           }
         } else if (res == 0) {
-          finish_req(rc, EIO);  // unexpected EOF inside a planned request
+          // unexpected EOF (read) / no-progress (write) inside a planned req
+          finish_req(rc, EIO);
         } else {
           finish_req(rc, 0);
         }
@@ -417,7 +436,9 @@ struct Engine {
       }
       int err = 0;
       while (rc->remaining > 0) {
-        ssize_t n = pread(rc->fd, rc->dest, rc->remaining, rc->file_off);
+        ssize_t n = rc->write
+                        ? pwrite(rc->fd, rc->dest, rc->remaining, rc->file_off)
+                        : pread(rc->fd, rc->dest, rc->remaining, rc->file_off);
         if (n < 0) {
           if (errno == EINTR) continue;
           err = errno;
@@ -471,8 +492,18 @@ struct Engine {
     Task* t = create_task();
     uint64_t t0 = now_ns();
     for (int32_t i = 0; i < nreq; i++) {
-      auto* rc = new ReqCtx{t, reqs[i].fd, reqs[i].file_off, reqs[i].len,
-                            (char*)dest_base + reqs[i].dest_off};
+      bool is_write = (reqs[i].flags & NSTPU_REQ_WRITE) != 0;
+      unsigned member = (reqs[i].flags >> NSTPU_REQ_MEMBER_SHIFT) & 0xFF;
+      if (member >= NSTPU_MAX_MEMBERS) member = NSTPU_MAX_MEMBERS - 1;
+      auto* rc = new ReqCtx{t,
+                            reqs[i].fd,
+                            reqs[i].file_off,
+                            reqs[i].len,
+                            (char*)dest_base + reqs[i].dest_off,
+                            is_write,
+                            (uint8_t)member,
+                            reqs[i].len,
+                            now_ns()};
       task_get(t);
       // respect the bounded in-flight window
       {
@@ -494,6 +525,11 @@ struct Engine {
       ctr[NSTPU_CTR_TOTAL_DMA_LENGTH].fetch_add(reqs[i].len,
                                                 std::memory_order_relaxed);
       ctr[NSTPU_CTR_NR_SUBMIT_DMA].fetch_add(1, std::memory_order_relaxed);
+      if (is_write) {
+        ctr[NSTPU_CTR_NR_WRITE_DMA].fetch_add(1, std::memory_order_relaxed);
+        ctr[NSTPU_CTR_TOTAL_WRITE_LENGTH].fetch_add(
+            reqs[i].len, std::memory_order_relaxed);
+      }
       if (backend == NSTPU_BACKEND_IO_URING) {
         std::lock_guard<std::mutex> lk(sq_m);
         // invariant: every queued SQE is entered under sq_m before the lock
@@ -717,6 +753,16 @@ int nstpu_engine_stats(uint64_t engine, uint64_t* out, int32_t cap) {
   Engine* e = lookup(engine);
   if (!e) return -ENOENT;
   return e->stats(out, cap);
+}
+
+int nstpu_engine_member_stats(uint64_t engine, int32_t member,
+                              uint64_t* out3) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  if (member < 0 || member >= NSTPU_MAX_MEMBERS || !out3) return -EINVAL;
+  for (int i = 0; i < 3; i++)
+    out3[i] = e->member_ctr[member][i].load(std::memory_order_relaxed);
+  return 0;
 }
 
 }  // extern "C"

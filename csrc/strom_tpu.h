@@ -47,16 +47,34 @@ enum {
   NSTPU_CTR_TOTAL_DMA_LENGTH,
   NSTPU_CTR_CUR_DMA_COUNT,
   NSTPU_CTR_MAX_DMA_COUNT,      /* read-and-reset by stats snapshot */
-  NSTPU_CTR_NR_RESUBMIT,        /* short-read continuations */
+  NSTPU_CTR_NR_RESUBMIT,        /* short-read/-write continuations */
   NSTPU_CTR_NR_SQ_FULL,         /* submission stalls on full SQ */
+  NSTPU_CTR_NR_WRITE_DMA,       /* write requests submitted (RAM2SSD leg) */
+  NSTPU_CTR_TOTAL_WRITE_LENGTH, /* bytes submitted as writes */
   NSTPU_CTR__COUNT
 };
 
+/* request flags */
+#define NSTPU_REQ_WRITE 0x1   /* buffer -> file instead of file -> buffer */
+
+/* stripe-member attribution rides in flags bits 8..15 (index within the
+ * striped source, clamped to NSTPU_MAX_MEMBERS-1); per-member counters
+ * are the reference's per-disk iostat analog (part_stat_add incl. the md
+ * aggregate, kmod/nvme_strom.c:1101-1123). */
+#define NSTPU_REQ_MEMBER_SHIFT 8
+#define NSTPU_MAX_MEMBERS 64
+
 /* One planned I/O request: read [file_off, file_off+len) from fd into
- * dest_base + dest_off.  len <= the planner's dma_max cap. */
+ * dest_base + dest_off — or, with NSTPU_REQ_WRITE, write the same span
+ * from dest_base + dest_off into fd (the RAM2SSD leg; the reference's
+ * engine was read-only, kmod/nvme_strom.c:1136-1224, so the write
+ * direction is a capability beyond it).  len <= the planner's dma_max
+ * cap.  Callers MUST zero-initialize nstpu_req (v1's field here was a
+ * pad whose value was ignored; now it is meaningful, and stack garbage
+ * in it could silently turn a read into a write). */
 typedef struct nstpu_req {
   int32_t  fd;
-  int32_t  _pad;
+  int32_t  flags;
   uint64_t file_off;
   uint64_t len;
   uint64_t dest_off;
@@ -98,6 +116,12 @@ int      nstpu_engine_reap(uint64_t engine, int64_t* failed_out, int32_t cap,
  * read-and-reset to the current in-flight count, like the reference's
  * STAT_INFO (kmod/nvme_strom.c:2087).  Returns entries written. */
 int      nstpu_engine_stats(uint64_t engine, uint64_t* out, int32_t cap);
+
+/* Per-member accounting: out3[0]=completed requests, out3[1]=bytes,
+ * out3[2]=ns of request busy time.  Returns 0, -EINVAL for member out of
+ * [0, NSTPU_MAX_MEMBERS), -ENOENT for a bad engine handle. */
+int      nstpu_engine_member_stats(uint64_t engine, int32_t member,
+                                   uint64_t* out3);
 
 #ifdef __cplusplus
 }

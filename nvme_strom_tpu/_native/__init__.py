@@ -36,11 +36,17 @@ NATIVE_COUNTERS = (
     "max_dma_count",
     "nr_resubmit",
     "nr_sq_full",
+    "nr_write_dma",
+    "total_write_length",
 )
+
+REQ_WRITE = 0x1        # NSTPU_REQ_WRITE
+REQ_MEMBER_SHIFT = 8   # NSTPU_REQ_MEMBER_SHIFT
+MAX_MEMBERS = 64       # NSTPU_MAX_MEMBERS
 
 
 class _Req(ctypes.Structure):
-    _fields_ = [("fd", ctypes.c_int32), ("_pad", ctypes.c_int32),
+    _fields_ = [("fd", ctypes.c_int32), ("flags", ctypes.c_int32),
                 ("file_off", ctypes.c_uint64), ("len", ctypes.c_uint64),
                 ("dest_off", ctypes.c_uint64)]
 
@@ -84,6 +90,12 @@ def _load() -> Optional[ctypes.CDLL]:
                                            ctypes.POINTER(ctypes.c_uint64),
                                            ctypes.c_int32]
         try:
+            lib.nstpu_engine_member_stats.argtypes = [
+                ctypes.c_uint64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint64)]
+        except AttributeError:  # pragma: no cover - older .so
+            pass
+        try:
             lib.nstpu_signature.restype = ctypes.c_char_p
         except AttributeError:  # pragma: no cover - older .so
             pass
@@ -123,14 +135,26 @@ class NativeEngine:
         self.backend_name = _BACKEND_NAMES.get(
             lib.nstpu_engine_backend(self._h), "unknown")
         self._prev_stats: Dict[str, int] = {}
+        self._prev_members: Dict[int, Tuple[int, int, int]] = {}
         self._stats_lock = threading.Lock()
 
     def submit(self, dest_addr: int,
-               reqs: Sequence[Tuple[int, int, int, int]]) -> int:
-        """Submit one task of (fd, file_off, len, dest_off) requests."""
+               reqs: Sequence[Tuple[int, int, int, int]], *,
+               write: bool = False,
+               members: Optional[Sequence[int]] = None) -> int:
+        """Submit one task of (fd, file_off, len, dest_off) requests.
+
+        ``write=True`` reverses direction for the whole task: the buffer
+        span at dest_off is WRITTEN to the fd (the GIL-free RAM2SSD leg
+        the read-only reference lacked).  ``members[i]`` attributes request
+        *i* to a stripe member for per-member accounting."""
         arr = (_Req * len(reqs))()
+        base_flags = REQ_WRITE if write else 0
         for i, (fd, off, ln, doff) in enumerate(reqs):
             arr[i].fd = fd
+            m = members[i] if members is not None else 0
+            arr[i].flags = base_flags | (min(max(m, 0), MAX_MEMBERS - 1)
+                                         << REQ_MEMBER_SHIFT)
             arr[i].file_off = off
             arr[i].len = ln
             arr[i].dest_off = doff
@@ -139,6 +163,14 @@ class NativeEngine:
         if tid < 0:
             raise StromError(-tid, f"native submit failed ({-tid})")
         return tid
+
+    def member_stats(self, member: int) -> Tuple[int, int, int]:
+        """(completed requests, bytes, busy ns) for one stripe member."""
+        out = (ctypes.c_uint64 * 3)()
+        rc = self._lib.nstpu_engine_member_stats(self._h, member, out)
+        if rc < 0:
+            raise StromError(-rc, f"member_stats({member}) failed")
+        return out[0], out[1], out[2]
 
     def wait(self, task_id: int, timeout_ms: int = -1) -> None:
         rc = self._lib.nstpu_wait(self._h, task_id, timeout_ms)
@@ -176,6 +208,24 @@ class NativeEngine:
                     out[k] = v
                 else:
                     out[k] = v - prev.get(k, 0)
+            return out
+
+    def member_stats_delta(self, members: Sequence[int]) -> Dict[int, Tuple[int, int, int]]:
+        """Per-member (nreq, bytes, ns) deltas since the previous call,
+        for the given member indices.  Serialized like stats_delta.
+        Indices clamp to the engine's member table the same way submit()
+        clamps them, so callers may pass raw source indices."""
+        if not hasattr(self._lib, "nstpu_engine_member_stats"):
+            return {}  # older .so without per-member accounting
+        with self._stats_lock:
+            out: Dict[int, Tuple[int, int, int]] = {}
+            for m in sorted({min(max(m, 0), MAX_MEMBERS - 1)
+                             for m in members}):
+                cur = self.member_stats(m)
+                prev = self._prev_members.get(m, (0, 0, 0))
+                if cur != prev:
+                    out[m] = tuple(c - p for c, p in zip(cur, prev))
+                    self._prev_members[m] = cur
             return out
 
     def close(self) -> None:

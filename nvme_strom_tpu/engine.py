@@ -682,6 +682,7 @@ class Session:
                                         thread_name_prefix="strom-io")
         self._closed = False
         self._abandon_native = False
+        self._members_used: set = set()  # members seen by native submits
         # native engine: the GIL-free executor for planned request batches
         self._native = None
         want = io_backend or config.get("io_backend")
@@ -931,19 +932,30 @@ class Session:
             if use_native:
                 fds = source.member_fds()
                 native_reqs = []
+                native_members = []
                 for r in reqs:
                     if r.buffered or fds[r.member] < 0:
                         # misaligned tails: synchronous buffered copy, like
-                        # the reference's in-ioctl page-cache memcpy
+                        # the reference's in-ioctl page-cache memcpy —
+                        # accounted like the pool path so per-member stats
+                        # agree regardless of which branch executed
+                        tb = time.monotonic_ns()
                         source.read_member_buffered(
                             r.member, r.file_off,
                             dest[r.dest_off:r.dest_off + r.length])
+                        stats.member_add(r.member, r.length,
+                                         time.monotonic_ns() - tb)
+                        stats.count_clock("submit_dma", 0)
+                        stats.add("total_dma_length", r.length)
                     else:
                         native_reqs.append((fds[r.member], r.file_off,
                                             r.length, r.dest_off))
+                        native_members.append(r.member)
                 if native_reqs:
+                    self._members_used.update(native_members)
                     addr = ctypes.addressof(ctypes.c_char.from_buffer(dest))
-                    nid = self._native.submit(addr, native_reqs)
+                    nid = self._native.submit(addr, native_reqs,
+                                              members=native_members)
                     self._task_get(task)
                     try:
                         self._pool.submit(self._await_native, task, nid)
@@ -997,9 +1009,10 @@ class Session:
         chunk ``chunk_ids[i]``.  Planning reuses the read-side merge logic
         (same extents, same ≤dma_max requests, buffered legs for
         misaligned pieces); writes are always direct — there is no cache
-        to arbitrate against — and run on the thread pool (the native
-        engine's queues are read-only for now).  Durability of buffered
-        legs needs a ``sink.sync()`` after the wait."""
+        to arbitrate against.  Aligned legs run GIL-free on the native
+        engine (IORING_OP_WRITE) when available, mirroring the read path;
+        misaligned tails take a synchronous buffered write.  Durability of
+        buffered legs needs a ``sink.sync()`` after the wait."""
         t0 = time.monotonic_ns()
         if self._closed:
             raise StromError(_errno.EBADF, "session closed")
@@ -1016,18 +1029,56 @@ class Session:
             with stats.stage("setup_prps"):
                 reqs = plan_requests(sink, [(cid, i) for i, cid in enumerate(chunk_ids)],
                                      chunk_size, src_offset)
-            for r in reqs:
-                self._task_get(task)
-                cur = stats.gauge_add("cur_dma_count", 1)
-                stats.gauge_max("max_dma_count", cur)
-                stats.count_clock("submit_dma", 0)
-                stats.add("total_dma_length", r.length)
-                try:
-                    self._pool.submit(self._do_write_request, task, sink, r, src)
-                except BaseException as e:
-                    stats.gauge_add("cur_dma_count", -1)
-                    self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
-                    raise
+            # GIL-free write leg, mirroring the read path's native branch
+            # (fakes overriding the write leg keep the Python path so
+            # fault injection still works)
+            use_native = (self._native is not None and reqs
+                          and type(sink).write_member_direct
+                          is Source.write_member_direct)
+            if use_native:
+                fds = sink.member_fds()
+                native_reqs = []
+                native_members = []
+                for r in reqs:
+                    if r.buffered or fds[r.member] < 0:
+                        # misaligned tails: synchronous buffered write,
+                        # accounted like the pool path
+                        tb = time.monotonic_ns()
+                        sink.write_member_buffered(
+                            r.member, r.file_off,
+                            src[r.dest_off:r.dest_off + r.length])
+                        stats.member_add(r.member, r.length,
+                                         time.monotonic_ns() - tb)
+                        stats.count_clock("submit_dma", 0)
+                        stats.add("total_dma_length", r.length)
+                    else:
+                        native_reqs.append((fds[r.member], r.file_off,
+                                            r.length, r.dest_off))
+                        native_members.append(r.member)
+                if native_reqs:
+                    self._members_used.update(native_members)
+                    addr = ctypes.addressof(ctypes.c_char.from_buffer(src))
+                    nid = self._native.submit(addr, native_reqs, write=True,
+                                              members=native_members)
+                    self._task_get(task)
+                    try:
+                        self._pool.submit(self._await_native, task, nid)
+                    except BaseException as e:
+                        self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
+                        raise
+            else:
+                for r in reqs:
+                    self._task_get(task)
+                    cur = stats.gauge_add("cur_dma_count", 1)
+                    stats.gauge_max("max_dma_count", cur)
+                    stats.count_clock("submit_dma", 0)
+                    stats.add("total_dma_length", r.length)
+                    try:
+                        self._pool.submit(self._do_write_request, task, sink, r, src)
+                    except BaseException as e:
+                        stats.gauge_add("cur_dma_count", -1)
+                        self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
+                        raise
         except BaseException:
             self._task_put(task, StromError(_errno.ECANCELED, "submit aborted"))
             try:
@@ -1051,6 +1102,7 @@ class Session:
     def _do_write_request(self, task: DmaTask, sink: Source,
                           r: Request, src: memoryview) -> None:
         err: Optional[StromError] = None
+        t0 = time.monotonic_ns()
         try:
             piece = src[r.dest_off:r.dest_off + r.length]
             if r.buffered:
@@ -1064,12 +1116,14 @@ class Session:
         except BaseException as e:
             err = StromError(_errno.EIO, f"unexpected write failure: {e!r}")
         finally:
+            stats.member_add(r.member, r.length, time.monotonic_ns() - t0)
             stats.gauge_add("cur_dma_count", -1)
             self._task_put(task, err)
 
     def _do_request(self, task: DmaTask, source: Source,
                     r: Request, dest: memoryview) -> None:
         err: Optional[StromError] = None
+        t0 = time.monotonic_ns()
         try:
             if r.buffered:
                 source.read_member_buffered(r.member, r.file_off,
@@ -1084,6 +1138,7 @@ class Session:
         except BaseException as e:  # any failure must latch, never silently DONE
             err = StromError(_errno.EIO, f"{type(e).__name__}: {e}")
         finally:
+            stats.member_add(r.member, r.length, time.monotonic_ns() - t0)
             stats.gauge_add("cur_dma_count", -1)
             self._task_put(task, err)
 
@@ -1124,6 +1179,10 @@ class Session:
                 "nr_debug1": d.get("nr_resubmit", 0),
                 "nr_debug2": d.get("nr_sq_full", 0),
             })
+            # per-member deltas fold into the registry the same way
+            for m, (nreq, nbytes, ns) in self._native.member_stats_delta(
+                    sorted(self._members_used)).items():
+                stats.member_add(m, nbytes, ns, n=nreq)
             snap = stats.snapshot(debug=debug)
             # gauges combine at snapshot time (never merged into the registry)
             snap.counters["cur_dma_count"] += d.get("cur_dma_count", 0)

@@ -38,6 +38,12 @@ class StatRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._c = {name: 0 for name in STAT_FIELDS}
+        # per-stripe-member request/byte/latency accounting — the
+        # part_stat_add per-disk iostat analog incl. the md aggregate
+        # (kmod/nvme_strom.c:1101-1123): member index -> [nreq, bytes, ns].
+        # Indexed by position within the (striped) source; single-file
+        # sources are member 0.
+        self._members: dict = {}
 
     def enabled(self) -> bool:
         return bool(config.get("stat_info"))
@@ -70,6 +76,24 @@ class StatRegistry:
         with self._lock:
             self._c[name] += delta
             return self._c[name]
+
+    def member_add(self, member: int, nbytes: int, ns: int, n: int = 1) -> None:
+        """Account one request against a stripe member (part_stat_add
+        analog): a slow member in a 4-way set becomes visible in
+        ``tpu_stat -v`` instead of hiding inside the aggregate."""
+        if not self.enabled():
+            return
+        with self._lock:
+            m = self._members.setdefault(member, [0, 0, 0])
+            m[0] += n
+            m[1] += nbytes
+            m[2] += ns
+
+    def member_snapshot(self) -> dict:
+        """{member: {"nreq", "bytes", "clk_ns"}} snapshot."""
+        with self._lock:
+            return {k: {"nreq": v[0], "bytes": v[1], "clk_ns": v[2]}
+                    for k, v in sorted(self._members.items())}
 
     @contextmanager
     def stage(self, name: str):
@@ -143,7 +167,8 @@ class StatRegistry:
         path = path or DEFAULT_STAT_EXPORT
         snap = self.snapshot(debug=True, reset_max=False)
         payload = {"timestamp_ns": snap.timestamp_ns, "pid": os.getpid(),
-                   "version": snap.version, "counters": snap.counters}
+                   "version": snap.version, "counters": snap.counters,
+                   "members": self.member_snapshot()}
         try:
             # mkstemp: O_EXCL private temp (no symlink following in shared
             # /tmp), then atomic replace

@@ -97,6 +97,14 @@ def main(argv=None) -> int:
         width = max(len(k) for k in c)
         for k in sorted(c):
             print(f"  {k:<{width}} {c[k]}")
+        if args.verbose and snap.get("members"):
+            # per-stripe-member breakdown (part_stat_add analog): a slow
+            # member shows as an outlier avg-lat at similar req/byte counts
+            print("per-member:")
+            print("  member   reqs        bytes   avg-lat")
+            for m, v in sorted(snap["members"].items(), key=lambda kv: int(kv[0])):
+                print(f"  {int(m):>6} {v['nreq']:>6} {v['bytes']:>12} "
+                      f"  {show_avg(v['clk_ns'], v['nreq'])}")
         return 0
 
     prev = snap["counters"]

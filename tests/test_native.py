@@ -139,6 +139,86 @@ def test_native_stats_counters(tmp_data_file):
 
 
 # ---------------------------------------------------------------------------
+# write direction (IORING_OP_WRITE / pwrite; beyond the read-only reference)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["io_uring", "threadpool"])
+def test_native_write_correct(tmp_path, backend):
+    try:
+        eng = NativeEngine(backend, 16)
+    except StromError:
+        pytest.skip(f"{backend} unavailable")
+    path = str(tmp_path / "w.bin")
+    with open(path, "wb") as f:
+        f.write(b"\0" * (1 << 20))
+    fd = os.open(path, os.O_RDWR | os.O_DIRECT)
+    buf = mmap.mmap(-1, 1 << 20)
+    try:
+        pattern = bytes(random.Random(7).randbytes(1 << 20))
+        buf[:] = pattern
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        # 4 writes, shuffled: file block i comes from buffer slot (i+1)%4
+        reqs = [(fd, i * (256 << 10), 256 << 10, ((i + 1) % 4) * (256 << 10))
+                for i in range(4)]
+        tid = eng.submit(addr, reqs, write=True)
+        eng.wait(tid, 10000)
+        s = eng.stats()
+        assert s["nr_write_dma"] == 4
+        assert s["total_write_length"] == 1 << 20
+        with open(path, "rb") as f:
+            got = f.read()
+        for i in range(4):
+            src = ((i + 1) % 4) * (256 << 10)
+            assert got[i * (256 << 10):(i + 1) * (256 << 10)] == \
+                pattern[src:src + (256 << 10)], f"block {i}"
+    finally:
+        os.close(fd)
+        eng.close()
+        buf.close()
+
+
+def test_native_write_error_latched(tmp_path):
+    eng = NativeEngine("auto", 8)
+    path = str(tmp_path / "ro.bin")
+    with open(path, "wb") as f:
+        f.write(b"\0" * 8192)
+    fd = os.open(path, os.O_RDONLY)  # write on a read-only fd must fail
+    buf = mmap.mmap(-1, 8192)
+    try:
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        tid = eng.submit(addr, [(fd, 0, 8192, 0)], write=True)
+        with pytest.raises(StromError) as ei:
+            eng.wait(tid, 10000)
+        assert ei.value.errno in (errno.EBADF, errno.EINVAL, errno.EPERM)
+    finally:
+        os.close(fd)
+        eng.close()
+        buf.close()
+
+
+def test_session_ram2ssd_uses_native_write_queue(tmp_path):
+    """The write leg must ride the native engine (GIL-free), not the
+    Python thread pool: native write counters move after memcpy_ram2ssd."""
+    from nvme_strom_tpu.engine import open_source
+
+    path = str(tmp_path / "w.bin")
+    with open(path, "wb") as f:
+        f.write(b"\0" * (4 << 20))
+    with open_source(path, writable=True) as sink, Session() as sess:
+        if sess._native is None:
+            pytest.skip("native engine not active in session")
+        before = sess._native.stats()
+        handle, buf = sess.alloc_dma_buffer(4 << 20)
+        buf.view()[:] = bytes(random.Random(11).randbytes(4 << 20))
+        res = sess.memcpy_ram2ssd(sink, handle, [2, 0, 3, 1], 1 << 20)
+        sess.memcpy_wait(res.dma_task_id)
+        after = sess._native.stats()
+        assert after["nr_write_dma"] > before["nr_write_dma"]
+        assert after["total_write_length"] - before["total_write_length"] \
+            == 4 << 20
+
+
+# ---------------------------------------------------------------------------
 # differential: native session vs python session
 # ---------------------------------------------------------------------------
 
