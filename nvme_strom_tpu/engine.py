@@ -184,6 +184,43 @@ class Source:
         here with mincore(2))."""
         return 0.0
 
+    # -- hot-data signal (the PageDirty analog) ----------------------------
+    # The reference scores a dirty page at threshold+1 — ONE dirty page
+    # tips the whole chunk to write-back (kmod/nvme_strom.c:1639-1645),
+    # because a dirty page makes the on-disk block stale and a direct read
+    # would either return stale data or stall on a forced flush.  Userspace
+    # cannot see PageDirty directly, so the signal is rebuilt from two
+    # sides: an explicit hint API for writers that know their hot ranges,
+    # plus (where /proc/kpageflags is readable) a best-effort probe.
+
+    def hint_hot_range(self, offset: int, length: int) -> None:
+        """Declare [offset, offset+length) hot (being written / recently
+        written): chunks overlapping it take the write-back path instead
+        of forcing a flush stall on the direct path."""
+        if length <= 0:
+            return
+        hints = getattr(self, "_hot_hints", None)
+        if hints is None:
+            hints = self._hot_hints = []
+        hints.append((offset, offset + length))
+
+    def clear_hot_hints(self) -> None:
+        self._hot_hints = []
+
+    def hot_fraction(self, offset: int, length: int) -> float:
+        """Fraction of the range covered by hot hints (subclasses may add
+        measured dirtiness).  Any value > 0 routes the chunk write-back,
+        mirroring the reference's one-dirty-page rule."""
+        hints = getattr(self, "_hot_hints", None)
+        if not hints or length <= 0:
+            return 0.0
+        covered = 0
+        for h0, h1 in hints:
+            lo, hi = max(offset, h0), min(offset + length, h1)
+            if hi > lo:
+                covered += hi - lo  # hints may overlap; fraction is advisory
+        return min(covered / length, 1.0)
+
     def read_buffered(self, offset: int, dest: memoryview) -> None:
         """Page-cache copy path (reference memcpy_pgcache_to_ubuffer,
         kmod/nvme_strom.c:1344-1401)."""
@@ -282,10 +319,11 @@ class _FileMember:
             self._mm_addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
         return self._mm
 
-    def cached_fraction(self, offset: int, length: int) -> float:
+    def _mincore_vec(self, offset: int, length: int):
+        """(residency bytevec, start, npages) for the page-aligned range."""
         mm = self.mm()
         if mm is None or length <= 0:
-            return 0.0
+            return None, 0, 0
         start = offset & ~(PAGE_SIZE - 1)
         end = min((offset + length + PAGE_SIZE - 1) & ~(PAGE_SIZE - 1), self.size)
         npages = max((end - start + PAGE_SIZE - 1) // PAGE_SIZE, 1)
@@ -293,9 +331,65 @@ class _FileMember:
         rc = _libc.mincore(ctypes.c_void_p(self._mm_addr + start),
                            ctypes.c_size_t(end - start), vec)
         if rc != 0:
+            return None, 0, 0
+        return vec, start, npages
+
+    def cached_fraction(self, offset: int, length: int) -> float:
+        vec, _start, npages = self._mincore_vec(offset, length)
+        if vec is None:
             return 0.0
         resident = sum(1 for b in vec if b & 1)
         return resident / npages
+
+    def dirty_fraction(self, offset: int, length: int) -> float:
+        """Best-effort PageDirty probe (kmod/nvme_strom.c:1643 analog)
+        via /proc/self/pagemap -> /proc/kpageflags (KPF_DIRTY).
+
+        Only pages mincore reports resident are touched (mapping an
+        already-resident page into our tables does not perturb the cache);
+        unreadable proc files degrade to 0.0 — the hint API is then the
+        only dirty signal."""
+        vec, start, npages = self._mincore_vec(offset, length)
+        if vec is None:
+            return 0.0
+        resident = [i for i in range(npages) if vec[i] & 1]
+        if not resident:
+            return 0.0
+        try:
+            pm = os.open("/proc/self/pagemap", os.O_RDONLY)
+        except OSError:
+            return 0.0
+        try:
+            try:
+                kf = os.open("/proc/kpageflags", os.O_RDONLY)
+            except OSError:
+                return 0.0
+            try:
+                dirty = 0
+                for i in resident:
+                    va = self._mm_addr + start + i * PAGE_SIZE
+                    # fault the (resident) page into our tables so pagemap
+                    # shows its PFN; a read fault never dirties it
+                    ctypes.c_ubyte.from_address(va).value
+                    ent = os.pread(pm, 8, (va // PAGE_SIZE) * 8)
+                    if len(ent) != 8:
+                        continue
+                    word = int.from_bytes(ent, "little")
+                    if not word >> 63:  # not present
+                        continue
+                    pfn = word & ((1 << 55) - 1)
+                    if pfn == 0:
+                        continue
+                    flags_b = os.pread(kf, 8, pfn * 8)
+                    if len(flags_b) != 8:
+                        continue
+                    if (int.from_bytes(flags_b, "little") >> 4) & 1:  # KPF_DIRTY
+                        dirty += 1
+                return dirty / npages
+            finally:
+                os.close(kf)
+        finally:
+            os.close(pm)
 
     def close(self) -> None:
         if self._mm is not None:
@@ -337,6 +431,13 @@ class PlainSource(Source):
 
     def cached_fraction(self, offset: int, length: int) -> float:
         return self._m.cached_fraction(offset, length)
+
+    def hot_fraction(self, offset: int, length: int) -> float:
+        # explicit hints plus measured page dirtiness, whichever is louder
+        hinted = super().hot_fraction(offset, length)
+        if hinted >= 1.0:
+            return hinted
+        return max(hinted, self._m.dirty_fraction(offset, length))
 
     def read_buffered(self, offset: int, dest: memoryview) -> None:
         n = os.preadv(self._m.fd_buffered, [dest], offset)
@@ -903,7 +1004,13 @@ class Session:
                 length = min(chunk_size, source.size - base)
                 if length <= 0:
                     raise StromError(_errno.EINVAL, f"chunk {cid} beyond EOF")
-                if arbitrate and source.cached_fraction(base, length) > threshold:
+                # hot/dirty data is decisive, not weighted: the reference
+                # scores one dirty page at threshold+1 (:1643), because a
+                # direct read of a dirty range either stalls on a forced
+                # flush or reads stale blocks
+                if arbitrate and (source.hot_fraction(base, length) > 0.0
+                                  or source.cached_fraction(base, length)
+                                  > threshold):
                     wb_ids.append(cid)
                 else:
                     direct_ids.append(cid)

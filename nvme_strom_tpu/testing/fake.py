@@ -95,3 +95,13 @@ class FakeNvmeSource(PlainSource):
         if self.force_cached_fraction is not None:
             return self.force_cached_fraction
         return super().cached_fraction(offset, length)
+
+    def hot_fraction(self, offset: int, length: int) -> float:
+        # with a forced cache verdict the test owns arbitration: only
+        # explicit hints count, not the ambient dirtiness of a freshly
+        # written test file (which would route everything write-back and
+        # bypass the direct path the fault plan instruments)
+        if self.force_cached_fraction is not None:
+            from ..engine import Source
+            return Source.hot_fraction(self, offset, length)
+        return super().hot_fraction(offset, length)

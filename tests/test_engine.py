@@ -156,6 +156,68 @@ def test_cache_arbitration_writeback(tmp_data_file):
         src.close()
 
 
+def test_hot_hint_forces_writeback(tmp_data_file):
+    """One hot page is decisive (reference scores PageDirty at
+    threshold+1, kmod/nvme_strom.c:1643): a chunk overlapping a hot hint
+    takes the write-back path even when nothing is page-cached."""
+    src = FakeNvmeSource(tmp_data_file, force_cached_fraction=0.0)
+    try:
+        # hint one page inside chunk 2 only
+        src.hint_hot_range(2 * CHUNK + 4096, 4096)
+        res, data = _run_copy(src, [0, 1, 2, 3])
+        assert res.nr_ram2dev == 1 and res.nr_ssd2dev == 3
+        # write-back chunks ride the tail of chunk_ids (reference contract)
+        assert res.chunk_ids[-1] == 2
+        for slot, cid in enumerate(res.chunk_ids):
+            assert data[slot * CHUNK:(slot + 1) * CHUNK] == \
+                expected_bytes(cid * CHUNK, CHUNK)
+        # clearing the hints restores the direct path
+        src.clear_hot_hints()
+        res2, _ = _run_copy(src, [0, 1, 2, 3])
+        assert res2.nr_ram2dev == 0 and res2.nr_ssd2dev == 4
+    finally:
+        src.close()
+
+
+def test_hot_fraction_overlap_math(tmp_data_file):
+    # force_cached_fraction pins arbitration to hints-only (no ambient
+    # dirtiness of the freshly written test file)
+    src = FakeNvmeSource(tmp_data_file, force_cached_fraction=0.0)
+    try:
+        assert src.hot_fraction(0, CHUNK) == 0.0
+        src.hint_hot_range(0, CHUNK // 2)
+        assert src.hot_fraction(0, CHUNK) == pytest.approx(0.5)
+        assert src.hot_fraction(CHUNK, CHUNK) == 0.0
+        src.hint_hot_range(CHUNK // 2, CHUNK // 2)
+        assert src.hot_fraction(0, CHUNK) == pytest.approx(1.0)
+        src.clear_hot_hints()
+        assert src.hot_fraction(0, CHUNK) == 0.0
+    finally:
+        src.close()
+
+
+@pytest.mark.skipif(not os.access("/proc/kpageflags", os.R_OK),
+                    reason="kpageflags not readable here")
+def test_dirty_pages_detected_via_kpageflags(tmp_path):
+    """Freshly buffered-written (un-fsynced) pages read back as dirty
+    through pagemap->kpageflags, feeding hot_fraction without any hint."""
+    from nvme_strom_tpu.engine import PlainSource
+    path = str(tmp_path / "d.bin")
+    with open(path, "wb") as f:
+        f.write(b"\0" * (1 << 20))
+        f.flush()
+        os.fsync(f.fileno())
+    with PlainSource(path) as src:
+        clean = src.hot_fraction(0, 1 << 20)
+        # dirty the first 64KB with a buffered write, no fsync
+        fd = os.open(path, os.O_WRONLY)
+        os.pwrite(fd, b"x" * (64 << 10), 0)
+        os.close(fd)
+        dirty = src.hot_fraction(0, 1 << 20)
+    assert dirty > clean, (clean, dirty)
+    assert dirty > 0.0
+
+
 def test_cache_arbitration_off(tmp_data_file):
     config.set("cache_arbitration", False)
     src = FakeNvmeSource(tmp_data_file, force_cached_fraction=1.0)
