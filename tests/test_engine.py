@@ -500,6 +500,10 @@ def test_any_exception_latches_task(tmp_data_file):
             raise ValueError("boom")
         def cached_fraction(self, offset, length):
             return 0.0
+        def hot_fraction(self, offset, length):
+            # pin to 0 so the freshly written (still dirty) test file
+            # cannot route the chunk write-back around the direct leg
+            return 0.0
     with BoomSource(tmp_data_file) as src, Session() as sess:
         handle, _ = sess.alloc_dma_buffer(CHUNK)
         res = sess.memcpy_ssd2ram(src, handle, [0], CHUNK)
