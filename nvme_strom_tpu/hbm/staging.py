@@ -84,8 +84,10 @@ def safe_device_put(host: np.ndarray, devlike) -> jax.Array:
 
 def default_device(index: int = 0) -> jax.Device:
     """Prefer an accelerator, like the reference preferring Tesla/Quadro
-    (`utils/ssd2gpu_test.c:632-656`); fall back to CPU."""
-    devs = jax.devices()
+    (`utils/ssd2gpu_test.c:632-656`); fall back to CPU.  Only this
+    process's own (addressable) devices qualify — under ``jax.distributed``
+    a remote default would make every unsharded landing span hosts."""
+    devs = jax.local_devices()
     accel = [d for d in devs if d.platform != "cpu"]
     pool = accel or devs
     return pool[index if index < len(pool) else 0]
