@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -41,7 +42,10 @@ def _should_interpret() -> bool:
 
 
 def _decode_block(w, schema: HeapSchema):
-    """(bp, 2048) int32 page words -> ([(bp, T) col ...], valid mask)."""
+    """(bp, 2048) int32 page words -> ([(bp, T) typed col ...], valid mask).
+
+    Typed columns are a same-width bitcast of their word range — the page
+    layout is dtype-independent (scan/heap.py HeapSchema docstring)."""
     bp = w.shape[0]
     t = schema.tuples_per_page
     n_tup = w[:, 2:3]                                   # header word 2
@@ -50,40 +54,64 @@ def _decode_block(w, schema: HeapSchema):
     cols = []
     for c in range(schema.n_cols):
         s, e = schema.col_word_range(c)
-        cols.append(w[:, s:e])
+        col = w[:, s:e]
+        dt = schema.col_dtype(c)
+        if dt != jnp.int32:
+            col = jax.lax.bitcast_convert_type(col, jnp.dtype(dt))
+        cols.append(col)
     if schema.visibility:
         s, e = schema.col_word_range(schema.n_cols)
         valid = valid & (w[:, s:e] != 0)
     return cols, valid
 
 
-def _check_int_schema(schema: HeapSchema) -> None:
-    if schema.dtypes is not None and any(
-            schema.col_dtype(c).kind != "i" for c in range(schema.n_cols)):
-        raise ValueError("the pallas kernel aggregates int32 schemas only "
-                         "(SMEM int accumulators); use the XLA path "
-                         "(ops.filter_xla) for typed columns")
+def _sum_slots(schema: HeapSchema):
+    """Per-column accumulator routing: integer-kind columns share the int32
+    SMEM bank (uint32 wraps bit-identically mod 2^32 — restored by a final
+    bitcast), float32 columns the f32 bank.  Returns (kinds, slots) where
+    ``kinds[c]`` is 'i' or 'f' and ``slots[c]`` the index in that bank."""
+    kinds, slots = [], []
+    ni = nf = 0
+    for c in range(schema.n_cols):
+        if schema.col_dtype(c).kind == "f":
+            kinds.append("f")
+            slots.append(nf)
+            nf += 1
+        else:
+            kinds.append("i")
+            slots.append(ni)
+            ni += 1
+    return kinds, slots, ni, nf
 
 
 def _make_kernel(schema: HeapSchema, predicate):
     n_cols = schema.n_cols
-    _check_int_schema(schema)
+    kinds, slots, ni, nf = _sum_slots(schema)
 
-    def kernel(thresh_ref, w_ref, count_ref, sums_ref):
+    def kernel(thresh_ref, w_ref, count_ref, isums_ref, fsums_ref):
         i = pl.program_id(0)
 
         @pl.when(i == 0)
         def _init():
             count_ref[0, 0] = 0
-            for c in range(n_cols):   # SMEM takes scalar stores only
-                sums_ref[0, c] = 0
+            for s in range(max(ni, 1)):   # SMEM takes scalar stores only
+                isums_ref[0, s] = 0
+            for s in range(max(nf, 1)):
+                fsums_ref[0, s] = 0.0
 
         w = w_ref[...]
         cols, valid = _decode_block(w, schema)
         sel = valid & predicate(cols, thresh_ref[0])
         count_ref[0, 0] += jnp.sum(sel.astype(jnp.int32))
         for c in range(n_cols):
-            sums_ref[0, c] += jnp.sum(jnp.where(sel, cols[c], 0))
+            col = cols[c]
+            if kinds[c] == "f":
+                fsums_ref[0, slots[c]] += jnp.sum(
+                    jnp.where(sel, col, jnp.float32(0)))
+            else:
+                if col.dtype != jnp.int32:  # uint32: accumulate the bits
+                    col = jax.lax.bitcast_convert_type(col, jnp.int32)
+                isums_ref[0, slots[c]] += jnp.sum(jnp.where(sel, col, 0))
 
     return kernel
 
@@ -100,12 +128,16 @@ def _pad_pages(pages_u8: jax.Array) -> jax.Array:
 
 def _run_filter(pages_u8, threshold, schema: HeapSchema, predicate,
                 interpret: Optional[bool]):
+    """Returns ``(count, [per-column sum ...])`` with each sum carrying its
+    column's dtype (uint32 sums are the int32 accumulator bit-restored —
+    identical to uint32 arithmetic mod 2^32)."""
     pages_u8 = _pad_pages(pages_u8)
     b = pages_u8.shape[0]
     words = jax.lax.bitcast_convert_type(
         pages_u8.reshape(b, _WORDS, 4), jnp.int32).reshape(b, _WORDS)
-    thresh = jnp.asarray(threshold, jnp.int32).reshape(1)
-    count, sums = pl.pallas_call(
+    thresh = jnp.asarray(threshold).reshape(1)
+    kinds, slots, ni, nf = _sum_slots(schema)
+    count, isums, fsums = pl.pallas_call(
         _make_kernel(schema, predicate),
         grid=(b // _BLOCK_PAGES,),
         in_specs=[
@@ -115,14 +147,26 @@ def _run_filter(pages_u8, threshold, schema: HeapSchema, predicate,
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
-            jax.ShapeDtypeStruct((1, schema.n_cols), jnp.int32),
+            jax.ShapeDtypeStruct((1, max(ni, 1)), jnp.int32),
+            jax.ShapeDtypeStruct((1, max(nf, 1)), jnp.float32),
         ],
         interpret=_should_interpret() if interpret is None else interpret,
     )(thresh, words)
-    return count[0, 0], sums[0]
+    sums = []
+    for c in range(schema.n_cols):
+        if kinds[c] == "f":
+            sums.append(fsums[0, slots[c]])
+        else:
+            s = isums[0, slots[c]]
+            dt = schema.col_dtype(c)
+            if dt != np.dtype(np.int32):
+                s = jax.lax.bitcast_convert_type(s, jnp.dtype(dt))
+            sums.append(s)
+    return count[0, 0], sums
 
 
 @partial(jax.jit, static_argnames=("interpret",))
@@ -140,17 +184,18 @@ def scan_filter_step_pallas(pages_u8: jax.Array, threshold: jax.Array,
 
 def make_filter_fn_pallas(schema: HeapSchema, predicate, *,
                           interpret: Optional[bool] = None):
-    """Pallas twin of :func:`..ops.filter_xla.make_filter_fn`.
+    """Pallas twin of :func:`..ops.filter_xla.make_filter_fn`, including
+    typed (float32/uint32) schemas — column decode is an in-register
+    bitcast, float sums ride a separate f32 accumulator bank.
 
     ``predicate(cols, threshold) -> bool (B, T)`` must be built from jnp ops
     (it is traced inside the kernel).  Returns a jitted
     ``run(pages_u8, threshold) -> {"count", "sums"}``."""
-    _check_int_schema(schema)
 
     @jax.jit
     def run(pages_u8, threshold=jnp.int32(0)):
         count, sums = _run_filter(pages_u8, threshold, schema, predicate,
                                   interpret)
-        return {"count": count, "sums": [sums[c] for c in range(schema.n_cols)]}
+        return {"count": count, "sums": sums}
 
     return run

@@ -120,22 +120,38 @@ def _launch_in(num_processes: int, devices_per_proc: int, workdir: str,
             env=env, cwd=_REPO_ROOT, stdout=lf, stderr=subprocess.STDOUT),
             lf))
 
+    # poll ALL workers: a worker that dies mid-run (e.g. a failed assert
+    # before a collective) leaves its peers blocked in the collective — a
+    # sequential pid-order wait would burn the whole timeout on the hung
+    # peer and blame ITS (clean) log.  First nonzero exit wins and the
+    # rest are killed.
     deadline = time.monotonic() + timeout
+    first_bad: Optional[int] = None
     try:
-        for pid, (p, _lf) in enumerate(procs):
-            left = deadline - time.monotonic()
-            try:
-                p.wait(timeout=max(left, 1.0))
-            except subprocess.TimeoutExpired:
+        while True:
+            running = [pid for pid, (p, _lf) in enumerate(procs)
+                       if p.poll() is None]
+            for pid, (p, _lf) in enumerate(procs):
+                if p.poll() is not None and p.returncode != 0:
+                    first_bad = pid
+            if first_bad is not None or not running:
+                break
+            if time.monotonic() > deadline:
                 raise RuntimeError(
-                    f"distributed worker {pid} timed out after {timeout}s; "
-                    f"log: {_tail(logs[pid])}")
+                    f"distributed workers {running} timed out after "
+                    f"{timeout}s; log: {_tail(logs[running[0]])}")
+            time.sleep(0.1)
     finally:
         for p, lf in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
             lf.close()
+    if first_bad is not None:
+        raise RuntimeError(
+            f"distributed worker {first_bad} exited "
+            f"rc={procs[first_bad][0].returncode}; "
+            f"log: {_tail(logs[first_bad])}")
 
     results = []
     for pid, (p, _lf) in enumerate(procs):
@@ -188,6 +204,12 @@ def _worker_main(process_id: int, num_processes: int, devices_per_proc: int,
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=num_processes, process_id=process_id)
+
+    # test hook: die between init and the first collective, so launch()'s
+    # failure attribution (blame the dead worker, kill its blocked peer)
+    # is exercisable
+    if os.environ.get("STROM_TEST_DIE_AFTER_INIT") and process_id == 1:
+        sys.exit(41)
 
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -33,8 +33,22 @@ def test_multi_process_distributed(tmp_path, nproc, dpp):
 
 
 def test_launch_surfaces_worker_failure(tmp_path):
-    """A worker that dies must fail launch() with its log tail, not hang."""
-    # corrupt the heap fixture after prepare by pointing workers at a
-    # workdir missing the checkpoint: simplest is an impossible geometry
+    """A worker that crashes pre-init (impossible geometry: 0 devices per
+    process) must fail launch() promptly with that worker's log, not hang
+    until the timeout."""
     with pytest.raises(RuntimeError):
         launch(2, 0, str(tmp_path), timeout=60.0)
+
+
+def test_launch_attributes_midrun_death_not_hung_peer(tmp_path, monkeypatch):
+    """A worker dying mid-run (its peer blocked in a collective) must be
+    the one blamed — promptly — not the peer that times out (the peer is
+    killed).  Exercised by making process 1 abort between init and the
+    first collective via a poison env var."""
+    import time as _time
+    monkeypatch.setenv("STROM_TEST_DIE_AFTER_INIT", "1")
+    t0 = _time.monotonic()
+    with pytest.raises(RuntimeError) as ei:
+        launch(2, 2, str(tmp_path), timeout=300.0)
+    assert "worker 1" in str(ei.value), str(ei.value)
+    assert _time.monotonic() - t0 < 200.0  # no full-timeout burn

@@ -455,13 +455,19 @@ def test_typed_float_columns_roundtrip_and_filter(tmp_path):
     with pytest.raises(ValueError):
         build_pages([i, i], schema)  # col0 dtype mismatch
 
-    # pallas + groupby refuse float schemas explicitly
+    # the pallas filter accepts typed schemas too (full differential
+    # coverage lives in tests/test_pallas.py); groupby — both paths —
+    # refuses float *aggregation* columns explicitly
     from nvme_strom_tpu.ops.filter_pallas import make_filter_fn_pallas
     from nvme_strom_tpu.ops.groupby import make_groupby_fn
-    with pytest.raises(ValueError):
-        make_filter_fn_pallas(schema, lambda cols, th: cols[1] > th)
+    from nvme_strom_tpu.ops.groupby_pallas import make_groupby_fn_pallas
+    pfn = make_filter_fn_pallas(schema, lambda cols, th: cols[0] > th)
+    pout = pfn(pages, np.float32(0.5))
+    assert int(pout["count"]) == int(sel.sum())
     with pytest.raises(ValueError):
         make_groupby_fn(schema, lambda cols: cols[1], 4, agg_cols=[0])
+    with pytest.raises(ValueError):
+        make_groupby_fn_pallas(schema, lambda cols: cols[1], 4, agg_cols=[0])
 
 
 def test_topk_matches_numpy_and_folds_across_batches(tmp_path):
