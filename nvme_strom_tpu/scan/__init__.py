@@ -1,5 +1,6 @@
 from .heap import (HEAP_MAGIC, PAGE_SIZE, HeapSchema, build_heap_file,
                    pages_from_bytes)
+from .query import Query, QueryPlan
 
-__all__ = ["HEAP_MAGIC", "PAGE_SIZE", "HeapSchema", "build_heap_file",
-           "pages_from_bytes"]
+__all__ = ["HEAP_MAGIC", "PAGE_SIZE", "HeapSchema", "Query", "QueryPlan",
+           "build_heap_file", "pages_from_bytes"]

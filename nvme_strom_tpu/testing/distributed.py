@@ -132,7 +132,8 @@ def _launch_in(num_processes: int, devices_per_proc: int, workdir: str,
             running = [pid for pid, (p, _lf) in enumerate(procs)
                        if p.poll() is None]
             for pid, (p, _lf) in enumerate(procs):
-                if p.poll() is not None and p.returncode != 0:
+                if p.poll() is not None and p.returncode != 0 \
+                        and first_bad is None:
                     first_bad = pid
             if first_bad is not None or not running:
                 break
@@ -207,9 +208,11 @@ def _worker_main(process_id: int, num_processes: int, devices_per_proc: int,
 
     # test hook: die between init and the first collective, so launch()'s
     # failure attribution (blame the dead worker, kill its blocked peer)
-    # is exercisable
+    # is exercisable.  os._exit, not sys.exit: a crash must not run jax's
+    # atexit distributed-shutdown barrier, which would block THIS process
+    # on its (soon to be hung) peer and invert the failure order
     if os.environ.get("STROM_TEST_DIE_AFTER_INIT") and process_id == 1:
-        sys.exit(41)
+        os._exit(41)
 
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P

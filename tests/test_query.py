@@ -1,0 +1,224 @@
+"""Declarative query layer: planning transparency + end-to-end results
+(the pgsql CustomScan / EXPLAIN analog, pgsql/nvme_strom.c:1642-1667)."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu import config
+from nvme_strom_tpu.api import StromError
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+from nvme_strom_tpu.scan.query import Query
+
+
+@pytest.fixture()
+def heap(tmp_path):
+    rng = np.random.default_rng(5)
+    schema = HeapSchema(n_cols=2, visibility=True)
+    n = schema.tuples_per_page * 24
+    c0 = rng.integers(-1000, 1000, n).astype(np.int32)
+    c1 = rng.integers(0, 16, n).astype(np.int32)
+    vis = (rng.random(n) > 0.2).astype(np.int32)
+    path = str(tmp_path / "t.heap")
+    build_heap_file(path, [c0, c1], schema, visibility=vis)
+    return path, schema, c0, c1, vis
+
+
+def test_explain_shows_the_plan(heap):
+    path, schema, *_ = heap
+    config.set("debug_no_threshold", True)
+    plan = Query(path, schema).where(lambda cols: cols[0] > 0).explain()
+    assert plan.operator == "aggregate"
+    assert plan.access_path == "direct"
+    assert plan.kernel in ("pallas", "xla")
+    assert plan.mode == "local"
+    assert plan.n_pages == 24
+    assert plan.cost_direct < plan.cost_vfs  # the reduced seq_page_cost
+    assert "direct-scan threshold" in plan.reason or "eligible" in plan.reason
+    s = str(plan)
+    assert "aggregate scan" in s and "direct path" in s
+
+
+def test_small_table_plans_vfs(heap):
+    path, schema, *_ = heap
+    config.set("debug_no_threshold", False)
+    plan = Query(path, schema).explain()
+    assert plan.access_path == "vfs"  # 192KB table is far below threshold
+
+
+def test_aggregate_both_paths_match_oracle(heap):
+    path, schema, c0, c1, vis = heap
+    sel = (vis != 0) & (c0 > 100)
+    for debug_thresh in (True, False):   # direct vs vfs access path
+        config.set("debug_no_threshold", debug_thresh)
+        q = Query(path, schema).where(lambda cols: cols[0] > 100)
+        assert q.explain().access_path == ("direct" if debug_thresh else "vfs")
+        out = q.run()
+        assert int(out["count"]) == int(sel.sum())
+        assert int(out["sums"][0]) == int(c0[sel].sum())
+        assert int(out["sums"][1]) == int(c1[sel].sum())
+
+
+def test_aggregate_kernel_override_pallas(heap):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = (vis != 0) & (c0 > 0)
+    out = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .run(kernel="pallas")   # interpret-mode pallas on CPU
+    assert int(out["count"]) == int(sel.sum())
+
+
+def test_aggregate_projection(heap):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = (vis != 0) & (c0 > 0)
+    out = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .aggregate(cols=[1]).run()
+    assert len(out["sums"]) == 1
+    assert int(out["sums"][0]) == int(c1[sel].sum())
+
+
+def test_group_by_matches_oracle(heap):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    G = 16
+    q = (Query(path, schema)
+         .where(lambda cols: cols[0] > 0)
+         .group_by(lambda cols: cols[1], G, agg_cols=[0]))
+    plan = q.explain()
+    assert plan.operator == "group_by"
+    out = q.run()
+    sel = (vis != 0) & (c0 > 0)
+    for g in range(G):
+        m = sel & (c1 == g)
+        assert out["count"][g] == int(m.sum())
+        assert out["sums"][0][g] == int(c0[m].sum())
+
+
+def test_group_by_large_g_plans_xla(heap):
+    path, schema, *_ = heap
+    plan = Query(path, schema).group_by(lambda cols: cols[1], 512).explain()
+    assert plan.kernel == "xla"
+    assert "unroll bound" in plan.reason
+
+
+def test_top_k_matches_oracle(heap):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    k = 8
+    out = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .top_k(0, k).run()
+    sel = (vis != 0) & (c0 > 0)
+    want = np.sort(c0[sel])[::-1][:k]
+    np.testing.assert_array_equal(np.sort(out["values"])[::-1], want)
+
+
+def test_join_matches_oracle(heap):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    keys = np.arange(0, 8, dtype=np.int32)          # join on c1 in [0, 8)
+    vals = (keys * 10).astype(np.int32)
+    out = Query(path, schema).join(1, keys, vals).run()
+    sel = (vis != 0) & (c1 < 8)
+    assert int(out["matched"]) == int(sel.sum())
+
+
+def test_one_terminal_operator_only(heap):
+    path, schema, *_ = heap
+    q = Query(path, schema).group_by(lambda cols: cols[1], 8)
+    with pytest.raises(StromError):
+        q.top_k(0, 4)
+    q2 = Query(path, schema).aggregate(cols=[0])
+    with pytest.raises(StromError):
+        q2.group_by(lambda cols: cols[1], 8)
+
+
+def test_mesh_mode_matches_local(heap):
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    mesh = make_scan_mesh(jax.devices())
+    q = Query(path, schema).where(lambda cols: cols[0] > 0)
+    plan = q.explain(mesh=mesh)
+    assert plan.mode == "mesh" and plan.kernel == "xla"
+    out_mesh = q.run(mesh=mesh, batch_pages=8)
+    out_local = q.run()
+    assert int(out_mesh["count"]) == int(out_local["count"])
+    assert int(out_mesh["sums"][0]) == int(out_local["sums"][0])
+
+
+def test_one_terminal_even_default_aggregate(heap):
+    path, schema, *_ = heap
+    q = Query(path, schema).aggregate()   # default projection
+    with pytest.raises(StromError):
+        q.group_by(lambda cols: cols[1], 8)
+
+
+def test_mesh_group_by_multibatch_mins_correct(heap):
+    """Mesh mode must use the operator's combiner: per-group mins across
+    batches are the MIN of batch mins, not their sum (review finding)."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    mesh = make_scan_mesh(jax.devices())
+    G = 8
+    q = Query(path, schema).group_by(lambda cols: cols[1] % G, G,
+                                     agg_cols=[0])
+    out = q.run(mesh=mesh, batch_pages=8)   # 24 pages -> 3 batches
+    sel = vis != 0
+    for g in range(G):
+        m = sel & (c1 % G == g)
+        assert out["count"][g] == int(m.sum())
+        assert out["sums"][0][g] == int(c0[m].sum())
+        if m.any():
+            assert out["mins"][0][g] == int(c0[m].min())
+            assert out["maxs"][0][g] == int(c0[m].max())
+
+
+def test_mesh_small_table_and_tail_covered(heap):
+    """Default mesh batch sizing must not return {} on a small table, and
+    a non-divisible page count must still cover every page."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    mesh = make_scan_mesh(jax.devices())
+    q = Query(path, schema)
+    # default batch_pages (128*shards) far exceeds the 24-page table
+    out = q.run(mesh=mesh)
+    assert int(out["count"]) == int((vis != 0).sum())
+    # batch_pages=16 leaves an 8-page tail that must still be scanned
+    out2 = Query(path, schema).run(mesh=mesh, batch_pages=16)
+    assert int(out2["count"]) == int((vis != 0).sum())
+
+
+def test_vfs_scan_multifile_stripe(tmp_path):
+    """The buffered fallback reads through the Source abstraction, so a
+    2-file stripe set scans completely (review finding)."""
+    rng = np.random.default_rng(31)
+    schema = HeapSchema(n_cols=1, visibility=False)
+    n = schema.tuples_per_page * 16
+    c0 = rng.integers(-100, 100, n).astype(np.int32)
+    whole = str(tmp_path / "w.heap")
+    build_heap_file(whole, [c0], schema)
+    raw = open(whole, "rb").read()
+    half = len(raw) // 2
+    pa, pb = str(tmp_path / "a.heap"), str(tmp_path / "b.heap")
+    open(pa, "wb").write(raw[:half])
+    open(pb, "wb").write(raw[half:])
+
+    config.set("debug_no_threshold", False)   # force the vfs path
+    from nvme_strom_tpu.engine import open_source
+    src = open_source([pa, pb], segment_size=half)
+    try:
+        q = Query(src, schema).where(lambda cols: cols[0] > 0)
+        assert q.explain().access_path == "vfs"
+        out = q.run()
+    finally:
+        src.close()
+    assert int(out["count"]) == int((c0 > 0).sum())
+    assert int(out["sums"][0]) == int(c0[c0 > 0].sum())
