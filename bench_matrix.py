@@ -144,7 +144,10 @@ import jax, jax.numpy as jnp
 from jax import lax
 from nvme_strom_tpu.scan.heap import HeapSchema, build_pages, PAGE_SIZE
 schema = HeapSchema(n_cols=2, visibility=True)
-batch_bytes = min(size, 128 << 20)
+# 32MB: the largest batch where this host's relay produces timings that
+# scale with work at all (larger batches return in near-constant time
+# regardless of loop length — untimeable through the tunnel)
+batch_bytes = min(size, 32 << 20)
 n_pages = batch_bytes // PAGE_SIZE
 rng = np.random.default_rng(0)
 n = schema.tuples_per_page * n_pages
@@ -154,10 +157,13 @@ if {use_pallas}:
     from nvme_strom_tpu.ops.filter_pallas import scan_filter_step_pallas as fn
 else:
     from nvme_strom_tpu.ops.filter_xla import scan_filter_step as fn
+# Each iteration filters a different page window (sliding dynamic_slice):
+# with an invariant input XLA hoists the whole decode out of the loop.
+# ITERS iterations run inside ONE dispatch (fori_loop) and the best of 3
+# dispatches is kept.  NB on this tunneled host absolute GB/s here is not
+# trustworthy (the relay's completion signaling inflates it); the
+# pallas-vs-XLA RATIO under identical conditions is the metric of record.
 ITERS = 16
-# each iteration filters a different page window (sliding dynamic_slice):
-# with an invariant input XLA hoists the whole decode out of the loop and
-# the "GB/s" would exceed HBM bandwidth
 pad = np.zeros((ITERS, PAGE_SIZE), np.uint8)
 big = np.concatenate([pages, pad], 0)
 @jax.jit
@@ -170,9 +176,12 @@ def loop(bp):
 dp = jax.device_put(big)
 jax.block_until_ready(dp)
 jax.block_until_ready(loop(dp))  # compile + warm
-t0 = time.monotonic()
-jax.block_until_ready(loop(dp))
-dt = time.monotonic() - t0
+dt = None
+for _ in range(3):
+    t0 = time.monotonic()
+    jax.block_until_ready(loop(dp))
+    d = time.monotonic() - t0
+    dt = d if dt is None else min(dt, d)
 print(f"GBPS={{n_pages * PAGE_SIZE * ITERS / dt / (1<<30):.3f}}")
 """
 

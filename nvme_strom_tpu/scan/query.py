@@ -72,9 +72,15 @@ class Query:
     >>> out = q.run()
     """
 
-    def __init__(self, source, schema: HeapSchema):
+    def __init__(self, source, schema: HeapSchema, *,
+                 stripe_chunk_size: int = 512 << 10):
+        if isinstance(source, os.PathLike):
+            source = str(source)
+        elif isinstance(source, (list, tuple)):
+            source = [str(p) for p in source]
         self.source = source
         self.schema = schema
+        self._stripe_chunk = stripe_chunk_size
         self._pred: Optional[Callable] = None
         self._op = "aggregate"
         self._terminal_set = False
@@ -131,16 +137,27 @@ class Query:
 
     # -- planning -----------------------------------------------------------
     def _source_facts(self):
-        if isinstance(self.source, (str, os.PathLike)):
-            path = str(self.source)
+        if isinstance(self.source, str):
+            path = self.source
             size = os.path.getsize(path)
         elif isinstance(self.source, (list, tuple)):
-            path = str(self.source[0])
+            path = self.source[0]
             size = sum(os.path.getsize(p) for p in self.source)
         else:  # live Source object
             path = getattr(self.source, "path", None)
             size = self.source.size
         return path, size
+
+    def _open_owned(self):
+        """(live Source, owned?) — multi-file sets open as RAID-0 stripes
+        with the query's stripe geometry."""
+        from ..engine import open_source
+        if hasattr(self.source, "size"):
+            return self.source, False
+        if isinstance(self.source, (list, tuple)):
+            return open_source(self.source,
+                               stripe_chunk_size=self._stripe_chunk), True
+        return open_source(self.source), True
 
     def _kernel_choice(self, mode: str):
         import jax
@@ -262,19 +279,20 @@ class Query:
         if mesh is not None:
             import jax
 
-            from ..engine import open_source
             from ..parallel.stream import distributed_scan_filter
             from .executor import fold_results
             n_shards = mesh.shape["dp"]
-            own = not hasattr(self.source, "size")
-            src = open_source(self.source) if own else self.source
+            src, own = self._open_owned()
             try:
                 n_pages = src.size // PAGE_SIZE
                 bp = batch_pages or max(
                     n_shards, (1 << 20) // PAGE_SIZE * n_shards)
-                # a table smaller than the default batch still scans:
-                # shrink to the largest shard-divisible batch that fits
-                bp = min(bp, n_pages // n_shards * n_shards)
+                # round DOWN to a shard multiple (user-supplied values
+                # included) and shrink to the largest batch that fits, so a
+                # small table or an odd batch_pages still scans; the
+                # remainder rides the tail path below
+                bp = min(bp // n_shards * n_shards,
+                         n_pages // n_shards * n_shards)
                 acc = None
                 covered = 0
                 if bp >= n_shards:
@@ -304,9 +322,15 @@ class Query:
                     src.close()
         if plan.access_path == "direct":
             from .executor import TableScanner
-            with TableScanner(self.source, self.schema,
-                              session=session) as sc:
-                return sc.scan_filter(fn, device=device, combine=combine)
+            src, own = self._open_owned()
+            try:
+                with TableScanner(src, self.schema,
+                                  session=session) as sc:
+                    return sc.scan_filter(fn, device=device,
+                                          combine=combine)
+            finally:
+                if own:
+                    src.close()
         return self._vfs_scan(fn, combine, device)
 
     def _vfs_scan(self, fn, combine, device) -> dict:
@@ -316,11 +340,9 @@ class Query:
         objects scan identically to the direct path."""
         import jax
 
-        from ..engine import open_source
         from .executor import fold_results
         dev = device or jax.local_devices()[0]
-        own = not hasattr(self.source, "size")
-        src = open_source(self.source) if own else self.source
+        src, own = self._open_owned()
         try:
             n_pages = src.size // PAGE_SIZE
             batch = max((8 << 20) // PAGE_SIZE, 1)

@@ -1,0 +1,148 @@
+"""strom_query — run a declarative scan query from the command line.
+
+The CLI face of :mod:`..scan.query` — the way psql is the CLI face of the
+reference's transparent CustomScan (`pgsql/nvme_strom.c:1642-1667`): the
+user states WHAT (filter/aggregate/group/top-k), the planner decides HOW
+(direct vs VFS path, pallas vs XLA kernel) and ``--explain`` shows the
+decision without running it.
+
+Usage:
+  strom_query FILE --cols 3 [--dtypes int32,float32,int32] [--visibility]
+              [--where "c0 > 10"] [--group-by "c1 % 8" --groups 8]
+              [--top-k COL:K[:smallest]] [--agg-cols 0,1]
+              [--explain] [--kernel auto|pallas|xla] [--mesh]
+
+Predicates/keys are restricted jnp expressions over columns c0..cN (and
+abs/min/max), evaluated with eval() on a whitelisted namespace — this is
+an operator convenience tool, not an SQL security boundary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from ..scan.heap import HeapSchema
+
+__all__ = ["main", "cli"]
+
+
+def _expr_fn(expr: str, n_cols: int):
+    """Compile "c0 > 10" style expressions to fn(cols) on a whitelisted
+    namespace (no builtins)."""
+    import jax.numpy as jnp
+    code = compile(expr, "<strom_query>", "eval")
+    for name in code.co_names:
+        if not (name.startswith("c") and name[1:].isdigit()) and \
+                name not in ("abs", "minimum", "maximum", "where", "jnp"):
+            raise SystemExit(f"error: name {name!r} not allowed in "
+                             f"expressions (use c0..c{n_cols - 1}, abs, "
+                             f"minimum, maximum, where)")
+
+    def fn(cols):
+        ns = {f"c{i}": cols[i] for i in range(len(cols))}
+        ns.update(abs=jnp.abs, minimum=jnp.minimum, maximum=jnp.maximum,
+                  where=jnp.where, jnp=jnp)
+        return eval(code, {"__builtins__": {}}, ns)
+
+    return fn
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="strom_query", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("file", nargs="+", help="heap file(s); several = stripe set")
+    ap.add_argument("--stripe-chunk", default="512k",
+                    help="stripe chunk size for multi-file sets (default 512k)")
+    ap.add_argument("--cols", type=int, required=True,
+                    help="number of data columns in the schema")
+    ap.add_argument("--dtypes", default=None,
+                    help="comma-separated per-column dtypes (int32/uint32/"
+                         "float32; default all int32)")
+    ap.add_argument("--visibility", action="store_true",
+                    help="schema carries a per-tuple visibility column")
+    ap.add_argument("--where", default=None, metavar="EXPR",
+                    help='row filter, e.g. "c0 > 10"')
+    ap.add_argument("--group-by", default=None, metavar="EXPR",
+                    help='int32 group key, e.g. "c1 % 8"')
+    ap.add_argument("--groups", type=int, default=None,
+                    help="number of groups (required with --group-by)")
+    ap.add_argument("--agg-cols", default=None,
+                    help="comma-separated column indices to aggregate")
+    ap.add_argument("--top-k", default=None, metavar="COL:K[:smallest]",
+                    help="top-k of a column instead of aggregation")
+    ap.add_argument("--kernel", choices=("auto", "pallas", "xla"),
+                    default="auto")
+    ap.add_argument("--mesh", action="store_true",
+                    help="stream sharded over all devices (dp axis)")
+    ap.add_argument("--explain", action="store_true",
+                    help="print the plan and exit without scanning")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    dtypes = tuple(args.dtypes.split(",")) if args.dtypes else None
+    schema = HeapSchema(n_cols=args.cols, visibility=args.visibility,
+                        dtypes=dtypes)
+    agg_cols = [int(c) for c in args.agg_cols.split(",")] \
+        if args.agg_cols else None
+
+    from .common import apply_platform_env
+    apply_platform_env()
+    from ..scan.query import Query
+    from .common import parse_size
+    src = args.file[0] if len(args.file) == 1 else list(args.file)
+    q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
+    if args.where:
+        q = q.where(_expr_fn(args.where, args.cols))
+    if args.group_by:
+        if not args.groups:
+            ap.error("--group-by requires --groups")
+        q = q.group_by(_expr_fn(args.group_by, args.cols), args.groups,
+                       agg_cols=agg_cols)
+    elif args.top_k:
+        parts = args.top_k.split(":")
+        largest = not (len(parts) > 2 and parts[2] == "smallest")
+        q = q.top_k(int(parts[0]), int(parts[1]), largest=largest)
+    elif agg_cols is not None:
+        q = q.aggregate(cols=agg_cols)
+
+    mesh = None
+    if args.mesh:
+        import jax
+
+        from ..parallel.mesh import make_scan_mesh
+        mesh = make_scan_mesh(jax.devices())
+
+    plan = q.explain(mesh=mesh)
+    if args.explain:
+        if args.as_json:
+            import dataclasses
+            print(json.dumps(dataclasses.asdict(plan)))
+        else:
+            print(plan)
+        return 0
+
+    out = q.run(mesh=mesh, kernel=args.kernel)
+    if args.as_json:
+        print(json.dumps({k: np.asarray(v).tolist() for k, v in out.items()}))
+        return 0
+    print(plan)
+    for k, v in out.items():
+        a = np.asarray(v)
+        if a.ndim == 0:
+            print(f"{k}: {a}")
+        else:
+            print(f"{k}: {np.array2string(a, threshold=32)}")
+    return 0
+
+
+def cli() -> None:
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    cli()

@@ -222,3 +222,47 @@ def test_vfs_scan_multifile_stripe(tmp_path):
         src.close()
     assert int(out["count"]) == int((c0 > 0).sum())
     assert int(out["sums"][0]) == int(c0[c0 > 0].sum())
+
+
+def test_query_multifile_and_pathlike(tmp_path):
+    """Stripe-set lists and PathLike sources work on every execution path
+    (review finding: they planned fine but crashed run())."""
+    import pathlib
+
+    rng = np.random.default_rng(41)
+    schema = HeapSchema(n_cols=1, visibility=False)
+    n = schema.tuples_per_page * 16
+    c0 = rng.integers(-100, 100, n).astype(np.int32)
+    whole = tmp_path / "w.heap"
+    build_heap_file(str(whole), [c0], schema)
+    raw = whole.read_bytes()
+    half = len(raw) // 2
+    pa, pb = tmp_path / "a.heap", tmp_path / "b.heap"
+    pa.write_bytes(raw[:half])
+    pb.write_bytes(raw[half:])
+    want_count, want_sum = int((c0 > 0).sum()), int(c0[c0 > 0].sum())
+
+    for debug in (True, False):   # direct and vfs paths
+        config.set("debug_no_threshold", debug)
+        out = Query([pa, pb], schema, stripe_chunk_size=half) \
+            .where(lambda cols: cols[0] > 0).run()
+        assert int(out["count"]) == want_count
+        assert int(out["sums"][0]) == want_sum
+
+    config.set("debug_no_threshold", True)
+    out = Query(pathlib.Path(str(whole)), schema) \
+        .where(lambda cols: cols[0] > 0).run()
+    assert int(out["count"]) == want_count
+
+
+def test_mesh_odd_batch_pages_rounded(heap):
+    """A user batch_pages not divisible by the dp axis is rounded down,
+    not rejected (review finding)."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    mesh = make_scan_mesh(jax.devices())
+    out = Query(path, schema).run(mesh=mesh, batch_pages=7)
+    assert int(out["count"]) == int((vis != 0).sum())

@@ -95,3 +95,62 @@ def test_tpu_stat_oneshot(data_file, tmp_path):
 def test_tpu_stat_missing_file(tmp_path):
     out = _run("nvme_strom_tpu.tools.tpu_stat", "-f", str(tmp_path / "nope"))
     assert out.returncode == 1
+
+
+def test_strom_query_cli_explain_and_run(tmp_path):
+    """strom_query: --explain shows the plan; a run returns oracle-correct
+    JSON (the psql-side face of the transparent scan)."""
+    import json
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    rng = np.random.default_rng(3)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 8
+    c0 = rng.integers(-100, 100, n).astype(np.int32)
+    c1 = rng.integers(0, 8, n).astype(np.int32)
+    path = str(tmp_path / "q.heap")
+    build_heap_file(path, [c0, c1], schema)
+
+    base = [sys.executable, "-m", "nvme_strom_tpu.tools.strom_query", path,
+            "--cols", "2", "--where", "c0 > 0"]
+    out = subprocess.run(base + ["--explain"], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "aggregate scan" in out.stdout
+
+    out = subprocess.run(base + ["--json"], capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    sel = c0 > 0
+    assert res["count"] == int(sel.sum())
+    assert res["sums"][0] == int(c0[sel].sum())
+
+    out = subprocess.run(
+        base + ["--group-by", "c1", "--groups", "8", "--agg-cols", "0",
+                "--json"], capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["count"][3] == int((sel & (c1 == 3)).sum())
+
+
+def test_strom_query_rejects_evil_expression(tmp_path):
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=1, visibility=False)
+    path = str(tmp_path / "q.heap")
+    build_heap_file(path, [np.zeros(10, np.int32)], schema)
+    out = subprocess.run(
+        [sys.executable, "-m", "nvme_strom_tpu.tools.strom_query", path,
+         "--cols", "1", "--where", "__import__('os').system('true')"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode != 0
+    assert "not allowed" in out.stderr
