@@ -60,14 +60,19 @@ _SSD2RAM = _COMMON + """
 from nvme_strom_tpu import open_source, Session
 path = {path!r}
 make_test_file(path, size) if not (os.path.exists(path) and os.path.getsize(path) == size) else None
-drop_page_cache(path)
-with open_source(path) as src, Session() as s:
-    h, buf = s.alloc_dma_buffer(size)
-    t0 = time.monotonic()
-    res = s.memcpy_ssd2ram(src, h, list(range(size >> 20)), 1 << 20)
-    s.memcpy_wait(res.dma_task_id)
-    dt = time.monotonic() - t0
-print(f"GBPS={{size/dt/(1<<30):.3f}}")
+# best-of-3: this shared host's disk throughput swings ~2x run to run,
+# and a single cold sample under-reports the engine by that factor
+best = 0.0
+for _ in range(3):
+    drop_page_cache(path)
+    with open_source(path) as src, Session() as s:
+        h, buf = s.alloc_dma_buffer(size)
+        t0 = time.monotonic()
+        res = s.memcpy_ssd2ram(src, h, list(range(size >> 20)), 1 << 20)
+        s.memcpy_wait(res.dma_task_id)
+        best = max(best, size / (time.monotonic() - t0))
+        s.unmap_buffer(h); buf.close()
+print(f"GBPS={{best/(1<<30):.3f}}")
 """
 
 _SSD2TPU = _COMMON + """
@@ -93,15 +98,20 @@ for i in range(4):
         make_test_file(p, per, seed=i)
     drop_page_cache(p)
     members.append(p)
-src = StripedSource(members, stripe_chunk_size=512 << 10)
-with Session() as s:
-    h, buf = s.alloc_dma_buffer(size)
-    t0 = time.monotonic()
-    res = s.memcpy_ssd2ram(src, h, list(range(size >> 20)), 1 << 20)
-    s.memcpy_wait(res.dma_task_id)
-    dt = time.monotonic() - t0
-src.close()
-print(f"GBPS={{size/dt/(1<<30):.3f}}")
+best = 0.0
+for _ in range(3):   # best-of-3 (shared-host disk noise)
+    for p in members:
+        drop_page_cache(p)
+    src = StripedSource(members, stripe_chunk_size=512 << 10)
+    with Session() as s:
+        h, buf = s.alloc_dma_buffer(size)
+        t0 = time.monotonic()
+        res = s.memcpy_ssd2ram(src, h, list(range(size >> 20)), 1 << 20)
+        s.memcpy_wait(res.dma_task_id)
+        best = max(best, size / (time.monotonic() - t0))
+        s.unmap_buffer(h); buf.close()
+    src.close()
+print(f"GBPS={{best/(1<<30):.3f}}")
 """
 
 _SCAN = _COMMON + """
@@ -191,22 +201,57 @@ _RAW = _COMMON + """
 path = {path!r}
 make_test_file(path, size) if not (os.path.exists(path) and os.path.getsize(path) == size) else None
 drop_page_cache(path)
-try:
-    fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
-except OSError:  # tmpfs etc. reject O_DIRECT; measure buffered-cold instead
-    fd = os.open(path, os.O_RDONLY)
 import mmap
 blk = 4 << 20
 buf = mmap.mmap(-1, blk)
-t0 = time.monotonic()
-off = 0
-while off < size:
-    n = os.preadv(fd, [buf], off)
-    assert n > 0
-    off += n
-dt = time.monotonic() - t0
-os.close(fd)
-print(f"GBPS={{size/dt/(1<<30):.3f}}")
+best = 0.0
+for _ in range(3):   # best-of-3, same policy as the engine rows
+    drop_page_cache(path)
+    try:
+        fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
+    except OSError:  # tmpfs etc. reject O_DIRECT; measure buffered-cold
+        fd = os.open(path, os.O_RDONLY)
+    t0 = time.monotonic()
+    off = 0
+    while off < size:
+        n = os.preadv(fd, [buf], off)
+        assert n > 0
+        off += n
+    best = max(best, size / (time.monotonic() - t0))
+    os.close(fd)
+print(f"GBPS={{best/(1<<30):.3f}}")
+"""
+
+_RAW_WRITE = _COMMON + """
+# raw write denominator: sequential O_DIRECT pwrite, no framework — the
+# number ram2ssd_seq is a percentage of (a read denominator would be
+# wrong-in-kind for the write leg)
+import mmap
+path = {path!r} + ".rawwr"
+blk = 4 << 20
+buf = mmap.mmap(-1, blk)
+buf[:] = os.urandom(blk)
+best = 0.0
+try:
+    for _ in range(3):
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_DIRECT, 0o644)
+        except OSError:  # tmpfs etc. reject O_DIRECT; buffered+fsync instead
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT, 0o644)
+        os.ftruncate(fd, size)
+        t0 = time.monotonic()
+        off = 0
+        while off < size:
+            n = os.pwritev(fd, [buf], off)
+            assert n > 0
+            off += n
+        os.fsync(fd)
+        best = max(best, size / (time.monotonic() - t0))
+        os.close(fd)
+finally:
+    if os.path.exists(path):
+        os.unlink(path)
+print(f"GBPS={{best/(1<<30):.3f}}")
 """
 
 _RAM2SSD = _COMMON + """
@@ -215,17 +260,20 @@ from nvme_strom_tpu.engine import open_source
 path = {path!r} + ".wr"
 with open(path, "wb") as f:
     f.truncate(size)
-with open_source(path, writable=True) as sink, Session() as s:
-    h, buf = s.alloc_dma_buffer(size)
-    buf.view()[:] = np.random.default_rng(3).integers(
-        0, 255, size, dtype=np.uint8).tobytes()
-    t0 = time.monotonic()
-    res = s.memcpy_ram2ssd(sink, h, list(range(size >> 20)), 1 << 20)
-    s.memcpy_wait(res.dma_task_id)
-    sink.sync()
-    dt = time.monotonic() - t0
+payload = np.random.default_rng(3).integers(0, 255, size, dtype=np.uint8).tobytes()
+best = 0.0
+for _ in range(3):   # best-of-3 (shared-host disk noise)
+    with open_source(path, writable=True) as sink, Session() as s:
+        h, buf = s.alloc_dma_buffer(size)
+        buf.view()[:] = payload
+        t0 = time.monotonic()
+        res = s.memcpy_ram2ssd(sink, h, list(range(size >> 20)), 1 << 20)
+        s.memcpy_wait(res.dma_task_id)
+        sink.sync()
+        best = max(best, size / (time.monotonic() - t0))
+        s.unmap_buffer(h); buf.close()
 os.unlink(path)
-print(f"GBPS={{size/dt/(1<<30):.3f}}")
+print(f"GBPS={{best/(1<<30):.3f}}")
 """
 
 _H2D = _COMMON + """
@@ -288,6 +336,8 @@ def main() -> int:
          _H2D.format(size=size), None),
         ("ssd2ram_seq", "SSD->pinned RAM, O_DIRECT seq",
          _SSD2RAM.format(size=size, path=base + ".bin"), None),
+        ("raw_seq_write", "raw O_DIRECT pwrite (write denominator)",
+         _RAW_WRITE.format(size=size, path=base), None),
         ("ram2ssd_seq", "pinned RAM->SSD write (native write queue)",
          _RAM2SSD.format(size=size, path=base), None),
         # seq vs mq32 isolates async depth: the engine queue is capped at 4
@@ -323,9 +373,14 @@ def main() -> int:
     h2d = results.get("h2d_peak", 0.0)
     # *_chip rows are on-chip compute, not storage rows — a chip/raw-SSD
     # ratio would be meaningless in the ">=90% of raw" checkable block
+    raww = results.get("raw_seq_write", 0.0)
     pct_of_raw = {k: round(v / raw, 3) for k, v in results.items()
-                  if raw and k != "raw_seq_read"
+                  if raw and k not in ("raw_seq_read", "raw_seq_write",
+                                       "ram2ssd_seq")
                   and not k.endswith("_chip")}
+    if raww and "ram2ssd_seq" in results:
+        # the write leg's denominator is the raw WRITE bandwidth
+        pct_of_raw["ram2ssd_seq"] = round(results["ram2ssd_seq"] / raww, 3)
     ceiling = min(raw, h2d) if raw and h2d else 0.0
     overlap_efficiency = {
         k: round(results[k] / ceiling, 3)

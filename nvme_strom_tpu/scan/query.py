@@ -218,16 +218,20 @@ class Query:
         """Returns (fn(pages)->dict, combine or None)."""
         pred = self._pred
         if self._op == "aggregate":
+            import jax.numpy as jnp
+
+            # no predicate = every valid row.  NOT cols[0]==cols[0]: that
+            # is False for float NaN and would silently drop NaN rows
+            all_rows = lambda cols: jnp.ones(cols[0].shape, bool)
             if kernel == "pallas":
                 from ..ops.filter_pallas import make_filter_fn_pallas
                 p = (lambda cols, th: pred(cols)) if pred is not None \
-                    else (lambda cols, th: cols[0] == cols[0])
+                    else (lambda cols, th: all_rows(cols))
                 run = make_filter_fn_pallas(self.schema, p)
                 fn = lambda pages: run(pages, np.int32(0))
             else:
                 from ..ops.filter_xla import make_filter_fn
-                p = pred if pred is not None else \
-                    (lambda cols: cols[0] == cols[0])
+                p = pred if pred is not None else all_rows
                 fn = make_filter_fn(self.schema, p)
             if self._agg_cols is not None:
                 keep = list(self._agg_cols)
@@ -287,12 +291,12 @@ class Query:
                 n_pages = src.size // PAGE_SIZE
                 bp = batch_pages or max(
                     n_shards, (1 << 20) // PAGE_SIZE * n_shards)
-                # round DOWN to a shard multiple (user-supplied values
-                # included) and shrink to the largest batch that fits, so a
-                # small table or an odd batch_pages still scans; the
-                # remainder rides the tail path below
-                bp = min(bp // n_shards * n_shards,
-                         n_pages // n_shards * n_shards)
+                # round to a shard multiple (user-supplied values included,
+                # never below one page per shard) and shrink to the largest
+                # batch that fits, so a small table or an odd batch_pages
+                # still scans; the remainder rides the tail path below
+                bp = max(bp // n_shards * n_shards, n_shards)
+                bp = min(bp, n_pages // n_shards * n_shards)
                 acc = None
                 covered = 0
                 if bp >= n_shards:
@@ -305,11 +309,15 @@ class Query:
                     covered = (n_pages // bp) * bp
                 # the stream drops any partial final batch (it cannot fill
                 # every shard evenly); scan the tail on a local device so
-                # mesh results cover every page, like the local path does
-                if covered < n_pages:
-                    dev = jax.local_devices()[0]
-                    raw = bytearray((n_pages - covered) * PAGE_SIZE)
-                    src.read_buffered(covered * PAGE_SIZE, memoryview(raw))
+                # mesh results cover every page, like the local path does.
+                # Batched reads: a table smaller than batch_pages arrives
+                # whole on this path and must not become one giant alloc
+                dev = jax.local_devices()[0]
+                tail_batch = max((8 << 20) // PAGE_SIZE, 1)
+                for p0 in range(covered, n_pages, tail_batch):
+                    npg = min(tail_batch, n_pages - p0)
+                    raw = bytearray(npg * PAGE_SIZE)
+                    src.read_buffered(p0 * PAGE_SIZE, memoryview(raw))
                     pages = np.frombuffer(raw, np.uint8).reshape(
                         -1, PAGE_SIZE)
                     acc = fold_results(acc, fn(jax.device_put(pages, dev)),

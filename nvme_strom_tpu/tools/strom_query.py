@@ -36,8 +36,11 @@ def _expr_fn(expr: str, n_cols: int):
     import jax.numpy as jnp
     code = compile(expr, "<strom_query>", "eval")
     for name in code.co_names:
-        if not (name.startswith("c") and name[1:].isdigit()) and \
-                name not in ("abs", "minimum", "maximum", "where", "jnp"):
+        if name.startswith("c") and name[1:].isdigit():
+            if int(name[1:]) >= n_cols:
+                raise SystemExit(f"error: {name} out of range — this "
+                                 f"schema has columns c0..c{n_cols - 1}")
+        elif name not in ("abs", "minimum", "maximum", "where", "jnp"):
             raise SystemExit(f"error: name {name!r} not allowed in "
                              f"expressions (use c0..c{n_cols - 1}, abs, "
                              f"minimum, maximum, where)")
@@ -95,6 +98,11 @@ def main(argv=None) -> int:
     from ..scan.query import Query
     from .common import parse_size
     src = args.file[0] if len(args.file) == 1 else list(args.file)
+    if args.group_by and args.top_k:
+        ap.error("--group-by and --top-k are exclusive "
+                 "(one terminal operator per query)")
+    if args.top_k and agg_cols is not None:
+        ap.error("--agg-cols has no effect with --top-k")
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
@@ -127,6 +135,12 @@ def main(argv=None) -> int:
         return 0
 
     out = q.run(mesh=mesh, kernel=args.kernel)
+    if args.kernel != "auto" and args.kernel != plan.kernel:
+        # the printed plan must reflect what actually ran
+        import dataclasses
+        plan = dataclasses.replace(
+            plan, kernel=args.kernel,
+            reason=plan.reason + f" [overridden: --kernel {args.kernel}]")
     if args.as_json:
         print(json.dumps({k: np.asarray(v).tolist() for k, v in out.items()}))
         return 0

@@ -266,3 +266,20 @@ def test_mesh_odd_batch_pages_rounded(heap):
     mesh = make_scan_mesh(jax.devices())
     out = Query(path, schema).run(mesh=mesh, batch_pages=7)
     assert int(out["count"]) == int((vis != 0).sum())
+
+
+def test_no_predicate_counts_nan_rows(tmp_path):
+    """With no WHERE, every valid row counts — including float NaN rows
+    (a cols[0]==cols[0] default mask would drop them)."""
+    from nvme_strom_tpu.scan.heap import build_pages  # noqa: F401
+    schema = HeapSchema(n_cols=1, visibility=False, dtypes=("float32",))
+    n = schema.tuples_per_page * 2
+    vals = np.linspace(0, 1, n).astype(np.float32)
+    vals[::7] = np.nan
+    path = str(tmp_path / "nan.heap")
+    build_heap_file(path, [vals], schema)
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).run(kernel="xla")
+    assert int(out["count"]) == n
+    out_p = Query(path, schema).run(kernel="pallas")
+    assert int(out_p["count"]) == n

@@ -154,3 +154,26 @@ def test_strom_query_rejects_evil_expression(tmp_path):
         capture_output=True, text=True, timeout=300)
     assert out.returncode != 0
     assert "not allowed" in out.stderr
+
+
+def test_strom_query_cli_conflicting_terminals_and_bad_column(tmp_path):
+    """Conflicting terminal flags error out; out-of-range columns get the
+    clean diagnostic, not a NameError from inside tracing."""
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    path = str(tmp_path / "q.heap")
+    build_heap_file(path, [np.zeros(10, np.int32)] * 2, schema)
+    base = [sys.executable, "-m", "nvme_strom_tpu.tools.strom_query", path,
+            "--cols", "2"]
+    out = subprocess.run(base + ["--group-by", "c1", "--groups", "4",
+                                 "--top-k", "0:4"],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "exclusive" in out.stderr
+    out = subprocess.run(base + ["--where", "c9 > 0"],
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode != 0 and "out of range" in out.stderr
