@@ -399,7 +399,8 @@ def main() -> int:
                            "after a burst); TPU-destination rows are bounded "
                            "by it, CPU-destination rows (ssd2ram/raid0) show "
                            "the engine's own throughput. pct_of_raw anchors "
-                           "each row to raw_seq_read; overlap_efficiency = "
+                           "read rows to raw_seq_read and ram2ssd_seq to "
+                           "raw_seq_write (like-for-like); overlap_efficiency = "
                            "achieved / min(raw ssd, h2d ceiling) isolates "
                            "pipeline overlap quality from transport limits. "
                            "filter_*_chip rows run identical single-dispatch "

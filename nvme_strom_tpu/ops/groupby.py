@@ -43,6 +43,30 @@ _I32_MIN = np.int32(-(1 << 31))
 _I32_MAX = np.int32((1 << 31) - 1)
 
 
+def _check_agg_cols(schema: HeapSchema, agg_cols):
+    """Validate + resolve aggregation columns: one shared dtype, int32 or
+    float32.  Returns (indices, dtype)."""
+    cols_idx = list(agg_cols) if agg_cols is not None else \
+        list(range(schema.n_cols))
+    if not cols_idx:
+        raise ValueError("groupby needs at least one aggregation column")
+    for ci in cols_idx:
+        if not 0 <= ci < schema.n_cols:
+            raise ValueError(f"aggregation column {ci} out of range — "
+                             f"this schema has columns 0..{schema.n_cols - 1}")
+    dts = {schema.col_dtype(ci) for ci in cols_idx}
+    if len(dts) > 1:
+        raise ValueError(f"groupby aggregation columns must share one "
+                         f"dtype, got {sorted(str(d) for d in dts)}; "
+                         f"split into one groupby per dtype")
+    dt = dts.pop()
+    if dt not in (np.dtype(np.int32), np.dtype(np.float32)):
+        raise ValueError(f"groupby aggregates int32 or float32 columns "
+                         f"(got {dt}); bitcast uint32 data to int32 or "
+                         f"filter it via make_filter_fn")
+    return cols_idx, dt
+
+
 def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
                     agg_cols: Optional[Sequence[int]] = None,
                     predicate: Optional[Callable] = None):
@@ -53,16 +77,17 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
     optional row filter.  ``agg_cols`` — column indices to aggregate
     (default: all).  Returns per group: ``count (G,)``, and ``sums / mins /
     maxs`` of shape ``(len(agg_cols), G)``; empty groups report 0 count,
-    0 sum, int32 max/min sentinels.
+    0 sum, and the dtype's worst-value sentinels for min/max.
+
+    Aggregation columns must share one dtype — int32 or float32 (uniform
+    ``(V, G)`` result arrays; the reference's per-tuple walk had the same
+    one-type-at-a-time shape).  uint32/mixed sets raise.
     """
-    cols_idx = list(agg_cols) if agg_cols is not None else \
-        list(range(schema.n_cols))
-    for ci in cols_idx:
-        if schema.col_dtype(ci) != np.dtype(np.int32):
-            raise ValueError(f"groupby aggregates int32 columns only "
-                             f"(col {ci} is {schema.col_dtype(ci)}); "
-                             f"filter float columns via make_filter_fn")
+    cols_idx, agg_dt = _check_agg_cols(schema, agg_cols)
     G = int(n_groups)
+    is_f = agg_dt.kind == "f"
+    lo = np.float32(-np.inf) if is_f else _I32_MIN
+    hi = np.float32(np.inf) if is_f else _I32_MAX
 
     @jax.jit
     def run(pages_u8, *params):
@@ -73,25 +98,37 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
             sel = sel & predicate(cols, *params)
         keys = jnp.where(sel, keys, G)  # overflow bucket, sliced off below
         flat_keys = keys.reshape(-1)
-        onehot = jax.nn.one_hot(flat_keys, G + 1, dtype=jnp.int32)[:, :G]
+        onehot_t = jnp.float32 if is_f else jnp.int32
+        onehot = jax.nn.one_hot(flat_keys, G + 1, dtype=onehot_t)[:, :G]
         vals = jnp.stack([c.reshape(-1) for c in (cols[i] for i in cols_idx)],
                          axis=-1)                       # (N, V)
-        count = jnp.sum(onehot, axis=0)                 # (G,)
-        # the MXU path: integer contraction (N,G)x(N,V)->(G,V).  Exact per
-        # batch within int32; under x64 the accumulator (and the cross-batch
-        # fold) widens to int64, matching scan_filter_step's convention —
-        # without x64, sums past 2^31 wrap (as any int32 engine would)
-        acc_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
-        sums = jax.lax.dot_general(
-            onehot, vals, (((0,), (0,)), ((), ())),
-            preferred_element_type=acc_t).T             # (V, G)
+        count = jnp.sum(onehot.astype(jnp.int32), axis=0)  # (G,)
         flat_sel = sel.reshape(-1)
+        if is_f:
+            # per-group scatter sum, NOT the matmul: 0*NaN = NaN, so one
+            # selected NaN row would poison EVERY group's sum through the
+            # contraction — segment_sum confines it to its own group,
+            # matching the pallas twin's per-group masking
+            sums = jnp.stack([
+                jax.ops.segment_sum(jnp.where(flat_sel, v, 0.0), flat_keys,
+                                    num_segments=G + 1)[:G]
+                for v in vals.T])
+        else:
+            # the MXU path: (N,G)x(N,V)->(G,V) integer contraction.  Exact
+            # per batch within int32; under x64 the accumulator (and the
+            # cross-batch fold) widens to int64, matching scan_filter_step's
+            # convention — without x64, sums past 2^31 wrap (as any int32
+            # engine would)
+            acc_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+            sums = jax.lax.dot_general(
+                onehot, vals, (((0,), (0,)), ((), ())),
+                preferred_element_type=acc_t).T         # (V, G)
         mins = jnp.stack([
-            jax.ops.segment_min(jnp.where(flat_sel, v, _I32_MAX), flat_keys,
+            jax.ops.segment_min(jnp.where(flat_sel, v, hi), flat_keys,
                                 num_segments=G + 1)[:G]
             for v in vals.T])
         maxs = jnp.stack([
-            jax.ops.segment_max(jnp.where(flat_sel, v, _I32_MIN), flat_keys,
+            jax.ops.segment_max(jnp.where(flat_sel, v, lo), flat_keys,
                                 num_segments=G + 1)[:G]
             for v in vals.T])
         return {"count": count, "sums": sums, "mins": mins, "maxs": maxs}

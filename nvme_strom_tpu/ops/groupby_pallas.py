@@ -54,16 +54,20 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
     ``key_fn(cols, *params) -> (B, T) int32`` group ids in ``[0, n_groups)``
     (out-of-range ids fall into no group); scalar ``*params`` are staged
     through SMEM as int32.  Returns per group: ``count (G,)`` and
-    ``sums / mins / maxs`` of shape ``(len(agg_cols), G)``."""
-    cols_idx = list(agg_cols) if agg_cols is not None else \
-        list(range(schema.n_cols))
-    for ci in cols_idx:
-        if schema.col_dtype(ci) != np.dtype(np.int32):
-            raise ValueError(f"groupby aggregates int32 columns only "
-                             f"(col {ci} is {schema.col_dtype(ci)}); "
-                             f"filter float columns via make_filter_fn")
+    ``sums / mins / maxs`` of shape ``(len(agg_cols), G)``.  Aggregation
+    columns share one dtype, int32 or float32 (same contract as the XLA
+    twin)."""
+    from .groupby import _check_agg_cols
+    cols_idx, agg_dt = _check_agg_cols(schema, agg_cols)
     G = int(n_groups)
     V = len(cols_idx)
+    is_f = agg_dt.kind == "f"
+    acc_t = jnp.float32 if is_f else jnp.int32
+    # np scalars, not jnp: traced values would be captured constants
+    # inside the pallas kernel closure
+    zero = np.float32(0.0) if is_f else np.int32(0)
+    lo = np.float32(-np.inf) if is_f else _I32_MIN
+    hi = np.float32(np.inf) if is_f else _I32_MAX
 
     def make_kernel(n_params: int):
       def kernel(params_ref, w_ref, count_ref, sums_ref, mins_ref, maxs_ref):
@@ -74,9 +78,9 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             for g in range(G):      # SMEM takes scalar stores only
                 count_ref[0, g] = 0
                 for vi in range(V):
-                    sums_ref[vi, g] = 0
-                    mins_ref[vi, g] = _I32_MAX
-                    maxs_ref[vi, g] = _I32_MIN
+                    sums_ref[vi, g] = zero
+                    mins_ref[vi, g] = hi
+                    maxs_ref[vi, g] = lo
 
         params = [params_ref[k] for k in range(n_params)]
         cols, valid = _decode_block(w_ref[...], schema)
@@ -91,11 +95,11 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             count_ref[0, g] += jnp.sum(m.astype(jnp.int32))
             for vi, ci in enumerate(cols_idx):
                 v = cols[ci]
-                sums_ref[vi, g] += jnp.sum(jnp.where(m, v, 0))
+                sums_ref[vi, g] += jnp.sum(jnp.where(m, v, zero))
                 mins_ref[vi, g] = jnp.minimum(
-                    mins_ref[vi, g], jnp.min(jnp.where(m, v, _I32_MAX)))
+                    mins_ref[vi, g], jnp.min(jnp.where(m, v, hi)))
                 maxs_ref[vi, g] = jnp.maximum(
-                    maxs_ref[vi, g], jnp.max(jnp.where(m, v, _I32_MIN)))
+                    maxs_ref[vi, g], jnp.max(jnp.where(m, v, lo)))
       return kernel
 
     @jax.jit
@@ -121,9 +125,9 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((1, G), jnp.int32),
-                jax.ShapeDtypeStruct((V, G), jnp.int32),
-                jax.ShapeDtypeStruct((V, G), jnp.int32),
-                jax.ShapeDtypeStruct((V, G), jnp.int32),
+                jax.ShapeDtypeStruct((V, G), acc_t),
+                jax.ShapeDtypeStruct((V, G), acc_t),
+                jax.ShapeDtypeStruct((V, G), acc_t),
             ],
             interpret=_should_interpret() if interpret is None else interpret,
         )(pvec, words)

@@ -172,16 +172,16 @@ class Query:
             return "xla", "non-TPU backend: interpret-mode pallas would " \
                           "be pure overhead"
         if self._op == "group_by":
+            from ..ops.groupby import _check_agg_cols
             _, g, agg = self._group
-            cols_ok = all(
-                self.schema.col_dtype(c) == np.dtype(np.int32)
-                for c in (agg if agg is not None
-                          else range(self.schema.n_cols)))
-            if on_tpu and g <= _PALLAS_MAX_GROUPS and cols_ok:
+            try:
+                _check_agg_cols(self.schema, agg)
+            except ValueError as e:
+                # EXPLAIN must show the problem, not raise; run() refuses
+                return "invalid", str(e)
+            if on_tpu and g <= _PALLAS_MAX_GROUPS:
                 return "pallas", f"G={g} within the static-unroll bound " \
                                  f"({_PALLAS_MAX_GROUPS})"
-            if not cols_ok:
-                return "xla", "non-int32 aggregation columns"
             return "xla", (f"G={g} exceeds the pallas unroll bound"
                            if g > _PALLAS_MAX_GROUPS
                            else "non-TPU backend")
@@ -278,6 +278,8 @@ class Query:
         "pallas" | "xla").  With *mesh*, batches stream sharded over the
         mesh's ``dp`` axis and XLA inserts the reduction collectives."""
         plan = self.explain(mesh=mesh)
+        if plan.kernel == "invalid":
+            raise StromError(22, f"query not executable: {plan.reason}")
         chosen = plan.kernel if kernel == "auto" else kernel
         fn, combine = self._build_fn(chosen)
         if mesh is not None:

@@ -191,3 +191,87 @@ def test_pallas_groupby_no_params_and_multi_agg():
     for k in ("count", "sums", "mins", "maxs"):
         np.testing.assert_array_equal(out_p[k], out_x[k], err_msg=k)
     assert out_p["sums"].shape == (2, G)
+
+
+def test_float_groupby_both_paths_match_oracle():
+    """float32 aggregation columns in GROUP BY: pallas == XLA == numpy,
+    with inf sentinels for empty groups (AVG(price) GROUP BY category)."""
+    from nvme_strom_tpu.ops.groupby import make_groupby_fn
+    from nvme_strom_tpu.ops.groupby_pallas import make_groupby_fn_pallas
+
+    rng = np.random.default_rng(37)
+    schema = HeapSchema(n_cols=2, visibility=True,
+                        dtypes=("float32", "int32"))
+    n = schema.tuples_per_page * 6 + 11
+    price = (rng.standard_normal(n) * 50 + 100).astype(np.float32)
+    cat = rng.integers(-2, 10, n).astype(np.int32)   # some out of range
+    vis = (rng.random(n) > 0.25).astype(np.int32)
+    pages = build_pages([price, cat], schema, visibility=vis)
+    G = 8
+
+    key = lambda cols: cols[1]
+    for make in (make_groupby_fn, make_groupby_fn_pallas):
+        run = make(schema, key, G, agg_cols=[0])
+        out = {k: np.asarray(v) for k, v in run(pages).items()}
+        assert out["sums"].dtype == np.float32
+        sel = (vis != 0) & (cat >= 0) & (cat < G)
+        for g in range(G):
+            m = sel & (cat == g)
+            assert out["count"][g] == int(m.sum())
+            np.testing.assert_allclose(out["sums"][0][g],
+                                       price[m].sum(dtype=np.float64),
+                                       rtol=1e-5)
+            if m.any():
+                assert out["mins"][0][g] == price[m].min()
+                assert out["maxs"][0][g] == price[m].max()
+            else:
+                assert out["mins"][0][g] == np.inf
+                assert out["maxs"][0][g] == -np.inf
+
+    # NaN values in unselected rows must not poison float sums
+    price2 = price.copy()
+    price2[vis == 0] = np.nan
+    pages2 = build_pages([price2, cat], schema, visibility=vis)
+    run = make_groupby_fn(schema, key, G, agg_cols=[0])
+    out2 = {k: np.asarray(v) for k, v in run(pages2).items()}
+    assert np.isfinite(out2["sums"]).all()
+
+
+def test_groupby_uint32_and_empty_agg_refused():
+    from nvme_strom_tpu.ops.groupby import make_groupby_fn
+
+    schema = HeapSchema(n_cols=1, visibility=False, dtypes=("uint32",))
+    with pytest.raises(ValueError):
+        make_groupby_fn(schema, lambda cols: cols[0], 4)
+    schema2 = HeapSchema(n_cols=1, visibility=False)
+    with pytest.raises(ValueError):
+        make_groupby_fn(schema2, lambda cols: cols[0], 4, agg_cols=[])
+
+
+def test_float_groupby_nan_confined_to_its_group():
+    """A selected NaN row poisons only ITS group's sum on both paths (the
+    one-hot matmul would have spread it to every group)."""
+    from nvme_strom_tpu.ops.groupby import make_groupby_fn
+    from nvme_strom_tpu.ops.groupby_pallas import make_groupby_fn_pallas
+
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("float32", "int32"))
+    n = schema.tuples_per_page
+    vals = np.ones(n, np.float32)
+    cat = (np.arange(n) % 4).astype(np.int32)
+    vals[2] = np.nan                     # row 2 -> group 2
+    pages = build_pages([vals, cat], schema)
+    for make in (make_groupby_fn, make_groupby_fn_pallas):
+        out = {k: np.asarray(v) for k, v in
+               make(schema, lambda cols: cols[1], 4, agg_cols=[0])(pages).items()}
+        assert np.isnan(out["sums"][0][2])
+        ok = [0, 1, 3]
+        assert np.isfinite(out["sums"][0][ok]).all(), out["sums"]
+
+
+def test_groupby_agg_col_out_of_range_clean_error():
+    from nvme_strom_tpu.ops.groupby import make_groupby_fn
+
+    schema = HeapSchema(n_cols=2, visibility=False)
+    with pytest.raises(ValueError, match="out of range"):
+        make_groupby_fn(schema, lambda cols: cols[0], 4, agg_cols=[9])

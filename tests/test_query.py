@@ -283,3 +283,21 @@ def test_no_predicate_counts_nan_rows(tmp_path):
     assert int(out["count"]) == n
     out_p = Query(path, schema).run(kernel="pallas")
     assert int(out_p["count"]) == n
+
+
+def test_explain_shows_invalid_plan_without_raising(heap, tmp_path):
+    """EXPLAIN on a non-executable query reports the problem as a plan,
+    and run() refuses with the same reason (review finding)."""
+    rng = np.random.default_rng(7)
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("int32", "float32"))
+    n = schema.tuples_per_page * 2
+    path = str(tmp_path / "mix.heap")
+    build_heap_file(path, [rng.integers(0, 9, n).astype(np.int32),
+                           rng.random(n).astype(np.float32)], schema)
+    q = Query(path, schema).group_by(lambda cols: cols[0], 4)  # mixed aggs
+    plan = q.explain()
+    assert plan.kernel == "invalid"
+    assert "share one dtype" in plan.reason
+    with pytest.raises(StromError, match="not executable"):
+        q.run()

@@ -457,17 +457,18 @@ def test_typed_float_columns_roundtrip_and_filter(tmp_path):
 
     # the pallas filter accepts typed schemas too (full differential
     # coverage lives in tests/test_pallas.py); groupby — both paths —
-    # refuses float *aggregation* columns explicitly
+    # accepts uniform-dtype aggregation sets and refuses mixed ones
     from nvme_strom_tpu.ops.filter_pallas import make_filter_fn_pallas
     from nvme_strom_tpu.ops.groupby import make_groupby_fn
     from nvme_strom_tpu.ops.groupby_pallas import make_groupby_fn_pallas
     pfn = make_filter_fn_pallas(schema, lambda cols, th: cols[0] > th)
     pout = pfn(pages, np.float32(0.5))
     assert int(pout["count"]) == int(sel.sum())
+    with pytest.raises(ValueError):   # float + int mixed
+        make_groupby_fn(schema, lambda cols: cols[1], 4, agg_cols=[0, 1])
     with pytest.raises(ValueError):
-        make_groupby_fn(schema, lambda cols: cols[1], 4, agg_cols=[0])
-    with pytest.raises(ValueError):
-        make_groupby_fn_pallas(schema, lambda cols: cols[1], 4, agg_cols=[0])
+        make_groupby_fn_pallas(schema, lambda cols: cols[1], 4,
+                               agg_cols=[0, 1])
 
 
 def test_topk_matches_numpy_and_folds_across_batches(tmp_path):

@@ -115,24 +115,21 @@ def test_strom_query_cli_explain_and_run(tmp_path):
     path = str(tmp_path / "q.heap")
     build_heap_file(path, [c0, c1], schema)
 
-    base = [sys.executable, "-m", "nvme_strom_tpu.tools.strom_query", path,
+    base = ["nvme_strom_tpu.tools.strom_query", path,
             "--cols", "2", "--where", "c0 > 0"]
-    out = subprocess.run(base + ["--explain"], capture_output=True,
-                         text=True, timeout=300)
+    out = _run(*base, "--explain")
     assert out.returncode == 0, out.stderr
     assert "aggregate scan" in out.stdout
 
-    out = subprocess.run(base + ["--json"], capture_output=True, text=True,
-                         timeout=300)
+    out = _run(*base, "--json")
     assert out.returncode == 0, out.stderr
     res = json.loads(out.stdout.strip().splitlines()[-1])
     sel = c0 > 0
     assert res["count"] == int(sel.sum())
     assert res["sums"][0] == int(c0[sel].sum())
 
-    out = subprocess.run(
-        base + ["--group-by", "c1", "--groups", "8", "--agg-cols", "0",
-                "--json"], capture_output=True, text=True, timeout=300)
+    out = _run(*base, "--group-by", "c1", "--groups", "8",
+               "--agg-cols", "0", "--json")
     assert out.returncode == 0, out.stderr
     res = json.loads(out.stdout.strip().splitlines()[-1])
     assert res["count"][3] == int((sel & (c1 == 3)).sum())
@@ -148,10 +145,8 @@ def test_strom_query_rejects_evil_expression(tmp_path):
     schema = HeapSchema(n_cols=1, visibility=False)
     path = str(tmp_path / "q.heap")
     build_heap_file(path, [np.zeros(10, np.int32)], schema)
-    out = subprocess.run(
-        [sys.executable, "-m", "nvme_strom_tpu.tools.strom_query", path,
-         "--cols", "1", "--where", "__import__('os').system('true')"],
-        capture_output=True, text=True, timeout=300)
+    out = _run("nvme_strom_tpu.tools.strom_query", path,
+               "--cols", "1", "--where", "__import__('os').system('true')")
     assert out.returncode != 0
     assert "not allowed" in out.stderr
 
@@ -168,12 +163,8 @@ def test_strom_query_cli_conflicting_terminals_and_bad_column(tmp_path):
     schema = HeapSchema(n_cols=2, visibility=False)
     path = str(tmp_path / "q.heap")
     build_heap_file(path, [np.zeros(10, np.int32)] * 2, schema)
-    base = [sys.executable, "-m", "nvme_strom_tpu.tools.strom_query", path,
-            "--cols", "2"]
-    out = subprocess.run(base + ["--group-by", "c1", "--groups", "4",
-                                 "--top-k", "0:4"],
-                         capture_output=True, text=True, timeout=120)
+    base = ["nvme_strom_tpu.tools.strom_query", path, "--cols", "2"]
+    out = _run(*base, "--group-by", "c1", "--groups", "4", "--top-k", "0:4")
     assert out.returncode != 0 and "exclusive" in out.stderr
-    out = subprocess.run(base + ["--where", "c9 > 0"],
-                         capture_output=True, text=True, timeout=300)
+    out = _run(*base, "--where", "c9 > 0")
     assert out.returncode != 0 and "out of range" in out.stderr
