@@ -98,11 +98,10 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
             sel = sel & predicate(cols, *params)
         keys = jnp.where(sel, keys, G)  # overflow bucket, sliced off below
         flat_keys = keys.reshape(-1)
-        onehot_t = jnp.float32 if is_f else jnp.int32
-        onehot = jax.nn.one_hot(flat_keys, G + 1, dtype=onehot_t)[:, :G]
+        onehot = jax.nn.one_hot(flat_keys, G + 1, dtype=jnp.int32)[:, :G]
         vals = jnp.stack([c.reshape(-1) for c in (cols[i] for i in cols_idx)],
                          axis=-1)                       # (N, V)
-        count = jnp.sum(onehot.astype(jnp.int32), axis=0)  # (G,)
+        count = jnp.sum(onehot, axis=0)                 # (G,)
         flat_sel = sel.reshape(-1)
         if is_f:
             # per-group scatter sum, NOT the matmul: 0*NaN = NaN, so one

@@ -30,7 +30,50 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import make_scan_mesh
 
-__all__ = ["make_bucket_exchange"]
+__all__ = ["make_bucket_exchange", "bucket_dispatch"]
+
+
+def bucket_dispatch(rows, bucket, ok, dp: int, capacity: int, *,
+                    fill_value: int = 0):
+    """Shard-local MoE-style dispatch + all_to_all (shared by the bucket
+    exchange and :mod:`.sort`; call inside shard_map over a ``dp`` axis).
+
+    ``rows (N, width) int32``, ``bucket (N,) int32`` owner device ids,
+    ``ok (N,) bool`` rows eligible to send.  Rows rank within their
+    (device, bucket); rank ≥ *capacity* is dropped.  Returns
+
+    * ``recv (dp*capacity, width)`` — this device's bucket, one
+      capacity-slab per sender, padded with *fill_value*,
+    * ``recv_counts (dp,)`` — valid rows per sender slab,
+    * ``keep (N,) bool`` — which local rows were actually sent (drop
+      accounting is the caller's: ``sum(valid) - sum(keep)``).
+    """
+    onehot = (bucket[:, None] == jnp.arange(dp)[None, :]) & ok[:, None]
+    oh32 = onehot.astype(jnp.int32)
+    # rank = number of earlier same-bucket rows (the MoE dispatch rank)
+    rank = jnp.cumsum(oh32, axis=0) - oh32              # (N, dp)
+    pos = jnp.sum(rank * oh32, axis=1)                  # (N,)
+    keep = ok & (pos < capacity)
+
+    # scatter into the (dp, capacity, width) send slab; rejected rows are
+    # routed out of bounds so mode="drop" discards them instead of
+    # clobbering slot (0, 0)
+    width = rows.shape[1]
+    slab = jnp.full((dp, capacity, width), fill_value, jnp.int32)
+    slot_b = jnp.where(keep, bucket, dp)
+    slot_c = jnp.where(keep, pos, capacity)
+    slab = slab.at[slot_b, slot_c].set(rows, mode="drop")
+    sent = jnp.sum(oh32 * keep[:, None].astype(jnp.int32), axis=0)
+
+    # the collective: slab axis 0 splits across dp, the local batch axis
+    # concatenates — every device receives its own bucket from every peer
+    recv = jax.lax.all_to_all(slab[None], "dp", split_axis=1,
+                              concat_axis=0, tiled=False)
+    recv = recv.reshape(dp * capacity, width)
+    recv_counts = jax.lax.all_to_all(sent[None, :, None], "dp",
+                                     split_axis=1, concat_axis=0,
+                                     tiled=False).reshape(dp)
+    return recv, recv_counts, keep
 
 
 def make_bucket_exchange(devices: Optional[Sequence[jax.Device]] = None, *,
@@ -59,34 +102,10 @@ def make_bucket_exchange(devices: Optional[Sequence[jax.Device]] = None, *,
         # out-of-range keys are drops, never silent (and never allowed to
         # reach the scatter, where a negative index would wrap)
         ok = valid & (keys >= 0) & (keys < dp)
-        # rank rows within their bucket on this device: position = number
-        # of earlier same-bucket rows (the MoE dispatch rank)
-        onehot = (keys[:, None] == jnp.arange(dp)[None, :]) & ok[:, None]
-        oh32 = onehot.astype(jnp.int32)
-        rank = jnp.cumsum(oh32, axis=0) - oh32          # (N, dp)
-        pos = jnp.sum(rank * oh32, axis=1)              # (N,)
-        keep = ok & (pos < capacity)
+        recv, recv_counts, keep = bucket_dispatch(
+            rows, keys, ok, dp, capacity, fill_value=fill_value)
         # counts capacity overflow AND bad-key rows the caller marked valid
         n_dropped = jnp.sum(valid) - jnp.sum(keep)
-
-        # scatter rows into the (dp, capacity, width) send slab; rejected
-        # rows are routed out of bounds so mode="drop" discards them
-        # instead of clobbering slot (0, 0)
-        slab = jnp.full((dp, capacity, width), fill_value, jnp.int32)
-        slot_b = jnp.where(keep, keys, dp)
-        slot_c = jnp.where(keep, pos, capacity)
-        slab = slab.at[slot_b, slot_c].set(rows, mode="drop")
-        sent = jnp.sum(oh32 * keep[:, None].astype(jnp.int32), axis=0)
-
-        # the collective: slab axis 0 is split across dp, the local batch
-        # axis concatenates — every device receives its own bucket from
-        # every peer
-        recv = jax.lax.all_to_all(slab[None], "dp", split_axis=1,
-                                  concat_axis=0, tiled=False)
-        recv = recv.reshape(dp * capacity, width)
-        recv_counts = jax.lax.all_to_all(sent[None, :, None], "dp",
-                                         split_axis=1, concat_axis=0,
-                                         tiled=False).reshape(dp)
         count = jnp.sum(recv_counts)
         return {"rows": recv[None], "count": count[None],
                 "n_dropped": jax.lax.psum(n_dropped, "dp")}

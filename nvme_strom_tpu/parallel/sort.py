@@ -1,0 +1,154 @@
+"""Distributed sample sort: a full ORDER BY at mesh scale.
+
+Completes the scan-compute tier's ordering story: :mod:`..ops.topk` covers
+``ORDER BY .. LIMIT k`` with a streaming fold, this module sorts the whole
+key set across the ``dp`` mesh — the capability a CUDA framework would
+build on multi-GPU radix sort and the reference (a storage engine) leaves
+to PostgreSQL's executor.
+
+TPU-native shape (everything static, one jitted shard_map):
+
+1. **local sort** per device (``lax.sort`` — bitonic on TPU),
+2. **splitter election**: every device contributes ``dp`` local quantile
+   samples; an ``all_gather`` + sort of the ``dp²`` samples yields the
+   ``dp-1`` global splitters (classic sample sort — splitters balance the
+   buckets to ~N/dp each with high probability),
+3. **bucket exchange**: ``searchsorted(splitters, v)`` names each
+   element's owner device; a fixed-capacity ``all_to_all`` slab exchange
+   moves them (the same MoE token-dispatch discipline as
+   :mod:`.exchange` — capacity drops are counted, never silent),
+4. **local sort of the received bucket** → device *b* holds the *b*-th
+   globally-ordered key range; concatenating the per-device prefixes in
+   mesh order is the sorted sequence.
+
+Values may be int32 or float32 (floats ride the slab as an
+order-irrelevant bitcast and are restored before the final sort); an
+optional int32 payload (e.g. global row positions from the scan)
+permutes with the keys.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import make_scan_mesh
+
+__all__ = ["make_distributed_sort"]
+
+_I32_MAX = np.int32((1 << 31) - 1)
+
+
+def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
+                          capacity: int, dtype=np.int32,
+                          descending: bool = False):
+    """Build the jitted distributed sort over a 1-D ``dp`` mesh.
+
+    ``capacity`` — received-elements bound per (sender, receiver) pair;
+    a bucket can absorb up to ``dp * capacity`` elements, so ``capacity ≳
+    (N/dp²) · safety`` keeps drops at zero for near-uniform data (drops
+    are reported via ``n_dropped``, resize and rerun on overflow).
+
+    Returns ``(run, mesh)``.  ``run(values, payload=None, valid=None)``
+    with ``values (N,)`` dp-sharded yields global ``(dp, dp*capacity)``
+    arrays:
+
+    * ``values`` — device *b*'s row sorted (descending if requested),
+      padded at the tail with the dtype's worst value,
+    * ``payload`` — int32, permuted with values (-1 padding),
+    * ``count`` — ``(dp,)`` valid elements per device row,
+    * ``n_dropped`` — scalar capacity-overflow count.
+
+    Global order = concatenation of row ``b``'s first ``count[b]``
+    elements for ``b = 0..dp-1``.
+    """
+    mesh = make_scan_mesh(devices, sp=1)
+    dp = mesh.shape["dp"]
+    dt = np.dtype(dtype)
+    if dt not in (np.dtype(np.int32), np.dtype(np.float32)):
+        raise ValueError(f"sort supports int32/float32 values, got {dt}")
+    is_f = dt.kind == "f"
+    worst = np.array((-np.inf if descending else np.inf) if is_f
+                     else (-(1 << 31) if descending else _I32_MAX), dt)
+
+    def key_of(v):
+        # order-reversing transforms that cannot overflow (ops/topk.py)
+        if not descending:
+            return v
+        return -v if is_f else ~v
+
+    def _local(values, payload, valid):
+        n = values.shape[0]
+        # 1+2. splitter election: sort the local keys (invalid ride as the
+        # worst key, i.e. to the tail), take dp quantiles of the valid
+        # prefix, all_gather them, and cut the dp-1 global splitters — all
+        # in key space, so descending order works unchanged
+        v = jnp.where(valid, values, worst)
+        nvalid = jnp.sum(valid.astype(jnp.int32))
+        sorted_keys = jnp.sort(key_of(v))
+        qpos = ((jnp.arange(dp) + 1) * nvalid) // (dp + 1)
+        qpos = jnp.clip(qpos, 0, n - 1)
+        local_samples = sorted_keys[qpos]
+        all_samples = jax.lax.all_gather(local_samples, "dp").reshape(-1)
+        all_samples = jnp.sort(all_samples)
+        splitters = all_samples[(jnp.arange(dp - 1) + 1) * dp]
+
+        # 3. owner bucket per element (key space keeps it monotone);
+        # dispatch + all_to_all shared with the bucket exchange
+        from .exchange import bucket_dispatch
+        bucket = jnp.searchsorted(splitters, key_of(values),
+                                  side="right").astype(jnp.int32)
+        vbits = jax.lax.bitcast_convert_type(values, jnp.int32) \
+            if is_f else values
+        recv, counts, keep = bucket_dispatch(
+            jnp.stack([vbits, payload], -1), bucket, valid, dp, capacity)
+        n_dropped = jnp.sum(valid) - jnp.sum(keep)
+
+        # 4. local sort of the received bucket; pad slots (slot >= its
+        # sub-slab's count) sort to the tail
+        slot = jnp.arange(dp * capacity) % capacity
+        src = jnp.arange(dp * capacity) // capacity
+        got = slot < counts[src]
+        rv = recv[:, 0]
+        if is_f:
+            rv = jax.lax.bitcast_convert_type(rv, jnp.float32)
+        rv = jnp.where(got, rv, worst)
+        rp = jnp.where(got, recv[:, 1], -1)
+        _, sv, sp = jax.lax.sort((key_of(rv), rv, rp), num_keys=1)
+        return {"values": sv[None], "payload": sp[None],
+                "count": jnp.sum(counts)[None],
+                "n_dropped": jax.lax.psum(n_dropped, "dp")}
+
+    shard_mapped = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P("dp"), P("dp"), P("dp")),
+        out_specs={"values": P("dp", None), "payload": P("dp", None),
+                   "count": P("dp"), "n_dropped": P()})
+    step = jax.jit(shard_mapped)
+
+    def run(values_np, payload_np=None, valid_np=None):
+        values_np = np.asarray(values_np, dt)
+        n = len(values_np)
+        if payload_np is None:
+            payload_np = np.arange(n, dtype=np.int32)
+        payload_np = np.asarray(payload_np, np.int32)
+        if valid_np is None:
+            valid_np = np.ones(n, bool)
+        valid_np = np.asarray(valid_np, bool)
+        pad = (-n) % dp
+        if pad:
+            values_np = np.concatenate([values_np, np.zeros(pad, dt)])
+            payload_np = np.concatenate(
+                [payload_np, np.full(pad, -1, np.int32)])
+            valid_np = np.concatenate([valid_np, np.zeros(pad, bool)])
+        sh = NamedSharding(mesh, P("dp"))
+        out = step(jax.device_put(values_np, sh),
+                   jax.device_put(payload_np, sh),
+                   jax.device_put(valid_np, sh))
+        return out
+
+    return run, mesh

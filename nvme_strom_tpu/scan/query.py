@@ -161,6 +161,16 @@ class Query:
 
     def _kernel_choice(self, mode: str):
         import jax
+
+        # operator validity is mode-independent — check BEFORE any mode
+        # early-return so mesh plans surface 'invalid' too
+        if self._op == "group_by":
+            from ..ops.groupby import _check_agg_cols
+            try:
+                _check_agg_cols(self.schema, self._group[2])
+            except ValueError as e:
+                # EXPLAIN must show the problem, not raise; run() refuses
+                return "invalid", str(e)
         on_tpu = jax.default_backend() == "tpu"
         if mode == "mesh":
             return "xla", "mesh mode: XLA partitions the reduction and " \
@@ -172,13 +182,7 @@ class Query:
             return "xla", "non-TPU backend: interpret-mode pallas would " \
                           "be pure overhead"
         if self._op == "group_by":
-            from ..ops.groupby import _check_agg_cols
             _, g, agg = self._group
-            try:
-                _check_agg_cols(self.schema, agg)
-            except ValueError as e:
-                # EXPLAIN must show the problem, not raise; run() refuses
-                return "invalid", str(e)
             if on_tpu and g <= _PALLAS_MAX_GROUPS:
                 return "pallas", f"G={g} within the static-unroll bound " \
                                  f"({_PALLAS_MAX_GROUPS})"

@@ -301,3 +301,24 @@ def test_explain_shows_invalid_plan_without_raising(heap, tmp_path):
     assert "share one dtype" in plan.reason
     with pytest.raises(StromError, match="not executable"):
         q.run()
+
+
+def test_mesh_explain_also_reports_invalid(tmp_path):
+    """The 'invalid' plan contract holds under a mesh too (review
+    finding: mode early-return used to bypass validation)."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    rng = np.random.default_rng(7)
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("int32", "float32"))
+    n = schema.tuples_per_page * 2
+    path = str(tmp_path / "mix.heap")
+    build_heap_file(path, [rng.integers(0, 9, n).astype(np.int32),
+                           rng.random(n).astype(np.float32)], schema)
+    mesh = make_scan_mesh(jax.devices())
+    q = Query(path, schema).group_by(lambda cols: cols[0], 4)
+    plan = q.explain(mesh=mesh)
+    assert plan.kernel == "invalid"
+    with pytest.raises(StromError, match="not executable"):
+        q.run(mesh=mesh)
