@@ -87,6 +87,7 @@ class Query:
         self._agg_cols: Optional[Sequence[int]] = None
         self._group: Optional[tuple] = None
         self._topk: Optional[tuple] = None
+        self._order: Optional[tuple] = None
         self._join: Optional[tuple] = None
 
     # -- builders -----------------------------------------------------------
@@ -119,6 +120,18 @@ class Query:
         self._op = "top_k"
         self._terminal_set = True
         self._topk = (int(col), int(k), largest)
+        return self
+
+    def order_by(self, col: int, *, descending: bool = False) -> "Query":
+        """Terminal: the full ordering of *col* over selected rows —
+        sorted values + their global row positions (ORDER BY without
+        LIMIT; use :meth:`top_k` when only the head is needed).  With a
+        mesh, runs the distributed sample sort; device *b* ends up owning
+        the *b*-th key range."""
+        self._require_no_terminal()
+        self._op = "order_by"
+        self._terminal_set = True
+        self._order = (int(col), descending)
         return self
 
     def join(self, probe_col: int, build_keys: np.ndarray,
@@ -189,6 +202,10 @@ class Query:
             return "xla", (f"G={g} exceeds the pallas unroll bound"
                            if g > _PALLAS_MAX_GROUPS
                            else "non-TPU backend")
+        if self._op == "order_by":
+            return "xla", ("distributed sample sort (splitter election + "
+                           "all_to_all)" if mode == "mesh"
+                           else "single-device lax sort")
         return "xla", f"{self._op} runs on lax.top_k/searchsorted (XLA)"
 
     def explain(self, *, mesh=None) -> QueryPlan:
@@ -284,6 +301,8 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
+        if self._op == "order_by":
+            return self._run_order_by(plan, mesh, device, session)
         chosen = plan.kernel if kernel == "auto" else kernel
         fn, combine = self._build_fn(chosen)
         if mesh is not None:
@@ -346,6 +365,104 @@ class Query:
                 if own:
                     src.close()
         return self._vfs_scan(fn, combine, device)
+
+    def _run_order_by(self, plan: QueryPlan, mesh, device, session) -> dict:
+        """ORDER BY: gather (values, global positions, validity) through
+        the planned access path, then sort — distributed sample sort on a
+        mesh, one-device lax sort locally.  Returns the flat global order
+        ``{"values", "positions"}`` (+ ``per_device_count``/``n_dropped``
+        info keys in mesh mode)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.filter_xla import decode_pages
+        from ..scan.heap import PAGE_SIZE as _PS
+        col, descending = self._order
+        if not 0 <= col < self.schema.n_cols:
+            raise StromError(22, f"order_by column {col} out of range")
+        dt = self.schema.col_dtype(col)
+        if dt not in (np.dtype(np.int32), np.dtype(np.float32)):
+            raise StromError(22, f"order_by supports int32/float32 "
+                                 f"columns (got {dt})")
+        pred = self._pred
+        t = self.schema.tuples_per_page
+        words_per_page = _PS // 4
+
+        @jax.jit
+        def gather(pages):
+            cols, valid = decode_pages(pages, self.schema)
+            if pred is not None:
+                valid = valid & pred(cols)
+            words = jax.lax.bitcast_convert_type(
+                pages.reshape(pages.shape[0], words_per_page, 4),
+                jnp.int32).reshape(pages.shape[0], words_per_page)
+            page_ids = words[:, 1]
+            # int32 positions wrap past 2^31 rows; under x64 widen to
+            # int64 (same convention as ops/topk.py)
+            pos_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+            pos = (page_ids[:, None].astype(pos_t) * t
+                   + jnp.arange(t, dtype=pos_t)[None, :])
+            return {"values": cols[col].reshape(-1),
+                    "positions": pos.reshape(-1),
+                    "valid": valid.reshape(-1)}
+
+        # per-batch host append + one concatenate (a fold-style growing
+        # device concat would copy the accumulator once per batch)
+        chunks = []
+
+        def collect(pages_dev):
+            out = gather(pages_dev)
+            mask = np.asarray(out["valid"]).astype(bool)
+            chunks.append((np.asarray(out["values"])[mask],
+                           np.asarray(out["positions"])[mask]))
+            return {}   # nothing to fold
+
+        if plan.access_path == "direct":
+            from .executor import TableScanner
+            src, own = self._open_owned()
+            try:
+                with TableScanner(src, self.schema, session=session) as sc:
+                    sc.scan_filter(collect, device=device)
+            finally:
+                if own:
+                    src.close()
+        else:
+            self._vfs_scan(collect, None, device)
+        pos_np_t = np.int64 if jax.config.jax_enable_x64 else np.int32
+        if chunks:
+            vals = np.concatenate([c[0] for c in chunks])
+            poss = np.concatenate([c[1] for c in chunks])
+        else:
+            vals = np.zeros(0, dt)
+            poss = np.zeros(0, pos_np_t)
+        if len(vals) == 0:   # empty source or nothing selected
+            return {"values": vals, "positions": poss}
+
+        if mesh is None:
+            key = vals if not descending else \
+                (-vals if dt.kind == "f" else ~vals)
+            order = np.argsort(key, kind="stable")
+            return {"values": vals[order], "positions": poss[order]}
+
+        from ..parallel.sort import make_distributed_sort
+        dp = mesh.shape["dp"]
+        n = len(vals)
+        capacity = max(64, -(-n * 5 // (2 * dp * dp)))  # 2.5x balance slack
+        while True:
+            run_sort, _ = make_distributed_sort(
+                list(mesh.devices.reshape(-1)), capacity=capacity,
+                dtype=dt, descending=descending)
+            out = run_sort(vals, poss)
+            if int(out["n_dropped"]) == 0:
+                break
+            capacity *= 2          # skewed keys: resize and rerun
+        counts = np.asarray(out["count"])
+        v = np.concatenate([np.asarray(out["values"])[b][:counts[b]]
+                            for b in range(dp)])
+        p = np.concatenate([np.asarray(out["payload"])[b][:counts[b]]
+                            for b in range(dp)])
+        return {"values": v, "positions": p,
+                "per_device_count": counts, "n_dropped": np.int32(0)}
 
     def _vfs_scan(self, fn, combine, device) -> dict:
         """Buffered fallback below the planner threshold (the conventional

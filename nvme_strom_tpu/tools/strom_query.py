@@ -77,6 +77,9 @@ def main(argv=None) -> int:
                     help="comma-separated column indices to aggregate")
     ap.add_argument("--top-k", default=None, metavar="COL:K[:smallest]",
                     help="top-k of a column instead of aggregation")
+    ap.add_argument("--order-by", default=None, metavar="COL[:desc]",
+                    help="full ordering of a column (values + row "
+                         "positions); distributed sample sort with --mesh")
     ap.add_argument("--kernel", choices=("auto", "pallas", "xla"),
                     default="auto")
     ap.add_argument("--mesh", action="store_true",
@@ -98,11 +101,14 @@ def main(argv=None) -> int:
     from ..scan.query import Query
     from .common import parse_size
     src = args.file[0] if len(args.file) == 1 else list(args.file)
-    if args.group_by and args.top_k:
-        ap.error("--group-by and --top-k are exclusive "
-                 "(one terminal operator per query)")
-    if args.top_k and agg_cols is not None:
-        ap.error("--agg-cols has no effect with --top-k")
+    terminals = [f for f, v in (("--group-by", args.group_by),
+                                ("--top-k", args.top_k),
+                                ("--order-by", args.order_by)) if v]
+    if len(terminals) > 1:
+        ap.error(f"{' and '.join(terminals)} are exclusive "
+                 f"(one terminal operator per query)")
+    if (args.top_k or args.order_by) and agg_cols is not None:
+        ap.error(f"--agg-cols has no effect with {terminals[0]}")
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
@@ -115,6 +121,10 @@ def main(argv=None) -> int:
         parts = args.top_k.split(":")
         largest = not (len(parts) > 2 and parts[2] == "smallest")
         q = q.top_k(int(parts[0]), int(parts[1]), largest=largest)
+    elif args.order_by:
+        parts = args.order_by.split(":")
+        q = q.order_by(int(parts[0]),
+                       descending=len(parts) > 1 and parts[1] == "desc")
     elif agg_cols is not None:
         q = q.aggregate(cols=agg_cols)
 
@@ -135,8 +145,10 @@ def main(argv=None) -> int:
         return 0
 
     out = q.run(mesh=mesh, kernel=args.kernel)
-    if args.kernel != "auto" and args.kernel != plan.kernel:
-        # the printed plan must reflect what actually ran
+    if args.kernel != "auto" and args.kernel != plan.kernel \
+            and not args.order_by:
+        # the printed plan must reflect what actually ran (order_by has a
+        # fixed sort pipeline — run() ignores the kernel override there)
         import dataclasses
         plan = dataclasses.replace(
             plan, kernel=args.kernel,

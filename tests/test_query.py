@@ -322,3 +322,59 @@ def test_mesh_explain_also_reports_invalid(tmp_path):
     assert plan.kernel == "invalid"
     with pytest.raises(StromError, match="not executable"):
         q.run(mesh=mesh)
+
+
+def test_order_by_local_and_mesh_match_numpy(heap):
+    """ORDER BY: full ordering with row positions, local lax sort and the
+    distributed sample sort both match numpy."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = (vis != 0) & (c0 > 0)
+    q = Query(path, schema).where(lambda cols: cols[0] > 0).order_by(0)
+    plan = q.explain()
+    assert plan.operator == "order_by"
+    out = q.run()
+    want = np.sort(c0[sel])
+    np.testing.assert_array_equal(out["values"], want)
+    # positions name rows carrying those values, all selected
+    assert sel[out["positions"]].all()
+    np.testing.assert_array_equal(c0[out["positions"]], out["values"])
+
+    mesh = make_scan_mesh(jax.devices())
+    mout = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .order_by(0).run(mesh=mesh)
+    np.testing.assert_array_equal(mout["values"], want)
+    np.testing.assert_array_equal(c0[mout["positions"]], mout["values"])
+    assert int(mout["n_dropped"]) == 0
+
+
+def test_order_by_descending_and_vfs_path(heap):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", False)   # vfs access path
+    q = Query(path, schema).order_by(0, descending=True)
+    assert q.explain().access_path == "vfs"
+    out = q.run()
+    np.testing.assert_array_equal(out["values"], np.sort(c0[vis != 0])[::-1])
+
+
+def test_order_by_float_column(tmp_path):
+    rng = np.random.default_rng(43)
+    schema = HeapSchema(n_cols=1, visibility=False, dtypes=("float32",))
+    n = schema.tuples_per_page * 4
+    f = rng.standard_normal(n).astype(np.float32)
+    path = str(tmp_path / "f.heap")
+    build_heap_file(path, [f], schema)
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).order_by(0).run()
+    np.testing.assert_array_equal(out["values"], np.sort(f))
+
+
+def test_order_by_nothing_selected_and_empty(heap, tmp_path):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).where(lambda cols: cols[0] > 10**6) \
+        .order_by(0).run()
+    assert len(out["values"]) == 0 and len(out["positions"]) == 0

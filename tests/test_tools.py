@@ -168,3 +168,22 @@ def test_strom_query_cli_conflicting_terminals_and_bad_column(tmp_path):
     assert out.returncode != 0 and "exclusive" in out.stderr
     out = _run(*base, "--where", "c9 > 0")
     assert out.returncode != 0 and "out of range" in out.stderr
+
+
+def test_strom_query_cli_order_by(tmp_path):
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=1, visibility=False)
+    rng = np.random.default_rng(6)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(-100, 100, n).astype(np.int32)
+    path = str(tmp_path / "o.heap")
+    build_heap_file(path, [c0], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
+               "--order-by", "0:desc", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["values"] == np.sort(c0)[::-1].tolist()
