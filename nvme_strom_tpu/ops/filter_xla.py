@@ -51,6 +51,21 @@ def decode_pages(pages_u8: jax.Array, schema: HeapSchema = DEFAULT_SCHEMA):
     return cols, valid
 
 
+def global_row_positions(pages_u8: jax.Array, schema: HeapSchema):
+    """(B, T) global row numbers from the page headers (word 1 is the
+    page id), batch-position-independent so streamed folds stay exact.
+    int32 positions wrap past 2^31 rows; under x64 widen to int64 —
+    shared convention of ops/topk.py and the ORDER BY gather."""
+    b = pages_u8.shape[0]
+    words = jax.lax.bitcast_convert_type(
+        pages_u8.reshape(b, _WORDS, 4), jnp.int32).reshape(b, _WORDS)
+    page_ids = words[:, 1]
+    t = schema.tuples_per_page
+    pos_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return (page_ids[:, None].astype(pos_t) * t
+            + jnp.arange(t, dtype=pos_t)[None, :])
+
+
 @jax.jit
 def scan_filter_step(pages_u8: jax.Array, threshold: jax.Array):
     """Flagship single-chip step: predicate col0 > threshold over a page

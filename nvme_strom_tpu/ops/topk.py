@@ -62,16 +62,8 @@ def make_topk_fn(schema: HeapSchema, col: int, k: int, *,
             valid & predicate(cols, *params)
         v = cols[col]
         # global row ids from the page header, not the batch position
-        words = jax.lax.bitcast_convert_type(
-            pages_u8.reshape(pages_u8.shape[0], _WORDS, 4),
-            jnp.int32).reshape(pages_u8.shape[0], _WORDS)
-        page_ids = words[:, 1]
-        # int32 positions wrap past 2^31 rows; under x64 widen to int64
-        # (same convention as groupby's sum accumulator) so streaming
-        # arbitrarily large tables keeps row identity exact
-        pos_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
-        pos = (page_ids[:, None].astype(pos_t) * t
-               + jnp.arange(t, dtype=pos_t)[None, :])
+        from .filter_xla import global_row_positions
+        pos = global_row_positions(pages_u8, schema)
         flat_v = jnp.where(sel, v, worst).reshape(-1)
         flat_p = jnp.where(sel, pos, -1).reshape(-1)
         kk = min(k, flat_v.size)

@@ -385,23 +385,15 @@ class Query:
             raise StromError(22, f"order_by supports int32/float32 "
                                  f"columns (got {dt})")
         pred = self._pred
-        t = self.schema.tuples_per_page
-        words_per_page = _PS // 4
+
+        from ..ops.filter_xla import global_row_positions
 
         @jax.jit
         def gather(pages):
             cols, valid = decode_pages(pages, self.schema)
             if pred is not None:
                 valid = valid & pred(cols)
-            words = jax.lax.bitcast_convert_type(
-                pages.reshape(pages.shape[0], words_per_page, 4),
-                jnp.int32).reshape(pages.shape[0], words_per_page)
-            page_ids = words[:, 1]
-            # int32 positions wrap past 2^31 rows; under x64 widen to
-            # int64 (same convention as ops/topk.py)
-            pos_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
-            pos = (page_ids[:, None].astype(pos_t) * t
-                   + jnp.arange(t, dtype=pos_t)[None, :])
+            pos = global_row_positions(pages, self.schema)
             return {"values": cols[col].reshape(-1),
                     "positions": pos.reshape(-1),
                     "valid": valid.reshape(-1)}
@@ -436,7 +428,12 @@ class Query:
             vals = np.zeros(0, dt)
             poss = np.zeros(0, pos_np_t)
         if len(vals) == 0:   # empty source or nothing selected
-            return {"values": vals, "positions": poss}
+            out = {"values": vals, "positions": poss}
+            if mesh is not None:   # keep the mesh contract's info keys
+                out["per_device_count"] = np.zeros(
+                    int(np.prod(list(mesh.shape.values()))), np.int32)
+                out["n_dropped"] = np.int32(0)
+            return out
 
         if mesh is None:
             key = vals if not descending else \
@@ -445,12 +442,24 @@ class Query:
             return {"values": vals[order], "positions": poss[order]}
 
         from ..parallel.sort import make_distributed_sort
-        dp = mesh.shape["dp"]
+        # the sort flattens the caller's (sp, dp) mesh into its own 1-D
+        # dp axis — the concat below must walk ALL its buckets, not the
+        # caller mesh's dp size
+        sort_devices = list(mesh.devices.reshape(-1))
+        dp = len(sort_devices)
         n = len(vals)
+        if poss.dtype != np.int32:
+            # slab payloads are int32; past 2^31 rows a cast would wrap
+            # row identity silently — refuse instead
+            if n and int(poss.max()) > (1 << 31) - 1:
+                raise StromError(
+                    34, "mesh order_by row positions exceed int32; "
+                    "tables past 2^31 rows need the local sort path")
+            poss = poss.astype(np.int32)
         capacity = max(64, -(-n * 5 // (2 * dp * dp)))  # 2.5x balance slack
         while True:
             run_sort, _ = make_distributed_sort(
-                list(mesh.devices.reshape(-1)), capacity=capacity,
+                sort_devices, capacity=capacity,
                 dtype=dt, descending=descending)
             out = run_sort(vals, poss)
             if int(out["n_dropped"]) == 0:

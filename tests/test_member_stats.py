@@ -72,10 +72,14 @@ def test_slow_member_visible_python_path(tmp_path):
     for m in range(4):
         assert delta(m, "nreq") > 0
         assert delta(m, "bytes") > 0
-    # ...but the slow member's average latency is the outlier
+    # ...but the slow member's average latency is the outlier.  Compare
+    # against the MEDIAN fast member: on this shared host a single fast
+    # leg can catch a multi-ms disk hiccup under full-suite load, and one
+    # spiky healthy member must not mask the genuinely slow one
     avg = {m: delta(m, "clk_ns") / delta(m, "nreq") for m in range(4)}
-    fast = [avg[m] for m in range(4) if m != SlowMemberStripe.SLOW_MEMBER]
-    assert avg[SlowMemberStripe.SLOW_MEMBER] > 2 * max(fast), avg
+    fast = sorted(avg[m] for m in range(4)
+                  if m != SlowMemberStripe.SLOW_MEMBER)
+    assert avg[SlowMemberStripe.SLOW_MEMBER] > 2 * fast[1], avg
 
 
 def test_native_member_attribution(tmp_path):

@@ -378,3 +378,31 @@ def test_order_by_nothing_selected_and_empty(heap, tmp_path):
     out = Query(path, schema).where(lambda cols: cols[0] > 10**6) \
         .order_by(0).run()
     assert len(out["values"]) == 0 and len(out["positions"]) == 0
+
+
+def test_order_by_sp_mesh_keeps_all_buckets(heap):
+    """An (sp=2, dp) caller mesh must not truncate the sorted output to
+    the caller's dp bucket count (review finding)."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    mesh = make_scan_mesh(jax.devices(), sp=2)
+    out = Query(path, schema).order_by(0).run(mesh=mesh)
+    want = np.sort(c0[vis != 0])
+    np.testing.assert_array_equal(out["values"], want)
+
+
+def test_order_by_mesh_empty_keeps_info_keys(heap):
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    mesh = make_scan_mesh(jax.devices())
+    out = Query(path, schema).where(lambda cols: cols[0] > 10**6) \
+        .order_by(0).run(mesh=mesh)
+    assert len(out["values"]) == 0
+    assert int(out["n_dropped"]) == 0
+    assert (np.asarray(out["per_device_count"]) == 0).all()

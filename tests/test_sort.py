@@ -93,3 +93,23 @@ def test_sort_float_special_values():
     out = run(values)
     v, _ = _collect(out)
     np.testing.assert_array_equal(v, np.sort(values))
+
+
+def test_sort_property_random():
+    """Property-style sweep: random sizes, ranges, duplicates, and valid
+    densities all reduce to np.sort (the independent oracle)."""
+    rng = np.random.default_rng(17)
+    run, mesh = make_distributed_sort(jax.devices(), capacity=4096)
+    for trial in range(8):
+        n = int(rng.integers(1, 3000))
+        lo, hi = sorted(rng.integers(-1000, 1000, 2).tolist()) or [0, 1]
+        if lo == hi:
+            hi += 1
+        values = rng.integers(lo, hi, n).astype(np.int32)
+        valid = rng.random(n) < rng.random()
+        out = run(values, valid_np=valid)
+        assert int(out["n_dropped"]) == 0, trial
+        counts = np.asarray(out["count"])
+        v = np.concatenate([np.asarray(out["values"])[b][:counts[b]]
+                            for b in range(len(counts))])
+        np.testing.assert_array_equal(v, np.sort(values[valid]), err_msg=str(trial))
