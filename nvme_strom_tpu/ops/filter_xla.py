@@ -16,7 +16,7 @@ import numpy as np
 
 from ..scan.heap import HEADER_WORDS, PAGE_SIZE, HeapSchema
 
-__all__ = ["decode_pages", "scan_filter_step", "make_filter_fn"]
+__all__ = ["decode_pages", "scan_filter_step", "make_filter_fn", "global_row_positions"]
 
 _WORDS = PAGE_SIZE // 4
 

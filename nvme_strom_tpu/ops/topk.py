@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..scan.heap import HeapSchema, PAGE_SIZE
-from .filter_xla import DEFAULT_SCHEMA, decode_pages
+from .filter_xla import DEFAULT_SCHEMA, decode_pages, \
+    global_row_positions
 
 __all__ = ["make_topk_fn", "combine_topk", "scan_topk_step"]
 
@@ -45,7 +46,6 @@ def make_topk_fn(schema: HeapSchema, col: int, k: int, *,
     else:
         info = np.iinfo(dt)
         worst = np.array(info.min if largest else info.max, dt)
-    t = schema.tuples_per_page
 
     def key_of(v):
         # order-reversing key for smallest-k that cannot overflow: unary
@@ -62,7 +62,6 @@ def make_topk_fn(schema: HeapSchema, col: int, k: int, *,
             valid & predicate(cols, *params)
         v = cols[col]
         # global row ids from the page header, not the batch position
-        from .filter_xla import global_row_positions
         pos = global_row_positions(pages_u8, schema)
         flat_v = jnp.where(sel, v, worst).reshape(-1)
         flat_p = jnp.where(sel, pos, -1).reshape(-1)

@@ -19,8 +19,8 @@ and :meth:`Query.explain` shows the chosen plan the way ``EXPLAIN`` shows
 the reference's custom scan node.
 
 One terminal operator per query (it is one scan node): ``aggregate`` |
-``group_by`` | ``top_k`` | ``join``.  Predicates are plain jnp lambdas
-over decoded columns — ``lambda cols: cols[0] > 10``.
+``group_by`` | ``top_k`` | ``order_by`` | ``join``.  Predicates are plain
+jnp lambdas over decoded columns — ``lambda cols: cols[0] > 10``.
 """
 
 from __future__ import annotations
@@ -371,12 +371,15 @@ class Query:
         the planned access path, then sort — distributed sample sort on a
         mesh, one-device lax sort locally.  Returns the flat global order
         ``{"values", "positions"}`` (+ ``per_device_count``/``n_dropped``
-        info keys in mesh mode)."""
+        info keys in mesh mode).
+
+        The gather phase runs on one local device even in mesh mode (the
+        sort collectives are the distributed piece); for multi-host
+        gather-side sharding, stream via ``load_pages_sharded`` and feed
+        :func:`..parallel.sort.make_distributed_sort` directly."""
         import jax
-        import jax.numpy as jnp
 
         from ..ops.filter_xla import decode_pages
-        from ..scan.heap import PAGE_SIZE as _PS
         col, descending = self._order
         if not 0 <= col < self.schema.n_cols:
             raise StromError(22, f"order_by column {col} out of range")
@@ -420,7 +423,10 @@ class Query:
                     src.close()
         else:
             self._vfs_scan(collect, None, device)
-        pos_np_t = np.int64 if jax.config.jax_enable_x64 else np.int32
+        # positions normalize to int32 on the mesh path (slab payload
+        # width); keep the empty case's dtype consistent with that
+        pos_np_t = np.int32 if mesh is not None else (
+            np.int64 if jax.config.jax_enable_x64 else np.int32)
         if chunks:
             vals = np.concatenate([c[0] for c in chunks])
             poss = np.concatenate([c[1] for c in chunks])
@@ -428,7 +434,7 @@ class Query:
             vals = np.zeros(0, dt)
             poss = np.zeros(0, pos_np_t)
         if len(vals) == 0:   # empty source or nothing selected
-            out = {"values": vals, "positions": poss}
+            out = {"values": vals, "positions": poss.astype(pos_np_t)}
             if mesh is not None:   # keep the mesh contract's info keys
                 out["per_device_count"] = np.zeros(
                     int(np.prod(list(mesh.shape.values()))), np.int32)

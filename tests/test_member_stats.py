@@ -28,12 +28,15 @@ class DirectStripe(StripedSource):
 
 
 class SlowMemberStripe(DirectStripe):
-    """Member 1 is 5ms slower per request (a degraded disk in the set).
+    """Member 1 is 50ms slower per request (a degraded disk in the set).
     Overriding the read leg routes through the Python path, where
-    per-member accounting happens inline."""
+    per-member accounting happens inline.  The delay is far above this
+    shared host's disk-hiccup noise (multi-ms under full-suite load) so
+    the latency-outlier assertion cannot flake on a healthy member's
+    spike."""
 
     SLOW_MEMBER = 1
-    DELAY_S = 0.005
+    DELAY_S = 0.05
 
     def read_member_direct(self, member, file_off, dest):
         if member == self.SLOW_MEMBER:

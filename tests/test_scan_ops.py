@@ -578,3 +578,32 @@ def test_join_matches_numpy_oracle(tmp_path):
     with pytest.raises(ValueError):
         make_join_fn(schema, 0, np.array([1, 1], np.int32),
                      np.array([2, 3], np.int32))  # duplicate keys
+
+
+def test_mesh_stream_surfaces_injected_fault(tmp_path):
+    """A mid-stream injected read error must surface as StromError from
+    the sharded batch stream (error retention holds through the mesh
+    pipeline) and the stream must still close cleanly."""
+    import jax
+    import pytest as _pytest
+
+    from nvme_strom_tpu.api import StromError
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    from nvme_strom_tpu.parallel.stream import ShardedBatchStream
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE
+    from nvme_strom_tpu.testing import (FakeNvmeSource, FaultPlan,
+                                        make_test_file)
+
+    path = str(tmp_path / "f.bin")
+    n_pages = 32
+    make_test_file(path, n_pages * PAGE_SIZE)
+    mesh = make_scan_mesh(jax.devices(), sp=1)
+    src = FakeNvmeSource(path, force_cached_fraction=0.0,
+                         fault_plan=FaultPlan(fail_offsets={8 * PAGE_SIZE}))
+    try:
+        with _pytest.raises(StromError):
+            with ShardedBatchStream(src, mesh, batch_pages=8) as stream:
+                for _first, _arr in stream:
+                    pass
+    finally:
+        src.close()

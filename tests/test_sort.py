@@ -102,7 +102,7 @@ def test_sort_property_random():
     run, mesh = make_distributed_sort(jax.devices(), capacity=4096)
     for trial in range(8):
         n = int(rng.integers(1, 3000))
-        lo, hi = sorted(rng.integers(-1000, 1000, 2).tolist()) or [0, 1]
+        lo, hi = sorted(rng.integers(-1000, 1000, 2).tolist())
         if lo == hi:
             hi += 1
         values = rng.integers(lo, hi, n).astype(np.int32)
