@@ -26,6 +26,7 @@ import errno as _errno
 import json
 import os
 import struct
+import tempfile
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -64,6 +65,10 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
     RAM→SSD write path (``memcpy_ram2ssd``) — O_DIRECT, merge-planned,
     page-cache-free — which keeps a large save from evicting the page
     cache the rest of the host is using.
+
+    Crash-safe: bytes land in a same-directory temp file that is fsynced
+    and atomically renamed over *path* — a failure mid-save never
+    corrupts an existing checkpoint at *path*.
     """
     import jax
 
@@ -85,24 +90,57 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
     header = json.dumps({"version": _VERSION, "leaves": entries}).encode()
     header_len = _pad(16 + len(header))
     end = header_len + off
-    with open(path, "wb") as f:
-        f.write(struct.pack("<QQ", _MAGIC, len(header)))
-        f.write(header)
-        f.write(b"\0" * (header_len - 16 - len(header)))
-        if not direct:
-            # stream one leaf at a time: peak extra host memory = one leaf
-            for e, (key, leaf) in zip(entries, flat):
-                f.seek(header_len + e["offset"])
-                arr = np.ascontiguousarray(np.asarray(leaf))
-                if arr.dtype.str != e["dtype"]:
-                    arr = arr.astype(np.dtype(e["dtype"]))
-                f.write(arr.data if arr.shape else arr.tobytes())
-        f.truncate(_pad(end))
-        f.flush()
-        os.fsync(f.fileno())
-    if direct:
-        _save_leaves_direct(path, entries, flat, header_len,
-                            session, staging_bytes)
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    # sweep temp litter from hard-killed saves (checkpoint-sized files
+    # nothing else would ever reclaim)
+    for stale in os.listdir(directory):
+        if stale.startswith(base + ".tmp."):
+            try:
+                os.unlink(os.path.join(directory, stale))
+            except OSError:
+                pass
+    # mkstemp: unique per save, so concurrent savers to one path cannot
+    # truncate each other's in-flight temp (same pattern as stats.export)
+    tmp_fd, tmp = tempfile.mkstemp(dir=directory, prefix=base + ".tmp.")
+    try:
+        with os.fdopen(tmp_fd, "wb") as f:
+            f.write(struct.pack("<QQ", _MAGIC, len(header)))
+            f.write(header)
+            f.write(b"\0" * (header_len - 16 - len(header)))
+            if not direct:
+                # stream one leaf at a time: peak extra host memory = one
+                # leaf
+                for e, (key, leaf) in zip(entries, flat):
+                    f.seek(header_len + e["offset"])
+                    arr = np.ascontiguousarray(np.asarray(leaf))
+                    if arr.dtype.str != e["dtype"]:
+                        arr = arr.astype(np.dtype(e["dtype"]))
+                    f.write(arr.data if arr.shape else arr.tobytes())
+            f.truncate(_pad(end))
+            f.flush()
+            os.fsync(f.fileno())
+        if direct:
+            _save_leaves_direct(tmp, entries, flat, header_len,
+                                session, staging_bytes)
+        os.replace(tmp, path)
+        try:
+            dirfd = os.open(directory, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)     # persist the rename itself
+            finally:
+                os.close(dirfd)
+        except OSError:
+            # the checkpoint IS installed at this point; a directory-fsync
+            # refusal (weird fs, EACCES) only weakens rename durability —
+            # failing the whole save here would misreport installed state
+            pass
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return {"path": path, "leaves": len(entries), "bytes": _pad(end)}
 
 

@@ -92,6 +92,8 @@ def main(argv=None) -> int:
         if name == "bench":
             p.add_argument("--loops", type=int, default=1)
     args = ap.parse_args(argv)
+    from .common import apply_platform_env
+    apply_platform_env()   # broken-tunnel escape hatch, like ssd2tpu_test
     if args.cmd == "info":
         return _info(args.file)
     if args.cmd == "verify":

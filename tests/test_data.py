@@ -372,3 +372,49 @@ def test_checkpoint_direct_save_roundtrip(tmp_path):
     p2 = str(tmp_path / "b.strom")
     save_checkpoint(p2, tree)
     assert open(path, "rb").read() == open(p2, "rb").read()
+
+
+def test_save_checkpoint_crash_safe(tmp_path):
+    """A failure mid-save must leave an existing checkpoint at the path
+    untouched (temp-file + atomic rename discipline)."""
+    import numpy as np
+    import pytest
+
+    from nvme_strom_tpu.data import restore_checkpoint, save_checkpoint
+
+    path = str(tmp_path / "ck.strom")
+    good = {"w": np.arange(64, dtype=np.float32).reshape(8, 8)}
+    save_checkpoint(path, good)
+
+    class Boom:
+        dtype = np.dtype(np.float32)
+        shape = (4,)
+
+        def __array__(self, dtype=None, copy=None):
+            raise RuntimeError("leaf serialization boom")
+
+    with pytest.raises(RuntimeError):
+        save_checkpoint(path, {"w": Boom()})
+    # the original survives, bit-exact, and no temp litter remains
+    import os as _os
+    out = restore_checkpoint(path)
+    np.testing.assert_array_equal(np.asarray(out["['w']"]), good["w"])
+    assert not [p for p in _os.listdir(tmp_path) if ".tmp." in p]
+
+
+def test_save_checkpoint_sweeps_stale_tmp(tmp_path):
+    """Temp litter from a hard-killed save is reclaimed by the next save
+    (checkpoint-sized files nothing else would delete)."""
+    import os as _os
+
+    import numpy as np
+
+    from nvme_strom_tpu.data import save_checkpoint
+
+    path = str(tmp_path / "ck.strom")
+    litter = str(tmp_path / "ck.strom.tmp.dead123")
+    with open(litter, "wb") as f:
+        f.write(b"\0" * 4096)
+    save_checkpoint(path, {"w": np.zeros(8, np.float32)})
+    assert not _os.path.exists(litter)
+    assert _os.path.exists(path)
