@@ -27,6 +27,7 @@ import json
 import os
 import struct
 import tempfile
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -39,6 +40,9 @@ __all__ = ["save_checkpoint", "restore_checkpoint", "checkpoint_info"]
 
 _MAGIC = 0x53544B50_54505531  # "STKP" "TPU1"
 _ALIGN = 4096
+# temp litter younger than this may be a live concurrent save; only
+# older files are swept (an in-flight writer touches its temp constantly)
+_TMP_SWEEP_AGE_S = 3600.0
 _CHUNK = 4096          # restore chunk grid; contiguous ids merge to dma_max
 _VERSION = 1
 
@@ -90,20 +94,33 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
     header = json.dumps({"version": _VERSION, "leaves": entries}).encode()
     header_len = _pad(16 + len(header))
     end = header_len + off
-    directory = os.path.dirname(os.path.abspath(path)) or "."
+    # write through symlinks ('latest.strom -> step-N.strom' layouts):
+    # os.replace on the link path would swap the link for a regular file
+    # and leave the target stale
+    path = os.path.realpath(path)
+    directory = os.path.dirname(path) or "."
     base = os.path.basename(path)
     # sweep temp litter from hard-killed saves (checkpoint-sized files
-    # nothing else would ever reclaim)
+    # nothing else would ever reclaim) — but only litter OLD enough that
+    # it cannot be a concurrent saver's in-flight temp
+    now = time.time()
     for stale in os.listdir(directory):
         if stale.startswith(base + ".tmp."):
+            sp = os.path.join(directory, stale)
             try:
-                os.unlink(os.path.join(directory, stale))
+                if now - os.path.getmtime(sp) > _TMP_SWEEP_AGE_S:
+                    os.unlink(sp)
             except OSError:
                 pass
     # mkstemp: unique per save, so concurrent savers to one path cannot
     # truncate each other's in-flight temp (same pattern as stats.export)
     tmp_fd, tmp = tempfile.mkstemp(dir=directory, prefix=base + ".tmp.")
     try:
+        # mkstemp's 0600 would stick after the rename; honor the umask
+        # like the old open(path, 'wb') writer did
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(tmp_fd, 0o666 & ~umask)
         with os.fdopen(tmp_fd, "wb") as f:
             f.write(struct.pack("<QQ", _MAGIC, len(header)))
             f.write(header)

@@ -129,18 +129,49 @@ def check_native_signature() -> bool:
     return _report("signature", OK, f"python {__version__}; {sig}")
 
 
-def check_jax() -> bool:
+def check_jax(timeout_s: float = 45.0) -> bool:
+    """Device probe in a KILLABLE subprocess: a wedged accelerator tunnel
+    hangs backend init indefinitely, and the doctor must diagnose that
+    state, not inherit it (the very failure bench.py's probe/backoff
+    works around)."""
+    import subprocess
+    import sys
+    code = ("import jax\n"
+            "d = jax.devices()\n"
+            "print('PROBE', jax.__version__, len(d),"
+            " sorted({x.platform for x in d}))\n")
+    # Popen + bounded communicate, NOT subprocess.run: run()'s timeout
+    # handler kills then WAITS UNBOUNDED for the reap — a child wedged in
+    # uninterruptible (D-state) driver sleep never reaps, and the doctor
+    # would inherit the very hang it is diagnosing
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
     try:
-        import jax
-        devs = jax.devices()
-        kinds = {d.platform for d in devs}
-        status = OK if any(k != "cpu" for k in kinds) else WARN
-        return _report("jax", status,
-                       f"{jax.__version__}, {len(devs)} device(s) {sorted(kinds)}",
-                       "no accelerator visible; HBM loads will target CPU "
-                       "buffers")
-    except Exception as e:
-        return _report("jax", FAIL, f"import failed: {e}")
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            pass   # D-state child: report without reaping
+        return _report("jax", FAIL,
+                       f"accelerator backend unresponsive (device query "
+                       f"hung > {timeout_s:.0f}s)",
+                       "tunnel/driver wedged: leave it idle or restart "
+                       "the relay; CPU-path tools keep working with "
+                       "STROM_JAX_PLATFORMS=cpu")
+    out = subprocess.CompletedProcess(proc.args, proc.returncode,
+                                      stdout, stderr)
+    for line in out.stdout.splitlines():
+        if line.startswith("PROBE "):
+            _, ver, n, kinds = line.split(" ", 3)
+            status = OK if "cpu" != kinds.strip("[]'\"") else WARN
+            return _report("jax", status, f"{ver}, {n} device(s) {kinds}",
+                           "no accelerator visible; HBM loads will "
+                           "target CPU buffers")
+    return _report("jax", FAIL,
+                   f"device probe failed: {out.stderr.strip()[-200:]}")
 
 
 def check_backing(path: str) -> bool:

@@ -415,6 +415,65 @@ def test_save_checkpoint_sweeps_stale_tmp(tmp_path):
     litter = str(tmp_path / "ck.strom.tmp.dead123")
     with open(litter, "wb") as f:
         f.write(b"\0" * 4096)
+    _os.utime(litter, (1, 1))   # old: cannot be a live concurrent save
     save_checkpoint(path, {"w": np.zeros(8, np.float32)})
     assert not _os.path.exists(litter)
     assert _os.path.exists(path)
+
+
+def test_save_checkpoint_writes_through_symlink(tmp_path):
+    """'latest.strom -> step-N.strom' layouts: the save updates the link
+    TARGET (the old writer's semantics), never swaps the link for a file."""
+    import os as _os
+
+    import numpy as np
+
+    from nvme_strom_tpu.data import restore_checkpoint, save_checkpoint
+
+    target = str(tmp_path / "step-1000.strom")
+    link = str(tmp_path / "latest.strom")
+    save_checkpoint(target, {"w": np.zeros(8, np.float32)})
+    _os.symlink(target, link)
+    new = {"w": np.arange(8, dtype=np.float32)}
+    save_checkpoint(link, new)
+    assert _os.path.islink(link)
+    out = restore_checkpoint(target)   # the TARGET carries the new bytes
+    np.testing.assert_array_equal(np.asarray(out["['w']"]), new["w"])
+
+
+def test_save_checkpoint_honors_umask(tmp_path):
+    import os as _os
+
+    import numpy as np
+
+    from nvme_strom_tpu.data import save_checkpoint
+
+    path = str(tmp_path / "perm.strom")
+    old = _os.umask(0o022)
+    try:
+        save_checkpoint(path, {"w": np.zeros(4, np.float32)})
+    finally:
+        _os.umask(old)
+    assert _os.stat(path).st_mode & 0o777 == 0o644
+
+
+def test_save_checkpoint_sweep_spares_fresh_tmp(tmp_path):
+    """A FRESH temp (a concurrent saver's in-flight file) survives the
+    sweep; only old litter is reclaimed."""
+    import os as _os
+
+    import numpy as np
+
+    from nvme_strom_tpu.data import save_checkpoint
+
+    path = str(tmp_path / "ck.strom")
+    fresh = str(tmp_path / "ck.strom.tmp.live1")
+    with open(fresh, "wb") as f:
+        f.write(b"\0" * 128)
+    old_litter = str(tmp_path / "ck.strom.tmp.dead1")
+    with open(old_litter, "wb") as f:
+        f.write(b"\0" * 128)
+    _os.utime(old_litter, (1, 1))   # ancient mtime
+    save_checkpoint(path, {"w": np.zeros(4, np.float32)})
+    assert _os.path.exists(fresh)
+    assert not _os.path.exists(old_litter)

@@ -30,3 +30,44 @@ def test_strom_check_fails_on_bad_path(tmp_path):
          "--path", str(tmp_path / "nope")],
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 1
+
+
+def test_strom_check_jax_probe_diagnoses_hang(monkeypatch):
+    """A wedged accelerator backend must be diagnosed (FAIL row), not
+    inherited as a hang — the doctor probes in a killable subprocess."""
+    import subprocess
+
+    from nvme_strom_tpu.tools import strom_check
+
+    class FakeProc:
+        args = ["probe"]
+        returncode = None
+
+        def communicate(self, timeout=None):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+        def kill(self):
+            pass
+
+        def wait(self, timeout=None):
+            # a D-state child never reaps — wait() itself times out
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+    monkeypatch.setattr(subprocess, "Popen", lambda *a, **k: FakeProc())
+    assert strom_check.check_jax(timeout_s=0.1) is False
+
+
+def test_strom_check_jax_probe_ok(monkeypatch):
+    import subprocess
+    from nvme_strom_tpu.tools import strom_check
+
+    class FakeProc:
+        args = ["probe"]
+        returncode = 0
+
+        def communicate(self, timeout=None):
+            return "PROBE 0.9.0 8 ['cpu']\n", ""
+
+    monkeypatch.setattr(subprocess, "Popen", lambda *a, **k: FakeProc())
+    # cpu-only reports WARN (True return: warn is not a required failure)
+    assert strom_check.check_jax(timeout_s=5) is True
