@@ -43,6 +43,19 @@ _ALIGN = 4096
 # temp litter younger than this may be a live concurrent save; only
 # older files are swept (an in-flight writer touches its temp constantly)
 _TMP_SWEEP_AGE_S = 3600.0
+
+
+def _read_umask() -> int:
+    """Process umask, read once at import: os.umask(0);os.umask(x) is the
+    only portable read but opens a world-writable window — doing it while
+    the process is still single-threaded confines the race the per-save
+    read would rerun under concurrent savers."""
+    u = os.umask(0)
+    os.umask(u)
+    return u
+
+
+_UMASK = _read_umask()
 _CHUNK = 4096          # restore chunk grid; contiguous ids merge to dma_max
 _VERSION = 1
 
@@ -117,10 +130,8 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
     tmp_fd, tmp = tempfile.mkstemp(dir=directory, prefix=base + ".tmp.")
     try:
         # mkstemp's 0600 would stick after the rename; honor the umask
-        # like the old open(path, 'wb') writer did
-        umask = os.umask(0)
-        os.umask(umask)
-        os.fchmod(tmp_fd, 0o666 & ~umask)
+        # like a plain open(path, 'wb') writer would
+        os.fchmod(tmp_fd, 0o666 & ~_UMASK)
         with os.fdopen(tmp_fd, "wb") as f:
             f.write(struct.pack("<QQ", _MAGIC, len(header)))
             f.write(header)

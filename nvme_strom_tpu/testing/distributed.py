@@ -281,7 +281,33 @@ def _worker_main(process_id: int, num_processes: int, devices_per_proc: int,
         (int(folded["count"]), exp_count)
     result["checks"]["stream_fold"] = int(folded["count"])
 
-    # 4. sharded checkpoint restore: dp-sharded leaf + replicated scalar;
+    # 4. distributed sample sort: splitter election (all_gather) and the
+    #    capacity-bounded bucket exchange (all_to_all) across REAL process
+    #    boundaries — the collectives the psum-based checks don't touch
+    from ..parallel.sort import make_distributed_sort
+    rng = np.random.default_rng(99)
+    svals = rng.integers(-10_000, 10_000, 64 * n_global).astype(np.int32)
+    srun, _smesh = make_distributed_sort(jax.devices(),
+                                         capacity=len(svals))
+    sout = srun(svals)
+    assert int(np.asarray(sout["n_dropped"])) == 0
+    # counts are dp-sharded; gather the tiny vector so every process can
+    # compute the global bucket boundaries, then check only its own
+    # addressable value rows against the numpy oracle
+    from jax.experimental import multihost_utils
+    scounts = np.asarray(
+        multihost_utils.process_allgather(sout["count"],
+                                          tiled=True)).reshape(-1)
+    sorted_all = np.sort(svals)
+    bounds = np.concatenate([[0], np.cumsum(scounts)])
+    for shard in sout["values"].addressable_shards:
+        b = shard.index[0].start or 0
+        got = np.asarray(shard.data).reshape(-1)[:scounts[b]]
+        want = sorted_all[bounds[b]:bounds[b + 1]]
+        np.testing.assert_array_equal(got, want)
+    result["checks"]["dist_sort"] = int(scounts.sum())
+
+    # 5. sharded checkpoint restore: dp-sharded leaf + replicated scalar;
     #    oracle = raw bytes straight from the file (no framework code)
     ck_path = os.path.join(workdir, CKPT_NAME)
     meta = checkpoint_info(ck_path)

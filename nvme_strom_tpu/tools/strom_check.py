@@ -136,7 +136,14 @@ def check_jax(timeout_s: float = 45.0) -> bool:
     works around)."""
     import subprocess
     import sys
-    code = ("import jax\n"
+    # the child honors STROM_JAX_PLATFORMS exactly like the other tools
+    # (apply_platform_env): the doctor's own remediation advice must work
+    # when the user applies it
+    code = ("import os\n"
+            "import jax\n"
+            "p = os.environ.get('STROM_JAX_PLATFORMS')\n"
+            "if p:\n"
+            "    jax.config.update('jax_platforms', p)\n"
             "d = jax.devices()\n"
             "print('PROBE', jax.__version__, len(d),"
             " sorted({x.platform for x in d}))\n")
@@ -161,9 +168,7 @@ def check_jax(timeout_s: float = 45.0) -> bool:
                        "tunnel/driver wedged: leave it idle or restart "
                        "the relay; CPU-path tools keep working with "
                        "STROM_JAX_PLATFORMS=cpu")
-    out = subprocess.CompletedProcess(proc.args, proc.returncode,
-                                      stdout, stderr)
-    for line in out.stdout.splitlines():
+    for line in stdout.splitlines():
         if line.startswith("PROBE "):
             _, ver, n, kinds = line.split(" ", 3)
             status = OK if "cpu" != kinds.strip("[]'\"") else WARN
@@ -171,7 +176,7 @@ def check_jax(timeout_s: float = 45.0) -> bool:
                            "no accelerator visible; HBM loads will "
                            "target CPU buffers")
     return _report("jax", FAIL,
-                   f"device probe failed: {out.stderr.strip()[-200:]}")
+                   f"device probe failed: {stderr.strip()[-200:]}")
 
 
 def check_backing(path: str) -> bool:

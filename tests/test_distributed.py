@@ -25,7 +25,8 @@ def test_multi_process_distributed(tmp_path, nproc, dpp):
         assert r["n_local"] == dpp
         # every proof ran
         assert set(r["checks"]) == {"sharded_load", "scan_step",
-                                    "stream_fold", "ckpt_restore"}
+                                    "stream_fold", "dist_sort",
+                                    "ckpt_restore"}
     # each process loaded exactly its share of the rows (2 pages/device)
     n_pages = 2 * nproc * dpp
     assert all(r["checks"]["sharded_load"] == n_pages // nproc
