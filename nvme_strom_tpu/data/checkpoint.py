@@ -46,16 +46,26 @@ _TMP_SWEEP_AGE_S = 3600.0
 
 
 def _read_umask() -> int:
-    """Process umask, read once at import: os.umask(0);os.umask(x) is the
-    only portable read but opens a world-writable window — doing it while
-    the process is still single-threaded confines the race the per-save
-    read would rerun under concurrent savers."""
-    u = os.umask(0)
+    """Current process umask WITHOUT the mutating os.umask(0) dance (which
+    opens a world-writable window for other threads): Linux exposes it in
+    /proc/self/status.  Falls back to the import-time snapshot below."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("Umask:"):
+                    return int(line.split()[1], 8)
+    except (OSError, ValueError, IndexError):
+        pass
+    return _UMASK_AT_IMPORT
+
+
+def _umask_at_import() -> int:
+    u = os.umask(0)   # import runs single-threaded; window is confined
     os.umask(u)
     return u
 
 
-_UMASK = _read_umask()
+_UMASK_AT_IMPORT = _umask_at_import()
 _CHUNK = 4096          # restore chunk grid; contiguous ids merge to dma_max
 _VERSION = 1
 
@@ -131,7 +141,7 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
     try:
         # mkstemp's 0600 would stick after the rename; honor the umask
         # like a plain open(path, 'wb') writer would
-        os.fchmod(tmp_fd, 0o666 & ~_UMASK)
+        os.fchmod(tmp_fd, 0o666 & ~_read_umask())
         with os.fdopen(tmp_fd, "wb") as f:
             f.write(struct.pack("<QQ", _MAGIC, len(header)))
             f.write(header)

@@ -1273,23 +1273,31 @@ class Session:
         self._task_put(task, err)
 
     # -- stats + lifecycle -------------------------------------------------
+    def _fold_native_stats(self) -> dict:
+        """Fold the native engine's counter deltas into the global
+        registry (returns the raw delta dict).  Called from stat_info and
+        from close() — a session must not take its I/O accounting to the
+        grave just because nobody snapshotted before it closed."""
+        d = self._native.stats_delta()
+        # nr/clk_ssd2dev + wait are counted per *Python* task already;
+        # resubmit/sq_full ride the reference's spare debug counters
+        stats.merge_native({
+            "nr_submit_dma": d.get("nr_submit_dma", 0),
+            "clk_submit_dma": d.get("clk_submit_dma", 0),
+            "total_dma_length": d.get("total_dma_length", 0),
+            "nr_debug1": d.get("nr_resubmit", 0),
+            "nr_debug2": d.get("nr_sq_full", 0),
+        })
+        # per-member deltas fold into the registry the same way
+        for m, (nreq, nbytes, ns) in self._native.member_stats_delta(
+                sorted(self._members_used)).items():
+            stats.member_add(m, nbytes, ns, n=nreq)
+        return d
+
     def stat_info(self, *, debug: bool = False):
         snap = None
         if self._native is not None:
-            d = self._native.stats_delta()
-            # nr/clk_ssd2dev + wait are counted per *Python* task already;
-            # resubmit/sq_full ride the reference's spare debug counters
-            stats.merge_native({
-                "nr_submit_dma": d.get("nr_submit_dma", 0),
-                "clk_submit_dma": d.get("clk_submit_dma", 0),
-                "total_dma_length": d.get("total_dma_length", 0),
-                "nr_debug1": d.get("nr_resubmit", 0),
-                "nr_debug2": d.get("nr_sq_full", 0),
-            })
-            # per-member deltas fold into the registry the same way
-            for m, (nreq, nbytes, ns) in self._native.member_stats_delta(
-                    sorted(self._members_used)).items():
-                stats.member_add(m, nbytes, ns, n=nreq)
+            d = self._fold_native_stats()
             snap = stats.snapshot(debug=debug)
             # gauges combine at snapshot time (never merged into the registry)
             snap.counters["cur_dma_count"] += d.get("cur_dma_count", 0)
@@ -1322,6 +1330,10 @@ class Session:
         self._pool.shutdown(wait=True)
         if self._native is not None:
             self._native.reap(timeout_ms=int(timeout * 1000))
+            try:
+                self._fold_native_stats()
+            except StromError:
+                pass
             self._native.close()
         return reaped
 

@@ -257,16 +257,22 @@ class TableScanner:
         synchronization is the final fetch."""
         import jax
 
+        from ..hbm.staging import safe_device_put
         dev = device or jax.devices()[0]
         acc: Optional[dict] = None
         with ResourceOwner("scan_filter") as owner:
             for batch in self.batches(owner=owner):
-                dev_pages = jax.device_put(batch.pages, dev)
-                # fence: device_put is async and batch.pages is a view into a
-                # pool chunk that is recycled (and re-filled by the next SSD
-                # DMA) as soon as the next batch is drawn — the H2D read must
-                # complete first.  The DMA ring keeps progressing in native
-                # threads while we wait, so overlap is preserved.
+                # safe_device_put, NOT jax.device_put: batch.pages is a
+                # view into a pool chunk, and CPU-backend device_put
+                # zero-copy ALIASES it — the async filter compute would
+                # read the chunk after recycle+refill (silent wrong
+                # aggregates; caught by a cold-file 64KB-chunk scan)
+                dev_pages = safe_device_put(batch.pages, dev)
+                # fence: device_put is async and batch.pages is recycled
+                # (and re-filled by the next SSD DMA) as soon as the next
+                # batch is drawn — the H2D read must complete first.  The
+                # DMA ring keeps progressing in native threads while we
+                # wait, so overlap is preserved.
                 dev_pages.block_until_ready()
                 acc = fold_results(acc, filter_fn(dev_pages), combine)
         if acc is None:

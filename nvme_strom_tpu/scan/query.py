@@ -292,12 +292,52 @@ class Query:
 
     # -- execution ----------------------------------------------------------
     def run(self, *, mesh=None, device=None, kernel: str = "auto",
-            batch_pages: Optional[int] = None, session=None) -> dict:
+            batch_pages: Optional[int] = None, session=None,
+            analyze: bool = False) -> dict:
         """Execute the planned scan and return numpy results.
 
         ``kernel`` overrides the planner's pallas/XLA choice ("auto" |
         "pallas" | "xla").  With *mesh*, batches stream sharded over the
-        mesh's ``dp`` axis and XLA inserts the reduction collectives."""
+        mesh's ``dp`` axis and XLA inserts the reduction collectives.
+        ``analyze=True`` attaches an ``"_analyze"`` key — elapsed wall
+        time plus the engine's stage counters for this run (the EXPLAIN
+        ANALYZE face of the STAT_INFO registry,
+        kmod/nvme_strom.c:2056-2103)."""
+        if analyze:
+            import time as _time
+
+            from ..stats import stats as _stats
+
+            def _fold(sess):
+                # a caller-supplied session keeps its native-engine
+                # counters until stat_info/close; fold them so both
+                # snapshots see this run's I/O (not some later window's)
+                if sess is not None and getattr(sess, "_native", None) \
+                        is not None:
+                    sess._fold_native_stats()
+
+            _fold(session)
+            before = _stats.snapshot(reset_max=False).counters
+            t0 = _time.monotonic()
+            out = self.run(mesh=mesh, device=device, kernel=kernel,
+                           batch_pages=batch_pages, session=session)
+            dt = _time.monotonic() - t0
+            _fold(session)
+            after = _stats.snapshot(reset_max=False).counters
+            d = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in ("total_dma_length", "nr_submit_dma",
+                           "nr_ioctl_memcpy_wait", "nr_wrong_wakeup")}
+            nsub = max(d["nr_submit_dma"], 1)
+            out["_analyze"] = {
+                "elapsed_s": round(dt, 6),
+                "bytes_direct": int(d["total_dma_length"]),
+                "requests": int(d["nr_submit_dma"]),
+                "avg_dma_bytes": int(d["total_dma_length"] // nsub),
+                "waits": int(d["nr_ioctl_memcpy_wait"]),
+                "scan_GBps": round(d["total_dma_length"] / dt / (1 << 30), 3)
+                if dt > 0 else None,
+            }
+            return out
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")

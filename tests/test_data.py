@@ -443,19 +443,21 @@ def test_save_checkpoint_writes_through_symlink(tmp_path):
 
 def test_save_checkpoint_honors_umask(tmp_path):
     """Saved checkpoints carry normal umask-derived modes, not mkstemp's
-    0600 (the umask is cached at import — single-threaded — because the
-    only portable read mutates it)."""
+    0600 — including a umask changed AFTER import (read mutation-free
+    from /proc/self/status)."""
     import os as _os
 
     import numpy as np
 
-    from nvme_strom_tpu.data import checkpoint as _ck
     from nvme_strom_tpu.data import save_checkpoint
 
     path = str(tmp_path / "perm.strom")
-    save_checkpoint(path, {"w": np.zeros(4, np.float32)})
-    assert _os.stat(path).st_mode & 0o777 == 0o666 & ~_ck._UMASK
-    assert _os.stat(path).st_mode & 0o777 != 0o600 or _ck._UMASK == 0o066
+    old = _os.umask(0o027)
+    try:
+        save_checkpoint(path, {"w": np.zeros(4, np.float32)})
+    finally:
+        _os.umask(old)
+    assert _os.stat(path).st_mode & 0o777 == 0o640
 
 
 def test_save_checkpoint_sweep_spares_fresh_tmp(tmp_path):

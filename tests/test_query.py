@@ -406,3 +406,32 @@ def test_order_by_mesh_empty_keeps_info_keys(heap):
     assert len(out["values"]) == 0
     assert int(out["n_dropped"]) == 0
     assert (np.asarray(out["per_device_count"]) == 0).all()
+
+
+def test_run_analyze_reports_io_breakdown(heap):
+    """EXPLAIN ANALYZE face: analyze=True attaches elapsed time + the
+    engine's stage counters for THIS run (STAT_INFO delta)."""
+    import os
+
+    path, schema, c0, c1, vis = heap
+    # fsync + fadvise so the direct path engages (a freshly written file
+    # is 100% cached/dirty and would ride the write-back path)
+    fd = os.open(path, os.O_RDONLY)
+    os.fsync(fd)
+    os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    os.close(fd)
+    config.set("debug_no_threshold", True)
+    # the 24-page table must span several chunks or it is all buffered
+    # tail (the default 16MB chunk swallows it whole)
+    config.set("chunk_size", "64k")   # order matters: buffer is a
+    config.set("buffer_size", "1m")   # multiple-of-chunk invariant
+    out = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .run(analyze=True)
+    a = out["_analyze"]
+    assert a["elapsed_s"] > 0
+    assert a["requests"] >= 1
+    assert a["bytes_direct"] >= 24 * 8192 * 0.5   # most pages direct
+    assert 0 < a["avg_dma_bytes"] <= config.get("dma_max_size")
+    # the query result itself is unchanged
+    sel = (vis != 0) & (c0 > 0)
+    assert int(out["count"]) == int(sel.sum())

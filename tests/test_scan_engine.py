@@ -370,3 +370,44 @@ def test_rescan_reruns_table(tmp_path):
     for out in (first, again):
         assert int(out["count"]) == int(sel.sum())
         assert int(out["sum"]) == int(c1[sel].sum())
+
+
+def test_scan_filter_cold_multichunk_exact(tmp_path):
+    """Cold-file multi-chunk scan_filter must be exact: the CPU backend's
+    zero-copy device_put aliased the recycled pool chunk and silently
+    corrupted aggregates (regression: 64KB chunks, 32 batches)."""
+    import os
+
+    import numpy as np
+
+    from nvme_strom_tpu import config
+    from nvme_strom_tpu.ops.filter_xla import make_filter_fn
+    from nvme_strom_tpu.scan.executor import TableScanner
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+
+    rng = np.random.default_rng(5)
+    schema = HeapSchema(n_cols=2, visibility=True)
+    n = schema.tuples_per_page * 256
+    c0 = rng.integers(-1000, 1000, n).astype(np.int32)
+    c1 = rng.integers(0, 16, n).astype(np.int32)
+    vis = (rng.random(n) > 0.2).astype(np.int32)
+    path = str(tmp_path / "cold.heap")
+    build_heap_file(path, [c0, c1], schema, visibility=vis)
+    fd = os.open(path, os.O_RDONLY)
+    os.fsync(fd)
+    os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    os.close(fd)
+
+    config.set("chunk_size", "64k")
+    config.set("buffer_size", "1m")
+    fn = make_filter_fn(schema, lambda cols: cols[0] > 0)
+    sel = (vis != 0) & (c0 > 0)
+    for trial in range(3):   # the race was intermittent; hammer it
+        if trial:
+            fd = os.open(path, os.O_RDONLY)
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+            os.close(fd)
+        with TableScanner(path, schema, numa_bind=False) as sc:
+            out = sc.scan_filter(fn)
+        assert int(out["count"]) == int(sel.sum()), trial
+        assert int(out["sums"][0]) == int(c0[sel].sum()), trial
