@@ -1,11 +1,12 @@
 from .dscan import make_distributed_scan_step, shard_pages
 from .mesh import make_scan_mesh, pages_sharding
 from .ring import make_ring_multi_query_scan
-from .sort import make_distributed_sort
+from .sort import make_distributed_distinct, make_distributed_sort
 from .stream import (ShardedBatchStream, distributed_scan_filter,
                      load_pages_sharded)
 
 __all__ = ["make_distributed_scan_step", "shard_pages", "make_scan_mesh",
            "pages_sharding", "make_ring_multi_query_scan",
-           "make_distributed_sort", "load_pages_sharded",
+           "make_distributed_sort", "make_distributed_distinct",
+           "load_pages_sharded",
            "ShardedBatchStream", "distributed_scan_filter"]

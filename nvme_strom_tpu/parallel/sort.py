@@ -38,14 +38,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import make_scan_mesh
 
-__all__ = ["make_distributed_sort"]
+__all__ = ["make_distributed_sort", "make_distributed_distinct"]
 
 _I32_MAX = np.int32((1 << 31) - 1)
 
 
 def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
                           capacity: int, dtype=np.int32,
-                          descending: bool = False):
+                          descending: bool = False,
+                          with_payload: bool = True):
     """Build the jitted distributed sort over a 1-D ``dp`` mesh.
 
     ``capacity`` — received-elements bound per (sender, receiver) pair;
@@ -65,6 +66,10 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
 
     Global order = concatenation of row ``b``'s first ``count[b]``
     elements for ``b = 0..dp-1``.
+
+    ``with_payload=False`` drops the payload column from the all_to_all
+    slab (halves exchange bytes; ``payload`` is then absent from the
+    result) — for value-only consumers like COUNT(DISTINCT).
     """
     mesh = make_scan_mesh(devices, sp=1)
     dp = mesh.shape["dp"]
@@ -104,8 +109,9 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
                                   side="right").astype(jnp.int32)
         vbits = jax.lax.bitcast_convert_type(values, jnp.int32) \
             if is_f else values
+        cols = [vbits, payload] if with_payload else [vbits]
         recv, counts, keep = bucket_dispatch(
-            jnp.stack([vbits, payload], -1), bucket, valid, dp, capacity)
+            jnp.stack(cols, -1), bucket, valid, dp, capacity)
         n_dropped = jnp.sum(valid) - jnp.sum(keep)
 
         # 4. local sort of the received bucket; pad slots (slot >= its
@@ -117,17 +123,25 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
         if is_f:
             rv = jax.lax.bitcast_convert_type(rv, jnp.float32)
         rv = jnp.where(got, rv, worst)
-        rp = jnp.where(got, recv[:, 1], -1)
-        _, sv, sp = jax.lax.sort((key_of(rv), rv, rp), num_keys=1)
-        return {"values": sv[None], "payload": sp[None],
-                "count": jnp.sum(counts)[None],
-                "n_dropped": jax.lax.psum(n_dropped, "dp")}
+        out = {"count": jnp.sum(counts)[None],
+               "n_dropped": jax.lax.psum(n_dropped, "dp")}
+        if with_payload:
+            rp = jnp.where(got, recv[:, 1], -1)
+            _, sv, sp = jax.lax.sort((key_of(rv), rv, rp), num_keys=1)
+            out["values"], out["payload"] = sv[None], sp[None]
+        else:
+            sv = jax.lax.sort_key_val(key_of(rv), rv)[1]
+            out["values"] = sv[None]
+        return out
 
+    out_specs = {"values": P("dp", None), "count": P("dp"),
+                 "n_dropped": P()}
+    if with_payload:
+        out_specs["payload"] = P("dp", None)
     shard_mapped = jax.shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp"), P("dp"), P("dp")),
-        out_specs={"values": P("dp", None), "payload": P("dp", None),
-                   "count": P("dp"), "n_dropped": P()})
+        out_specs=out_specs)
     step = jax.jit(shard_mapped)
 
     def run(values_np, payload_np=None, valid_np=None):
@@ -139,7 +153,9 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
         if valid_np is None:
             valid_np = np.ones(n, bool)
         valid_np = np.asarray(valid_np, bool)
-        pad = (-n) % dp
+        # zero-length shards break the in-kernel gathers: an empty input
+        # still ships one invalid row per shard
+        pad = (-n) % dp if n else dp
         if pad:
             values_np = np.concatenate([values_np, np.zeros(pad, dt)])
             payload_np = np.concatenate(
@@ -150,5 +166,50 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
                    jax.device_put(payload_np, sh),
                    jax.device_put(valid_np, sh))
         return out
+
+    return run, mesh
+
+
+def make_distributed_distinct(devices=None, *, capacity: int,
+                              dtype=np.int32):
+    """COUNT(DISTINCT col) over the mesh: distributed sample sort, then an
+    on-device adjacent-diff per bucket, reduced with psum.
+
+    No cross-device boundary handling is needed — bucket assignment is
+    ``searchsorted`` on the VALUE, so every copy of an equal key lands in
+    the same bucket by construction; a run can never span devices.  (A
+    ppermute "dedup" here would only ever misfire, e.g. on a sentinel
+    collision with an empty predecessor bucket.)
+
+    NaNs count individually (IEEE ``!=`` semantics — each NaN is its own
+    value, as the local path also implements).
+
+    Returns ``(run, mesh)``; ``run(values, valid=None)`` yields
+    ``{"distinct": scalar int32, "n_dropped": scalar}``."""
+    import jax
+
+    sort_run, mesh = make_distributed_sort(devices, capacity=capacity,
+                                           dtype=dtype, with_payload=False)
+
+    def _local(vals_row, count_row):
+        v = vals_row.reshape(-1)                  # (dp*capacity,) sorted,
+        n = count_row.reshape(())                 # first n valid
+        idx = jnp.arange(v.shape[0])
+        valid = idx < n
+        prev_ok = valid & (idx > 0)
+        new_run = valid & jnp.where(
+            prev_ok, v != jnp.roll(v, 1), True)   # first valid starts a run
+        return jax.lax.psum(jnp.sum(new_run.astype(jnp.int32)), "dp")[None]
+
+    counted = jax.jit(jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P("dp", None), P("dp")),
+        out_specs=P()))
+
+    def run(values_np, valid_np=None):
+        out = sort_run(values_np, valid_np=valid_np)
+        distinct = counted(out["values"], out["count"])
+        return {"distinct": np.asarray(distinct).reshape(())[()],
+                "n_dropped": np.asarray(out["n_dropped"])}
 
     return run, mesh

@@ -19,8 +19,9 @@ and :meth:`Query.explain` shows the chosen plan the way ``EXPLAIN`` shows
 the reference's custom scan node.
 
 One terminal operator per query (it is one scan node): ``aggregate`` |
-``group_by`` | ``top_k`` | ``order_by`` | ``join``.  Predicates are plain
-jnp lambdas over decoded columns — ``lambda cols: cols[0] > 10``.
+``group_by`` | ``top_k`` | ``order_by`` | ``count_distinct`` | ``join``.
+Predicates are plain jnp lambdas over decoded columns —
+``lambda cols: cols[0] > 10``.
 """
 
 from __future__ import annotations
@@ -134,6 +135,17 @@ class Query:
         self._order = (int(col), descending)
         return self
 
+    def count_distinct(self, col: int) -> "Query":
+        """Terminal: exact COUNT(DISTINCT col) of selected rows — the
+        distributed sort + per-bucket run count under a mesh, a local
+        unique count otherwise (each float NaN counts as distinct on
+        both paths)."""
+        self._require_no_terminal()
+        self._op = "count_distinct"
+        self._terminal_set = True
+        self._order = (int(col), False)   # reuses the order_by gather
+        return self
+
     def join(self, probe_col: int, build_keys: np.ndarray,
              build_values: np.ndarray) -> "Query":
         """Terminal: inner join against a host-side dimension table."""
@@ -202,7 +214,7 @@ class Query:
             return "xla", (f"G={g} exceeds the pallas unroll bound"
                            if g > _PALLAS_MAX_GROUPS
                            else "non-TPU backend")
-        if self._op == "order_by":
+        if self._op in ("order_by", "count_distinct"):
             return "xla", ("distributed sample sort (splitter election + "
                            "all_to_all)" if mode == "mesh"
                            else "single-device lax sort")
@@ -343,6 +355,8 @@ class Query:
             raise StromError(22, f"query not executable: {plan.reason}")
         if self._op == "order_by":
             return self._run_order_by(plan, mesh, device, session)
+        if self._op == "count_distinct":
+            return self._run_count_distinct(plan, mesh, device, session)
         chosen = plan.kernel if kernel == "auto" else kernel
         fn, combine = self._build_fn(chosen)
         if mesh is not None:
@@ -406,50 +420,46 @@ class Query:
                     src.close()
         return self._vfs_scan(fn, combine, device)
 
-    def _run_order_by(self, plan: QueryPlan, mesh, device, session) -> dict:
-        """ORDER BY: gather (values, global positions, validity) through
-        the planned access path, then sort — distributed sample sort on a
-        mesh, one-device lax sort locally.  Returns the flat global order
-        ``{"values", "positions"}`` (+ ``per_device_count``/``n_dropped``
-        info keys in mesh mode).
-
-        The gather phase runs on one local device even in mesh mode (the
-        sort collectives are the distributed piece); for multi-host
-        gather-side sharding, stream via ``load_pages_sharded`` and feed
-        :func:`..parallel.sort.make_distributed_sort` directly."""
-        import jax
-
-        from ..ops.filter_xla import decode_pages
-        col, descending = self._order
+    def _check_sortable_col(self, col: int, opname: str) -> np.dtype:
         if not 0 <= col < self.schema.n_cols:
-            raise StromError(22, f"order_by column {col} out of range")
+            raise StromError(22, f"{opname} column {col} out of range")
         dt = self.schema.col_dtype(col)
         if dt not in (np.dtype(np.int32), np.dtype(np.float32)):
-            raise StromError(22, f"order_by supports int32/float32 "
+            raise StromError(22, f"{opname} supports int32/float32 "
                                  f"columns (got {dt})")
-        pred = self._pred
+        return dt
 
-        from ..ops.filter_xla import global_row_positions
+    def _gather_column(self, plan: QueryPlan, col: int, device, session,
+                       want_positions: bool = True):
+        """Stream the planned access path and collect (values, global
+        positions) of selected rows, per batch, host-side (one concat at
+        the caller — a fold-style growing device concat would copy the
+        accumulator once per batch)."""
+        import jax
+
+        from ..ops.filter_xla import decode_pages, global_row_positions
+        pred = self._pred
 
         @jax.jit
         def gather(pages):
             cols, valid = decode_pages(pages, self.schema)
             if pred is not None:
                 valid = valid & pred(cols)
-            pos = global_row_positions(pages, self.schema)
-            return {"values": cols[col].reshape(-1),
-                    "positions": pos.reshape(-1),
-                    "valid": valid.reshape(-1)}
+            out = {"values": cols[col].reshape(-1),
+                   "valid": valid.reshape(-1)}
+            if want_positions:   # distinct never reads them — skip the
+                out["positions"] = global_row_positions(   # decode + D2H
+                    pages, self.schema).reshape(-1)
+            return out
 
-        # per-batch host append + one concatenate (a fold-style growing
-        # device concat would copy the accumulator once per batch)
         chunks = []
 
         def collect(pages_dev):
             out = gather(pages_dev)
             mask = np.asarray(out["valid"]).astype(bool)
             chunks.append((np.asarray(out["values"])[mask],
-                           np.asarray(out["positions"])[mask]))
+                           np.asarray(out["positions"])[mask]
+                           if want_positions else None))
             return {}   # nothing to fold
 
         if plan.access_path == "direct":
@@ -463,6 +473,54 @@ class Query:
                     src.close()
         else:
             self._vfs_scan(collect, None, device)
+        return chunks
+
+    def _run_count_distinct(self, plan: QueryPlan, mesh, device,
+                            session) -> dict:
+        """Exact COUNT(DISTINCT col): gathered values dedupe via the
+        distributed sort + ppermute boundary count under a mesh, or a
+        host unique count locally."""
+        col, _ = self._order
+        dt = self._check_sortable_col(col, "count_distinct")
+        chunks = self._gather_column(plan, col, device, session,
+                                     want_positions=False)
+        vals = np.concatenate([c[0] for c in chunks]) if chunks \
+            else np.zeros(0, dt)
+        if mesh is None:
+            # equal_nan=False: each NaN is its own value (IEEE !=), the
+            # same semantics the mesh kernel's adjacent-diff implements
+            return {"distinct": np.int32(len(
+                np.unique(vals, equal_nan=False)))}
+        from ..parallel.sort import make_distributed_distinct
+        sort_devices = list(mesh.devices.reshape(-1))
+        dp = len(sort_devices)
+        n = len(vals)
+        capacity = max(64, -(-n * 5 // (2 * dp * dp)))
+        while True:
+            run_d, _ = make_distributed_distinct(sort_devices,
+                                                 capacity=capacity,
+                                                 dtype=dt)
+            out = run_d(vals)
+            if int(out["n_dropped"]) == 0:
+                return {"distinct": np.int32(out["distinct"])}
+            capacity *= 2   # skewed keys: resize and rerun
+
+    def _run_order_by(self, plan: QueryPlan, mesh, device, session) -> dict:
+        """ORDER BY: gather (values, global positions, validity) through
+        the planned access path, then sort — distributed sample sort on a
+        mesh, one-device lax sort locally.  Returns the flat global order
+        ``{"values", "positions"}`` (+ ``per_device_count``/``n_dropped``
+        info keys in mesh mode).
+
+        The gather phase runs on one local device even in mesh mode (the
+        sort collectives are the distributed piece); for multi-host
+        gather-side sharding, stream via ``load_pages_sharded`` and feed
+        :func:`..parallel.sort.make_distributed_sort` directly."""
+        import jax
+
+        col, descending = self._order
+        dt = self._check_sortable_col(col, "order_by")
+        chunks = self._gather_column(plan, col, device, session)
         # positions normalize to int32 on the mesh path (slab payload
         # width); keep the empty case's dtype consistent with that
         pos_np_t = np.int32 if mesh is not None else (

@@ -80,6 +80,8 @@ def main(argv=None) -> int:
     ap.add_argument("--order-by", default=None, metavar="COL[:desc]",
                     help="full ordering of a column (values + row "
                          "positions); distributed sample sort with --mesh")
+    ap.add_argument("--count-distinct", default=None, metavar="COL",
+                    type=int, help="exact COUNT(DISTINCT col)")
     ap.add_argument("--kernel", choices=("auto", "pallas", "xla"),
                     default="auto")
     ap.add_argument("--mesh", action="store_true",
@@ -103,11 +105,14 @@ def main(argv=None) -> int:
     src = args.file[0] if len(args.file) == 1 else list(args.file)
     terminals = [f for f, v in (("--group-by", args.group_by),
                                 ("--top-k", args.top_k),
-                                ("--order-by", args.order_by)) if v]
+                                ("--order-by", args.order_by),
+                                ("--count-distinct",
+                                 args.count_distinct is not None)) if v]
     if len(terminals) > 1:
         ap.error(f"{' and '.join(terminals)} are exclusive "
                  f"(one terminal operator per query)")
-    if (args.top_k or args.order_by) and agg_cols is not None:
+    if (args.top_k or args.order_by or args.count_distinct is not None) \
+            and agg_cols is not None:
         ap.error(f"--agg-cols has no effect with {terminals[0]}")
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.where:
@@ -125,6 +130,8 @@ def main(argv=None) -> int:
         parts = args.order_by.split(":")
         q = q.order_by(int(parts[0]),
                        descending=len(parts) > 1 and parts[1] == "desc")
+    elif args.count_distinct is not None:
+        q = q.count_distinct(args.count_distinct)
     elif agg_cols is not None:
         q = q.aggregate(cols=agg_cols)
 
@@ -146,7 +153,7 @@ def main(argv=None) -> int:
 
     out = q.run(mesh=mesh, kernel=args.kernel)
     if args.kernel != "auto" and args.kernel != plan.kernel \
-            and not args.order_by:
+            and not args.order_by and args.count_distinct is None:
         # the printed plan must reflect what actually ran (order_by has a
         # fixed sort pipeline — run() ignores the kernel override there)
         import dataclasses

@@ -435,3 +435,26 @@ def test_run_analyze_reports_io_breakdown(heap):
     # the query result itself is unchanged
     sel = (vis != 0) & (c0 > 0)
     assert int(out["count"]) == int(sel.sum())
+
+
+def test_count_distinct_local_and_mesh(heap):
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = (vis != 0) & (c0 > 0)
+    q = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .count_distinct(1)
+    assert q.explain().operator == "count_distinct"
+    out = q.run()
+    want = len(np.unique(c1[sel]))
+    assert int(out["distinct"]) == want
+    mesh = make_scan_mesh(jax.devices())
+    mout = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .count_distinct(1).run(mesh=mesh)
+    assert int(mout["distinct"]) == want
+    # empty selection
+    e = Query(path, schema).where(lambda cols: cols[0] > 10**6) \
+        .count_distinct(0).run(mesh=mesh)
+    assert int(e["distinct"]) == 0

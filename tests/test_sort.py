@@ -113,3 +113,45 @@ def test_sort_property_random():
         v = np.concatenate([np.asarray(out["values"])[b][:counts[b]]
                             for b in range(len(counts))])
         np.testing.assert_array_equal(v, np.sort(values[valid]), err_msg=str(trial))
+
+
+def test_distinct_matches_numpy():
+    """COUNT(DISTINCT) == len(np.unique): the ppermute boundary exchange
+    must not double-count runs spanning bucket boundaries."""
+    from nvme_strom_tpu.parallel.sort import make_distributed_distinct
+
+    rng = np.random.default_rng(19)
+    run, mesh = make_distributed_distinct(jax.devices(), capacity=4096)
+    for trial in range(6):
+        n = int(rng.integers(1, 3000))
+        hi = int(rng.integers(2, 60))          # heavy duplication
+        values = rng.integers(0, hi, n).astype(np.int32)
+        valid = rng.random(n) < 0.8
+        out = run(values, valid_np=valid)
+        assert int(out["n_dropped"]) == 0
+        assert int(out["distinct"]) == len(np.unique(values[valid])), trial
+
+
+def test_distinct_single_value_everywhere():
+    from nvme_strom_tpu.parallel.sort import make_distributed_distinct
+
+    run, mesh = make_distributed_distinct(jax.devices(), capacity=2048)
+    out = run(np.zeros(1024, np.int32))
+    # one value, split across every bucket boundary: still exactly 1
+    assert int(out["distinct"]) == 1
+    out2 = run(np.zeros(0, np.int32))
+    assert int(out2["distinct"]) == 0
+
+
+def test_distinct_sentinel_valued_keys():
+    """Keys equal to the pad sentinel (I32_MAX) must count correctly —
+    the review case where a boundary 'dedup' undercounted to 0."""
+    from nvme_strom_tpu.parallel.sort import make_distributed_distinct
+
+    run, mesh = make_distributed_distinct(jax.devices(), capacity=64)
+    out = run(np.full(8, (1 << 31) - 1, np.int32))
+    assert int(out["distinct"]) == 1
+    fr, _ = make_distributed_distinct(jax.devices(), capacity=64,
+                                      dtype=np.float32)
+    fout = fr(np.array([np.inf, np.inf, 1.0, np.inf], np.float32))
+    assert int(fout["distinct"]) == 2
