@@ -18,10 +18,10 @@ that surface for the TPU framework: one :class:`Query` builder that
 and :meth:`Query.explain` shows the chosen plan the way ``EXPLAIN`` shows
 the reference's custom scan node.
 
-One terminal operator per query (it is one scan node): ``aggregate`` |
-``group_by`` | ``top_k`` | ``order_by`` | ``count_distinct`` | ``join``.
-Predicates are plain jnp lambdas over decoded columns —
-``lambda cols: cols[0] > 10``.
+One terminal operator per query (it is one scan node): ``select`` |
+``aggregate`` | ``group_by`` | ``top_k`` | ``order_by`` |
+``count_distinct`` | ``join``.  Predicates are plain jnp lambdas over
+decoded columns — ``lambda cols: cols[0] > 10``.
 """
 
 from __future__ import annotations
@@ -41,6 +41,12 @@ from .planner import (capability_cache, cost_direct_scan, cost_vfs_scan,
 __all__ = ["Query", "QueryPlan"]
 
 _PALLAS_MAX_GROUPS = 64   # static unroll bound (ops/groupby_pallas.py)
+
+
+class _ScanLimitReached(Exception):
+    """Private control flow: the gather collected ``LIMIT`` rows early and
+    the scan can stop issuing DMA (the executor stops pulling tuples once
+    the plan's limit is satisfied)."""
 
 
 @dataclass(frozen=True)
@@ -90,11 +96,32 @@ class Query:
         self._topk: Optional[tuple] = None
         self._order: Optional[tuple] = None
         self._join: Optional[tuple] = None
+        self._select: Optional[tuple] = None
 
     # -- builders -----------------------------------------------------------
     def where(self, predicate: Callable) -> "Query":
         """Row filter: ``predicate(cols) -> (B, T) bool`` (jnp ops only)."""
         self._pred = predicate
+        return self
+
+    def select(self, cols: Optional[Sequence[int]] = None, *,
+               limit: Optional[int] = None, offset: int = 0) -> "Query":
+        """Terminal: materialize the selected rows themselves — projected
+        column values + global row positions, the face the reference scan
+        actually exposes (tuples handed back to the executor,
+        `pgsql/nvme_strom.c:941-979`).  ``cols=None`` projects every
+        column.  ``limit`` stops the scan early once enough rows are
+        gathered; row order is physical arrival order (SQL without ORDER
+        BY — use :meth:`order_by`/:meth:`top_k` for ordered heads)."""
+        self._require_no_terminal()
+        if limit is not None and limit < 0:
+            raise StromError(22, "select limit must be >= 0")
+        if offset < 0:
+            raise StromError(22, "select offset must be >= 0")
+        self._op = "select"
+        self._terminal_set = True
+        self._select = (None if cols is None else [int(c) for c in cols],
+                        limit, int(offset))
         return self
 
     def aggregate(self, cols: Optional[Sequence[int]] = None) -> "Query":
@@ -123,16 +150,24 @@ class Query:
         self._topk = (int(col), int(k), largest)
         return self
 
-    def order_by(self, col: int, *, descending: bool = False) -> "Query":
+    def order_by(self, col: int, *, descending: bool = False,
+                 limit: Optional[int] = None, offset: int = 0) -> "Query":
         """Terminal: the full ordering of *col* over selected rows —
-        sorted values + their global row positions (ORDER BY without
-        LIMIT; use :meth:`top_k` when only the head is needed).  With a
-        mesh, runs the distributed sample sort; device *b* ends up owning
-        the *b*-th key range."""
+        sorted values + their global row positions.  ``limit``/``offset``
+        slice the sorted output (ORDER BY ... LIMIT n OFFSET m; for a
+        small head :meth:`top_k` streams without materializing the whole
+        order).  With a mesh, runs the distributed sample sort; device
+        *b* ends up owning the *b*-th key range — the
+        ``per_device_count`` info key always describes that full
+        pre-slice distribution, not the sliced arrays."""
         self._require_no_terminal()
+        if limit is not None and limit < 0:
+            raise StromError(22, "order_by limit must be >= 0")
+        if offset < 0:
+            raise StromError(22, "order_by offset must be >= 0")
         self._op = "order_by"
         self._terminal_set = True
-        self._order = (int(col), descending)
+        self._order = (int(col), descending, limit, int(offset))
         return self
 
     def count_distinct(self, col: int) -> "Query":
@@ -143,7 +178,7 @@ class Query:
         self._require_no_terminal()
         self._op = "count_distinct"
         self._terminal_set = True
-        self._order = (int(col), False)   # reuses the order_by gather
+        self._order = (int(col), False, None, 0)  # reuses the order_by gather
         return self
 
     def join(self, probe_col: int, build_keys: np.ndarray,
@@ -196,6 +231,18 @@ class Query:
             except ValueError as e:
                 # EXPLAIN must show the problem, not raise; run() refuses
                 return "invalid", str(e)
+        if self._op == "select":
+            bad = [c for c in (self._select[0] or [])
+                   if not 0 <= c < self.schema.n_cols]
+            if bad:   # EXPLAIN must show the problem, not raise
+                return "invalid", (f"select column {bad[0]} out of range "
+                                   f"(schema has {self.schema.n_cols})")
+            return "xla", ("row materialization: decode + mask-compress "
+                           "gather, rows return to the host like tuples "
+                           "to the executor" +
+                           ("; gather runs on a local device (no mesh "
+                            "reduction in a materialization)"
+                            if mode == "mesh" else ""))
         on_tpu = jax.default_backend() == "tpu"
         if mode == "mesh":
             return "xla", "mesh mode: XLA partitions the reduction and " \
@@ -353,6 +400,8 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
+        if self._op == "select":
+            return self._run_select(plan, device, session)
         if self._op == "order_by":
             return self._run_order_by(plan, mesh, device, session)
         if self._op == "count_distinct":
@@ -429,23 +478,28 @@ class Query:
                                  f"columns (got {dt})")
         return dt
 
-    def _gather_column(self, plan: QueryPlan, col: int, device, session,
-                       want_positions: bool = True):
-        """Stream the planned access path and collect (values, global
-        positions) of selected rows, per batch, host-side (one concat at
-        the caller — a fold-style growing device concat would copy the
-        accumulator once per batch)."""
+    def _gather_rows(self, plan: QueryPlan, cols: Sequence[int], device,
+                     session, *, want_positions: bool = True,
+                     stop_rows: Optional[int] = None):
+        """Stream the planned access path and collect, per batch and
+        host-side, the projected column values (+ global positions) of
+        selected rows — one concat at the caller (a fold-style growing
+        device concat would copy the accumulator once per batch).
+        Returns ``[(list_of_col_arrays, positions_or_None), ...]``; with
+        *stop_rows*, stops issuing I/O once that many rows are gathered
+        (LIMIT early-exit)."""
         import jax
 
         from ..ops.filter_xla import decode_pages, global_row_positions
         pred = self._pred
+        cols = list(cols)
 
         @jax.jit
         def gather(pages):
-            cols, valid = decode_pages(pages, self.schema)
+            dcols, valid = decode_pages(pages, self.schema)
             if pred is not None:
-                valid = valid & pred(cols)
-            out = {"values": cols[col].reshape(-1),
+                valid = valid & pred(dcols)
+            out = {"values": [dcols[c].reshape(-1) for c in cols],
                    "valid": valid.reshape(-1)}
             if want_positions:   # distinct never reads them — skip the
                 out["positions"] = global_row_positions(   # decode + D2H
@@ -453,34 +507,77 @@ class Query:
             return out
 
         chunks = []
+        gathered = 0
 
         def collect(pages_dev):
+            nonlocal gathered
             out = gather(pages_dev)
             mask = np.asarray(out["valid"]).astype(bool)
-            chunks.append((np.asarray(out["values"])[mask],
+            chunks.append(([np.asarray(v)[mask] for v in out["values"]],
                            np.asarray(out["positions"])[mask]
                            if want_positions else None))
+            gathered += int(mask.sum())
+            if stop_rows is not None and gathered >= stop_rows:
+                raise _ScanLimitReached
             return {}   # nothing to fold
 
-        if plan.access_path == "direct":
-            from .executor import TableScanner
-            src, own = self._open_owned()
-            try:
-                with TableScanner(src, self.schema, session=session) as sc:
-                    sc.scan_filter(collect, device=device)
-            finally:
-                if own:
-                    src.close()
-        else:
-            self._vfs_scan(collect, None, device)
+        try:
+            if plan.access_path == "direct":
+                from .executor import TableScanner
+                src, own = self._open_owned()
+                try:
+                    with TableScanner(src, self.schema,
+                                      session=session) as sc:
+                        sc.scan_filter(collect, device=device)
+                finally:
+                    if own:
+                        src.close()
+            else:
+                self._vfs_scan(collect, None, device)
+        except _ScanLimitReached:
+            pass
         return chunks
+
+    def _gather_column(self, plan: QueryPlan, col: int, device, session,
+                       want_positions: bool = True):
+        """One-column face of :meth:`_gather_rows` (order_by / distinct)."""
+        return [(vals[0], pos) for vals, pos in self._gather_rows(
+            plan, [col], device, session, want_positions=want_positions)]
+
+    def _run_select(self, plan: QueryPlan, device, session) -> dict:
+        """SELECT: stream the scan and hand the matching rows back —
+        ``{"col<i>": values, "positions": rows, "count": n}``.  Mesh mode
+        gathers on a local device (materialization has no reduction for
+        the mesh to partition)."""
+        import jax
+
+        cols, limit, offset = self._select
+        if cols is None:
+            cols = list(range(self.schema.n_cols))
+        # out-of-range columns already surfaced by explain() as an
+        # invalid plan; run() refused before reaching here
+        end = None if limit is None else offset + limit
+        rows = self._gather_rows(plan, cols, device, session,
+                                 stop_rows=end)
+        if rows:
+            vals = [np.concatenate([r[0][i] for r in rows])
+                    for i in range(len(cols))]
+            poss = np.concatenate([r[1] for r in rows])
+        else:
+            vals = [np.zeros(0, self.schema.col_dtype(c)) for c in cols]
+            poss = np.zeros(0, np.int64 if jax.config.jax_enable_x64
+                            else np.int32)
+        out = {f"col{c}": v[offset:end] for c, v in zip(cols, vals)}
+        out["positions"] = poss[offset:end]
+        out["count"] = np.int64(len(out["positions"]))
+        return out
 
     def _run_count_distinct(self, plan: QueryPlan, mesh, device,
                             session) -> dict:
         """Exact COUNT(DISTINCT col): gathered values dedupe via the
         distributed sort + ppermute boundary count under a mesh, or a
         host unique count locally."""
-        col, _ = self._order
+        col = self._order[0]
         dt = self._check_sortable_col(col, "count_distinct")
         chunks = self._gather_column(plan, col, device, session,
                                      want_positions=False)
@@ -518,7 +615,8 @@ class Query:
         :func:`..parallel.sort.make_distributed_sort` directly."""
         import jax
 
-        col, descending = self._order
+        col, descending, limit, offset = self._order
+        end = None if limit is None else offset + limit
         dt = self._check_sortable_col(col, "order_by")
         chunks = self._gather_column(plan, col, device, session)
         # positions normalize to int32 on the mesh path (slab payload
@@ -542,7 +640,7 @@ class Query:
         if mesh is None:
             key = vals if not descending else \
                 (-vals if dt.kind == "f" else ~vals)
-            order = np.argsort(key, kind="stable")
+            order = np.argsort(key, kind="stable")[offset:end]
             return {"values": vals[order], "positions": poss[order]}
 
         from ..parallel.sort import make_distributed_sort
@@ -574,7 +672,7 @@ class Query:
                             for b in range(dp)])
         p = np.concatenate([np.asarray(out["payload"])[b][:counts[b]]
                             for b in range(dp)])
-        return {"values": v, "positions": p,
+        return {"values": v[offset:end], "positions": p[offset:end],
                 "per_device_count": counts, "n_dropped": np.int32(0)}
 
     def _vfs_scan(self, fn, combine, device) -> dict:

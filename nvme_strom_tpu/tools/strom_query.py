@@ -10,6 +10,7 @@ Usage:
   strom_query FILE --cols 3 [--dtypes int32,float32,int32] [--visibility]
               [--where "c0 > 10"] [--group-by "c1 % 8" --groups 8]
               [--top-k COL:K[:smallest]] [--agg-cols 0,1]
+              [--select COLS|all --limit N --offset M]
               [--explain] [--kernel auto|pallas|xla] [--mesh]
 
 Predicates/keys are restricted jnp expressions over columns c0..cN (and
@@ -77,9 +78,18 @@ def main(argv=None) -> int:
                     help="comma-separated column indices to aggregate")
     ap.add_argument("--top-k", default=None, metavar="COL:K[:smallest]",
                     help="top-k of a column instead of aggregation")
+    ap.add_argument("--select", default=None, metavar="COLS|all",
+                    help="materialize matching rows: comma-separated "
+                         "column indices (or 'all'); returns values + "
+                         "row positions instead of aggregating")
     ap.add_argument("--order-by", default=None, metavar="COL[:desc]",
                     help="full ordering of a column (values + row "
                          "positions); distributed sample sort with --mesh")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="with --select/--order-by: return at most N rows "
+                         "(--select stops scanning early)")
+    ap.add_argument("--offset", type=int, default=0,
+                    help="with --select/--order-by: skip the first N rows")
     ap.add_argument("--count-distinct", default=None, metavar="COL",
                     type=int, help="exact COUNT(DISTINCT col)")
     ap.add_argument("--kernel", choices=("auto", "pallas", "xla"),
@@ -103,7 +113,8 @@ def main(argv=None) -> int:
     from ..scan.query import Query
     from .common import parse_size
     src = args.file[0] if len(args.file) == 1 else list(args.file)
-    terminals = [f for f, v in (("--group-by", args.group_by),
+    terminals = [f for f, v in (("--select", args.select),
+                                ("--group-by", args.group_by),
                                 ("--top-k", args.top_k),
                                 ("--order-by", args.order_by),
                                 ("--count-distinct",
@@ -111,13 +122,20 @@ def main(argv=None) -> int:
     if len(terminals) > 1:
         ap.error(f"{' and '.join(terminals)} are exclusive "
                  f"(one terminal operator per query)")
-    if (args.top_k or args.order_by or args.count_distinct is not None) \
-            and agg_cols is not None:
+    if (args.select or args.top_k or args.order_by
+            or args.count_distinct is not None) and agg_cols is not None:
         ap.error(f"--agg-cols has no effect with {terminals[0]}")
+    if (args.limit is not None or args.offset) \
+            and not (args.select or args.order_by):
+        ap.error("--limit/--offset apply to --select or --order-by")
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
-    if args.group_by:
+    if args.select:
+        sel_cols = None if args.select == "all" else \
+            [int(c) for c in args.select.split(",")]
+        q = q.select(sel_cols, limit=args.limit, offset=args.offset)
+    elif args.group_by:
         if not args.groups:
             ap.error("--group-by requires --groups")
         q = q.group_by(_expr_fn(args.group_by, args.cols), args.groups,
@@ -129,7 +147,8 @@ def main(argv=None) -> int:
     elif args.order_by:
         parts = args.order_by.split(":")
         q = q.order_by(int(parts[0]),
-                       descending=len(parts) > 1 and parts[1] == "desc")
+                       descending=len(parts) > 1 and parts[1] == "desc",
+                       limit=args.limit, offset=args.offset)
     elif args.count_distinct is not None:
         q = q.count_distinct(args.count_distinct)
     elif agg_cols is not None:
@@ -153,7 +172,8 @@ def main(argv=None) -> int:
 
     out = q.run(mesh=mesh, kernel=args.kernel)
     if args.kernel != "auto" and args.kernel != plan.kernel \
-            and not args.order_by and args.count_distinct is None:
+            and not args.order_by and not args.select \
+            and args.count_distinct is None:
         # the printed plan must reflect what actually ran (order_by has a
         # fixed sort pipeline — run() ignores the kernel override there)
         import dataclasses

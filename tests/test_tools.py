@@ -187,3 +187,32 @@ def test_strom_query_cli_order_by(tmp_path):
     assert out.returncode == 0, out.stderr
     res = json.loads(out.stdout.strip().splitlines()[-1])
     assert res["values"] == np.sort(c0)[::-1].tolist()
+
+
+def test_strom_query_cli_select_limit(tmp_path):
+    """--select materializes rows; --limit/--offset slice them; the flags
+    are rejected where they make no sense."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(9)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(-100, 100, n).astype(np.int32)
+    c1 = rng.integers(0, 8, n).astype(np.int32)
+    path = str(tmp_path / "s.heap")
+    build_heap_file(path, [c0, c1], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--where", "c0 > 50", "--select", "1", "--limit", "6",
+               "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["count"] == 6 and len(res["col1"]) == 6
+    assert all(c0[p] > 50 for p in res["positions"])
+    assert [c1[p] for p in res["positions"]] == res["col1"]
+    # --limit without a row-returning terminal is a usage error
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--limit", "3")
+    assert out.returncode != 0 and "--limit" in out.stderr
