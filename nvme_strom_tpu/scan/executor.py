@@ -261,20 +261,30 @@ class TableScanner:
         dev = device or jax.devices()[0]
         acc: Optional[dict] = None
         with ResourceOwner("scan_filter") as owner:
-            for batch in self.batches(owner=owner):
-                # safe_device_put, NOT jax.device_put: batch.pages is a
-                # view into a pool chunk, and CPU-backend device_put
-                # zero-copy ALIASES it — the async filter compute would
-                # read the chunk after recycle+refill (silent wrong
-                # aggregates; caught by a cold-file 64KB-chunk scan)
-                dev_pages = safe_device_put(batch.pages, dev)
-                # fence: device_put is async and batch.pages is recycled
-                # (and re-filled by the next SSD DMA) as soon as the next
-                # batch is drawn — the H2D read must complete first.  The
-                # DMA ring keeps progressing in native threads while we
-                # wait, so overlap is preserved.
-                dev_pages.block_until_ready()
-                acc = fold_results(acc, filter_fn(dev_pages), combine)
+            gen = self.batches(owner=owner)
+            try:
+                for batch in gen:
+                    # safe_device_put, NOT jax.device_put: batch.pages is a
+                    # view into a pool chunk, and CPU-backend device_put
+                    # zero-copy ALIASES it — the async filter compute would
+                    # read the chunk after recycle+refill (silent wrong
+                    # aggregates; caught by a cold-file 64KB-chunk scan)
+                    dev_pages = safe_device_put(batch.pages, dev)
+                    # fence: device_put is async and batch.pages is recycled
+                    # (and re-filled by the next SSD DMA) as soon as the next
+                    # batch is drawn — the H2D read must complete first.  The
+                    # DMA ring keeps progressing in native threads while we
+                    # wait, so overlap is preserved.
+                    dev_pages.block_until_ready()
+                    acc = fold_results(acc, filter_fn(dev_pages), combine)
+            finally:
+                # drain the ring INSIDE the owner scope: when filter_fn
+                # raises (e.g. a LIMIT early-exit), the generator's finally
+                # must wait out in-flight SSD DMA before ResourceOwner
+                # abort-recovery returns those chunks to a possibly-shared
+                # pool — freeing first would let a concurrent scan alloc a
+                # chunk the SSD is still writing into
+                gen.close()
         if acc is None:
             return {}
         return {k: np.asarray(v) for k, v in

@@ -133,13 +133,19 @@ class Query:
         return self
 
     def group_by(self, key_fn: Callable, n_groups: int, *,
-                 agg_cols: Optional[Sequence[int]] = None) -> "Query":
-        """Terminal: per-group count/sum/min/max.
-        ``key_fn(cols) -> (B, T) int32`` ids in ``[0, n_groups)``."""
+                 agg_cols: Optional[Sequence[int]] = None,
+                 having: Optional[Callable] = None) -> "Query":
+        """Terminal: per-group count/sum/min/max/avg.
+        ``key_fn(cols) -> (B, T) int32`` ids in ``[0, n_groups)``.
+
+        ``having(groups) -> (G,) bool`` filters groups AFTER aggregation
+        (SQL HAVING): it receives the finished numpy result
+        (``count (G,)``, ``sums/mins/maxs/avgs (V, G)``) and surviving
+        groups are compressed out, their original ids in ``"groups"``."""
         self._require_no_terminal()
         self._op = "group_by"
         self._terminal_set = True
-        self._group = (key_fn, int(n_groups), agg_cols)
+        self._group = (key_fn, int(n_groups), agg_cols, having)
         return self
 
     def top_k(self, col: int, k: int, *, largest: bool = True) -> "Query":
@@ -254,7 +260,7 @@ class Query:
             return "xla", "non-TPU backend: interpret-mode pallas would " \
                           "be pure overhead"
         if self._op == "group_by":
-            _, g, agg = self._group
+            _, g, agg, _hv = self._group
             if on_tpu and g <= _PALLAS_MAX_GROUPS:
                 return "pallas", f"G={g} within the static-unroll bound " \
                                  f"({_PALLAS_MAX_GROUPS})"
@@ -321,7 +327,7 @@ class Query:
                     "sums": [o["sums"][c] for c in keep]})(inner(pages))
             return fn, None
         if self._op == "group_by":
-            key_fn, g, agg = self._group
+            key_fn, g, agg, _having = self._group
             kw = dict(agg_cols=agg,
                       predicate=(lambda cols: pred(cols)) if pred else None)
             if kernel == "pallas":
@@ -452,7 +458,8 @@ class Query:
                                        combine)
                 if acc is None:
                     return {}
-                return {k: np.asarray(v) for k, v in acc.items()}
+                return self._finalize(
+                    {k: np.asarray(v) for k, v in acc.items()})
             finally:
                 if own:
                     src.close()
@@ -462,12 +469,38 @@ class Query:
             try:
                 with TableScanner(src, self.schema,
                                   session=session) as sc:
-                    return sc.scan_filter(fn, device=device,
-                                          combine=combine)
+                    return self._finalize(
+                        sc.scan_filter(fn, device=device, combine=combine))
             finally:
                 if own:
                     src.close()
-        return self._vfs_scan(fn, combine, device)
+        return self._finalize(self._vfs_scan(fn, combine, device))
+
+    def _finalize(self, out: dict) -> dict:
+        """Post-aggregation decoration for group_by: derived ``avgs``
+        (sum/count, NaN for empty groups) and the HAVING filter — applied
+        AFTER the cross-batch/cross-device fold, which is what gives it
+        SQL's post-aggregation semantics."""
+        if self._op != "group_by" or not out:
+            return out
+        having = self._group[3]
+        count = np.asarray(out["count"])
+        sums = np.asarray(out["sums"])
+        with np.errstate(divide="ignore", invalid="ignore"):
+            avgs = np.where(count > 0, sums / np.maximum(count, 1), np.nan)
+        res = {"count": count, "sums": sums,
+               "mins": np.asarray(out["mins"]),
+               "maxs": np.asarray(out["maxs"]), "avgs": avgs}
+        if having is None:
+            return res
+        mask = np.asarray(having(res)).astype(bool)
+        if mask.shape != count.shape:
+            raise StromError(22, f"having must return a ({len(count)},) "
+                                 f"bool mask, got shape {mask.shape}")
+        res = {k: (v[mask] if v.ndim == 1 else v[..., mask])
+               for k, v in res.items()}
+        res["groups"] = np.flatnonzero(mask).astype(np.int32)
+        return res
 
     def _check_sortable_col(self, col: int, opname: str) -> np.dtype:
         if not 0 <= col < self.schema.n_cols:

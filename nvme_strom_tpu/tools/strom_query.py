@@ -55,6 +55,27 @@ def _expr_fn(expr: str, n_cols: int):
     return fn
 
 
+def _having_fn(expr: str):
+    """Compile a HAVING expression over the finished numpy group arrays
+    (count, sums, mins, maxs, avgs) on the same whitelisted-eval terms as
+    :func:`_expr_fn`."""
+    code = compile(expr, "<strom_query:having>", "eval")
+    allowed = ("count", "sums", "mins", "maxs", "avgs",
+               "abs", "minimum", "maximum", "where", "np")
+    for name in code.co_names:
+        if name not in allowed:
+            raise SystemExit(f"error: name {name!r} not allowed in "
+                             f"--having (use {', '.join(allowed)})")
+
+    def fn(groups):
+        ns = dict(groups)
+        ns.update(abs=np.abs, minimum=np.minimum, maximum=np.maximum,
+                  where=np.where, np=np)
+        return eval(code, {"__builtins__": {}}, ns)
+
+    return fn
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="strom_query", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -76,6 +97,10 @@ def main(argv=None) -> int:
                     help="number of groups (required with --group-by)")
     ap.add_argument("--agg-cols", default=None,
                     help="comma-separated column indices to aggregate")
+    ap.add_argument("--having", default=None, metavar="EXPR",
+                    help='post-aggregation group filter over count/sums/'
+                         'mins/maxs/avgs, e.g. "count > 100" or '
+                         '"avgs[0] > 5" (requires --group-by)')
     ap.add_argument("--top-k", default=None, metavar="COL:K[:smallest]",
                     help="top-k of a column instead of aggregation")
     ap.add_argument("--select", default=None, metavar="COLS|all",
@@ -131,6 +156,8 @@ def main(argv=None) -> int:
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
+    if args.having and not args.group_by:
+        ap.error("--having requires --group-by")
     if args.select:
         sel_cols = None if args.select == "all" else \
             [int(c) for c in args.select.split(",")]
@@ -139,7 +166,9 @@ def main(argv=None) -> int:
         if not args.groups:
             ap.error("--group-by requires --groups")
         q = q.group_by(_expr_fn(args.group_by, args.cols), args.groups,
-                       agg_cols=agg_cols)
+                       agg_cols=agg_cols,
+                       having=_having_fn(args.having)
+                       if args.having else None)
     elif args.top_k:
         parts = args.top_k.split(":")
         largest = not (len(parts) > 2 and parts[2] == "smallest")

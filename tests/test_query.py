@@ -598,3 +598,105 @@ def test_order_by_limit_offset_local_and_mesh(heap):
     mout = Query(path, schema).order_by(0, limit=10, offset=3) \
         .run(mesh=mesh)
     np.testing.assert_array_equal(mout["values"], want[3:13])
+
+
+def test_group_by_avgs_present_and_correct(heap):
+    """group_by results always carry derived avgs = sums/count, NaN for
+    empty groups, on both kernel paths."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = (vis != 0) & (c0 > 0)
+    for kernel in ("xla", "pallas"):
+        out = Query(path, schema).where(lambda cols: cols[0] > 0) \
+            .group_by(lambda cols: cols[1] % 8, 8, agg_cols=[0]) \
+            .run(kernel=kernel)
+        for g in range(8):
+            m = sel & (c1 % 8 == g)
+            if m.sum():
+                np.testing.assert_allclose(out["avgs"][0][g],
+                                           c0[m].mean(), rtol=1e-6)
+            else:
+                assert np.isnan(out["avgs"][0][g])
+
+
+def test_group_by_having_filters_groups(heap):
+    """HAVING applies after the fold: surviving groups are compressed,
+    original ids in "groups"."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = (vis != 0) & (c0 > 0)
+    counts = np.array([(sel & (c1 % 8 == g)).sum() for g in range(8)])
+    cut = int(np.median(counts))
+    out = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .group_by(lambda cols: cols[1] % 8, 8, agg_cols=[0],
+                  having=lambda gr: gr["count"] > cut).run()
+    want = np.flatnonzero(counts > cut)
+    np.testing.assert_array_equal(out["groups"], want)
+    np.testing.assert_array_equal(out["count"], counts[want])
+    assert out["sums"].shape == (1, len(want))
+    assert out["avgs"].shape == (1, len(want))
+
+
+def test_group_by_having_mesh_matches_local(heap):
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    q = lambda: Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .group_by(lambda cols: cols[1] % 8, 8, agg_cols=[0, 1],
+                  having=lambda gr: gr["avgs"][0] > 0)
+    local = q().run()
+    mesh = make_scan_mesh(jax.devices())
+    dist = q().run(mesh=mesh)
+    np.testing.assert_array_equal(local["groups"], dist["groups"])
+    np.testing.assert_array_equal(local["count"], dist["count"])
+    np.testing.assert_allclose(local["avgs"], dist["avgs"], rtol=1e-6)
+
+
+def test_group_by_having_bad_mask_shape(heap):
+    path, schema, *_ = heap
+    config.set("debug_no_threshold", True)
+    with pytest.raises(StromError, match="bool mask"):
+        Query(path, schema) \
+            .group_by(lambda cols: cols[1] % 8, 8, agg_cols=[0],
+                      having=lambda gr: gr["count"][:3] > 0).run()
+
+
+def test_select_limit_drains_ring_before_owner_recovery(tmp_path, monkeypatch):
+    """LIMIT early-exit ordering: the DMA ring is drained (waited +
+    released) INSIDE the ResourceOwner scope, so abort-recovery never
+    returns a chunk the SSD may still be writing into (review finding).
+    CPython's refcounting happened to close the generator first even
+    before the explicit gen.close(); this pins the invariant so it
+    survives any future code holding a generator reference (or a
+    non-refcounting runtime).  Observable: zero chunks still
+    owner-attached when __exit__ runs."""
+    import os
+
+    from nvme_strom_tpu.scan import pool as pool_mod
+
+    schema = HeapSchema(n_cols=1, visibility=False)
+    n = schema.tuples_per_page * 64
+    path = str(tmp_path / "d.heap")
+    build_heap_file(path, [np.arange(n, dtype=np.int32)], schema)
+    fd = os.open(path, os.O_RDONLY)
+    os.fsync(fd)
+    os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    os.close(fd)
+    config.set("debug_no_threshold", True)
+    config.set("chunk_size", "64k")
+    config.set("buffer_size", "1m")
+    config.set("async_depth", 2)
+
+    attached_at_exit = []
+    orig_exit = pool_mod.ResourceOwner.__exit__
+
+    def spy_exit(self, exc_type, exc, tb):
+        attached_at_exit.append(len(self._chunks))
+        return orig_exit(self, exc_type, exc, tb)
+
+    monkeypatch.setattr(pool_mod.ResourceOwner, "__exit__", spy_exit)
+    out = Query(path, schema).select(limit=4).run()
+    assert int(out["count"]) == 4
+    assert attached_at_exit and all(n == 0 for n in attached_at_exit)

@@ -216,3 +216,36 @@ def test_strom_query_cli_select_limit(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
                "--limit", "3")
     assert out.returncode != 0 and "--limit" in out.stderr
+
+
+def test_strom_query_cli_having(tmp_path):
+    """--having filters groups after aggregation; avgs are in the output."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(12)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 100, n).astype(np.int32)
+    c1 = (np.arange(n) % 4).astype(np.int32)
+    path = str(tmp_path / "h.heap")
+    build_heap_file(path, [c0, c1], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--group-by", "c1", "--groups", "4", "--agg-cols", "0",
+               "--having", "avgs[0] > 45", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    want = [g for g in range(4)
+            if c0[c1 == g].mean() > 45]
+    assert res["groups"] == want
+    # --having without --group-by is a usage error
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--having", "count > 1")
+    assert out.returncode != 0 and "--having" in out.stderr
+    # disallowed names rejected
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--group-by", "c1", "--groups", "4",
+               "--having", "__import__('os')")
+    assert out.returncode != 0 and "not allowed" in out.stderr
