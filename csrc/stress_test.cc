@@ -55,8 +55,20 @@ int main(int argc, char** argv) {
       int wfd = open(wpath, O_RDWR | O_CREAT | O_TRUNC, 0600);
       void* buf = mmap(nullptr, reqs_per_task * req_sz, PROT_READ | PROT_WRITE,
                        MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+      // half the threads register their buffer: mixed fixed/plain opcodes
+      // race on the shared fixed table + SQ exactly like production, and
+      // register/unregister churn runs concurrently with submits
+      int fixed_slot = (t % 2 == 0)
+                           ? nstpu_buf_register(eng, buf, reqs_per_task * req_sz)
+                           : -1;
       std::mt19937 rng(t);
       for (int i = 0; i < iters; i++) {
+        if (fixed_slot >= 0 && i == iters / 2) {
+          // mid-run churn: drop and re-take a registration while other
+          // threads are submitting
+          nstpu_buf_unregister(eng, fixed_slot);
+          fixed_slot = nstpu_buf_register(eng, buf, reqs_per_task * req_sz);
+        }
         bool is_write = wfd >= 0 && (i % 4 == 2);  // ~25% write tasks
         nstpu_req reqs[reqs_per_task];
         for (int r = 0; r < reqs_per_task; r++) {
@@ -79,6 +91,7 @@ int main(int argc, char** argv) {
         int rc = nstpu_wait(eng, tid, 30000);
         if (rc != 0) failures++;
       }
+      if (fixed_slot >= 0) nstpu_buf_unregister(eng, fixed_slot);
       munmap(buf, reqs_per_task * req_sz);
       close(fd);
       if (wfd >= 0) {
@@ -93,11 +106,12 @@ int main(int argc, char** argv) {
   uint64_t ctr[NSTPU_CTR__COUNT];
   nstpu_engine_stats(eng, ctr, NSTPU_CTR__COUNT);
   printf("submits=%llu bytes=%llu writes=%llu write_bytes=%llu "
-         "wrong_wakeups=%llu max_inflight(reset)=ok failures=%d\n",
+         "fixed=%llu wrong_wakeups=%llu max_inflight(reset)=ok failures=%d\n",
          (unsigned long long)ctr[NSTPU_CTR_NR_SUBMIT_DMA],
          (unsigned long long)ctr[NSTPU_CTR_TOTAL_DMA_LENGTH],
          (unsigned long long)ctr[NSTPU_CTR_NR_WRITE_DMA],
          (unsigned long long)ctr[NSTPU_CTR_TOTAL_WRITE_LENGTH],
+         (unsigned long long)ctr[NSTPU_CTR_NR_FIXED_DMA],
          (unsigned long long)ctr[NSTPU_CTR_NR_WRONG_WAKEUP], failures.load());
   nstpu_engine_destroy(eng);
   return failures.load() ? 1 : 0;

@@ -25,6 +25,7 @@
 #include <linux/io_uring.h>
 #include <sys/mman.h>
 #include <sys/syscall.h>
+#include <sys/uio.h>
 #include <sched.h>
 #include <time.h>
 #include <unistd.h>
@@ -59,6 +60,10 @@ int sys_io_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
                        unsigned flags) {
   return (int)syscall(__NR_io_uring_enter, fd, to_submit, min_complete, flags,
                       nullptr, 0);
+}
+int sys_io_uring_register(int fd, unsigned opcode, void* arg,
+                          unsigned nr_args) {
+  return (int)syscall(__NR_io_uring_register, fd, opcode, arg, nr_args);
 }
 
 struct Uring {
@@ -212,6 +217,17 @@ struct Engine {
   std::mutex sq_m;
   std::thread reaper;
 
+  // registered (fixed) buffer table — the PRP-list-pool analog
+  // (kmod/nvme_strom.c:912-936): pre-pinned, pre-translated destinations.
+  // Guarded by sq_m (register/unregister and the submit-path lookup).
+  static constexpr unsigned kFixedSlots = 64;
+  struct FixedReg {
+    char* base = nullptr;
+    uint64_t len = 0;  // 0 = free slot
+  };
+  FixedReg fixed[kFixedSlots];
+  bool fixed_ok = false;
+
   // threadpool backend
   std::mutex q_m;
   std::condition_variable q_cv;
@@ -265,6 +281,14 @@ struct Engine {
       if (ring.init(depth) && probe_ops()) {
         backend = NSTPU_BACKEND_IO_URING;
         depth = ring.sq_entries;
+        // sparse fixed-buffer table (5.13+); failure just disables the
+        // READ_FIXED fast path, never the engine
+        struct io_uring_rsrc_register rr;
+        memset(&rr, 0, sizeof rr);
+        rr.nr = kFixedSlots;
+        rr.flags = IORING_RSRC_REGISTER_SPARSE;
+        fixed_ok = sys_io_uring_register(ring.fd, IORING_REGISTER_BUFFERS2,
+                                         &rr, sizeof rr) == 0;
         reaper = std::thread([this] { reap_loop(); });
         return true;
       }
@@ -367,6 +391,20 @@ struct Engine {
     io_uring_sqe* sqe = ring.get_sqe();
     if (!sqe) return false;
     sqe->opcode = rc->write ? IORING_OP_WRITE : IORING_OP_READ;
+    if (fixed_ok) {
+      // destination inside a registered buffer -> fixed opcode: the pages
+      // are already pinned + translated, no per-request get_user_pages
+      for (unsigned i = 0; i < kFixedSlots; i++) {
+        if (fixed[i].len && rc->dest >= fixed[i].base &&
+            rc->dest + rc->remaining <= fixed[i].base + fixed[i].len) {
+          sqe->opcode = rc->write ? IORING_OP_WRITE_FIXED
+                                  : IORING_OP_READ_FIXED;
+          sqe->buf_index = (uint16_t)i;
+          ctr[NSTPU_CTR_NR_FIXED_DMA].fetch_add(1, std::memory_order_relaxed);
+          break;
+        }
+      }
+    }
     sqe->fd = rc->fd;
     sqe->addr = (uint64_t)rc->dest;
     sqe->len = (uint32_t)rc->remaining;
@@ -651,6 +689,53 @@ struct Engine {
         ctr[NSTPU_CTR_CUR_DMA_COUNT].load(std::memory_order_relaxed));
     return n;
   }
+
+  // ---- registered (fixed) buffers ----------------------------------------
+
+  int buf_update_slot(unsigned slot, void* base, uint64_t len) {
+    struct iovec iov;
+    iov.iov_base = base;
+    iov.iov_len = (size_t)len;
+    struct io_uring_rsrc_update2 up;
+    memset(&up, 0, sizeof up);
+    up.offset = slot;
+    up.data = (uint64_t)&iov;
+    up.nr = 1;
+    int rc = sys_io_uring_register(ring.fd, IORING_REGISTER_BUFFERS_UPDATE,
+                                   &up, sizeof up);
+    return rc < 0 ? -errno : 0;
+  }
+
+  int buf_register(void* base, uint64_t len) {
+    if (backend != NSTPU_BACKEND_IO_URING || !fixed_ok) return -ENOSYS;
+    if (!base || !len) return -EINVAL;
+    std::lock_guard<std::mutex> lk(sq_m);
+    int slot = -1;
+    for (unsigned i = 0; i < kFixedSlots; i++)
+      if (fixed[i].len == 0) {
+        slot = (int)i;
+        break;
+      }
+    if (slot < 0) return -ENOSPC;
+    int rc = buf_update_slot((unsigned)slot, base, len);
+    if (rc < 0) return rc;
+    fixed[slot] = {(char*)base, len};
+    return slot;
+  }
+
+  int buf_unregister(int32_t slot) {
+    if (backend != NSTPU_BACKEND_IO_URING || !fixed_ok) return -ENOSYS;
+    if (slot < 0 || slot >= (int32_t)kFixedSlots) return -EINVAL;
+    std::lock_guard<std::mutex> lk(sq_m);
+    if (fixed[slot].len == 0) return -ENOENT;
+    // clear the kernel slot (empty iovec = sparse again); in-flight fixed
+    // ops hold their own rsrc refs, so this never yanks pages mid-I/O.
+    // Either way the table entry is freed: a later register overwrites the
+    // kernel slot via the same update path.
+    int rc = buf_update_slot((unsigned)slot, nullptr, 0);
+    fixed[slot] = {nullptr, 0};
+    return rc;
+  }
 };
 
 // ---------------------------------------------------------------------------
@@ -753,6 +838,18 @@ int nstpu_engine_stats(uint64_t engine, uint64_t* out, int32_t cap) {
   Engine* e = lookup(engine);
   if (!e) return -ENOENT;
   return e->stats(out, cap);
+}
+
+int nstpu_buf_register(uint64_t engine, void* base, uint64_t len) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  return e->buf_register(base, len);
+}
+
+int nstpu_buf_unregister(uint64_t engine, int32_t slot) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  return e->buf_unregister(slot);
 }
 
 int nstpu_engine_member_stats(uint64_t engine, int32_t member,

@@ -51,6 +51,7 @@ enum {
   NSTPU_CTR_NR_SQ_FULL,         /* submission stalls on full SQ */
   NSTPU_CTR_NR_WRITE_DMA,       /* write requests submitted (RAM2SSD leg) */
   NSTPU_CTR_TOTAL_WRITE_LENGTH, /* bytes submitted as writes */
+  NSTPU_CTR_NR_FIXED_DMA,       /* requests that rode a registered buffer */
   NSTPU_CTR__COUNT
 };
 
@@ -122,6 +123,22 @@ int      nstpu_engine_stats(uint64_t engine, uint64_t* out, int32_t cap);
  * [0, NSTPU_MAX_MEMBERS), -ENOENT for a bad engine handle. */
 int      nstpu_engine_member_stats(uint64_t engine, int32_t member,
                                    uint64_t* out3);
+
+/* Registered (fixed) buffers — the PRP-list-pool analog: the reference
+ * pre-allocates DMA-coherent PRP arrays so the hot path never pays mapping
+ * setup (kmod/nvme_strom.c:912-936); here a pinned staging buffer is
+ * registered with io_uring once, and every request whose destination falls
+ * inside it rides IORING_OP_READ_FIXED/WRITE_FIXED with the pages already
+ * GUP-pinned and translated — no per-request get_user_pages.
+ *
+ * nstpu_buf_register returns a slot >= 0, -ENOSYS when the backend has no
+ * fixed-buffer support (threadpool, old kernel), -ENOSPC when all slots are
+ * taken, or another -errno from the kernel (e.g. -ENOMEM memlock limit).
+ * Callers MUST keep [base, base+len) mapped until nstpu_buf_unregister (or
+ * engine destroy); requests simply fall back to the normal opcode when
+ * their destination is not inside any registered region. */
+int      nstpu_buf_register(uint64_t engine, void* base, uint64_t len);
+int      nstpu_buf_unregister(uint64_t engine, int32_t slot);
 
 #ifdef __cplusplus
 }

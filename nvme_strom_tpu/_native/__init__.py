@@ -38,6 +38,7 @@ NATIVE_COUNTERS = (
     "nr_sq_full",
     "nr_write_dma",
     "total_write_length",
+    "nr_fixed_dma",
 )
 
 REQ_WRITE = 0x1        # NSTPU_REQ_WRITE
@@ -97,6 +98,14 @@ def _load() -> Optional[ctypes.CDLL]:
             pass
         try:
             lib.nstpu_signature.restype = ctypes.c_char_p
+        except AttributeError:  # pragma: no cover - older .so
+            pass
+        try:
+            lib.nstpu_buf_register.argtypes = [ctypes.c_uint64,
+                                               ctypes.c_void_p,
+                                               ctypes.c_uint64]
+            lib.nstpu_buf_unregister.argtypes = [ctypes.c_uint64,
+                                                 ctypes.c_int32]
         except AttributeError:  # pragma: no cover - older .so
             pass
         _lib = lib
@@ -163,6 +172,22 @@ class NativeEngine:
         if tid < 0:
             raise StromError(-tid, f"native submit failed ({-tid})")
         return tid
+
+    def buf_register(self, addr: int, length: int) -> Optional[int]:
+        """Register a pinned region as an io_uring fixed buffer (the
+        PRP-list-pool analog, kmod/nvme_strom.c:912-936).  Returns the
+        slot, or None when unsupported/full — callers just lose the fast
+        path, never correctness.  The region must stay mapped until
+        :meth:`buf_unregister` (or engine close)."""
+        if not hasattr(self._lib, "nstpu_buf_register"):
+            return None
+        slot = self._lib.nstpu_buf_register(self._h, ctypes.c_void_p(addr),
+                                            ctypes.c_uint64(length))
+        return slot if slot >= 0 else None
+
+    def buf_unregister(self, slot: int) -> None:
+        if hasattr(self._lib, "nstpu_buf_unregister") and self._h:
+            self._lib.nstpu_buf_unregister(self._h, slot)
 
     def member_stats(self, member: int) -> Tuple[int, int, int]:
         """(completed requests, bytes, busy ns) for one stripe member."""

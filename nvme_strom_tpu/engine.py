@@ -623,19 +623,54 @@ class DmaBuffer:
         # prefault so first DMA doesn't eat page faults (reference prefaults
         # its shm pool, pgsql/nvme_strom.c:1500-1510)
         mm[0:length:PAGE_SIZE] = b"\0" * len(range(0, length, PAGE_SIZE))
+        self._close_cbs: List = []
+        self._cb_lock = threading.Lock()
+        self._closing = False
+
+    def on_close(self, cb) -> bool:
+        """Arrange for *cb* to run when this buffer is closed (BEFORE the
+        munmap) — how a session keeps io_uring fixed-buffer registrations
+        exactly coextensive with the mapping (a registration outliving the
+        mmap would alias whatever lands at the address next).  Returns
+        False when the buffer is already closed/closing: the caller must
+        run its cleanup itself."""
+        with self._cb_lock:
+            if self._mm is None or self._closing:
+                return False
+            self._close_cbs.append(cb)
+            return True
 
     def view(self) -> memoryview:
         return memoryview(self._mm)
 
     def close(self) -> None:
-        if self._mm is not None:
-            if self.pinned:
-                _libc.munlock(ctypes.c_void_p(self.addr), ctypes.c_size_t(self.length))
+        with self._cb_lock:
+            if self._mm is None or self._closing:
+                return
+            self._closing = True
+            cbs, self._close_cbs = self._close_cbs, []
+        for cb in cbs:
             try:
-                self._mm.close()
-            except BufferError:
+                cb()
+            except Exception:
                 pass
+        if self.pinned:
+            _libc.munlock(ctypes.c_void_p(self.addr), ctypes.c_size_t(self.length))
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+        with self._cb_lock:
             self._mm = None
+
+    def __del__(self):  # pragma: no cover - GC backstop
+        # a registered-but-never-closed buffer must still release its
+        # io_uring registration BEFORE the mmap finalizer unmaps the range
+        # (a stale fixed slot over a recycled VA would alias silently)
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -784,6 +819,11 @@ class Session:
         self._closed = False
         self._abandon_native = False
         self._members_used: set = set()  # members seen by native submits
+        # io_uring fixed-buffer registrations: id(backing) -> slot (-1 =
+        # attempted, unsupported).  The PRP-pool analog: register once,
+        # every request into the region skips per-request page pinning.
+        self._fixed_regs: Dict[int, int] = {}
+        self._fixed_lock = threading.Lock()
         # native engine: the GIL-free executor for planned request batches
         self._native = None
         want = io_backend or config.get("io_backend")
@@ -816,6 +856,9 @@ class Session:
     def map_buffer(self, view: memoryview, *, kind: str = "user",
                    backing: object = None, device: Optional[str] = None) -> int:
         view = view.cast("B")
+        if (kind == "pinned_host" and self._native is not None
+                and isinstance(backing, DmaBuffer)):
+            self._register_fixed(backing)
         with self._buf_lock:
             handle = self._next_handle
             self._next_handle += 1
@@ -825,6 +868,34 @@ class Session:
                               device=device)
             self._buffers[handle] = ((view, backing), info)
         return handle
+
+    def _register_fixed(self, backing: "DmaBuffer") -> None:
+        """Register *backing* as an io_uring fixed buffer, once per buffer
+        per session; the registration is released by the buffer's own
+        close (so it can never outlive the mapping and alias a reuse of
+        the address range)."""
+        key = id(backing)
+        with self._fixed_lock:
+            if key in self._fixed_regs:
+                return
+            slot = self._native.buf_register(backing.addr, backing.length)
+            # -1 = unsupported/full: remembered so we don't retry the
+            # syscall on every map of a hot pool buffer
+            self._fixed_regs[key] = -1 if slot is None else slot
+            if slot is None:
+                return
+        if not backing.on_close(lambda: self._unregister_fixed(key)):
+            # buffer closed between register and hook-up: release now
+            self._unregister_fixed(key)
+
+    def _unregister_fixed(self, key: int) -> None:
+        with self._fixed_lock:
+            slot = self._fixed_regs.pop(key, -1)
+        if slot >= 0 and self._native is not None:
+            try:
+                self._native.buf_unregister(slot)
+            except Exception:   # engine already closed: kernel freed it
+                pass
 
     def _get_buffer(self, handle: int, need: int = 0) -> memoryview:
         with self._buf_lock:
@@ -1287,6 +1358,7 @@ class Session:
             "total_dma_length": d.get("total_dma_length", 0),
             "nr_debug1": d.get("nr_resubmit", 0),
             "nr_debug2": d.get("nr_sq_full", 0),
+            "nr_debug3": d.get("nr_fixed_dma", 0),
         })
         # per-member deltas fold into the registry the same way
         for m, (nreq, nbytes, ns) in self._native.member_stats_delta(

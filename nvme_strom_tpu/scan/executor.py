@@ -168,7 +168,9 @@ class TableScanner:
             chunk = self.pool.alloc(owner=owner)
             handle = None
             try:
-                handle = self.session.map_buffer(chunk.view, kind="pinned_host")
+                handle = self.session.map_buffer(
+                    chunk.view, kind="pinned_host",
+                    backing=self.pool.backing_buffer(chunk.node))
                 if first < self.n_chunks:
                     ids = [first]
                     res = self.session.memcpy_ssd2ram(self.source, handle,

@@ -120,6 +120,12 @@ class DmaBufferPool:
             self.n_chunks += per_node
         self._closed = False
 
+    def backing_buffer(self, node: int) -> DmaBuffer:
+        """The node's backing :class:`DmaBuffer` — scanners pass it as the
+        ``backing`` of per-chunk buffer maps so the session can register
+        the whole pool region as one io_uring fixed buffer."""
+        return self._buffers[self.nodes.index(node)]
+
     def alloc(self, *, preferred_node: int = -1, blocking: bool = True,
               timeout: Optional[float] = None,
               owner: Optional[ResourceOwner] = None) -> DmaChunk:

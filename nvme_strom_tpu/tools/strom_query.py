@@ -31,49 +31,86 @@ from ..scan.heap import HeapSchema
 __all__ = ["main", "cli"]
 
 
+def _compile_whitelisted(expr: str, label: str, name_error):
+    """Shared sandbox scaffolding for every eval'd CLI expression
+    (--where/--group-by/--having): compile, then reject any name the
+    caller's ``name_error`` flags (returns an error string, or None for
+    allowed).  One copy, so a hardening change covers every expression
+    kind."""
+    code = compile(expr, f"<strom_query:{label}>", "eval")
+    for name in code.co_names:
+        msg = name_error(name)
+        if msg:
+            raise SystemExit(f"error: {msg}")
+    return code
+
+
+def _eval_sandboxed(code, ns: dict):
+    return eval(code, {"__builtins__": {}}, ns)
+
+
 def _expr_fn(expr: str, n_cols: int):
     """Compile "c0 > 10" style expressions to fn(cols) on a whitelisted
     namespace (no builtins)."""
     import jax.numpy as jnp
-    code = compile(expr, "<strom_query>", "eval")
-    for name in code.co_names:
+
+    def name_error(name):
         if name.startswith("c") and name[1:].isdigit():
             if int(name[1:]) >= n_cols:
-                raise SystemExit(f"error: {name} out of range — this "
-                                 f"schema has columns c0..c{n_cols - 1}")
-        elif name not in ("abs", "minimum", "maximum", "where", "jnp"):
-            raise SystemExit(f"error: name {name!r} not allowed in "
-                             f"expressions (use c0..c{n_cols - 1}, abs, "
-                             f"minimum, maximum, where)")
+                return (f"{name} out of range — this schema has columns "
+                        f"c0..c{n_cols - 1}")
+            return None
+        if name in ("abs", "minimum", "maximum", "where", "jnp"):
+            return None
+        return (f"name {name!r} not allowed in expressions (use "
+                f"c0..c{n_cols - 1}, abs, minimum, maximum, where)")
+
+    code = _compile_whitelisted(expr, "expr", name_error)
 
     def fn(cols):
         ns = {f"c{i}": cols[i] for i in range(len(cols))}
         ns.update(abs=jnp.abs, minimum=jnp.minimum, maximum=jnp.maximum,
                   where=jnp.where, jnp=jnp)
-        return eval(code, {"__builtins__": {}}, ns)
+        return _eval_sandboxed(code, ns)
 
     return fn
 
 
 def _having_fn(expr: str):
     """Compile a HAVING expression over the finished numpy group arrays
-    (count, sums, mins, maxs, avgs) on the same whitelisted-eval terms as
+    (count, sums, mins, maxs, avgs) on the same sandbox terms as
     :func:`_expr_fn`."""
-    code = compile(expr, "<strom_query:having>", "eval")
     allowed = ("count", "sums", "mins", "maxs", "avgs",
                "abs", "minimum", "maximum", "where", "np")
-    for name in code.co_names:
-        if name not in allowed:
-            raise SystemExit(f"error: name {name!r} not allowed in "
-                             f"--having (use {', '.join(allowed)})")
+    code = _compile_whitelisted(
+        expr, "having",
+        lambda name: None if name in allowed else
+        f"name {name!r} not allowed in --having (use {', '.join(allowed)})")
 
     def fn(groups):
         ns = dict(groups)
         ns.update(abs=np.abs, minimum=np.minimum, maximum=np.maximum,
                   where=np.where, np=np)
-        return eval(code, {"__builtins__": {}}, ns)
+        return _eval_sandboxed(code, ns)
 
     return fn
+
+
+def _to_jsonable(v):
+    """tolist() with non-finite floats mapped to null — group avgs are NaN
+    for empty groups, and bare NaN in --json output would break strict
+    RFC-8259 consumers (jq et al.)."""
+    import math
+    a = np.asarray(v)
+    if a.dtype.kind != "f":
+        return a.tolist()
+
+    def fix(x):
+        if isinstance(x, list):
+            return [fix(y) for y in x]
+        return x if math.isfinite(x) else None
+
+    return fix(a.astype(float).tolist())
 
 
 def main(argv=None) -> int:
@@ -210,7 +247,8 @@ def main(argv=None) -> int:
             plan, kernel=args.kernel,
             reason=plan.reason + f" [overridden: --kernel {args.kernel}]")
     if args.as_json:
-        print(json.dumps({k: np.asarray(v).tolist() for k, v in out.items()}))
+        print(json.dumps({k: _to_jsonable(v) for k, v in out.items()},
+                         allow_nan=False))
         return 0
     print(plan)
     for k, v in out.items():

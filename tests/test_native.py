@@ -296,3 +296,99 @@ def test_native_concurrent_sessions_stress(tmp_path):
     for t in threads:
         t.join()
     assert not errors, errors[:3]
+
+
+# ---------------------------------------------------------------------------
+# registered (fixed) buffers — the PRP-list-pool analog
+# ---------------------------------------------------------------------------
+
+def test_fixed_buffer_register_read_unregister(tmp_data_file):
+    """Requests into a registered region ride READ_FIXED (counter moves),
+    bytes still correct; slots recycle after unregister."""
+    try:
+        eng = NativeEngine("io_uring", 16)
+    except StromError:
+        pytest.skip("io_uring unavailable")
+    fd = os.open(tmp_data_file, os.O_RDONLY | os.O_DIRECT)
+    buf = mmap.mmap(-1, 1 << 20)
+    try:
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        slot = eng.buf_register(addr, 1 << 20)
+        if slot is None:
+            pytest.skip("fixed buffers unsupported on this kernel")
+        reqs = [(fd, i * (256 << 10), 256 << 10, i * (256 << 10))
+                for i in range(4)]
+        tid = eng.submit(addr, reqs)
+        eng.wait(tid, 10000)
+        assert bytes(buf[:1 << 20]) == expected_bytes(0, 1 << 20)
+        assert eng.stats()["nr_fixed_dma"] == 4
+        eng.buf_unregister(slot)
+        # slot is reusable and non-registered reads still work
+        assert eng.buf_register(addr, 1 << 20) == slot
+        tid = eng.submit(addr, [(fd, 0, 64 << 10, 0)])
+        eng.wait(tid, 10000)
+    finally:
+        os.close(fd)
+        eng.close()
+        buf.close()
+
+
+def test_fixed_buffer_outside_region_falls_back(tmp_data_file):
+    """A destination not inside any registered region uses the plain
+    opcode — same bytes, counter unmoved."""
+    try:
+        eng = NativeEngine("io_uring", 16)
+    except StromError:
+        pytest.skip("io_uring unavailable")
+    fd = os.open(tmp_data_file, os.O_RDONLY | os.O_DIRECT)
+    reg = mmap.mmap(-1, 64 << 10)
+    other = mmap.mmap(-1, 256 << 10)
+    try:
+        reg_addr = ctypes.addressof(ctypes.c_char.from_buffer(reg))
+        if eng.buf_register(reg_addr, 64 << 10) is None:
+            pytest.skip("fixed buffers unsupported on this kernel")
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(other))
+        tid = eng.submit(addr, [(fd, 0, 256 << 10, 0)])
+        eng.wait(tid, 10000)
+        assert bytes(other[:256 << 10]) == \
+            expected_bytes(0, 256 << 10)
+        assert eng.stats()["nr_fixed_dma"] == 0
+    finally:
+        os.close(fd)
+        eng.close()
+        reg.close()
+        other.close()
+
+
+def test_session_ssd2ram_rides_fixed_path(tmp_path):
+    """Session.alloc_dma_buffer registers the buffer; a ssd2ram memcpy on
+    the io_uring backend reports fixed-path requests in the stats debug
+    counter, and unregistration follows the buffer's close."""
+    path = str(tmp_path / "fixed_sess.bin")
+    make_test_file(path, 1 << 20)
+    _drop_cache(path)
+    config.set("io_backend", "io_uring")
+    try:
+        with Session() as s:
+            if s.backend_name != "io_uring":
+                pytest.skip("io_uring unavailable")
+            h, buf = s.alloc_dma_buffer(1 << 20)
+            with PlainSource(path) as src:
+                res = s.memcpy_ssd2ram(src, h, list(range(16)), CHUNK)
+                s.memcpy_wait(res.dma_task_id)
+            assert bytes(buf.view()[:1 << 20]) == \
+                expected_bytes(0, 1 << 20)
+            d = s._native.stats()
+            if d.get("nr_fixed_dma", 0) == 0:
+                pytest.skip("fixed buffers unsupported on this kernel")
+            key = id(buf)
+            assert s._fixed_regs.get(key, -1) >= 0
+            slot = s._fixed_regs[key]
+            buf.close()   # close callback releases the registration
+            assert key not in s._fixed_regs
+            # the slot is free again: a new buffer can take it
+            h2, buf2 = s.alloc_dma_buffer(1 << 20)
+            assert s._fixed_regs.get(id(buf2)) == slot
+            buf2.close()
+    finally:
+        config.set("io_backend", "auto")

@@ -249,3 +249,26 @@ def test_strom_query_cli_having(tmp_path):
                "--group-by", "c1", "--groups", "4",
                "--having", "__import__('os')")
     assert out.returncode != 0 and "not allowed" in out.stderr
+
+
+def test_strom_query_json_empty_group_avgs_are_null(tmp_path):
+    """Empty-group avgs serialize as null, never bare NaN (--json must
+    stay RFC-8259 parseable)."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page
+    c0 = np.arange(n, dtype=np.int32)
+    c1 = (np.arange(n) % 3).astype(np.int32)   # groups 3..4 stay empty
+    path = str(tmp_path / "n.heap")
+    build_heap_file(path, [c0, c1], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--group-by", "c1", "--groups", "5", "--agg-cols", "0",
+               "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])  # strict parse
+    assert res["avgs"][0][3] is None and res["avgs"][0][4] is None
+    assert res["avgs"][0][0] is not None
