@@ -400,7 +400,12 @@ struct Engine {
           sqe->opcode = rc->write ? IORING_OP_WRITE_FIXED
                                   : IORING_OP_READ_FIXED;
           sqe->buf_index = (uint16_t)i;
-          ctr[NSTPU_CTR_NR_FIXED_DMA].fetch_add(1, std::memory_order_relaxed);
+          // count once per request, not per continuation, matching the
+          // NR_SUBMIT_DMA convention (a short-read resubmit has
+          // remaining < orig_len)
+          if (rc->remaining == rc->orig_len)
+            ctr[NSTPU_CTR_NR_FIXED_DMA].fetch_add(1,
+                                                  std::memory_order_relaxed);
           break;
         }
       }

@@ -640,6 +640,15 @@ class DmaBuffer:
             self._close_cbs.append(cb)
             return True
 
+    def remove_close_cb(self, cb) -> None:
+        """Detach a close callback (a closing Session removes its hooks so
+        long-lived pool buffers don't accumulate dead-session closures)."""
+        with self._cb_lock:
+            try:
+                self._close_cbs.remove(cb)
+            except ValueError:
+                pass
+
     def view(self) -> memoryview:
         return memoryview(self._mm)
 
@@ -873,27 +882,27 @@ class Session:
         """Register *backing* as an io_uring fixed buffer, once per buffer
         per session; the registration is released by the buffer's own
         close (so it can never outlive the mapping and alias a reuse of
-        the address range)."""
+        the address range).  Failed attempts are cached as slot -1 but
+        still evicted on buffer close — ``id()`` recycles after GC, and a
+        sticky sentinel would silently deny a NEW buffer the fast path."""
         key = id(backing)
         with self._fixed_lock:
             if key in self._fixed_regs:
                 return
             slot = self._native.buf_register(backing.addr, backing.length)
-            # -1 = unsupported/full: remembered so we don't retry the
-            # syscall on every map of a hot pool buffer
-            self._fixed_regs[key] = -1 if slot is None else slot
-            if slot is None:
-                return
-        if not backing.on_close(lambda: self._unregister_fixed(key)):
+            cb = lambda: self._unregister_fixed(key)  # noqa: E731
+            self._fixed_regs[key] = (-1 if slot is None else slot,
+                                     backing, cb)
+        if not backing.on_close(cb):
             # buffer closed between register and hook-up: release now
             self._unregister_fixed(key)
 
     def _unregister_fixed(self, key: int) -> None:
         with self._fixed_lock:
-            slot = self._fixed_regs.pop(key, -1)
-        if slot >= 0 and self._native is not None:
+            entry = self._fixed_regs.pop(key, None)
+        if entry and entry[0] >= 0 and self._native is not None:
             try:
-                self._native.buf_unregister(slot)
+                self._native.buf_unregister(entry[0])
             except Exception:   # engine already closed: kernel freed it
                 pass
 
@@ -1400,6 +1409,16 @@ class Session:
                     del self._slots[s][tid]
         self._abandon_native = True  # bound pool shutdown on stuck native I/O
         self._pool.shutdown(wait=True)
+        # detach close hooks from long-lived (pool) buffers so a closed
+        # session is not pinned in their callback lists; the engine close
+        # below frees every kernel-side fixed slot wholesale
+        with self._fixed_lock:
+            regs, self._fixed_regs = list(self._fixed_regs.values()), {}
+        for _slot, backing, cb in regs:
+            try:
+                backing.remove_close_cb(cb)
+            except Exception:
+                pass
         if self._native is not None:
             self._native.reap(timeout_ms=int(timeout * 1000))
             try:

@@ -30,9 +30,10 @@ __all__ = ["make_groupby_fn", "scan_groupby_step", "combine_groupby"]
 def combine_groupby(acc: dict, out: dict) -> dict:
     """Batch-fold combiner for grouped results (pass as
     ``TableScanner.scan_filter(..., combine=combine_groupby)`` or to
-    ``distributed_scan_filter``): counts/sums add, mins/maxs meet."""
+    ``distributed_scan_filter``): counts/sums/sumsqs add, mins/maxs meet."""
     return {"count": acc["count"] + out["count"],
             "sums": acc["sums"] + out["sums"],
+            "sumsqs": acc["sumsqs"] + out["sumsqs"],
             "mins": jnp.minimum(acc["mins"], out["mins"]),
             "maxs": jnp.maximum(acc["maxs"], out["maxs"])}
 
@@ -75,9 +76,12 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
     ``key_fn(cols, *params) -> (B, T) int32`` group ids in ``[0, n_groups)``
     (out-of-range ids fall into no group); ``predicate(cols, *params)`` an
     optional row filter.  ``agg_cols`` — column indices to aggregate
-    (default: all).  Returns per group: ``count (G,)``, and ``sums / mins /
-    maxs`` of shape ``(len(agg_cols), G)``; empty groups report 0 count,
-    0 sum, and the dtype's worst-value sentinels for min/max.
+    (default: all).  Returns per group: ``count (G,)``, and ``sums / sumsqs
+    / mins / maxs`` of shape ``(len(agg_cols), G)``; empty groups report 0
+    count, 0 sum, and the dtype's worst-value sentinels for min/max.
+    ``sumsqs`` (for VAR/STDDEV) accumulates in floating point on every
+    path — int32 squares overflow long before sums do, and variance is a
+    statistical quantity, so float semantics are the honest contract.
 
     Aggregation columns must share one dtype — int32 or float32 (uniform
     ``(V, G)`` result arrays; the reference's per-tuple walk had the same
@@ -122,6 +126,15 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
             sums = jax.lax.dot_general(
                 onehot, vals, (((0,), (0,)), ((), ())),
                 preferred_element_type=acc_t).T         # (V, G)
+        # sum of squares for VAR/STDDEV: always floating (int32 squares
+        # wrap far earlier than sums; f64 under x64, else f32) and
+        # per-group confined like the float sums (NaN stays in its group)
+        sq_t = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        sumsqs = jnp.stack([
+            jax.ops.segment_sum(
+                jnp.where(flat_sel, v.astype(sq_t) * v.astype(sq_t), 0.0),
+                flat_keys, num_segments=G + 1)[:G]
+            for v in vals.T])
         mins = jnp.stack([
             jax.ops.segment_min(jnp.where(flat_sel, v, hi), flat_keys,
                                 num_segments=G + 1)[:G]
@@ -130,7 +143,8 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
             jax.ops.segment_max(jnp.where(flat_sel, v, lo), flat_keys,
                                 num_segments=G + 1)[:G]
             for v in vals.T])
-        return {"count": count, "sums": sums, "mins": mins, "maxs": maxs}
+        return {"count": count, "sums": sums, "sumsqs": sumsqs,
+                "mins": mins, "maxs": maxs}
 
     return run
 

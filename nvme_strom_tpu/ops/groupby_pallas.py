@@ -70,7 +70,8 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
     hi = np.float32(np.inf) if is_f else _I32_MAX
 
     def make_kernel(n_params: int):
-      def kernel(params_ref, w_ref, count_ref, sums_ref, mins_ref, maxs_ref):
+      def kernel(params_ref, w_ref, count_ref, sums_ref, sumsqs_ref,
+                 mins_ref, maxs_ref):
         i = pl.program_id(0)
 
         @pl.when(i == 0)
@@ -79,6 +80,7 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                 count_ref[0, g] = 0
                 for vi in range(V):
                     sums_ref[vi, g] = zero
+                    sumsqs_ref[vi, g] = 0.0
                     mins_ref[vi, g] = hi
                     maxs_ref[vi, g] = lo
 
@@ -95,7 +97,11 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             count_ref[0, g] += jnp.sum(m.astype(jnp.int32))
             for vi, ci in enumerate(cols_idx):
                 v = cols[ci]
+                vf = v.astype(jnp.float32)
                 sums_ref[vi, g] += jnp.sum(jnp.where(m, v, zero))
+                # float accumulator (shared sumsqs contract: int32
+                # squares would wrap far earlier than the sums do)
+                sumsqs_ref[vi, g] += jnp.sum(jnp.where(m, vf * vf, 0.0))
                 mins_ref[vi, g] = jnp.minimum(
                     mins_ref[vi, g], jnp.min(jnp.where(m, v, hi)))
                 maxs_ref[vi, g] = jnp.maximum(
@@ -110,7 +116,7 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             padded.reshape(b, _WORDS, 4), jnp.int32).reshape(b, _WORDS)
         pvec = jnp.stack([jnp.asarray(p, jnp.int32) for p in params]) \
             if params else jnp.zeros((1,), jnp.int32)
-        count, sums, mins, maxs = pl.pallas_call(
+        count, sums, sumsqs, mins, maxs = pl.pallas_call(
             make_kernel(len(params)),
             grid=(b // _BLOCK_PAGES,),
             in_specs=[
@@ -122,15 +128,18 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((1, G), jnp.int32),
                 jax.ShapeDtypeStruct((V, G), acc_t),
+                jax.ShapeDtypeStruct((V, G), jnp.float32),
                 jax.ShapeDtypeStruct((V, G), acc_t),
                 jax.ShapeDtypeStruct((V, G), acc_t),
             ],
             interpret=_should_interpret() if interpret is None else interpret,
         )(pvec, words)
-        return {"count": count[0], "sums": sums, "mins": mins, "maxs": maxs}
+        return {"count": count[0], "sums": sums, "sumsqs": sumsqs,
+                "mins": mins, "maxs": maxs}
 
     return run

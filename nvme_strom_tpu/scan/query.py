@@ -135,13 +135,14 @@ class Query:
     def group_by(self, key_fn: Callable, n_groups: int, *,
                  agg_cols: Optional[Sequence[int]] = None,
                  having: Optional[Callable] = None) -> "Query":
-        """Terminal: per-group count/sum/min/max/avg.
+        """Terminal: per-group count/sum/min/max/avg/var/stddev.
         ``key_fn(cols) -> (B, T) int32`` ids in ``[0, n_groups)``.
 
         ``having(groups) -> (G,) bool`` filters groups AFTER aggregation
         (SQL HAVING): it receives the finished numpy result
-        (``count (G,)``, ``sums/mins/maxs/avgs (V, G)``) and surviving
-        groups are compressed out, their original ids in ``"groups"``."""
+        (``count (G,)``, ``sums/sumsqs/mins/maxs/avgs/vars/stds (V, G)``)
+        and surviving groups are compressed out, their original ids in
+        ``"groups"``."""
         self._require_no_terminal()
         self._op = "group_by"
         self._terminal_set = True
@@ -478,7 +479,8 @@ class Query:
 
     def _finalize(self, out: dict) -> dict:
         """Post-aggregation decoration for group_by: derived ``avgs``
-        (sum/count, NaN for empty groups) and the HAVING filter — applied
+        (sum/count), ``vars``/``stds`` (population variance via
+        E[x²]−E[x]², NaN for empty groups) and the HAVING filter — applied
         AFTER the cross-batch/cross-device fold, which is what gives it
         SQL's post-aggregation semantics."""
         if self._op != "group_by" or not out:
@@ -487,10 +489,21 @@ class Query:
         count = np.asarray(out["count"])
         sums = np.asarray(out["sums"])
         with np.errstate(divide="ignore", invalid="ignore"):
-            avgs = np.where(count > 0, sums / np.maximum(count, 1), np.nan)
+            denom = np.maximum(count, 1)
+            avgs = np.where(count > 0, sums / denom, np.nan)
         res = {"count": count, "sums": sums,
                "mins": np.asarray(out["mins"]),
                "maxs": np.asarray(out["maxs"]), "avgs": avgs}
+        if "sumsqs" in out:
+            sumsqs = np.asarray(out["sumsqs"], dtype=np.float64)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                # clamp: E[x^2]-E[x]^2 can dip epsilon-negative in floats
+                vars_ = np.maximum(
+                    np.where(count > 0, sumsqs / denom - np.square(avgs),
+                             np.nan), 0.0)
+            res["sumsqs"] = sumsqs
+            res["vars"] = vars_
+            res["stds"] = np.sqrt(vars_)
         if having is None:
             return res
         mask = np.asarray(having(res)).astype(bool)

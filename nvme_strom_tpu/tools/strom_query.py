@@ -36,12 +36,25 @@ def _compile_whitelisted(expr: str, label: str, name_error):
     (--where/--group-by/--having): compile, then reject any name the
     caller's ``name_error`` flags (returns an error string, or None for
     allowed).  One copy, so a hardening change covers every expression
-    kind."""
+    kind.
+
+    The check recurses into nested code objects (lambdas, comprehensions):
+    their names live in the INNER code object's co_names, and an attribute
+    chain like ``().__class__.__bases__`` wrapped in a lambda would
+    otherwise slip past an outer-only scan (review finding)."""
+    import types
+
+    def check(code):
+        for name in code.co_names + code.co_varnames + code.co_freevars:
+            msg = name_error(name)
+            if msg:
+                raise SystemExit(f"error: {msg}")
+        for const in code.co_consts:
+            if isinstance(const, types.CodeType):
+                check(const)
+
     code = compile(expr, f"<strom_query:{label}>", "eval")
-    for name in code.co_names:
-        msg = name_error(name)
-        if msg:
-            raise SystemExit(f"error: {msg}")
+    check(code)
     return code
 
 
@@ -80,8 +93,8 @@ def _having_fn(expr: str):
     """Compile a HAVING expression over the finished numpy group arrays
     (count, sums, mins, maxs, avgs) on the same sandbox terms as
     :func:`_expr_fn`."""
-    allowed = ("count", "sums", "mins", "maxs", "avgs",
-               "abs", "minimum", "maximum", "where", "np")
+    allowed = ("count", "sums", "sumsqs", "mins", "maxs", "avgs", "vars",
+               "stds", "abs", "minimum", "maximum", "where", "np")
     code = _compile_whitelisted(
         expr, "having",
         lambda name: None if name in allowed else

@@ -382,13 +382,27 @@ def test_session_ssd2ram_rides_fixed_path(tmp_path):
             if d.get("nr_fixed_dma", 0) == 0:
                 pytest.skip("fixed buffers unsupported on this kernel")
             key = id(buf)
-            assert s._fixed_regs.get(key, -1) >= 0
-            slot = s._fixed_regs[key]
+            slot = s._fixed_regs[key][0]
+            assert slot >= 0
             buf.close()   # close callback releases the registration
             assert key not in s._fixed_regs
             # the slot is free again: a new buffer can take it
             h2, buf2 = s.alloc_dma_buffer(1 << 20)
-            assert s._fixed_regs.get(id(buf2)) == slot
+            assert s._fixed_regs[id(buf2)][0] == slot
             buf2.close()
     finally:
         config.set("io_backend", "auto")
+
+
+def test_session_close_detaches_pool_buffer_callbacks(tmp_path):
+    """Closed sessions must not accumulate in a long-lived pool buffer's
+    close-callback list (review finding)."""
+    from nvme_strom_tpu.engine import DmaBuffer
+    buf = DmaBuffer(1 << 20)
+    try:
+        for _ in range(3):
+            with Session(io_backend="auto") as s:
+                s.map_buffer(buf.view(), kind="pinned_host", backing=buf)
+        assert len(buf._close_cbs) == 0
+    finally:
+        buf.close()

@@ -700,3 +700,37 @@ def test_select_limit_drains_ring_before_owner_recovery(tmp_path, monkeypatch):
     out = Query(path, schema).select(limit=4).run()
     assert int(out["count"]) == 4
     assert attached_at_exit and all(n == 0 for n in attached_at_exit)
+
+
+def test_group_by_variance_and_stddev(heap):
+    """vars/stds derive from the sumsqs accumulator and match numpy's
+    population variance, on both kernel paths (float accumulation:
+    rtol, not equality)."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = (vis != 0) & (c0 > 0)
+    for kernel in ("xla", "pallas"):
+        out = Query(path, schema).where(lambda cols: cols[0] > 0) \
+            .group_by(lambda cols: cols[1] % 8, 8, agg_cols=[0]) \
+            .run(kernel=kernel)
+        for g in range(8):
+            m = sel & (c1 % 8 == g)
+            if m.sum():
+                np.testing.assert_allclose(out["vars"][0][g],
+                                           c0[m].var(), rtol=1e-4)
+                np.testing.assert_allclose(out["stds"][0][g],
+                                           c0[m].std(), rtol=1e-4)
+            else:
+                assert np.isnan(out["vars"][0][g])
+
+
+def test_group_by_having_on_stddev(heap):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = vis != 0
+    stds = np.array([c0[sel & (c1 % 4 == g)].std() for g in range(4)])
+    cut = float(np.median(stds))
+    out = Query(path, schema) \
+        .group_by(lambda cols: cols[1] % 4, 4, agg_cols=[0],
+                  having=lambda gr: gr["stds"][0] > cut).run()
+    np.testing.assert_array_equal(out["groups"], np.flatnonzero(stds > cut))

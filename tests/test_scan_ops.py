@@ -320,7 +320,12 @@ def test_groupby_distributed_matches_local(tmp_path):
     sharded = jax.device_put(pages, NamedSharding(mesh, P("dp", None)))
     dist = jax.tree.map(np.asarray, scan_groupby_step(sharded, np.int32(0), 8))
     for k in local:
-        np.testing.assert_array_equal(dist[k], local[k])
+        if local[k].dtype.kind == "f":
+            # float accumulators (sumsqs) reduce in a different order
+            # across devices; integers stay bit-exact
+            np.testing.assert_allclose(dist[k], local[k], rtol=1e-5)
+        else:
+            np.testing.assert_array_equal(dist[k], local[k])
 
 
 def test_bucket_exchange_repartitions_rows_by_key():

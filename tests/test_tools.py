@@ -272,3 +272,22 @@ def test_strom_query_json_empty_group_avgs_are_null(tmp_path):
     res = json.loads(out.stdout.strip().splitlines()[-1])  # strict parse
     assert res["avgs"][0][3] is None and res["avgs"][0][4] is None
     assert res["avgs"][0][0] is not None
+
+
+def test_strom_query_sandbox_rejects_nested_code_objects(tmp_path):
+    """Names inside lambdas/comprehensions are checked too — the classic
+    subclass-walk wrapped in a lambda must not slip past the whitelist
+    (review finding)."""
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=1, visibility=False)
+    path = str(tmp_path / "sb.heap")
+    build_heap_file(path, [np.zeros(10, np.int32)], schema)
+    evil = "(lambda: ().__class__.__bases__[0].__subclasses__())()"
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
+               "--where", evil)
+    assert out.returncode != 0 and "not allowed" in out.stderr
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
+               "--group-by", "c0", "--groups", "2", "--having", evil)
+    assert out.returncode != 0 and "not allowed" in out.stderr
