@@ -360,10 +360,39 @@ def main() -> int:
         ("ckpt_restore", "checkpoint -> HBM direct restore",
          _CKPT.format(size=size, path=base), None),
     ]
+    # BENCH_ROWS=a,b,c re-runs only those rows and merges over the existing
+    # BENCH_MATRIX.json — device rows depend on the host tunnel's token
+    # bucket, so they are re-measurable after idle without redoing the
+    # (slow, disk-bound) CPU rows
+    only = os.environ.get("BENCH_ROWS")
+    only = set(only.split(",")) if only else None
     results = {}
-    for i, (key, desc, code, env) in enumerate(configs):
-        if i and cooldown:
+    if only is not None:
+        try:
+            with open(os.path.join(REPO, "BENCH_MATRIX.json")) as f:
+                prior = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            prior = {}
+        if prior and prior.get("size_mb") != size_mb:
+            # a merge across sizes would divide incomparable numbers in
+            # the derived ratio block
+            raise SystemExit(
+                f"BENCH_ROWS: existing matrix measured at "
+                f"{prior.get('size_mb')}MB, this run is {size_mb}MB; "
+                f"set BENCH_SIZE_MB={prior.get('size_mb')} or rerun all")
+        known = {k for k, *_ in configs}
+        results.update({k: v for k, v in prior.get("results", {}).items()
+                        if k in known})   # drop stale rows
+        unknown = only - known
+        if unknown:
+            raise SystemExit(f"BENCH_ROWS: unknown rows {sorted(unknown)}")
+    ran = 0
+    for key, desc, code, env in configs:
+        if only is not None and key not in only:
+            continue
+        if ran and cooldown:
             time.sleep(cooldown)
+        ran += 1
         gbps = _run(code, env)
         results[key] = gbps
         print(f"{key:<14} {desc:<34} {gbps:7.3f} GB/s")

@@ -157,13 +157,16 @@ class Query:
         self._topk = (int(col), int(k), largest)
         return self
 
-    def order_by(self, col: int, *, descending: bool = False,
+    def order_by(self, col, *, descending: bool = False,
                  limit: Optional[int] = None, offset: int = 0) -> "Query":
-        """Terminal: the full ordering of *col* over selected rows —
-        sorted values + their global row positions.  ``limit``/``offset``
-        slice the sorted output (ORDER BY ... LIMIT n OFFSET m; for a
-        small head :meth:`top_k` streams without materializing the whole
-        order).  With a mesh, runs the distributed sample sort; device
+        """Terminal: the full ordering over selected rows — sorted primary
+        column values + their global row positions.  *col* may be a
+        sequence of column indices (ORDER BY c_a, c_b, ...): later
+        columns break ties of earlier ones; ``descending`` applies to the
+        whole ordering.  ``limit``/``offset`` slice the sorted output
+        (ORDER BY ... LIMIT n OFFSET m; for a small head :meth:`top_k`
+        streams without materializing the whole order).  With a mesh,
+        runs the distributed sample sort (single key column only); device
         *b* ends up owning the *b*-th key range — the
         ``per_device_count`` info key always describes that full
         pre-slice distribution, not the sliced arrays."""
@@ -172,9 +175,13 @@ class Query:
             raise StromError(22, "order_by limit must be >= 0")
         if offset < 0:
             raise StromError(22, "order_by offset must be >= 0")
+        cols = [int(col)] if isinstance(col, (int, np.integer)) \
+            else [int(c) for c in col]
+        if not cols:
+            raise StromError(22, "order_by needs at least one column")
         self._op = "order_by"
         self._terminal_set = True
-        self._order = (int(col), descending, limit, int(offset))
+        self._order = (cols, descending, limit, int(offset))
         return self
 
     def count_distinct(self, col: int) -> "Query":
@@ -185,7 +192,8 @@ class Query:
         self._require_no_terminal()
         self._op = "count_distinct"
         self._terminal_set = True
-        self._order = (int(col), False, None, 0)  # reuses the order_by gather
+        # reuses the order_by gather shape
+        self._order = ([int(col)], False, None, 0)
         return self
 
     def join(self, probe_col: int, build_keys: np.ndarray,
@@ -623,7 +631,7 @@ class Query:
         """Exact COUNT(DISTINCT col): gathered values dedupe via the
         distributed sort + ppermute boundary count under a mesh, or a
         host unique count locally."""
-        col = self._order[0]
+        col = self._order[0][0]
         dt = self._check_sortable_col(col, "count_distinct")
         chunks = self._gather_column(plan, col, device, session,
                                      want_positions=False)
@@ -661,20 +669,29 @@ class Query:
         :func:`..parallel.sort.make_distributed_sort` directly."""
         import jax
 
-        col, descending, limit, offset = self._order
+        cols, descending, limit, offset = self._order
         end = None if limit is None else offset + limit
-        dt = self._check_sortable_col(col, "order_by")
-        chunks = self._gather_column(plan, col, device, session)
+        if mesh is not None and len(cols) > 1:
+            raise StromError(
+                95,  # EOPNOTSUPP
+                "mesh order_by sorts one key column (the slab exchange "
+                "carries a single key); sort multi-column orderings "
+                "locally, or pre-combine the keys into one column")
+        dts = [self._check_sortable_col(c, "order_by") for c in cols]
+        dt = dts[0]
+        chunks = self._gather_rows(plan, cols, device, session)
         # positions normalize to int32 on the mesh path (slab payload
         # width); keep the empty case's dtype consistent with that
         pos_np_t = np.int32 if mesh is not None else (
             np.int64 if jax.config.jax_enable_x64 else np.int32)
         if chunks:
-            vals = np.concatenate([c[0] for c in chunks])
+            keys = [np.concatenate([c[0][i] for c in chunks])
+                    for i in range(len(cols))]
             poss = np.concatenate([c[1] for c in chunks])
         else:
-            vals = np.zeros(0, dt)
+            keys = [np.zeros(0, d) for d in dts]
             poss = np.zeros(0, pos_np_t)
+        vals = keys[0]
         if len(vals) == 0:   # empty source or nothing selected
             out = {"values": vals, "positions": poss.astype(pos_np_t)}
             if mesh is not None:   # keep the mesh contract's info keys
@@ -684,9 +701,14 @@ class Query:
             return out
 
         if mesh is None:
-            key = vals if not descending else \
-                (-vals if dt.kind == "f" else ~vals)
-            order = np.argsort(key, kind="stable")[offset:end]
+            # np.lexsort: LAST key is primary and the sort is stable, so
+            # reversed keys give ORDER BY cols[0], cols[1], ...
+            def sort_key(k):
+                if not descending:
+                    return k
+                return -k if k.dtype.kind == "f" else ~k
+            order = np.lexsort(tuple(sort_key(k)
+                                     for k in reversed(keys)))[offset:end]
             return {"values": vals[order], "positions": poss[order]}
 
         from ..parallel.sort import make_distributed_sort

@@ -55,8 +55,22 @@ def check_io_uring() -> bool:
                        "build it: make -C csrc (needs g++)")
     try:
         eng = _native.NativeEngine("io_uring", 8)
-        eng.close()
-        return _report("io_uring", OK, "available")
+        try:
+            import ctypes
+            import mmap
+            probe = mmap.mmap(-1, 4096)
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(probe))
+            slot = eng.buf_register(addr, 4096)
+            if slot is not None:
+                eng.buf_unregister(slot)
+                fixed = "registered (fixed) buffers supported"
+            else:
+                fixed = "no fixed-buffer support (pre-5.13 kernel?): " \
+                        "requests use plain opcodes"
+            probe.close()
+        finally:
+            eng.close()
+        return _report("io_uring", OK, f"available; {fixed}")
     except Exception as e:
         return _report("io_uring", WARN, f"unavailable ({e})",
                        "check /proc/sys/kernel/io_uring_disabled; the "

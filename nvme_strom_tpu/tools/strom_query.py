@@ -157,9 +157,11 @@ def main(argv=None) -> int:
                     help="materialize matching rows: comma-separated "
                          "column indices (or 'all'); returns values + "
                          "row positions instead of aggregating")
-    ap.add_argument("--order-by", default=None, metavar="COL[:desc]",
-                    help="full ordering of a column (values + row "
-                         "positions); distributed sample sort with --mesh")
+    ap.add_argument("--order-by", default=None,
+                    metavar="COL[,COL...][:desc]",
+                    help="full ordering (values + row positions); extra "
+                         "columns break ties; distributed sample sort "
+                         "with --mesh (single column)")
     ap.add_argument("--limit", type=int, default=None,
                     help="with --select/--order-by: return at most N rows "
                          "(--select stops scanning early)")
@@ -225,7 +227,7 @@ def main(argv=None) -> int:
         q = q.top_k(int(parts[0]), int(parts[1]), largest=largest)
     elif args.order_by:
         parts = args.order_by.split(":")
-        q = q.order_by(int(parts[0]),
+        q = q.order_by([int(c) for c in parts[0].split(",")],
                        descending=len(parts) > 1 and parts[1] == "desc",
                        limit=args.limit, offset=args.offset)
     elif args.count_distinct is not None:

@@ -71,7 +71,7 @@ def _row(cur: dict, prev: dict, verbose: bool) -> str:
 def _header(verbose: bool) -> str:
     cols = ["submit ", "wait   ", "dma-lat", " avg-sz", " wrong", "  cur", "  max"]
     if verbose:
-        cols += ["plan   ", "sq-sub ", "resub ", "sqfull", "h2d   ", "dbg4  "]
+        cols += ["plan   ", "sq-sub ", "resub ", "sqfull", "h2d   ", "fixed "]
     return " ".join(cols)
 
 

@@ -734,3 +734,35 @@ def test_group_by_having_on_stddev(heap):
         .group_by(lambda cols: cols[1] % 4, 4, agg_cols=[0],
                   having=lambda gr: gr["stds"][0] > cut).run()
     np.testing.assert_array_equal(out["groups"], np.flatnonzero(stds > cut))
+
+
+def test_order_by_multi_column_matches_lexsort(heap):
+    """ORDER BY c1, c0: later columns break ties (numpy lexsort oracle);
+    descending reverses the whole ordering."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = vis != 0
+    out = Query(path, schema).order_by([1, 0]).run()
+    order = np.lexsort((c0[sel], c1[sel]))
+    np.testing.assert_array_equal(out["values"], c1[sel][order])
+    np.testing.assert_array_equal(c1[out["positions"]], out["values"])
+    # full row order is pinned, not just the key column: tie-broken c0
+    np.testing.assert_array_equal(c0[out["positions"]], c0[sel][order])
+    # descending
+    outd = Query(path, schema).order_by([1, 0], descending=True).run()
+    np.testing.assert_array_equal(c1[outd["positions"]], c1[sel][order][::-1])
+    np.testing.assert_array_equal(c0[outd["positions"]], c0[sel][order][::-1])
+
+
+def test_order_by_multi_column_mesh_refused(heap):
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, *_ = heap
+    config.set("debug_no_threshold", True)
+    mesh = make_scan_mesh(jax.devices())
+    with pytest.raises(StromError, match="one key column"):
+        Query(path, schema).order_by([0, 1]).run(mesh=mesh)
+    # single-column mesh sort still fine
+    out = Query(path, schema).order_by([0]).run(mesh=mesh)
+    assert len(out["values"]) > 0
