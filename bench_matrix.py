@@ -419,7 +419,8 @@ def main() -> int:
     # the identical batch (>1.0 = the hand kernel earns its keep)
     pallas_vs_xla = (round(results["filter_pallas_chip"] /
                            results["filter_xla_chip"], 3)
-                     if results.get("filter_xla_chip") else None)
+                     if results.get("filter_xla_chip")
+                     and results.get("filter_pallas_chip") else None)
     path = os.path.join(REPO, "BENCH_MATRIX.json")
     with open(path, "w") as f:
         json.dump({"size_mb": size_mb, "unit": "GB/s",

@@ -27,7 +27,7 @@ import numpy as np
 from ..scan.heap import HeapSchema
 from .filter_xla import DEFAULT_SCHEMA, decode_pages
 
-__all__ = ["make_join_fn"]
+__all__ = ["make_join_fn", "make_join_rows_fn"]
 
 
 def make_join_fn(schema: HeapSchema, probe_col: int,
@@ -43,15 +43,7 @@ def make_join_fn(schema: HeapSchema, probe_col: int,
     rows, for the int32 fact columns listed in ``run.sum_cols``),
     ``payload_sum`` (sum of the matched build values).
     """
-    order = np.argsort(build_keys, kind="stable")
-    keys = jnp.asarray(np.asarray(build_keys, np.int32)[order])
-    vals = jnp.asarray(np.asarray(build_values, np.int32)[order])
-    if len(np.unique(build_keys)) != len(build_keys):
-        raise ValueError("build_keys must be unique (inner join on a "
-                         "dimension key)")
-    if schema.col_dtype(probe_col) != np.dtype(np.int32):
-        raise ValueError("probe column must be int32")
-
+    keys, vals = _sorted_build(build_keys, build_values, schema, probe_col)
     sum_cols = [c for c in range(schema.n_cols)
                 if schema.col_dtype(c) == np.dtype(np.int32)]
 
@@ -60,14 +52,62 @@ def make_join_fn(schema: HeapSchema, probe_col: int,
         cols, valid = decode_pages(pages_u8, schema)
         sel = valid if predicate is None else valid & predicate(cols, *params)
         probe = cols[probe_col]
-        idx = jnp.searchsorted(keys, probe)
-        idx = jnp.clip(idx, 0, keys.shape[0] - 1)
-        hit = sel & (keys[idx] == probe)
+        hit, pay = _probe(keys, vals, probe, sel)
         matched = jnp.sum(hit.astype(jnp.int32))
         sums = jnp.stack([jnp.sum(jnp.where(hit, cols[c], 0))
                           for c in sum_cols])
-        payload = jnp.sum(jnp.where(hit, vals[idx], 0))
+        payload = jnp.sum(jnp.where(hit, pay, 0))
         return {"matched": matched, "sums": sums, "payload_sum": payload}
 
     run.sum_cols = sum_cols
+    return run
+
+
+def _sorted_build(build_keys: np.ndarray, build_values: np.ndarray,
+                  schema: HeapSchema, probe_col: int):
+    """Shared build-side prep: unique-key check, sort, device constants."""
+    if len(np.unique(build_keys)) != len(build_keys):
+        raise ValueError("build_keys must be unique (inner join on a "
+                         "dimension key)")
+    if schema.col_dtype(probe_col) != np.dtype(np.int32):
+        raise ValueError("probe column must be int32")
+    order = np.argsort(build_keys, kind="stable")
+    return (jnp.asarray(np.asarray(build_keys, np.int32)[order]),
+            jnp.asarray(np.asarray(build_values, np.int32)[order]))
+
+
+def _probe(keys, vals, probe, sel):
+    """(hit mask, per-row payload) for one batch; an empty build table
+    joins nothing instead of tripping a zero-size gather."""
+    if keys.shape[0] == 0:
+        return jnp.zeros_like(sel), jnp.zeros_like(probe)
+    idx = jnp.clip(jnp.searchsorted(keys, probe), 0, keys.shape[0] - 1)
+    return sel & (keys[idx] == probe), vals[idx]
+
+
+def make_join_rows_fn(schema: HeapSchema, probe_col: int,
+                      build_keys: np.ndarray, build_values: np.ndarray, *,
+                      predicate: Optional[Callable] = None):
+    """Row-materializing twin of :func:`make_join_fn`: instead of folding
+    aggregates, each batch returns the per-row join outcome — ``hit``
+    mask, the probed ``key``, the matched build ``payload``, and the
+    rows' global ``positions`` — flattened for host-side compression
+    (the SELECT-with-JOIN face: joined tuples back to the executor,
+    like the reference scan hands tuples up, pgsql/nvme_strom.c:941-979).
+    """
+    from .filter_xla import global_row_positions
+    keys, vals = _sorted_build(build_keys, build_values, schema, probe_col)
+
+    @jax.jit
+    def run(pages_u8, *params):
+        cols, valid = decode_pages(pages_u8, schema)
+        sel = valid if predicate is None else valid & predicate(cols, *params)
+        probe = cols[probe_col]
+        hit, pay = _probe(keys, vals, probe, sel)
+        return {"hit": hit.reshape(-1),
+                "key": probe.reshape(-1),
+                "payload": pay.reshape(-1),
+                "positions": global_row_positions(
+                    pages_u8, schema).reshape(-1)}
+
     return run

@@ -197,12 +197,24 @@ class Query:
         return self
 
     def join(self, probe_col: int, build_keys: np.ndarray,
-             build_values: np.ndarray) -> "Query":
-        """Terminal: inner join against a host-side dimension table."""
+             build_values: np.ndarray, *, materialize: bool = False,
+             limit: Optional[int] = None, offset: int = 0) -> "Query":
+        """Terminal: inner join against a host-side dimension table.
+
+        Default: fold aggregates over joined rows (count/sums/payload
+        sum).  ``materialize=True`` returns the joined rows themselves —
+        ``{"positions", "keys", "payload", "count"}`` — with
+        ``limit``/``offset`` slicing like :meth:`select` (the early
+        DMA cut-off included)."""
         self._require_no_terminal()
+        if limit is not None and limit < 0:
+            raise StromError(22, "join limit must be >= 0")
+        if offset < 0:
+            raise StromError(22, "join offset must be >= 0")
         self._op = "join"
         self._terminal_set = True
-        self._join = (int(probe_col), build_keys, build_values)
+        self._join = (int(probe_col), build_keys, build_values,
+                      materialize, limit, int(offset))
         return self
 
     def _require_no_terminal(self) -> None:
@@ -358,7 +370,7 @@ class Query:
             return (lambda pages: run(pages)), run.combine
         # join
         from ..ops.join import make_join_fn
-        probe_col, bk, bv = self._join
+        probe_col, bk, bv = self._join[:3]
         run = make_join_fn(self.schema, probe_col, bk, bv,
                            predicate=(lambda cols: pred(cols))
                            if pred else None)
@@ -417,6 +429,8 @@ class Query:
             raise StromError(22, f"query not executable: {plan.reason}")
         if self._op == "select":
             return self._run_select(plan, device, session)
+        if self._op == "join" and self._join[3]:   # materialize=True
+            return self._run_join_rows(plan, device, session)
         if self._op == "order_by":
             return self._run_order_by(plan, mesh, device, session)
         if self._op == "count_distinct":
@@ -575,6 +589,14 @@ class Query:
                 raise _ScanLimitReached
             return {}   # nothing to fold
 
+        self._stream_collect(plan, collect, device, session)
+        return chunks
+
+    def _stream_collect(self, plan: QueryPlan, collect, device,
+                        session) -> None:
+        """Stream the planned access path through a host-side collector
+        (shared by the SELECT gather and the materializing join); a
+        :class:`_ScanLimitReached` from *collect* stops the scan."""
         try:
             if plan.access_path == "direct":
                 from .executor import TableScanner
@@ -590,7 +612,6 @@ class Query:
                 self._vfs_scan(collect, None, device)
         except _ScanLimitReached:
             pass
-        return chunks
 
     def _gather_column(self, plan: QueryPlan, col: int, device, session,
                        want_positions: bool = True):
@@ -623,6 +644,49 @@ class Query:
                             else np.int32)
         out = {f"col{c}": v[offset:end] for c, v in zip(cols, vals)}
         out["positions"] = poss[offset:end]
+        out["count"] = np.int64(len(out["positions"]))
+        return out
+
+    def _run_join_rows(self, plan: QueryPlan, device, session) -> dict:
+        """SELECT-with-JOIN: stream the scan, probe the broadcast build
+        table per batch, and hand the joined rows back —
+        ``{"positions", "keys", "payload", "count"}``."""
+        import jax
+
+        from ..ops.join import make_join_rows_fn
+        probe_col, bk, bv, _mat, limit, offset = self._join
+        pred = self._pred
+        run = make_join_rows_fn(
+            self.schema, probe_col, bk, bv,
+            predicate=(lambda cols: pred(cols)) if pred else None)
+        stop = None if limit is None else offset + limit
+        chunks = []
+        gathered = 0
+
+        def collect(pages_dev):
+            nonlocal gathered
+            out = run(pages_dev)
+            mask = np.asarray(out["hit"]).astype(bool)
+            chunks.append((np.asarray(out["positions"])[mask],
+                           np.asarray(out["key"])[mask],
+                           np.asarray(out["payload"])[mask]))
+            gathered += int(mask.sum())
+            if stop is not None and gathered >= stop:
+                raise _ScanLimitReached
+            return {}
+
+        self._stream_collect(plan, collect, device, session)
+        if chunks:
+            poss = np.concatenate([c[0] for c in chunks])
+            keyv = np.concatenate([c[1] for c in chunks])
+            payl = np.concatenate([c[2] for c in chunks])
+        else:
+            poss = np.zeros(0, np.int64 if jax.config.jax_enable_x64
+                            else np.int32)
+            keyv = np.zeros(0, np.int32)
+            payl = np.zeros(0, np.int32)
+        out = {"positions": poss[offset:stop], "keys": keyv[offset:stop],
+               "payload": payl[offset:stop]}
         out["count"] = np.int64(len(out["positions"]))
         return out
 

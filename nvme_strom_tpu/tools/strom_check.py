@@ -55,26 +55,29 @@ def check_io_uring() -> bool:
                        "build it: make -C csrc (needs g++)")
     try:
         eng = _native.NativeEngine("io_uring", 8)
-        try:
-            import ctypes
-            import mmap
-            probe = mmap.mmap(-1, 4096)
-            addr = ctypes.addressof(ctypes.c_char.from_buffer(probe))
-            slot = eng.buf_register(addr, 4096)
-            if slot is not None:
-                eng.buf_unregister(slot)
-                fixed = "registered (fixed) buffers supported"
-            else:
-                fixed = "no fixed-buffer support (pre-5.13 kernel?): " \
-                        "requests use plain opcodes"
-            probe.close()
-        finally:
-            eng.close()
-        return _report("io_uring", OK, f"available; {fixed}")
     except Exception as e:
         return _report("io_uring", WARN, f"unavailable ({e})",
                        "check /proc/sys/kernel/io_uring_disabled; the "
                        "threadpool backend will be used instead")
+    # io_uring itself is proven at this point: a probe-only failure must
+    # degrade to "no fixed buffers", never misreport io_uring as absent
+    try:
+        import ctypes
+        import mmap
+        probe = mmap.mmap(-1, 4096)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(probe))
+        slot = eng.buf_register(addr, 4096)
+        if slot is not None:
+            eng.buf_unregister(slot)
+            fixed = "registered (fixed) buffers supported"
+        else:
+            fixed = "no fixed-buffer support (pre-5.13 kernel?): " \
+                    "requests use plain opcodes"
+    except Exception as e:
+        fixed = f"fixed-buffer probe failed ({e}): plain opcodes"
+    finally:
+        eng.close()
+    return _report("io_uring", OK, f"available; {fixed}")
 
 
 def check_odirect(path: str) -> bool:

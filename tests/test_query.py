@@ -766,3 +766,45 @@ def test_order_by_multi_column_mesh_refused(heap):
     # single-column mesh sort still fine
     out = Query(path, schema).order_by([0]).run(mesh=mesh)
     assert len(out["values"]) > 0
+
+
+def test_join_materialize_rows(heap):
+    """materialize=True returns the joined rows (positions/keys/payload),
+    matching the numpy oracle; limit early-exits like select."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    keys = np.arange(0, 8, dtype=np.int32)
+    vals = (keys * 10).astype(np.int32)
+    out = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .join(1, keys, vals, materialize=True).run()
+    sel = (vis != 0) & (c0 > 0) & (c1 < 8)
+    order = np.argsort(out["positions"])
+    np.testing.assert_array_equal(out["positions"][order],
+                                  np.flatnonzero(sel))
+    np.testing.assert_array_equal(out["keys"][order], c1[sel])
+    np.testing.assert_array_equal(out["payload"][order], c1[sel] * 10)
+    assert int(out["count"]) == int(sel.sum())
+    # limit/offset slice (vfs path: deterministic arrival order)
+    config.set("debug_no_threshold", False)
+    full = Query(path, schema).join(1, keys, vals, materialize=True).run()
+    part = Query(path, schema).join(1, keys, vals, materialize=True,
+                                    limit=5, offset=3).run()
+    np.testing.assert_array_equal(part["positions"],
+                                  full["positions"][3:8])
+    np.testing.assert_array_equal(part["payload"], full["payload"][3:8])
+    # nothing joins -> empty arrays with count 0
+    none = Query(path, schema).join(1, keys + 100, vals,
+                                    materialize=True).run()
+    assert int(none["count"]) == 0 and len(none["positions"]) == 0
+
+
+def test_join_empty_build_table_joins_nothing(heap):
+    """An empty dimension table joins zero rows on both join faces
+    (review finding: was a zero-size gather crash)."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    ek = np.zeros(0, np.int32)
+    agg = Query(path, schema).join(1, ek, ek).run()
+    assert int(agg["matched"]) == 0 and int(agg["payload_sum"]) == 0
+    rows = Query(path, schema).join(1, ek, ek, materialize=True).run()
+    assert int(rows["count"]) == 0 and len(rows["payload"]) == 0
