@@ -211,6 +211,11 @@ class Query:
             raise StromError(22, "join limit must be >= 0")
         if offset < 0:
             raise StromError(22, "join offset must be >= 0")
+        if not materialize and (limit is not None or offset):
+            # silently aggregating the whole table under a "limit" would
+            # be a lie; row slicing only means something for rows
+            raise StromError(22, "join limit/offset require "
+                                 "materialize=True")
         self._op = "join"
         self._terminal_set = True
         self._join = (int(probe_col), build_keys, build_values,
@@ -546,16 +551,18 @@ class Query:
                                  f"columns (got {dt})")
         return dt
 
-    def _gather_rows(self, plan: QueryPlan, cols: Sequence[int], device,
-                     session, *, want_positions: bool = True,
-                     stop_rows: Optional[int] = None):
-        """Stream the planned access path and collect, per batch and
-        host-side, the projected column values (+ global positions) of
-        selected rows — one concat at the caller (a fold-style growing
-        device concat would copy the accumulator once per batch).
-        Returns ``[(list_of_col_arrays, positions_or_None), ...]``; with
-        *stop_rows*, stops issuing I/O once that many rows are gathered
-        (LIMIT early-exit)."""
+    @staticmethod
+    def _pos_dtype():
+        import jax
+        return np.int64 if jax.config.jax_enable_x64 else np.int32
+
+    def _make_gather_fn(self, cols: Sequence[int],
+                        want_positions: bool = True):
+        """Jitted per-batch gather of projected columns (+ global
+        positions) with the query predicate folded in.  Returns
+        ``(batch_fn, field_names, empty_dtypes)`` for
+        :meth:`_collect_rows`; field ``f<i>`` is ``cols[i]``, positions
+        (if requested) are last."""
         import jax
 
         from ..ops.filter_xla import decode_pages, global_row_positions
@@ -567,30 +574,52 @@ class Query:
             dcols, valid = decode_pages(pages, self.schema)
             if pred is not None:
                 valid = valid & pred(dcols)
-            out = {"values": [dcols[c].reshape(-1) for c in cols],
-                   "valid": valid.reshape(-1)}
+            out = {"mask": valid.reshape(-1)}
+            for i, c in enumerate(cols):
+                out[f"f{i}"] = dcols[c].reshape(-1)
             if want_positions:   # distinct never reads them — skip the
-                out["positions"] = global_row_positions(   # decode + D2H
+                out["pos"] = global_row_positions(   # decode + D2H
                     pages, self.schema).reshape(-1)
             return out
 
+        fields = [f"f{i}" for i in range(len(cols))]
+        dtypes = [self.schema.col_dtype(c) for c in cols]
+        if want_positions:
+            fields.append("pos")
+            dtypes.append(self._pos_dtype())
+        return gather, fields, dtypes
+
+    def _collect_rows(self, plan: QueryPlan, batch_fn, mask_key: str,
+                      fields: Sequence[str], empty_dtypes, device,
+                      session, *, limit: Optional[int] = None,
+                      offset: int = 0) -> List[np.ndarray]:
+        """Shared row-materialization engine (SELECT and the join's row
+        face): stream batches, compress rows by ``batch_fn``'s *mask_key*
+        output host-side (one concat at the end — a fold-style growing
+        device concat would copy the accumulator once per batch), stop
+        issuing I/O once ``offset+limit`` rows are gathered, and slice.
+        Returns one array per field."""
+        stop = None if limit is None else offset + limit
         chunks = []
         gathered = 0
 
         def collect(pages_dev):
             nonlocal gathered
-            out = gather(pages_dev)
-            mask = np.asarray(out["valid"]).astype(bool)
-            chunks.append(([np.asarray(v)[mask] for v in out["values"]],
-                           np.asarray(out["positions"])[mask]
-                           if want_positions else None))
+            out = batch_fn(pages_dev)
+            mask = np.asarray(out[mask_key]).astype(bool)
+            chunks.append([np.asarray(out[f])[mask] for f in fields])
             gathered += int(mask.sum())
-            if stop_rows is not None and gathered >= stop_rows:
+            if stop is not None and gathered >= stop:
                 raise _ScanLimitReached
             return {}   # nothing to fold
 
         self._stream_collect(plan, collect, device, session)
-        return chunks
+        if chunks:
+            arrs = [np.concatenate([c[i] for c in chunks])
+                    for i in range(len(fields))]
+        else:
+            arrs = [np.zeros(0, dt) for dt in empty_dtypes]
+        return [a[offset:stop] for a in arrs]
 
     def _stream_collect(self, plan: QueryPlan, collect, device,
                         session) -> None:
@@ -613,37 +642,22 @@ class Query:
         except _ScanLimitReached:
             pass
 
-    def _gather_column(self, plan: QueryPlan, col: int, device, session,
-                       want_positions: bool = True):
-        """One-column face of :meth:`_gather_rows` (order_by / distinct)."""
-        return [(vals[0], pos) for vals, pos in self._gather_rows(
-            plan, [col], device, session, want_positions=want_positions)]
-
     def _run_select(self, plan: QueryPlan, device, session) -> dict:
         """SELECT: stream the scan and hand the matching rows back —
         ``{"col<i>": values, "positions": rows, "count": n}``.  Mesh mode
         gathers on a local device (materialization has no reduction for
         the mesh to partition)."""
-        import jax
-
         cols, limit, offset = self._select
         if cols is None:
             cols = list(range(self.schema.n_cols))
         # out-of-range columns already surfaced by explain() as an
         # invalid plan; run() refused before reaching here
-        end = None if limit is None else offset + limit
-        rows = self._gather_rows(plan, cols, device, session,
-                                 stop_rows=end)
-        if rows:
-            vals = [np.concatenate([r[0][i] for r in rows])
-                    for i in range(len(cols))]
-            poss = np.concatenate([r[1] for r in rows])
-        else:
-            vals = [np.zeros(0, self.schema.col_dtype(c)) for c in cols]
-            poss = np.zeros(0, np.int64 if jax.config.jax_enable_x64
-                            else np.int32)
-        out = {f"col{c}": v[offset:end] for c, v in zip(cols, vals)}
-        out["positions"] = poss[offset:end]
+        gather, fields, dtypes = self._make_gather_fn(cols)
+        arrs = self._collect_rows(plan, gather, "mask", fields, dtypes,
+                                  device, session, limit=limit,
+                                  offset=offset)
+        out = {f"col{c}": v for c, v in zip(cols, arrs[:-1])}
+        out["positions"] = arrs[-1]
         out["count"] = np.int64(len(out["positions"]))
         return out
 
@@ -651,44 +665,18 @@ class Query:
         """SELECT-with-JOIN: stream the scan, probe the broadcast build
         table per batch, and hand the joined rows back —
         ``{"positions", "keys", "payload", "count"}``."""
-        import jax
-
         from ..ops.join import make_join_rows_fn
         probe_col, bk, bv, _mat, limit, offset = self._join
         pred = self._pred
         run = make_join_rows_fn(
             self.schema, probe_col, bk, bv,
             predicate=(lambda cols: pred(cols)) if pred else None)
-        stop = None if limit is None else offset + limit
-        chunks = []
-        gathered = 0
-
-        def collect(pages_dev):
-            nonlocal gathered
-            out = run(pages_dev)
-            mask = np.asarray(out["hit"]).astype(bool)
-            chunks.append((np.asarray(out["positions"])[mask],
-                           np.asarray(out["key"])[mask],
-                           np.asarray(out["payload"])[mask]))
-            gathered += int(mask.sum())
-            if stop is not None and gathered >= stop:
-                raise _ScanLimitReached
-            return {}
-
-        self._stream_collect(plan, collect, device, session)
-        if chunks:
-            poss = np.concatenate([c[0] for c in chunks])
-            keyv = np.concatenate([c[1] for c in chunks])
-            payl = np.concatenate([c[2] for c in chunks])
-        else:
-            poss = np.zeros(0, np.int64 if jax.config.jax_enable_x64
-                            else np.int32)
-            keyv = np.zeros(0, np.int32)
-            payl = np.zeros(0, np.int32)
-        out = {"positions": poss[offset:stop], "keys": keyv[offset:stop],
-               "payload": payl[offset:stop]}
-        out["count"] = np.int64(len(out["positions"]))
-        return out
+        poss, keyv, payl = self._collect_rows(
+            plan, run, "hit", ["positions", "key", "payload"],
+            [self._pos_dtype(), np.int32, np.int32],
+            device, session, limit=limit, offset=offset)
+        return {"positions": poss, "keys": keyv, "payload": payl,
+                "count": np.int64(len(poss))}
 
     def _run_count_distinct(self, plan: QueryPlan, mesh, device,
                             session) -> dict:
@@ -697,10 +685,10 @@ class Query:
         host unique count locally."""
         col = self._order[0][0]
         dt = self._check_sortable_col(col, "count_distinct")
-        chunks = self._gather_column(plan, col, device, session,
-                                     want_positions=False)
-        vals = np.concatenate([c[0] for c in chunks]) if chunks \
-            else np.zeros(0, dt)
+        gather, fields, dtypes = self._make_gather_fn(
+            [col], want_positions=False)
+        (vals,) = self._collect_rows(plan, gather, "mask", fields,
+                                     dtypes, device, session)
         if mesh is None:
             # equal_nan=False: each NaN is its own value (IEEE !=), the
             # same semantics the mesh kernel's adjacent-diff implements
@@ -731,8 +719,6 @@ class Query:
         sort collectives are the distributed piece); for multi-host
         gather-side sharding, stream via ``load_pages_sharded`` and feed
         :func:`..parallel.sort.make_distributed_sort` directly."""
-        import jax
-
         cols, descending, limit, offset = self._order
         end = None if limit is None else offset + limit
         if mesh is not None and len(cols) > 1:
@@ -743,18 +729,13 @@ class Query:
                 "locally, or pre-combine the keys into one column")
         dts = [self._check_sortable_col(c, "order_by") for c in cols]
         dt = dts[0]
-        chunks = self._gather_rows(plan, cols, device, session)
+        gather, fields, dtypes = self._make_gather_fn(cols)
+        arrs = self._collect_rows(plan, gather, "mask", fields, dtypes,
+                                  device, session)
+        keys, poss = arrs[:-1], arrs[-1]
         # positions normalize to int32 on the mesh path (slab payload
         # width); keep the empty case's dtype consistent with that
-        pos_np_t = np.int32 if mesh is not None else (
-            np.int64 if jax.config.jax_enable_x64 else np.int32)
-        if chunks:
-            keys = [np.concatenate([c[0][i] for c in chunks])
-                    for i in range(len(cols))]
-            poss = np.concatenate([c[1] for c in chunks])
-        else:
-            keys = [np.zeros(0, d) for d in dts]
-            poss = np.zeros(0, pos_np_t)
+        pos_np_t = np.int32 if mesh is not None else self._pos_dtype()
         vals = keys[0]
         if len(vals) == 0:   # empty source or nothing selected
             out = {"values": vals, "positions": poss.astype(pos_np_t)}

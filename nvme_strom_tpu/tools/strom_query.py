@@ -169,6 +169,14 @@ def main(argv=None) -> int:
                     help="with --select/--order-by: skip the first N rows")
     ap.add_argument("--count-distinct", default=None, metavar="COL",
                     type=int, help="exact COUNT(DISTINCT col)")
+    ap.add_argument("--join", default=None, metavar="COL:TABLE",
+                    help="inner join the probe column against a dimension "
+                         "table file (.npz with 'keys'/'values' int arrays, "
+                         "or .npy of (N, 2) [key, value] rows); aggregates "
+                         "joined rows")
+    ap.add_argument("--join-rows", action="store_true",
+                    help="with --join: return the joined rows themselves "
+                         "(positions/keys/payload; --limit/--offset apply)")
     ap.add_argument("--kernel", choices=("auto", "pallas", "xla"),
                     default="auto")
     ap.add_argument("--mesh", action="store_true",
@@ -194,17 +202,22 @@ def main(argv=None) -> int:
                                 ("--group-by", args.group_by),
                                 ("--top-k", args.top_k),
                                 ("--order-by", args.order_by),
+                                ("--join", args.join),
                                 ("--count-distinct",
                                  args.count_distinct is not None)) if v]
     if len(terminals) > 1:
         ap.error(f"{' and '.join(terminals)} are exclusive "
                  f"(one terminal operator per query)")
-    if (args.select or args.top_k or args.order_by
+    if (args.select or args.top_k or args.order_by or args.join
             or args.count_distinct is not None) and agg_cols is not None:
         ap.error(f"--agg-cols has no effect with {terminals[0]}")
     if (args.limit is not None or args.offset) \
-            and not (args.select or args.order_by):
-        ap.error("--limit/--offset apply to --select or --order-by")
+            and not (args.select or args.order_by
+                     or (args.join and args.join_rows)):
+        ap.error("--limit/--offset apply to --select, --order-by, or "
+                 "--join with --join-rows")
+    if args.join_rows and not args.join:
+        ap.error("--join-rows requires --join")
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
@@ -230,6 +243,30 @@ def main(argv=None) -> int:
         q = q.order_by([int(c) for c in parts[0].split(",")],
                        descending=len(parts) > 1 and parts[1] == "desc",
                        limit=args.limit, offset=args.offset)
+    elif args.join:
+        colspec, _, table = args.join.partition(":")
+        if not table or not colspec.isdigit():
+            ap.error("--join takes COL:TABLE (integer column index)")
+        try:
+            if table.endswith(".npz"):
+                z = np.load(table)
+                if "keys" not in z or "values" not in z:
+                    ap.error("--join .npz table needs 'keys' and "
+                             "'values' arrays")
+                jk = np.asarray(z["keys"], np.int32)
+                jv = np.asarray(z["values"], np.int32)
+            else:
+                a = np.load(table)
+                if a.ndim != 2 or a.shape[1] != 2:
+                    ap.error("--join .npy table must be (N, 2) "
+                             "[key, value]")
+                jk = np.asarray(a[:, 0], np.int32)
+                jv = np.asarray(a[:, 1], np.int32)
+        except (OSError, ValueError) as e:
+            ap.error(f"--join table {table!r} unreadable: {e}")
+        q = q.join(int(colspec), jk, jv, materialize=args.join_rows,
+                   limit=args.limit if args.join_rows else None,
+                   offset=args.offset if args.join_rows else 0)
     elif args.count_distinct is not None:
         q = q.count_distinct(args.count_distinct)
     elif agg_cols is not None:
@@ -253,7 +290,7 @@ def main(argv=None) -> int:
 
     out = q.run(mesh=mesh, kernel=args.kernel)
     if args.kernel != "auto" and args.kernel != plan.kernel \
-            and not args.order_by and not args.select \
+            and not args.order_by and not args.select and not args.join \
             and args.count_distinct is None:
         # the printed plan must reflect what actually ran (order_by has a
         # fixed sort pipeline — run() ignores the kernel override there)

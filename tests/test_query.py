@@ -808,3 +808,27 @@ def test_join_empty_build_table_joins_nothing(heap):
     assert int(agg["matched"]) == 0 and int(agg["payload_sum"]) == 0
     rows = Query(path, schema).join(1, ek, ek, materialize=True).run()
     assert int(rows["count"]) == 0 and len(rows["payload"]) == 0
+
+
+def test_join_aggregate_mesh_matches_local(heap):
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    keys = np.arange(0, 8, dtype=np.int32)
+    vals = (keys * 10).astype(np.int32)
+    local = Query(path, schema).join(1, keys, vals).run()
+    mesh = make_scan_mesh(jax.devices())
+    dist = Query(path, schema).join(1, keys, vals).run(mesh=mesh,
+                                                       batch_pages=8)
+    assert int(dist["matched"]) == int(local["matched"])
+    assert int(dist["payload_sum"]) == int(local["payload_sum"])
+    np.testing.assert_array_equal(dist["sums"], local["sums"])
+
+
+def test_join_limit_requires_materialize(heap):
+    path, schema, *_ = heap
+    with pytest.raises(StromError, match="materialize"):
+        Query(path, schema).join(1, np.arange(4, dtype=np.int32),
+                                 np.arange(4, dtype=np.int32), limit=5)

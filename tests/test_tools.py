@@ -291,3 +291,42 @@ def test_strom_query_sandbox_rejects_nested_code_objects(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
                "--group-by", "c0", "--groups", "2", "--having", evil)
     assert out.returncode != 0 and "not allowed" in out.stderr
+
+
+def test_strom_query_cli_join(tmp_path):
+    """--join COL:TABLE aggregates joined rows; --join-rows materializes
+    them with --limit."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(21)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 100, n).astype(np.int32)
+    c1 = rng.integers(0, 16, n).astype(np.int32)
+    path = str(tmp_path / "j.heap")
+    build_heap_file(path, [c0, c1], schema)
+    table = str(tmp_path / "dim.npz")
+    keys = np.arange(0, 8, dtype=np.int32)
+    np.savez(table, keys=keys, values=keys * 100)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--join", f"1:{table}", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    sel = c1 < 8
+    assert res["matched"] == int(sel.sum())
+    assert res["payload_sum"] == int((c1[sel] * 100).sum())
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--join", f"1:{table}", "--join-rows", "--limit", "5",
+               "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["count"] == 5
+    assert all(c1[p] * 100 == v
+               for p, v in zip(res["positions"], res["payload"]))
+    # --join-rows without --join is a usage error
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--join-rows")
+    assert out.returncode != 0 and "--join-rows" in out.stderr
