@@ -258,3 +258,60 @@ def test_heap_roundtrip_and_xla_decode(n_cols, visibility, n_rows, data):
             flat_rows[(r // t) * t + r % t] = cols[c][r]
         sel = want_valid.reshape(-1)
         np.testing.assert_array_equal(got[sel], flat_rows[sel])
+
+
+# ---------------------------------------------------------------------------
+# declarative query terminals vs numpy oracles (random schemas/data)
+# ---------------------------------------------------------------------------
+
+@given(n_pages=st.integers(1, 5),
+       thresh=st.integers(-50, 50),
+       limit=st.one_of(st.none(), st.integers(0, 40)),
+       offset=st.integers(0, 10),
+       seed=st.integers(0, 2**31 - 1))
+@settings(max_examples=15, deadline=None)
+def test_query_select_order_join_match_oracle(tmp_path_factory, n_pages,
+                                              thresh, limit, offset, seed):
+    """select/order_by/join row faces agree with numpy for random data,
+    predicates, and limit/offset combinations."""
+    import numpy as np
+
+    from nvme_strom_tpu import config
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.query import Query
+
+    rng = np.random.default_rng(seed)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * n_pages
+    c0 = rng.integers(-100, 100, n).astype(np.int32)
+    c1 = rng.integers(0, 10, n).astype(np.int32)
+    d = tmp_path_factory.mktemp("q")
+    path = str(d / "p.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", False)   # vfs: deterministic order
+    sel = c0 > thresh
+
+    out = Query(path, schema).where(lambda c: c[0] > thresh) \
+        .select(limit=limit, offset=offset).run()
+    want_pos = np.flatnonzero(sel)[offset:
+                                   None if limit is None else offset + limit]
+    np.testing.assert_array_equal(out["positions"], want_pos)
+    np.testing.assert_array_equal(out["col0"], c0[want_pos])
+
+    o = Query(path, schema).where(lambda c: c[0] > thresh) \
+        .order_by([1, 0], limit=limit, offset=offset).run()
+    order = np.lexsort((c0[sel], c1[sel]))[offset:
+                                           None if limit is None
+                                           else offset + limit]
+    np.testing.assert_array_equal(o["values"], c1[sel][order])
+    np.testing.assert_array_equal(c0[o["positions"]], c0[sel][order])
+
+    keys = np.arange(0, 5, dtype=np.int32)
+    j = Query(path, schema).where(lambda c: c[0] > thresh) \
+        .join(1, keys, keys * 7, materialize=True,
+              limit=limit, offset=offset).run()
+    jsel = sel & (c1 < 5)
+    jpos = np.flatnonzero(jsel)[offset:
+                                None if limit is None else offset + limit]
+    np.testing.assert_array_equal(j["positions"], jpos)
+    np.testing.assert_array_equal(j["payload"], c1[jpos] * 7)

@@ -411,3 +411,64 @@ def test_scan_filter_cold_multichunk_exact(tmp_path):
             out = sc.scan_filter(fn)
         assert int(out["count"]) == int(sel.sum()), trial
         assert int(out["sums"][0]) == int(c0[sel].sum()), trial
+
+
+def test_concurrent_scans_shared_pool_and_session(tmp_path):
+    """Two threads scan different cold files through ONE shared session +
+    ONE shared DmaBufferPool; both aggregates must match their oracles
+    (the chunk-recycling / fixed-registration paths under contention)."""
+    import os
+    import threading
+
+    import numpy as np
+
+    from nvme_strom_tpu import Session, config
+    from nvme_strom_tpu.ops.filter_xla import make_filter_fn
+    from nvme_strom_tpu.scan.executor import TableScanner
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.pool import DmaBufferPool
+
+    config.set("chunk_size", "64k")
+    config.set("buffer_size", "1m")
+    config.set("async_depth", 2)
+    schema = HeapSchema(n_cols=1, visibility=False)
+    rng = np.random.default_rng(3)
+    files = []
+    for i in range(2):
+        n = schema.tuples_per_page * 32
+        c0 = rng.integers(-1000, 1000, n).astype(np.int32)
+        p = str(tmp_path / f"t{i}.heap")
+        build_heap_file(p, [c0], schema)
+        fd = os.open(p, os.O_RDONLY)
+        os.fsync(fd)
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        os.close(fd)
+        files.append((p, c0))
+
+    fn = make_filter_fn(schema, lambda cols: cols[0] > 0)
+    pool = DmaBufferPool(chunk_size=64 << 10, total_size=1 << 20)
+    results = [None, None]
+    errors = []
+
+    def scan(i):
+        try:
+            with Session() as sess:
+                with TableScanner(files[i][0], schema, session=sess,
+                                  pool=pool, numa_bind=False) as sc:
+                    results[i] = sc.scan_filter(fn)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append((i, repr(e)))
+
+    ts = [threading.Thread(target=scan, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    # a hung scanner must fail loudly BEFORE the pool is freed out from
+    # under its in-flight DMA
+    assert not any(t.is_alive() for t in ts), "scan thread hung"
+    pool.close()
+    assert not errors, errors
+    for i, (p, c0) in enumerate(files):
+        assert int(results[i]["count"]) == int((c0 > 0).sum()), f"file {i}"
+        assert int(results[i]["sums"][0]) == int(c0[c0 > 0].sum())
