@@ -1,0 +1,63 @@
+"""Declarative scans: the transparent-CustomScan face of the framework.
+
+Run:  python examples/02_query.py
+
+Builds a small heap table, then runs the query terminals with EXPLAIN
+output — the planner chooses access path (direct vs buffered) and kernel
+(Pallas vs XLA) exactly like the reference's planner hook chooses its
+scan node (pgsql/nvme_strom.c:1642-1667).
+"""
+
+import sys
+import tempfile
+
+import numpy as np
+
+from nvme_strom_tpu import config
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+from nvme_strom_tpu.scan.query import Query
+
+
+def main() -> int:
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("int32", "float32"))
+    rng = np.random.default_rng(7)
+    n = schema.tuples_per_page * 64
+    c0 = rng.integers(-1000, 1000, n).astype(np.int32)
+    c1 = rng.standard_normal(n).astype(np.float32)
+
+    with tempfile.NamedTemporaryFile(suffix=".heap") as f:
+        build_heap_file(f.name, [c0, c1], schema)
+        config.set("debug_no_threshold", True)   # small demo table
+
+        q = (Query(f.name, schema)
+             .where(lambda c: c[0] > 0)
+             .group_by(lambda c: c[0] % 8, 8, agg_cols=[1],
+                       having=lambda g: g["count"] > 0))
+        print(q.explain(), "\n")
+        out = q.run()
+        print("GROUP BY c0%8 (avg/std of c1 per group):")
+        for i, g in enumerate(out["groups"]):
+            print(f"  g{g}: n={out['count'][i]:5d} "
+                  f"avg={out['avgs'][0][i]:+.4f} std={out['stds'][0][i]:.4f}")
+
+        sel = (Query(f.name, schema).where(lambda c: c[0] > 995)
+               .select([0, 1], limit=5))
+        rows = sel.run()
+        print(f"\nSELECT c0,c1 WHERE c0>995 LIMIT 5 -> {rows['count']} rows")
+        for i in range(int(rows["count"])):
+            print(f"  row@{rows['positions'][i]}: "
+                  f"c0={rows['col0'][i]} c1={rows['col1'][i]:+.4f}")
+
+        qt = Query(f.name, schema).quantiles(1, [0.01, 0.5, 0.99]).run()
+        print(f"\nquantiles of c1 (p1/p50/p99): "
+              f"{[round(float(v), 4) for v in qt['quantiles']]}")
+
+        ana = Query(f.name, schema).where(lambda c: c[0] > 0) \
+            .run(analyze=True)
+        print(f"\nEXPLAIN ANALYZE: {ana['_analyze']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

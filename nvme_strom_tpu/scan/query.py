@@ -19,8 +19,8 @@ and :meth:`Query.explain` shows the chosen plan the way ``EXPLAIN`` shows
 the reference's custom scan node.
 
 One terminal operator per query (it is one scan node): ``select`` |
-``aggregate`` | ``group_by`` | ``top_k`` | ``order_by`` |
-``count_distinct`` | ``join``.  Predicates are plain jnp lambdas over
+``aggregate`` | ``group_by`` | ``top_k`` | ``order_by`` | ``quantiles``
+| ``count_distinct`` | ``join``.  Predicates are plain jnp lambdas over
 decoded columns — ``lambda cols: cols[0] > 10``.
 """
 
@@ -29,7 +29,7 @@ from __future__ import annotations
 import dataclasses
 import os
 from dataclasses import dataclass
-from typing import Callable, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -97,6 +97,7 @@ class Query:
         self._order: Optional[tuple] = None
         self._join: Optional[tuple] = None
         self._select: Optional[tuple] = None
+        self._quantiles: Optional[List[float]] = None
 
     # -- builders -----------------------------------------------------------
     def where(self, predicate: Callable) -> "Query":
@@ -182,6 +183,25 @@ class Query:
         self._op = "order_by"
         self._terminal_set = True
         self._order = (cols, descending, limit, int(offset))
+        return self
+
+    def quantiles(self, col: int, qs: Sequence[float]) -> "Query":
+        """Terminal: exact quantiles of *col* over selected rows (nearest-
+        rank on the true sorted order — percentile/median without
+        materializing the ordering for the caller).  With a mesh, rides
+        the distributed sample sort: only the per-device bucket holding
+        each rank is touched, using the bucket count distribution."""
+        self._require_no_terminal()
+        qs = [float(q) for q in qs]
+        if not qs:
+            raise StromError(22, "quantiles needs at least one q")
+        for q in qs:
+            if not 0.0 <= q <= 1.0:
+                raise StromError(22, f"quantile {q} outside [0, 1]")
+        self._op = "quantiles"
+        self._terminal_set = True
+        self._order = ([int(col)], False, None, 0)  # reuses the sort shape
+        self._quantiles = qs
         return self
 
     def count_distinct(self, col: int) -> "Query":
@@ -293,7 +313,7 @@ class Query:
             return "xla", (f"G={g} exceeds the pallas unroll bound"
                            if g > _PALLAS_MAX_GROUPS
                            else "non-TPU backend")
-        if self._op in ("order_by", "count_distinct"):
+        if self._op in ("order_by", "count_distinct", "quantiles"):
             return "xla", ("distributed sample sort (splitter election + "
                            "all_to_all)" if mode == "mesh"
                            else "single-device lax sort")
@@ -440,6 +460,8 @@ class Query:
             return self._run_order_by(plan, mesh, device, session)
         if self._op == "count_distinct":
             return self._run_count_distinct(plan, mesh, device, session)
+        if self._op == "quantiles":
+            return self._run_quantiles(plan, mesh, device, session)
         chosen = plan.kernel if kernel == "auto" else kernel
         fn, combine = self._build_fn(chosen)
         if mesh is not None:
@@ -678,6 +700,61 @@ class Query:
         return {"positions": poss, "keys": keyv, "payload": payl,
                 "count": np.int64(len(poss))}
 
+    @staticmethod
+    def _mesh_sort_loop(mesh, factory, *arrays):
+        """Shared capacity-resize loop of the distributed sort family:
+        start at 2.5x balance slack over perfectly uniform buckets,
+        double and rerun whenever skewed keys overflow a bucket.
+        ``factory(devices, capacity) -> run``; returns ``(out, dp)``."""
+        sort_devices = list(mesh.devices.reshape(-1))
+        dp = len(sort_devices)
+        n = len(arrays[0])
+        capacity = max(64, -(-n * 5 // (2 * dp * dp)))
+        while True:
+            run = factory(sort_devices, capacity)
+            out = run(*arrays)
+            if int(out["n_dropped"]) == 0:
+                return out, dp
+            capacity *= 2
+
+    def _run_quantiles(self, plan: QueryPlan, mesh, device,
+                       session) -> dict:
+        """Exact nearest-rank quantiles: gather the column, sort (locally
+        or via the distributed sample sort), and read one value per rank
+        from the bucket distribution — ``{"quantiles", "n"}``."""
+        col = self._order[0][0]
+        dt = self._check_sortable_col(col, "quantiles")
+        gather, fields, dtypes = self._make_gather_fn(
+            [col], want_positions=False)
+        (vals,) = self._collect_rows(plan, gather, "mask", fields,
+                                     dtypes, device, session)
+        qs = self._quantiles
+        n = len(vals)
+        if n == 0:
+            return {"quantiles": np.full(len(qs), np.nan, np.float64),
+                    "n": np.int64(0)}
+        # nearest-rank: index = ceil(q*n) - 1, clamped into the order
+        ranks = [min(n - 1, max(0, int(np.ceil(q * n)) - 1)) for q in qs]
+        if mesh is None:
+            svals = np.sort(vals)
+            return {"quantiles": svals[ranks], "n": np.int64(n)}
+        from ..parallel.sort import make_distributed_sort
+        out, _dp = self._mesh_sort_loop(
+            mesh,
+            lambda devs, cap: make_distributed_sort(
+                devs, capacity=cap, dtype=dt, with_payload=False)[0],
+            vals)
+        counts = np.asarray(out["count"])
+        cum = np.cumsum(counts)
+        picked = []
+        for r in ranks:
+            b = int(np.searchsorted(cum, r + 1))
+            within = r - (int(cum[b - 1]) if b else 0)
+            # fetch only the bucket row holding the rank, not the whole
+            # (dp, dp*capacity) sorted array (the docstring's contract)
+            picked.append(np.asarray(out["values"][b])[within])
+        return {"quantiles": np.array(picked, dt), "n": np.int64(n)}
+
     def _run_count_distinct(self, plan: QueryPlan, mesh, device,
                             session) -> dict:
         """Exact COUNT(DISTINCT col): gathered values dedupe via the
@@ -695,18 +772,12 @@ class Query:
             return {"distinct": np.int32(len(
                 np.unique(vals, equal_nan=False)))}
         from ..parallel.sort import make_distributed_distinct
-        sort_devices = list(mesh.devices.reshape(-1))
-        dp = len(sort_devices)
-        n = len(vals)
-        capacity = max(64, -(-n * 5 // (2 * dp * dp)))
-        while True:
-            run_d, _ = make_distributed_distinct(sort_devices,
-                                                 capacity=capacity,
-                                                 dtype=dt)
-            out = run_d(vals)
-            if int(out["n_dropped"]) == 0:
-                return {"distinct": np.int32(out["distinct"])}
-            capacity *= 2   # skewed keys: resize and rerun
+        out, _dp = self._mesh_sort_loop(
+            mesh,
+            lambda devs, cap: make_distributed_distinct(
+                devs, capacity=cap, dtype=dt)[0],
+            vals)
+        return {"distinct": np.int32(out["distinct"])}
 
     def _run_order_by(self, plan: QueryPlan, mesh, device, session) -> dict:
         """ORDER BY: gather (values, global positions, validity) through
@@ -757,11 +828,6 @@ class Query:
             return {"values": vals[order], "positions": poss[order]}
 
         from ..parallel.sort import make_distributed_sort
-        # the sort flattens the caller's (sp, dp) mesh into its own 1-D
-        # dp axis — the concat below must walk ALL its buckets, not the
-        # caller mesh's dp size
-        sort_devices = list(mesh.devices.reshape(-1))
-        dp = len(sort_devices)
         n = len(vals)
         if poss.dtype != np.int32:
             # slab payloads are int32; past 2^31 rows a cast would wrap
@@ -771,15 +837,14 @@ class Query:
                     34, "mesh order_by row positions exceed int32; "
                     "tables past 2^31 rows need the local sort path")
             poss = poss.astype(np.int32)
-        capacity = max(64, -(-n * 5 // (2 * dp * dp)))  # 2.5x balance slack
-        while True:
-            run_sort, _ = make_distributed_sort(
-                sort_devices, capacity=capacity,
-                dtype=dt, descending=descending)
-            out = run_sort(vals, poss)
-            if int(out["n_dropped"]) == 0:
-                break
-            capacity *= 2          # skewed keys: resize and rerun
+        # the sort flattens the caller's (sp, dp) mesh into its own 1-D
+        # dp axis — the concat below must walk ALL its buckets, not the
+        # caller mesh's dp size
+        out, dp = self._mesh_sort_loop(
+            mesh,
+            lambda devs, cap: make_distributed_sort(
+                devs, capacity=cap, dtype=dt, descending=descending)[0],
+            vals, poss)
         counts = np.asarray(out["count"])
         v = np.concatenate([np.asarray(out["values"])[b][:counts[b]]
                             for b in range(dp)])

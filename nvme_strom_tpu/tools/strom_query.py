@@ -169,6 +169,9 @@ def main(argv=None) -> int:
                     help="with --select/--order-by: skip the first N rows")
     ap.add_argument("--count-distinct", default=None, metavar="COL",
                     type=int, help="exact COUNT(DISTINCT col)")
+    ap.add_argument("--quantiles", default=None, metavar="COL:Q[,Q...]",
+                    help="exact nearest-rank quantiles of a column, e.g. "
+                         "0:0.5,0.9,0.99 (distributed sort with --mesh)")
     ap.add_argument("--join", default=None, metavar="COL:TABLE",
                     help="inner join the probe column against a dimension "
                          "table file (.npz with 'keys'/'values' int arrays, "
@@ -203,12 +206,14 @@ def main(argv=None) -> int:
                                 ("--top-k", args.top_k),
                                 ("--order-by", args.order_by),
                                 ("--join", args.join),
+                                ("--quantiles", args.quantiles),
                                 ("--count-distinct",
                                  args.count_distinct is not None)) if v]
     if len(terminals) > 1:
         ap.error(f"{' and '.join(terminals)} are exclusive "
                  f"(one terminal operator per query)")
     if (args.select or args.top_k or args.order_by or args.join
+            or args.quantiles
             or args.count_distinct is not None) and agg_cols is not None:
         ap.error(f"--agg-cols has no effect with {terminals[0]}")
     if (args.limit is not None or args.offset) \
@@ -267,6 +272,15 @@ def main(argv=None) -> int:
         q = q.join(int(colspec), jk, jv, materialize=args.join_rows,
                    limit=args.limit if args.join_rows else None,
                    offset=args.offset if args.join_rows else 0)
+    elif args.quantiles:
+        colspec, _, qspec = args.quantiles.partition(":")
+        if not colspec.isdigit() or not qspec:
+            ap.error("--quantiles takes COL:Q[,Q...]")
+        try:
+            qlist = [float(x) for x in qspec.split(",")]
+        except ValueError:
+            ap.error("--quantiles: quantiles must be floats in [0, 1]")
+        q = q.quantiles(int(colspec), qlist)
     elif args.count_distinct is not None:
         q = q.count_distinct(args.count_distinct)
     elif agg_cols is not None:
@@ -291,7 +305,7 @@ def main(argv=None) -> int:
     out = q.run(mesh=mesh, kernel=args.kernel)
     if args.kernel != "auto" and args.kernel != plan.kernel \
             and not args.order_by and not args.select and not args.join \
-            and args.count_distinct is None:
+            and not args.quantiles and args.count_distinct is None:
         # the printed plan must reflect what actually ran (order_by has a
         # fixed sort pipeline — run() ignores the kernel override there)
         import dataclasses

@@ -832,3 +832,35 @@ def test_join_limit_requires_materialize(heap):
     with pytest.raises(StromError, match="materialize"):
         Query(path, schema).join(1, np.arange(4, dtype=np.int32),
                                  np.arange(4, dtype=np.int32), limit=5)
+
+
+def test_quantiles_local_and_mesh_match_numpy(heap):
+    """Exact nearest-rank quantiles: local sort and the distributed
+    sample sort agree with the numpy oracle (and each other)."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    sel = (vis != 0) & (c0 > 0)
+    qs = [0.0, 0.25, 0.5, 0.9, 1.0]
+    svals = np.sort(c0[sel])
+    n = len(svals)
+    want = svals[[min(n - 1, max(0, int(np.ceil(q * n)) - 1)) for q in qs]]
+    q = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .quantiles(0, qs)
+    assert q.explain().operator == "quantiles"
+    out = q.run()
+    assert int(out["n"]) == n
+    np.testing.assert_array_equal(out["quantiles"], want)
+    mesh = make_scan_mesh(jax.devices())
+    mout = Query(path, schema).where(lambda cols: cols[0] > 0) \
+        .quantiles(0, qs).run(mesh=mesh)
+    np.testing.assert_array_equal(mout["quantiles"], want)
+    # empty selection -> NaN quantiles, n == 0
+    e = Query(path, schema).where(lambda cols: cols[0] > 10**6) \
+        .quantiles(0, [0.5]).run()
+    assert int(e["n"]) == 0 and np.isnan(e["quantiles"]).all()
+    # invalid q refused at build time
+    with pytest.raises(StromError):
+        Query(path, schema).quantiles(0, [1.5])
