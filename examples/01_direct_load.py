@@ -31,7 +31,10 @@ def main() -> int:
     # 2. SSD -> pinned host RAM through the async engine (MEMCPY_SSD2RAM):
     #    one task, chunked requests, error-retaining wait.
     size = min(os.path.getsize(path), 16 << 20)
-    chunk = 1 << 20
+    chunk = min(1 << 20, size)   # small user files still get >= 1 chunk
+    if chunk == 0:
+        print("file is empty; nothing to load")
+        return 1
     with open_source(path) as src, Session() as sess:
         handle, buf = sess.alloc_dma_buffer(size)
         res = sess.memcpy_ssd2ram(src, handle,

@@ -11,9 +11,11 @@ semantics.
 
 from .records import RecordDataset, RecordWriter, write_records
 from .loader import DeviceLoader
-from .checkpoint import save_checkpoint, restore_checkpoint, checkpoint_info
+from .checkpoint import (checkpoint_info, restore_checkpoint, save_checkpoint,
+                         save_checkpoint_sharded)
 
 __all__ = [
     "RecordDataset", "RecordWriter", "write_records", "DeviceLoader",
-    "save_checkpoint", "restore_checkpoint", "checkpoint_info",
+    "save_checkpoint", "save_checkpoint_sharded", "restore_checkpoint",
+    "checkpoint_info",
 ]

@@ -36,7 +36,8 @@ from ..api import StromError
 from ..engine import Session, open_source, reorder_chunks
 from ..hbm.staging import default_device, safe_device_put
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "checkpoint_info"]
+__all__ = ["save_checkpoint", "save_checkpoint_sharded",
+           "restore_checkpoint", "checkpoint_info"]
 
 _MAGIC = 0x53544B50_54505531  # "STKP" "TPU1"
 _ALIGN = 4096
@@ -100,23 +101,17 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
     import jax
 
     flat = _flatten(tree)
-    entries = []
-    off = 0  # relative to data region start; offsets derive from sizes only
     for key, leaf in flat:
         if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
             raise StromError(_errno.EINVAL,
                              f"leaf {key} is not fully addressable from this "
-                             f"process; gather before saving")
-        dtype = np.dtype(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
-        shape = tuple(int(s) for s in np.shape(leaf))
-        nbytes = int(dtype.itemsize * np.prod(shape, dtype=np.int64)) \
-            if shape else dtype.itemsize
-        entries.append({"key": key, "dtype": dtype.str, "shape": list(shape),
-                        "offset": off, "nbytes": nbytes})
-        off = _pad(off + nbytes)
+                             f"process; gather before saving, or use "
+                             f"save_checkpoint_sharded")
+    entries = _entries_for(flat)
     header = json.dumps({"version": _VERSION, "leaves": entries}).encode()
     header_len = _pad(16 + len(header))
-    end = header_len + off
+    end = header_len + (entries[-1]["offset"] + _pad(entries[-1]["nbytes"])
+                        if entries else 0)
     # write through symlinks ('latest.strom -> step-N.strom' layouts):
     # os.replace on the link path would swap the link for a regular file
     # and leave the target stale
@@ -128,7 +123,8 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
     # it cannot be a concurrent saver's in-flight temp
     now = time.time()
     for stale in os.listdir(directory):
-        if stale.startswith(base + ".tmp."):
+        if stale.startswith(base + ".tmp.") \
+                or stale == base + ".shared_tmp":
             sp = os.path.join(directory, stale)
             try:
                 if now - os.path.getmtime(sp) > _TMP_SWEEP_AGE_S:
@@ -216,6 +212,172 @@ def _save_leaves_direct(path, entries, flat, header_len,
     finally:
         if own:
             sess.close()
+
+
+def _pwrite_all(fd: int, data, off: int) -> None:
+    """pwrite the whole buffer: loops over the ~2GiB-per-call Linux cap
+    and genuine short writes (NFS), without the full-copy ``tobytes()``
+    an ndarray would otherwise pay."""
+    mv = memoryview(data).cast("B")
+    done = 0
+    while done < len(mv):
+        n = os.pwrite(fd, mv[done:], off + done)
+        if n <= 0:
+            raise StromError(_errno.EIO,
+                            f"pwrite returned {n} at offset {off + done}")
+        done += n
+
+
+def _entries_for(flat) -> List[Dict]:
+    """Leaf table from GLOBAL shapes (identical on every process — a
+    jax.Array's .shape/.dtype are global even when sharded across hosts)."""
+    entries = []
+    off = 0
+    for key, leaf in flat:
+        dtype = np.dtype(getattr(leaf, "dtype", None)
+                         or np.asarray(leaf).dtype)
+        shape = tuple(int(s) for s in np.shape(leaf))
+        nbytes = int(dtype.itemsize * np.prod(shape, dtype=np.int64)) \
+            if shape else dtype.itemsize
+        entries.append({"key": key, "dtype": dtype.str,
+                        "shape": list(shape), "offset": off,
+                        "nbytes": nbytes})
+        off = _pad(off + nbytes)
+    return entries
+
+
+def save_checkpoint_sharded(path: str, tree: Any) -> Dict:
+    """Collective save of a pytree whose leaves may be sharded across
+    hosts: every process writes ONLY the row ranges its addressable
+    shards own into one shared file — the mirror image of the sharded
+    restore (no gather; a multi-terabyte model checkpoint never crosses
+    DCN).  The file layout is identical to :func:`save_checkpoint`, so
+    either restore path reads it.
+
+    Requirements: a filesystem every process can reach at *path*;
+    jax.Array leaves sharded (if at all) on the LEADING axis with
+    unit-step slices and full trailing axes (the same layout the sharded
+    restore reads natively); every process calls this function (it
+    synchronizes through global-device barriers when
+    ``jax.process_count() > 1``).  Replicated shards are written once,
+    by the process holding ``replica_id == 0``; non-array leaves are
+    written by process 0.
+
+    Crash-safe per save: bytes land in a shared deterministic temp file,
+    every process fsyncs its own writes, and process 0 renames it over
+    *path* after the barrier — but unlike :func:`save_checkpoint`,
+    CONCURRENT sharded saves to one path are not supported (all
+    processes must share one temp name to write into one file).  Shard
+    layouts are validated on every process BEFORE the first barrier so
+    bad specs fail symmetrically; a mid-write I/O error on one host
+    (ENOSPC/EIO), however, leaves the other hosts blocked at the data
+    barrier — the barrier has no timeout, so job-level supervision must
+    kill the collective (the installed checkpoint at *path* is never
+    touched until the final rename, so nothing is corrupted).
+    """
+    import jax
+
+    flat = _flatten(tree)
+    entries = _entries_for(flat)
+    # validate EVERY local shard's layout BEFORE the first barrier: a
+    # layout error must fail symmetrically on all processes, not strand
+    # the conforming ones at the data barrier while one process raises
+    for key, leaf in flat:
+        if not isinstance(leaf, jax.Array):
+            continue
+        if not np.shape(leaf):
+            continue
+        for shard in leaf.addressable_shards:
+            idx = shard.index
+            rows = idx[0] if idx else slice(None)
+            if not isinstance(rows, slice) or rows.step not in (None, 1):
+                raise StromError(
+                    _errno.EINVAL,
+                    f"leaf {key}: sharded save needs a unit-step "
+                    f"leading-axis slice, got {rows!r}")
+            if any(s != slice(None, None, None) for s in idx[1:]):
+                raise StromError(
+                    _errno.EINVAL,
+                    f"leaf {key}: sharded save supports leading-axis "
+                    f"sharding only (trailing index {idx[1:]!r} is "
+                    f"partial)")
+    header = json.dumps({"version": _VERSION,
+                         "leaves": entries}).encode()
+    header_len = _pad(16 + len(header))
+    end = header_len + (entries[-1]["offset"] + _pad(entries[-1]["nbytes"])
+                        if entries else 0)
+    path = os.path.realpath(path)
+    tmp = path + ".shared_tmp"
+    multi = jax.process_count() > 1
+    pid0 = jax.process_index() == 0
+
+    def barrier(tag: str) -> None:
+        if multi:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"strom_ckpt:{tag}")
+
+    if pid0:
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<QQ", _MAGIC, len(header)))
+            f.write(header)
+            f.write(b"\0" * (header_len - 16 - len(header)))
+            f.truncate(_pad(end))
+            f.flush()
+            os.fsync(f.fileno())
+    barrier("header")
+    try:
+        fd = os.open(tmp, os.O_WRONLY)
+        try:
+            for e, (key, leaf) in zip(entries, flat):
+                base = header_len + e["offset"]
+                if not isinstance(leaf, jax.Array):
+                    if pid0:
+                        arr = np.ascontiguousarray(np.asarray(leaf))
+                        if arr.dtype.str != e["dtype"]:
+                            arr = arr.astype(np.dtype(e["dtype"]))
+                        _pwrite_all(fd, arr.reshape(-1).view(np.uint8)
+                                    if arr.shape else arr.tobytes(), base)
+                    continue
+                shape = tuple(e["shape"])
+                rowbytes = int(np.dtype(e["dtype"]).itemsize
+                               * np.prod(shape[1:], dtype=np.int64)) \
+                    if len(shape) > 1 else np.dtype(e["dtype"]).itemsize
+                for shard in leaf.addressable_shards:
+                    if shard.replica_id != 0:
+                        continue   # one canonical writer per index block
+                    idx = shard.index
+                    if shape:   # layouts pre-validated before the barrier
+                        rows = idx[0] if idx else slice(None)
+                        r0 = rows.start or 0
+                        off = base + r0 * rowbytes
+                    else:
+                        off = base
+                    data = np.ascontiguousarray(np.asarray(shard.data))
+                    _pwrite_all(fd, data.reshape(-1).view(np.uint8)
+                                if data.shape else data.tobytes(), off)
+            os.fsync(fd)   # each process persists its own writes
+        finally:
+            os.close(fd)
+        barrier("data")
+        if pid0:
+            os.replace(tmp, path)
+            try:
+                dirfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dirfd)
+                finally:
+                    os.close(dirfd)
+            except OSError:
+                pass
+        barrier("installed")
+    except BaseException:
+        if pid0:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        raise
+    return {"path": path, "leaves": len(entries), "bytes": _pad(end)}
 
 
 # -- inspect -----------------------------------------------------------------
