@@ -31,10 +31,11 @@ def main() -> int:
     # 2. SSD -> pinned host RAM through the async engine (MEMCPY_SSD2RAM):
     #    one task, chunked requests, error-retaining wait.
     size = min(os.path.getsize(path), 16 << 20)
-    chunk = min(1 << 20, size)   # small user files still get >= 1 chunk
-    if chunk == 0:
+    if size == 0:
         print("file is empty; nothing to load")
         return 1
+    # chunks must be a power of two; small user files still get >= 1
+    chunk = min(1 << 20, 1 << (size.bit_length() - 1))
     with open_source(path) as src, Session() as sess:
         handle, buf = sess.alloc_dma_buffer(size)
         res = sess.memcpy_ssd2ram(src, handle,

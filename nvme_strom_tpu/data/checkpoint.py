@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..api import StromError
-from ..engine import Session, open_source, reorder_chunks
+from ..engine import Session, open_source, read_chunk_ids
 from ..hbm.staging import default_device, safe_device_put
 
 __all__ = ["save_checkpoint", "save_checkpoint_sharded",
@@ -462,12 +462,8 @@ def _read_span(sess, source, file_off: int, nbytes: int,
         c0 = start // _CHUNK
         c1 = (start + take + _CHUNK - 1) // _CHUNK
         if start % _CHUNK == 0 and c1 * _CHUNK <= source.size:
-            ids = list(range(c0, c1))
-            res = sess.memcpy_ssd2ram(source, handle, ids, _CHUNK)
-            sess.memcpy_wait(res.dma_task_id)
-            view = reorder_chunks(
-                np.frombuffer(buf.view()[:len(ids) * _CHUNK], np.uint8),
-                _CHUNK, res.chunk_ids, ids)[:take]
+            view = read_chunk_ids(sess, source, range(c0, c1), _CHUNK,
+                                  handle, buf.view())[:take]
         else:
             # unaligned head or grid running past EOF: buffered leg
             source.read_buffered(start, buf.view()[:take])

@@ -779,6 +779,22 @@ def reorder_chunks(raw: "np.ndarray", chunk_size: int,
     return ordered.reshape(raw.shape)
 
 
+def read_chunk_ids(sess: "Session", source: Source,
+                   chunk_ids: Sequence[int], chunk_size: int,
+                   buf_handle: int, buf_view: memoryview) -> "np.ndarray":
+    """One synchronous read of *chunk_ids* through a mapped pinned
+    buffer, returned in CALLER order — the submit/wait/reorder protocol
+    shared by the point-lookup fetch and the checkpoint restore (one
+    copy, so a fix to the read protocol lands everywhere)."""
+    import numpy as np
+    ids = [int(c) for c in chunk_ids]
+    res = sess.memcpy_ssd2ram(source, buf_handle, ids, chunk_size)
+    sess.memcpy_wait(res.dma_task_id)
+    return reorder_chunks(
+        np.frombuffer(buf_view[:len(ids) * chunk_size], np.uint8),
+        chunk_size, res.chunk_ids, ids)
+
+
 # ---------------------------------------------------------------------------
 # Async task table
 # ---------------------------------------------------------------------------

@@ -27,6 +27,7 @@ decoded columns — ``lambda cols: cols[0] > 10``.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
@@ -41,6 +42,25 @@ from .planner import (capability_cache, cost_direct_scan, cost_vfs_scan,
 __all__ = ["Query", "QueryPlan"]
 
 _PALLAS_MAX_GROUPS = 64   # static unroll bound (ops/groupby_pallas.py)
+
+
+@functools.lru_cache(maxsize=64)
+def _fetch_gather_fn(schema: HeapSchema, cols: tuple):
+    """Jitted point-lookup gather, cached per (schema, cols) so repeated
+    fetches hit the jit cache instead of recompiling decode_pages (a
+    per-call closure would make every sub-ms lookup pay a compile)."""
+    import jax
+
+    from ..ops.filter_xla import decode_pages
+
+    @jax.jit
+    def gather(pages_u8, page_idx, slot):
+        dcols, valid = decode_pages(pages_u8, schema)
+        out = {f"col{c}": dcols[c][page_idx, slot] for c in cols}
+        out["valid"] = valid[page_idx, slot]
+        return out
+
+    return gather
 
 
 class _ScanLimitReached(Exception):
@@ -663,6 +683,76 @@ class Query:
                 self._vfs_scan(collect, None, device)
         except _ScanLimitReached:
             pass
+
+    def fetch(self, positions, cols: Optional[Sequence[int]] = None, *,
+              session=None, device=None,
+              max_batch_pages: int = 4096) -> dict:
+        """Point lookup by global row position — the index-access face
+        the seqscan-only reference lacks: ONLY the pages containing
+        *positions* are read (8KB page grid; the engine's merge planner
+        consolidates contiguous pages into ``dma_max`` requests,
+        `kmod/nvme_strom.c:1473-1505`), decoded on device, and the
+        requested rows gathered in caller order.
+
+        Returns ``{"col<i>": values, "valid": mask}`` — ``valid`` is
+        False for rows whose slot is past the page's tuple count or
+        marked invisible.  Duplicate and unsorted positions are fine.
+        Not a terminal: usable on any Query (e.g. feed ``top_k``
+        positions back to fetch the full rows)."""
+        import jax
+
+        from ..engine import read_chunk_ids
+        if cols is None:
+            cols = list(range(self.schema.n_cols))
+        for c in cols:
+            if not 0 <= c < self.schema.n_cols:
+                raise StromError(22, f"fetch column {c} out of range")
+        pos = np.asarray(positions, np.int64).reshape(-1)
+        t = self.schema.tuples_per_page
+        src, own = self._open_owned()
+        try:
+            n_pages = src.size // PAGE_SIZE
+            if len(pos) and (pos.min() < 0 or pos.max() >= n_pages * t):
+                raise StromError(34, f"position outside the table "
+                                     f"({n_pages * t} rows)")
+            if not len(pos):
+                out = {f"col{c}": np.zeros(0, self.schema.col_dtype(c))
+                       for c in cols}
+                out["valid"] = np.zeros(0, bool)
+                return out
+            uniq = np.unique(pos // t)          # pages to touch, sorted
+            dev = device or jax.devices()[0]
+            gather = _fetch_gather_fn(self.schema, tuple(cols))
+
+            from ..engine import Session as _S
+            own_sess = session is None
+            sess = session or _S()
+            parts = []
+            try:
+                for b0 in range(0, len(uniq), max_batch_pages):
+                    batch_pages = uniq[b0:b0 + max_batch_pages]
+                    handle, buf = sess.alloc_dma_buffer(
+                        len(batch_pages) * PAGE_SIZE)
+                    try:
+                        raw = read_chunk_ids(sess, src, batch_pages,
+                                             PAGE_SIZE, handle, buf.view())
+                        parts.append(np.array(raw).reshape(-1, PAGE_SIZE))
+                    finally:
+                        sess.unmap_buffer(handle)
+                        buf.close()
+            finally:
+                if own_sess:
+                    sess.close()
+            pages = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            page_idx = np.searchsorted(uniq, pos // t).astype(np.int32)
+            slot = (pos % t).astype(np.int32)
+            out = gather(jax.device_put(pages, dev),
+                         jax.device_put(page_idx, dev),
+                         jax.device_put(slot, dev))
+            return {k: np.asarray(v) for k, v in out.items()}
+        finally:
+            if own:
+                src.close()
 
     def _run_select(self, plan: QueryPlan, device, session) -> dict:
         """SELECT: stream the scan and hand the matching rows back —

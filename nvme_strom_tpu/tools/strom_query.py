@@ -172,6 +172,9 @@ def main(argv=None) -> int:
     ap.add_argument("--quantiles", default=None, metavar="COL:Q[,Q...]",
                     help="exact nearest-rank quantiles of a column, e.g. "
                          "0:0.5,0.9,0.99 (distributed sort with --mesh)")
+    ap.add_argument("--fetch", default=None, metavar="POS[,POS...]",
+                    help="point lookup by global row position: reads only "
+                         "the pages containing those rows (no scan)")
     ap.add_argument("--join", default=None, metavar="COL:TABLE",
                     help="inner join the probe column against a dimension "
                          "table file (.npz with 'keys'/'values' int arrays, "
@@ -224,6 +227,32 @@ def main(argv=None) -> int:
     if args.join_rows and not args.join:
         ap.error("--join-rows requires --join")
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
+    if args.fetch:
+        if terminals:
+            ap.error(f"--fetch is a point lookup, exclusive of "
+                     f"{terminals[0]}")
+        if args.where:
+            ap.error("--fetch reads rows by position; --where does not "
+                     "apply (filter with a scan terminal instead)")
+        for flag, given in (("--explain", args.explain),
+                            ("--having", args.having),
+                            ("--mesh", args.mesh),
+                            ("--kernel", args.kernel != "auto")):
+            if given:
+                ap.error(f"--fetch is a point lookup; {flag} does not "
+                         f"apply")
+        try:
+            fpos = [int(x) for x in args.fetch.split(",")]
+        except ValueError:
+            ap.error("--fetch takes comma-separated integer positions")
+        out = q.fetch(fpos)
+        if args.as_json:
+            print(json.dumps({k: _to_jsonable(v) for k, v in out.items()},
+                             allow_nan=False))
+        else:
+            for k, v in out.items():
+                print(f"{k}: {np.array2string(np.asarray(v), threshold=32)}")
+        return 0
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
     if args.having and not args.group_by:

@@ -330,3 +330,25 @@ def test_strom_query_cli_join(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
                "--join-rows")
     assert out.returncode != 0 and "--join-rows" in out.stderr
+
+
+def test_strom_query_cli_fetch(tmp_path):
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=1, visibility=False)
+    n = schema.tuples_per_page * 2
+    c0 = np.arange(n, dtype=np.int32) * 3
+    path = str(tmp_path / "f.heap")
+    build_heap_file(path, [c0], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
+               "--fetch", "7,0,1000", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["col0"] == [21, 0, 3000]
+    assert res["valid"] == [True, True, True]
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
+               "--fetch", "1", "--where", "c0 > 0")
+    assert out.returncode != 0 and "--fetch" in out.stderr
