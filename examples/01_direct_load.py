@@ -39,7 +39,8 @@ def main() -> int:
     with open_source(path) as src, Session() as sess:
         handle, buf = sess.alloc_dma_buffer(size)
         res = sess.memcpy_ssd2ram(src, handle,
-                                  list(range(size // chunk)), chunk)
+                                  list(range((size + chunk - 1) // chunk)),
+                                  chunk)
         sess.memcpy_wait(res.dma_task_id)
         snap = sess.stat_info()
         print(f"ssd2ram: {res.nr_ssd2dev} direct + {res.nr_ram2dev} "

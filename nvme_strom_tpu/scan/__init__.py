@@ -1,6 +1,8 @@
 from .heap import (HEAP_MAGIC, PAGE_SIZE, HeapSchema, build_heap_file,
                    pages_from_bytes)
+from .index import SortedIndex, build_index, open_index
 from .query import Query, QueryPlan
 
 __all__ = ["HEAP_MAGIC", "PAGE_SIZE", "HeapSchema", "Query", "QueryPlan",
-           "build_heap_file", "pages_from_bytes"]
+           "SortedIndex", "build_heap_file", "build_index", "open_index",
+           "pages_from_bytes"]

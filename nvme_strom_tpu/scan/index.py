@@ -1,0 +1,187 @@
+"""Sorted secondary index: the index-access method over heap tables.
+
+The reference is a sequential-scan engine — its planner only ever chooses
+between the direct path and the buffered path for FULL scans
+(`pgsql/nvme_strom.c:448-633`).  This module adds the other access method
+a database user expects: a sorted ``(key, position)`` sidecar built by one
+scan, after which equality and range lookups touch ONLY the pages holding
+matching rows (binary search on the sidecar -> :meth:`..scan.query.Query.
+fetch`'s merge-planned page reads).
+
+TPU-first shape: the sidecar is two dense arrays (sorted keys + their
+global row positions), so every probe is ``searchsorted`` — the same
+vectorized-binary-search discipline as the broadcast join (`ops/join.py`)
+— rather than a pointer-chasing B-tree, which the VPU cannot batch.
+
+Sidecar layout (``<table>.idx`` by convention)::
+
+    [ magic u64 | json_len u64 | header json, padded to 4096 ]
+    [ sorted keys array ][ positions array (int64) ]
+
+Header json: ``{version, col, dtype, count, table_size, table_mtime_ns}``.
+``table_size``/``table_mtime_ns`` let :func:`open_index` detect a stale
+index after the table changed (the syscache-invalidation analog,
+`pgsql/nvme_strom.c:217-348`).
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import json
+import os
+import struct
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..api import StromError
+
+__all__ = ["build_index", "open_index", "SortedIndex"]
+
+_MAGIC = 0x53545258_49445831  # "STRX" "IDX1"
+_VERSION = 1
+_ALIGN = 4096
+
+
+def _table_stamp(path: str) -> Tuple[int, int]:
+    st = os.stat(path)
+    return int(st.st_size), int(st.st_mtime_ns)
+
+
+def build_index(table_path: str, schema, col: int, *,
+                index_path: Optional[str] = None,
+                session=None, device=None) -> str:
+    """One scan of the table -> a sorted (key, position) sidecar.
+
+    Returns the index path (``<table>.idx<col>`` by default).  NaN float
+    keys are excluded (they compare unordered; SQL indexes skip NULLs the
+    same way)."""
+    from .query import Query
+
+    # stamp BEFORE the scan: a table modified mid-build then mismatches
+    # the stamp and open_index fails stale (stamping after would bless an
+    # index holding pre-modification data)
+    size, mtime = _table_stamp(table_path)
+    q = Query(table_path, schema).order_by(col)
+    out = q.run(session=session, device=device)
+    keys = np.asarray(out["values"])
+    poss = np.asarray(out["positions"], np.int64)
+    if keys.dtype.kind == "f":
+        finite = ~np.isnan(keys)
+        keys, poss = keys[finite], poss[finite]
+    header = json.dumps({
+        "version": _VERSION, "col": int(col), "dtype": keys.dtype.str,
+        "count": int(len(keys)),
+        "table_size": size,
+        "table_mtime_ns": mtime,
+    }).encode()
+    hlen = (16 + len(header) + _ALIGN - 1) // _ALIGN * _ALIGN
+    path = index_path or f"{table_path}.idx{col}"
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<QQ", _MAGIC, len(header)))
+            f.write(header)
+            f.write(b"\0" * (hlen - 16 - len(header)))
+            f.write(np.ascontiguousarray(keys).tobytes())
+            f.write(np.ascontiguousarray(poss).tobytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+@dataclass
+class SortedIndex:
+    """An opened sidecar: dense sorted keys + row positions."""
+
+    path: str
+    col: int
+    keys: np.ndarray        # sorted, ascending
+    positions: np.ndarray   # int64 global row positions, aligned to keys
+
+    def lookup(self, values) -> np.ndarray:
+        """Row positions of rows whose key equals any of *values*
+        (duplicates in the table all match; order: ascending key, then
+        index order within equal keys).  A probe the key dtype cannot
+        represent exactly (e.g. 7.5 against int32 keys) matches nothing
+        — SQL equality semantics, not silent truncation."""
+        raw = np.asarray(values).reshape(-1)
+        vals = raw.astype(self.keys.dtype)
+        exact = vals.astype(raw.dtype) == raw if raw.dtype != vals.dtype \
+            else np.ones(len(raw), bool)
+        parts = []
+        for v in vals[exact]:
+            lo = int(np.searchsorted(self.keys, v, side="left"))
+            hi = int(np.searchsorted(self.keys, v, side="right"))
+            if hi > lo:
+                parts.append(self.positions[lo:hi])
+        return np.concatenate(parts) if parts else np.zeros(0, np.int64)
+
+    def range(self, lo=None, hi=None, *,
+              inclusive: str = "both") -> np.ndarray:
+        """Row positions with key in the given range (``inclusive`` one
+        of both|left|right|neither), in ascending key order."""
+        if inclusive not in ("both", "left", "right", "neither"):
+            raise StromError(_errno.EINVAL,
+                            f"inclusive={inclusive!r} invalid")
+        i0 = 0 if lo is None else int(np.searchsorted(
+            self.keys, lo, side="left" if inclusive in ("both", "left")
+            else "right"))
+        i1 = len(self.keys) if hi is None else int(np.searchsorted(
+            self.keys, hi, side="right" if inclusive in ("both", "right")
+            else "left"))
+        return self.positions[i0:max(i0, i1)]
+
+    def fetch(self, query, values=None, *, lo=None, hi=None,
+              cols=None, session=None, device=None,
+              inclusive: str = "both") -> dict:
+        """Index scan: resolve positions (equality *values* or a
+        [lo, hi] range) then read ONLY their pages via ``query.fetch``.
+        Adds ``"positions"`` to the fetch result."""
+        pos = self.lookup(values) if values is not None \
+            else self.range(lo, hi, inclusive=inclusive)
+        out = query.fetch(pos, cols=cols, session=session, device=device)
+        out["positions"] = pos
+        return out
+
+
+def open_index(index_path: str, *, table_path: Optional[str] = None,
+               check_stale: bool = True) -> SortedIndex:
+    """mmap-free open of a sidecar (one buffered read; indexes are small
+    next to their tables).  With *table_path* and ``check_stale``, a
+    size/mtime mismatch against the stamped table raises ESTALE — rebuild
+    with :func:`build_index`."""
+    with open(index_path, "rb") as f:
+        magic, jlen = struct.unpack("<QQ", f.read(16))
+        if magic != _MAGIC:
+            raise StromError(_errno.EINVAL,
+                            f"{index_path}: not a strom index")
+        meta = json.loads(f.read(jlen))
+        if meta.get("version") != _VERSION:
+            raise StromError(_errno.EINVAL,
+                            f"index version {meta.get('version')}")
+        if check_stale and table_path is not None:
+            size, mtime = _table_stamp(table_path)
+            if (size != meta["table_size"]
+                    or mtime != meta["table_mtime_ns"]):
+                raise StromError(_errno.ESTALE,
+                                f"{index_path} is stale: table changed "
+                                f"since the index was built")
+        hlen = (16 + jlen + _ALIGN - 1) // _ALIGN * _ALIGN
+        f.seek(hlen)
+        n = meta["count"]
+        kdt = np.dtype(meta["dtype"])
+        keys = np.frombuffer(f.read(n * kdt.itemsize), kdt)
+        poss = np.frombuffer(f.read(n * 8), np.int64)
+    if len(keys) != n or len(poss) != n:
+        raise StromError(_errno.EIO, f"{index_path}: truncated index")
+    return SortedIndex(path=index_path, col=meta["col"],
+                       keys=keys, positions=poss)

@@ -175,6 +175,14 @@ def main(argv=None) -> int:
     ap.add_argument("--fetch", default=None, metavar="POS[,POS...]",
                     help="point lookup by global row position: reads only "
                          "the pages containing those rows (no scan)")
+    ap.add_argument("--build-index", default=None, metavar="COL", type=int,
+                    help="one scan -> sorted (key, position) sidecar at "
+                         "FILE.idxCOL; later --index-lookup reads only "
+                         "matching pages")
+    ap.add_argument("--index-lookup", default=None, metavar="COL:V[,V...]",
+                    help="index scan: resolve positions from the sidecar, "
+                         "fetch only their pages (build with --build-index "
+                         "first; stale indexes are refused)")
     ap.add_argument("--join", default=None, metavar="COL:TABLE",
                     help="inner join the probe column against a dimension "
                          "table file (.npz with 'keys'/'values' int arrays, "
@@ -227,6 +235,45 @@ def main(argv=None) -> int:
     if args.join_rows and not args.join:
         ap.error("--join-rows requires --join")
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
+    if args.build_index is not None or args.index_lookup:
+        from ..scan.index import build_index, open_index
+        if terminals or args.where or args.fetch:
+            ap.error("--build-index/--index-lookup are exclusive index "
+                     "operations")
+        for flag, given in (("--explain", args.explain),
+                            ("--having", args.having),
+                            ("--mesh", args.mesh),
+                            ("--kernel", args.kernel != "auto")):
+            if given:
+                ap.error(f"{flag} does not apply to index operations")
+        if not isinstance(src, str):
+            ap.error("index operations take a single table file")
+        if args.build_index is not None:
+            ipath = build_index(src, schema, args.build_index)
+            print(f"built {ipath}")
+            if not args.index_lookup:
+                return 0
+        colspec, _, vspec = args.index_lookup.partition(":")
+        if not colspec.isdigit() or not vspec:
+            ap.error("--index-lookup takes COL:V[,V...]")
+        try:
+            vals = [float(x) if "." in x or "e" in x.lower() else int(x)
+                    for x in vspec.split(",")]
+        except ValueError:
+            ap.error("--index-lookup: values must be numbers")
+        try:
+            idx = open_index(f"{src}.idx{colspec}", table_path=src)
+        except FileNotFoundError:
+            ap.error(f"no index at {src}.idx{colspec}; build it with "
+                     f"--build-index {colspec}")
+        out = idx.fetch(q, values=vals)
+        if args.as_json:
+            print(json.dumps({k: _to_jsonable(v) for k, v in out.items()},
+                             allow_nan=False))
+        else:
+            for k, v in out.items():
+                print(f"{k}: {np.array2string(np.asarray(v), threshold=32)}")
+        return 0
     if args.fetch:
         if terminals:
             ap.error(f"--fetch is a point lookup, exclusive of "

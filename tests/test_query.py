@@ -883,7 +883,6 @@ def test_fetch_point_lookup_matches_oracle(heap):
     pos = rng.integers(0, len(c0), 50)
     pos = np.concatenate([pos, pos[:5]])   # duplicates, unsorted
     with Session() as sess:
-        sess._fold_native_stats() if sess._native else None
         before = sess.stat_info().counters["total_dma_length"]
         out = Query(path, schema).fetch(pos, session=sess)
         after = sess.stat_info().counters["total_dma_length"]

@@ -352,3 +352,35 @@ def test_strom_query_cli_fetch(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
                "--fetch", "1", "--where", "c0 > 0")
     assert out.returncode != 0 and "--fetch" in out.stderr
+
+
+def test_strom_query_cli_index(tmp_path):
+    """--build-index then --index-lookup: the sidecar resolves positions
+    and only matching rows come back."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(29)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 50, n).astype(np.int32)
+    c1 = np.arange(n, dtype=np.int32)
+    path = str(tmp_path / "i.heap")
+    build_heap_file(path, [c0, c1], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--build-index", "0")
+    assert out.returncode == 0, out.stderr
+    assert "built" in out.stdout
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--index-lookup", "0:7", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    want = np.flatnonzero(c0 == 7)
+    assert sorted(res["positions"]) == want.tolist()
+    assert sorted(res["col1"]) == c1[want].tolist()
+    # exclusive with scan terminals
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--index-lookup", "0:7", "--top-k", "0:3")
+    assert out.returncode != 0 and "exclusive" in out.stderr
