@@ -37,7 +37,7 @@ import numpy as np
 
 from ..api import StromError
 
-__all__ = ["build_index", "open_index", "SortedIndex"]
+__all__ = ["build_index", "open_index", "probe_index", "SortedIndex"]
 
 _MAGIC = 0x53545258_49445831  # "STRX" "IDX1"
 _VERSION = 1
@@ -153,6 +153,33 @@ class SortedIndex:
         return out
 
 
+def _read_header(f) -> Tuple[dict, int]:
+    """(header json, aligned header length); raises on any malformation."""
+    magic, jlen = struct.unpack("<QQ", f.read(16))
+    if magic != _MAGIC:
+        raise StromError(_errno.EINVAL, "not a strom index")
+    meta = json.loads(f.read(jlen))
+    if meta.get("version") != _VERSION:
+        raise StromError(_errno.EINVAL,
+                        f"index version {meta.get('version')}")
+    return meta, (16 + jlen + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def probe_index(index_path: str, table_path: str) -> bool:
+    """Header-only freshness check for the PLANNER: one 4KB-class read,
+    no key/position load.  Returns False for missing, stale, corrupt, or
+    unreadable sidecars — the planner never fails a query over an
+    optional accelerator."""
+    try:
+        with open(index_path, "rb") as f:
+            meta, _ = _read_header(f)
+        size, mtime = _table_stamp(table_path)
+        return (size == meta["table_size"]
+                and mtime == meta["table_mtime_ns"])
+    except Exception:
+        return False
+
+
 def open_index(index_path: str, *, table_path: Optional[str] = None,
                check_stale: bool = True) -> SortedIndex:
     """mmap-free open of a sidecar (one buffered read; indexes are small
@@ -160,14 +187,7 @@ def open_index(index_path: str, *, table_path: Optional[str] = None,
     size/mtime mismatch against the stamped table raises ESTALE — rebuild
     with :func:`build_index`."""
     with open(index_path, "rb") as f:
-        magic, jlen = struct.unpack("<QQ", f.read(16))
-        if magic != _MAGIC:
-            raise StromError(_errno.EINVAL,
-                            f"{index_path}: not a strom index")
-        meta = json.loads(f.read(jlen))
-        if meta.get("version") != _VERSION:
-            raise StromError(_errno.EINVAL,
-                            f"index version {meta.get('version')}")
+        meta, hlen = _read_header(f)
         if check_stale and table_path is not None:
             size, mtime = _table_stamp(table_path)
             if (size != meta["table_size"]
@@ -175,7 +195,6 @@ def open_index(index_path: str, *, table_path: Optional[str] = None,
                 raise StromError(_errno.ESTALE,
                                 f"{index_path} is stale: table changed "
                                 f"since the index was built")
-        hlen = (16 + jlen + _ALIGN - 1) // _ALIGN * _ALIGN
         f.seek(hlen)
         n = meta["count"]
         kdt = np.dtype(meta["dtype"])

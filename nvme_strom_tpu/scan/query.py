@@ -72,8 +72,8 @@ class _ScanLimitReached(Exception):
 @dataclass(frozen=True)
 class QueryPlan:
     """What ``run()`` will do, decided before any I/O (EXPLAIN analog)."""
-    operator: str          # aggregate | group_by | top_k | join
-    access_path: str       # direct | vfs
+    operator: str          # aggregate | group_by | top_k | join | ...
+    access_path: str       # direct | vfs | index
     kernel: str            # pallas | xla
     mode: str              # local | mesh
     n_pages: int
@@ -118,11 +118,28 @@ class Query:
         self._join: Optional[tuple] = None
         self._select: Optional[tuple] = None
         self._quantiles: Optional[List[float]] = None
+        self._eq: Optional[tuple] = None   # structured equality (col, v)
 
     # -- builders -----------------------------------------------------------
     def where(self, predicate: Callable) -> "Query":
         """Row filter: ``predicate(cols) -> (B, T) bool`` (jnp ops only)."""
         self._pred = predicate
+        self._eq = None   # an opaque predicate supersedes a structured one
+        return self
+
+    def where_eq(self, col: int, value) -> "Query":
+        """Structured equality filter: ``col == value``.  Unlike the
+        opaque :meth:`where` lambda, the planner can SEE this one — when
+        a fresh sorted index sidecar exists for *col* (built by
+        :func:`..scan.index.build_index`), a :meth:`select` runs as an
+        INDEX SCAN touching only matching pages; every other terminal
+        (and a missing/stale index) falls back to the filtered seqscan,
+        the way the reference's planner hook transparently swaps access
+        paths (`pgsql/nvme_strom.c:1642-1667`)."""
+        if not 0 <= col < self.schema.n_cols:
+            raise StromError(22, f"where_eq column {col} out of range")
+        self._pred = lambda cols: cols[col] == value
+        self._eq = (int(col), value)
         return self
 
     def select(self, cols: Optional[Sequence[int]] = None, *,
@@ -339,6 +356,33 @@ class Query:
                            else "single-device lax sort")
         return "xla", f"{self._op} runs on lax.top_k/searchsorted (XLA)"
 
+    def _index_path_for_eq(self) -> Optional[str]:
+        if self._eq is None or not isinstance(self.source, str):
+            return None
+        return f"{self.source}.idx{self._eq[0]}"
+
+    def _index_fresh_for_eq(self) -> bool:
+        """Header-only planner probe (no key/position load — EXPLAIN
+        stays I/O-cheap); missing/stale/corrupt all mean False."""
+        ipath = self._index_path_for_eq()
+        if ipath is None:
+            return False
+        from .index import probe_index
+        return probe_index(ipath, self.source)
+
+    def _index_for_eq(self):
+        """A FRESH sorted-index sidecar for the where_eq column, or None
+        (missing/stale/corrupt all mean seqscan fallback, silently — the
+        planner never fails a query over an optional accelerator)."""
+        ipath = self._index_path_for_eq()
+        if ipath is None:
+            return None
+        from .index import open_index
+        try:
+            return open_index(ipath, table_path=self.source)
+        except Exception:   # corrupt sidecars included, not just Strom/OS
+            return None
+
     def explain(self, *, mesh=None) -> QueryPlan:
         path, size = self._source_facts()
         n_pages = size // PAGE_SIZE
@@ -349,6 +393,16 @@ class Query:
         kernel, why = self._kernel_choice(mode)
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
+        if (self._op == "select" and mode == "local"
+                and kernel != "invalid" and self._index_fresh_for_eq()):
+            c, v = self._eq
+            return QueryPlan(
+                operator=self._op, access_path="index", kernel=kernel,
+                mode=mode, n_pages=n_pages, cost_direct=cd.total,
+                cost_vfs=cv.total,
+                reason=f"fresh index on col{c}: equality col{c} == {v!r} "
+                       f"resolves positions from the sidecar and reads "
+                       f"only matching pages; " + why)
         if direct:
             reason = ("table above the direct-scan threshold and backing "
                       "eligible; " + why)
@@ -473,6 +527,10 @@ class Query:
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
         if self._op == "select":
+            if plan.access_path == "index":
+                idx = self._index_for_eq()
+                if idx is not None:   # raced away since explain: seqscan
+                    return self._run_select_indexed(idx, device, session)
             return self._run_select(plan, device, session)
         if self._op == "join" and self._join[3]:   # materialize=True
             return self._run_join_rows(plan, device, session)
@@ -753,6 +811,26 @@ class Query:
         finally:
             if own:
                 src.close()
+
+    def _run_select_indexed(self, idx, device, session) -> dict:
+        """INDEX SCAN select: positions from the sidecar, then only the
+        matching pages are read (``fetch``'s merge-planned lookups).
+        Same result contract as :meth:`_run_select`; row order is index
+        order (ascending key, build order within duplicates)."""
+        cols, limit, offset = self._select
+        if cols is None:
+            cols = list(range(self.schema.n_cols))
+        pos = idx.lookup([self._eq[1]])
+        end = None if limit is None else offset + limit
+        pos = pos[offset:end]
+        out = self.fetch(pos, cols=cols, session=session, device=device)
+        # index rows were valid at build time and the table is stamped
+        # unchanged; keep the defensive mask anyway
+        keep = out.pop("valid")
+        res = {f"col{c}": out[f"col{c}"][keep] for c in cols}
+        res["positions"] = pos[keep]
+        res["count"] = np.int64(len(res["positions"]))
+        return res
 
     def _run_select(self, plan: QueryPlan, device, session) -> dict:
         """SELECT: stream the scan and hand the matching rows back —

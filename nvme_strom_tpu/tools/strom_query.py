@@ -26,6 +26,7 @@ import sys
 
 import numpy as np
 
+from ..api import StromError
 from ..scan.heap import HeapSchema
 
 __all__ = ["main", "cli"]
@@ -141,6 +142,10 @@ def main(argv=None) -> int:
                     help="schema carries a per-tuple visibility column")
     ap.add_argument("--where", default=None, metavar="EXPR",
                     help='row filter, e.g. "c0 > 10"')
+    ap.add_argument("--where-eq", default=None, metavar="COL:VALUE",
+                    help="structured equality filter the planner can see: "
+                         "with a fresh --build-index sidecar, --select "
+                         "runs as an index scan (check with --explain)")
     ap.add_argument("--group-by", default=None, metavar="EXPR",
                     help='int32 group key, e.g. "c1 % 8"')
     ap.add_argument("--groups", type=int, default=None,
@@ -237,7 +242,7 @@ def main(argv=None) -> int:
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.build_index is not None or args.index_lookup:
         from ..scan.index import build_index, open_index
-        if terminals or args.where or args.fetch:
+        if terminals or args.where or args.where_eq or args.fetch:
             ap.error("--build-index/--index-lookup are exclusive index "
                      "operations")
         for flag, given in (("--explain", args.explain),
@@ -266,6 +271,9 @@ def main(argv=None) -> int:
         except FileNotFoundError:
             ap.error(f"no index at {src}.idx{colspec}; build it with "
                      f"--build-index {colspec}")
+        except StromError as e:
+            ap.error(f"{src}.idx{colspec}: {e}; rebuild with "
+                     f"--build-index {colspec}")
         out = idx.fetch(q, values=vals)
         if args.as_json:
             print(json.dumps({k: _to_jsonable(v) for k, v in out.items()},
@@ -278,9 +286,9 @@ def main(argv=None) -> int:
         if terminals:
             ap.error(f"--fetch is a point lookup, exclusive of "
                      f"{terminals[0]}")
-        if args.where:
-            ap.error("--fetch reads rows by position; --where does not "
-                     "apply (filter with a scan terminal instead)")
+        if args.where or args.where_eq:
+            ap.error("--fetch reads rows by position; --where/--where-eq "
+                     "do not apply (filter with a scan terminal instead)")
         for flag, given in (("--explain", args.explain),
                             ("--having", args.having),
                             ("--mesh", args.mesh),
@@ -300,8 +308,20 @@ def main(argv=None) -> int:
             for k, v in out.items():
                 print(f"{k}: {np.array2string(np.asarray(v), threshold=32)}")
         return 0
+    if args.where and args.where_eq:
+        ap.error("--where and --where-eq are exclusive")
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
+    elif args.where_eq:
+        colspec, _, vspec = args.where_eq.partition(":")
+        if not colspec.isdigit() or not vspec:
+            ap.error("--where-eq takes COL:VALUE")
+        try:
+            val = float(vspec) if "." in vspec or "e" in vspec.lower() \
+                else int(vspec)
+        except ValueError:
+            ap.error("--where-eq: VALUE must be a number")
+        q = q.where_eq(int(colspec), val)
     if args.having and not args.group_by:
         ap.error("--having requires --group-by")
     if args.select:

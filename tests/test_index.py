@@ -109,3 +109,75 @@ def test_index_staleness_and_float_nan(tmp_path):
     # but an explicit opt-out still opens it
     assert open_index(ipath, table_path=path,
                       check_stale=False).col == 0
+
+
+def test_where_eq_planner_picks_index_scan(table):
+    """The transparent access-path swap: with a fresh sidecar, a
+    where_eq select plans and runs as an INDEX SCAN; results equal the
+    seqscan's; stale/missing indexes fall back silently; non-select
+    terminals still scan."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+
+    q = Query(path, schema).where_eq(0, 42).select()
+    assert q.explain().access_path == "direct"   # no index yet
+    seq = q.run()
+
+    build_index(path, schema, 0)
+    q2 = Query(path, schema).where_eq(0, 42).select()
+    plan = q2.explain()
+    assert plan.access_path == "index"
+    assert "index" in plan.reason and "42" in plan.reason
+    idx_out = q2.run()
+    assert int(idx_out["count"]) == int(seq["count"])
+    np.testing.assert_array_equal(np.sort(idx_out["positions"]),
+                                  np.sort(seq["positions"]))
+    np.testing.assert_array_equal(
+        np.sort(idx_out["col1"]), np.sort(seq["col1"]))
+
+    # limit slices index order; I/O bounded by pages of the slice
+    lim = Query(path, schema).where_eq(0, 42).select(limit=3).run()
+    assert int(lim["count"]) == 3
+    assert (c0[lim["positions"]] == 42).all()
+
+    # a non-select terminal keeps the scan path but uses the equality
+    agg = Query(path, schema).where_eq(0, 42).aggregate(cols=[1]).run()
+    assert Query(path, schema).where_eq(0, 42).aggregate(
+        cols=[1]).explain().access_path == "direct"
+    assert int(agg["count"]) == int((c0 == 42).sum())
+    assert int(agg["sums"][0]) == int(c1[c0 == 42].sum())
+
+    # stale index: silent seqscan fallback, same answer
+    build_heap_file(path, [c0, c1 + 1], schema)   # rewrite table
+    q3 = Query(path, schema).where_eq(0, 42).select()
+    assert q3.explain().access_path == "direct"
+    out3 = q3.run()
+    np.testing.assert_array_equal(np.sort(out3["positions"]),
+                                  np.flatnonzero(c0 == 42))
+
+
+def test_where_after_where_eq_clears_index_plan(table):
+    """where() after where_eq() must clear the structured equality — the
+    planner would otherwise index-scan the OLD filter (review finding)."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    build_index(path, schema, 0)
+    q = Query(path, schema).where_eq(0, 42) \
+        .where(lambda c: c[0] > 100).select()
+    assert q.explain().access_path != "index"
+    out = q.run()
+    np.testing.assert_array_equal(np.sort(out["positions"]),
+                                  np.flatnonzero(c0 > 100))
+
+
+def test_corrupt_sidecar_falls_back_silently(table):
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    ipath = build_index(path, schema, 0)
+    with open(ipath, "wb") as f:
+        f.write(b"garbage")   # not even a valid header
+    q = Query(path, schema).where_eq(0, 42).select()
+    assert q.explain().access_path != "index"
+    out = q.run()   # seqscan answers correctly
+    np.testing.assert_array_equal(np.sort(out["positions"]),
+                                  np.flatnonzero(c0 == 42))

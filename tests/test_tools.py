@@ -384,3 +384,36 @@ def test_strom_query_cli_index(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
                "--index-lookup", "0:7", "--top-k", "0:3")
     assert out.returncode != 0 and "exclusive" in out.stderr
+
+
+def test_strom_query_cli_where_eq_index_plan(tmp_path):
+    """--where-eq + --select: --explain shows the index access path once
+    a sidecar exists, and the run returns the matching rows."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(31)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 30, n).astype(np.int32)
+    c1 = np.arange(n, dtype=np.int32)
+    path = str(tmp_path / "w.heap")
+    build_heap_file(path, [c0, c1], schema)
+    _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+         "--build-index", "0")
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--where-eq", "0:9", "--select", "all", "--explain")
+    assert out.returncode == 0, out.stderr
+    assert "index path" in out.stdout
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--where-eq", "0:9", "--select", "all", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    want = np.flatnonzero(c0 == 9)
+    assert sorted(res["positions"]) == want.tolist()
+    # --where and --where-eq are exclusive
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--where", "c0 > 1", "--where-eq", "0:9")
+    assert out.returncode != 0 and "exclusive" in out.stderr
