@@ -8,6 +8,7 @@ output — the planner chooses access path (direct vs buffered) and kernel
 scan node (pgsql/nvme_strom.c:1642-1667).
 """
 
+import os
 import sys
 import tempfile
 
@@ -56,6 +57,16 @@ def main() -> int:
         ana = Query(f.name, schema).where(lambda c: c[0] > 0) \
             .run(analyze=True)
         print(f"\nEXPLAIN ANALYZE: {ana['_analyze']}")
+
+        # index scan: build a sorted sidecar, then the planner swaps the
+        # where_eq select onto it transparently (EXPLAIN shows the path)
+        from nvme_strom_tpu.scan.index import build_index
+        build_index(f.name, schema, 0)
+        iq = Query(f.name, schema).where_eq(0, 777).select([1])
+        print(f"\n{iq.explain()}")
+        irows = iq.run()
+        print(f"index scan: {irows['count']} rows with c0 == 777")
+        os.unlink(f.name + ".idx0")
     return 0
 
 

@@ -51,12 +51,14 @@ def _table_stamp(path: str) -> Tuple[int, int]:
 
 def build_index(table_path: str, schema, col: int, *,
                 index_path: Optional[str] = None,
-                session=None, device=None) -> str:
+                session=None, device=None, mesh=None) -> str:
     """One scan of the table -> a sorted (key, position) sidecar.
 
     Returns the index path (``<table>.idx<col>`` by default).  NaN float
     keys are excluded (they compare unordered; SQL indexes skip NULLs the
-    same way)."""
+    same way).  With *mesh*, the sort runs as the distributed sample
+    sort over the device mesh — index builds over large tables scale
+    the same way ORDER BY does."""
     from .query import Query
 
     # stamp BEFORE the scan: a table modified mid-build then mismatches
@@ -64,7 +66,7 @@ def build_index(table_path: str, schema, col: int, *,
     # index holding pre-modification data)
     size, mtime = _table_stamp(table_path)
     q = Query(table_path, schema).order_by(col)
-    out = q.run(session=session, device=device)
+    out = q.run(session=session, device=device, mesh=mesh)
     keys = np.asarray(out["values"])
     poss = np.asarray(out["positions"], np.int64)
     if keys.dtype.kind == "f":
@@ -153,15 +155,15 @@ class SortedIndex:
         return out
 
 
-def _read_header(f) -> Tuple[dict, int]:
+def _read_header(f, path: str) -> Tuple[dict, int]:
     """(header json, aligned header length); raises on any malformation."""
     magic, jlen = struct.unpack("<QQ", f.read(16))
     if magic != _MAGIC:
-        raise StromError(_errno.EINVAL, "not a strom index")
+        raise StromError(_errno.EINVAL, f"{path}: not a strom index")
     meta = json.loads(f.read(jlen))
     if meta.get("version") != _VERSION:
         raise StromError(_errno.EINVAL,
-                        f"index version {meta.get('version')}")
+                        f"{path}: index version {meta.get('version')}")
     return meta, (16 + jlen + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
@@ -172,7 +174,7 @@ def probe_index(index_path: str, table_path: str) -> bool:
     optional accelerator."""
     try:
         with open(index_path, "rb") as f:
-            meta, _ = _read_header(f)
+            meta, _ = _read_header(f, index_path)
         size, mtime = _table_stamp(table_path)
         return (size == meta["table_size"]
                 and mtime == meta["table_mtime_ns"])
@@ -187,7 +189,7 @@ def open_index(index_path: str, *, table_path: Optional[str] = None,
     size/mtime mismatch against the stamped table raises ESTALE — rebuild
     with :func:`build_index`."""
     with open(index_path, "rb") as f:
-        meta, hlen = _read_header(f)
+        meta, hlen = _read_header(f, index_path)
         if check_stale and table_path is not None:
             size, mtime = _table_stamp(table_path)
             if (size != meta["table_size"]

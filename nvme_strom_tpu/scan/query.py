@@ -135,11 +135,28 @@ class Query:
         INDEX SCAN touching only matching pages; every other terminal
         (and a missing/stale index) falls back to the filtered seqscan,
         the way the reference's planner hook transparently swaps access
-        paths (`pgsql/nvme_strom.c:1642-1667`)."""
+        paths (`pgsql/nvme_strom.c:1642-1667`).
+
+        The literal is normalized to the COLUMN dtype up front so both
+        access paths agree: a float literal against a float32 column
+        compares as float32 (``0.1`` matches stored ``float32(0.1)``),
+        and a non-integral literal against an integer column matches
+        nothing — on the seqscan AND the index."""
         if not 0 <= col < self.schema.n_cols:
             raise StromError(22, f"where_eq column {col} out of range")
-        self._pred = lambda cols: cols[col] == value
-        self._eq = (int(col), value)
+        dt = self.schema.col_dtype(col)
+        arr = np.asarray(value)
+        cast = arr.astype(dt)
+        if dt.kind in "iu" and arr.dtype.kind == "f" \
+                and not np.array_equal(cast.astype(arr.dtype), arr):
+            # int column vs non-integral literal: no row can match —
+            # int != int is identically False (no NaN in this branch)
+            self._pred = lambda cols: cols[col] != cols[col]
+            self._eq = (int(col), None)   # index path: empty result
+            return self
+        v = cast[()]                      # np scalar typed as the column
+        self._pred = lambda cols: cols[col] == v
+        self._eq = (int(col), v)
         return self
 
     def select(self, cols: Optional[Sequence[int]] = None, *,
@@ -529,8 +546,16 @@ class Query:
         if self._op == "select":
             if plan.access_path == "index":
                 idx = self._index_for_eq()
-                if idx is not None:   # raced away since explain: seqscan
+                if idx is not None:
                     return self._run_select_indexed(idx, device, session)
+                # index raced away since explain: recompute the SCAN
+                # path choice (falling into the vfs branch unconditionally
+                # would demote large tables off the direct DMA path)
+                path, size = self._source_facts()
+                plan = dataclasses.replace(
+                    plan, access_path="direct"
+                    if path is not None and should_use_direct_scan(
+                        path, table_size=size) else "vfs")
             return self._run_select(plan, device, session)
         if self._op == "join" and self._join[3]:   # materialize=True
             return self._run_join_rows(plan, device, session)
@@ -820,7 +845,10 @@ class Query:
         cols, limit, offset = self._select
         if cols is None:
             cols = list(range(self.schema.n_cols))
-        pos = idx.lookup([self._eq[1]])
+        # value None = the normalized literal can match no row (e.g. 7.5
+        # against an int column) — same empty answer the seqscan gives
+        pos = idx.lookup([self._eq[1]]) if self._eq[1] is not None \
+            else np.zeros(0, np.int64)
         end = None if limit is None else offset + limit
         pos = pos[offset:end]
         out = self.fetch(pos, cols=cols, session=session, device=device)

@@ -26,7 +26,6 @@ import sys
 
 import numpy as np
 
-from ..api import StromError
 from ..scan.heap import HeapSchema
 
 __all__ = ["main", "cli"]
@@ -108,6 +107,12 @@ def _having_fn(expr: str):
         return _eval_sandboxed(code, ns)
 
     return fn
+
+
+def _parse_number(s: str):
+    """One numeric-literal grammar for every CLI value flag
+    (--index-lookup / --where-eq): int unless it reads as a float."""
+    return float(s) if "." in s or "e" in s.lower() else int(s)
 
 
 def _to_jsonable(v):
@@ -262,8 +267,7 @@ def main(argv=None) -> int:
         if not colspec.isdigit() or not vspec:
             ap.error("--index-lookup takes COL:V[,V...]")
         try:
-            vals = [float(x) if "." in x or "e" in x.lower() else int(x)
-                    for x in vspec.split(",")]
+            vals = [_parse_number(x) for x in vspec.split(",")]
         except ValueError:
             ap.error("--index-lookup: values must be numbers")
         try:
@@ -271,7 +275,7 @@ def main(argv=None) -> int:
         except FileNotFoundError:
             ap.error(f"no index at {src}.idx{colspec}; build it with "
                      f"--build-index {colspec}")
-        except StromError as e:
+        except Exception as e:   # stale/corrupt: rebuild hint, no trace
             ap.error(f"{src}.idx{colspec}: {e}; rebuild with "
                      f"--build-index {colspec}")
         out = idx.fetch(q, values=vals)
@@ -317,8 +321,7 @@ def main(argv=None) -> int:
         if not colspec.isdigit() or not vspec:
             ap.error("--where-eq takes COL:VALUE")
         try:
-            val = float(vspec) if "." in vspec or "e" in vspec.lower() \
-                else int(vspec)
+            val = _parse_number(vspec)
         except ValueError:
             ap.error("--where-eq: VALUE must be a number")
         q = q.where_eq(int(colspec), val)

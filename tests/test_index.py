@@ -181,3 +181,53 @@ def test_corrupt_sidecar_falls_back_silently(table):
     out = q.run()   # seqscan answers correctly
     np.testing.assert_array_equal(np.sort(out["positions"]),
                                   np.flatnonzero(c0 == 42))
+
+
+def test_build_index_over_mesh_matches_local(table):
+    """Index builds ride the distributed sample sort under a mesh; the
+    resulting sidecar answers lookups identically."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    mesh = make_scan_mesh(jax.devices())
+    ipath = build_index(path, schema, 0, mesh=mesh,
+                        index_path=path + ".meshidx")
+    idx = open_index(ipath, table_path=path)
+    local = open_index(build_index(path, schema, 0), table_path=path)
+    np.testing.assert_array_equal(idx.keys, local.keys)
+    for key in (0, 42, 199):
+        np.testing.assert_array_equal(np.sort(idx.lookup([key])),
+                                      np.sort(local.lookup([key])))
+
+
+def test_where_eq_float_and_nonintegral_semantics(tmp_path):
+    """Index and seqscan must AGREE on float-literal equality: 0.1 vs a
+    float32 column matches float32(0.1) on both paths; 7.5 vs an int
+    column matches nothing on both (review finding)."""
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("float32", "int32"))
+    n = schema.tuples_per_page
+    f = np.zeros(n, np.float32)
+    f[5] = np.float32(0.1)
+    i = np.arange(n, dtype=np.int32)
+    path = str(tmp_path / "fe.heap")
+    build_heap_file(path, [f, i], schema)
+    config.set("debug_no_threshold", True)
+
+    seq = Query(path, schema).where_eq(0, 0.1).select().run()
+    assert int(seq["count"]) == 1 and seq["positions"][0] == 5
+    build_index(path, schema, 0)
+    q = Query(path, schema).where_eq(0, 0.1).select()
+    assert q.explain().access_path == "index"
+    idx_out = q.run()
+    assert int(idx_out["count"]) == 1 and idx_out["positions"][0] == 5
+
+    # non-integral literal vs int column: empty on BOTH paths
+    build_index(path, schema, 1)
+    for want_path in ("index",):
+        q2 = Query(path, schema).where_eq(1, 7.5).select()
+        assert int(q2.run()["count"]) == 0
+    assert int(Query(path, schema).where(lambda c: c[1] == 7.5)
+               .select().run()["count"]) == 0
