@@ -118,13 +118,16 @@ class Query:
         self._join: Optional[tuple] = None
         self._select: Optional[tuple] = None
         self._quantiles: Optional[List[float]] = None
-        self._eq: Optional[tuple] = None   # structured equality (col, v)
+        self._eq: Optional[tuple] = None     # structured equality (col, v)
+        self._range: Optional[tuple] = None  # structured range (col, lo, hi)
 
     # -- builders -----------------------------------------------------------
     def where(self, predicate: Callable) -> "Query":
         """Row filter: ``predicate(cols) -> (B, T) bool`` (jnp ops only)."""
         self._pred = predicate
-        self._eq = None   # an opaque predicate supersedes a structured one
+        # an opaque predicate supersedes any structured one
+        self._eq = None
+        self._range = None
         return self
 
     def where_eq(self, col: int, value) -> "Query":
@@ -145,18 +148,88 @@ class Query:
         if not 0 <= col < self.schema.n_cols:
             raise StromError(22, f"where_eq column {col} out of range")
         dt = self.schema.col_dtype(col)
-        arr = np.asarray(value)
-        cast = arr.astype(dt)
-        if dt.kind in "iu" and arr.dtype.kind == "f" \
-                and not np.array_equal(cast.astype(arr.dtype), arr):
-            # int column vs non-integral literal: no row can match —
-            # int != int is identically False (no NaN in this branch)
+        v = self._representable(dt, value)
+        if v is None:
+            # the literal has no exact representative in the column dtype
+            # (non-integral or out-of-range vs int, e.g. 7.5 or 2**40):
+            # SQL says no row matches — on BOTH paths, never a wraparound
             self._pred = lambda cols: cols[col] != cols[col]
             self._eq = (int(col), None)   # index path: empty result
-            return self
-        v = cast[()]                      # np scalar typed as the column
-        self._pred = lambda cols: cols[col] == v
-        self._eq = (int(col), v)
+        else:
+            self._pred = lambda cols: cols[col] == v
+            self._eq = (int(col), v)
+        self._range = None
+        return self
+
+    @staticmethod
+    def _representable(dt: np.dtype, value):
+        """The literal as an exact np scalar of *dt*, or None when no
+        such value exists (non-integral/out-of-range against an int
+        column — astype would silently WRAP, changing which rows match).
+        Float columns always cast (the jnp weak-typing semantics the
+        seqscan applies)."""
+        if dt.kind in "iu":
+            f = float(value)
+            if not np.isfinite(f) or f != int(f):
+                return None
+            i = int(value)
+            info = np.iinfo(dt)
+            if not info.min <= i <= info.max:
+                return None
+            return dt.type(i)
+        return dt.type(float(value))
+
+    def where_range(self, col: int, lo=None, hi=None) -> "Query":
+        """Structured range filter: ``lo <= col <= hi`` (either bound may
+        be None for open-ended).  Planner-visible like :meth:`where_eq`:
+        a fresh sidecar turns a :meth:`select` into an index RANGE scan
+        reading only matching pages; everything else seqscans with the
+        filter."""
+        if not 0 <= col < self.schema.n_cols:
+            raise StromError(22, f"where_range column {col} out of range")
+        if lo is None and hi is None:
+            raise StromError(22, "where_range needs at least one bound")
+        dt = self.schema.col_dtype(col)
+        # normalize bounds so the index searchsorted and the seqscan
+        # predicate agree (and never overflow):
+        #  - float column: bounds cast to the column dtype (the seqscan's
+        #    weak-typing would compare at float32, so the index must too)
+        #  - int column: fractional in-range bounds stay raw (7.5 means
+        #    ">= 8" / "<= 7" on both paths); bounds beyond the dtype's
+        #    range clamp to open / empty instead of wrapping or raising
+        never = False
+        if dt.kind == "f":
+            nlo = None if lo is None else dt.type(float(lo))
+            nhi = None if hi is None else dt.type(float(hi))
+        else:
+            info = np.iinfo(dt)
+            nlo = nhi = None
+            if lo is not None:
+                if float(lo) > info.max:
+                    never = True           # nothing can be >= lo
+                elif float(lo) > info.min:
+                    nlo = dt.type(int(lo)) if float(lo) == int(lo) else lo
+            if hi is not None and not never:
+                if float(hi) < info.min:
+                    never = True           # nothing can be <= hi
+                elif float(hi) < info.max:
+                    nhi = dt.type(int(hi)) if float(hi) == int(hi) else hi
+        if never:
+            # an empty range encodes "never": lo > hi on both paths
+            nlo, nhi = dt.type(1), dt.type(0)
+
+        def pred(cols):
+            m = cols[col] == cols[col] if dt.kind != "f" \
+                else ~(cols[col] != cols[col])   # NaN rows never match
+            if nlo is not None:
+                m = m & (cols[col] >= nlo)
+            if nhi is not None:
+                m = m & (cols[col] <= nhi)
+            return m
+
+        self._pred = pred
+        self._eq = None
+        self._range = (int(col), nlo, nhi)
         return self
 
     def select(self, cols: Optional[Sequence[int]] = None, *,
@@ -373,10 +446,19 @@ class Query:
                            else "single-device lax sort")
         return "xla", f"{self._op} runs on lax.top_k/searchsorted (XLA)"
 
+    def _index_col(self) -> Optional[int]:
+        """The column a structured (eq or range) filter targets."""
+        if self._eq is not None:
+            return self._eq[0]
+        if self._range is not None:
+            return self._range[0]
+        return None
+
     def _index_path_for_eq(self) -> Optional[str]:
-        if self._eq is None or not isinstance(self.source, str):
+        col = self._index_col()
+        if col is None or not isinstance(self.source, str):
             return None
-        return f"{self.source}.idx{self._eq[0]}"
+        return f"{self.source}.idx{col}"
 
     def _index_fresh_for_eq(self) -> bool:
         """Header-only planner probe (no key/position load — EXPLAIN
@@ -412,14 +494,19 @@ class Query:
         cv = cost_vfs_scan(n_pages, n_pages * t)
         if (self._op == "select" and mode == "local"
                 and kernel != "invalid" and self._index_fresh_for_eq()):
-            c, v = self._eq
+            if self._eq is not None:
+                c, v = self._eq
+                cond = f"equality col{c} == {v!r}"
+            else:
+                c, lo, hi = self._range
+                cond = f"range {lo!r} <= col{c} <= {hi!r}"
             return QueryPlan(
                 operator=self._op, access_path="index", kernel=kernel,
                 mode=mode, n_pages=n_pages, cost_direct=cd.total,
                 cost_vfs=cv.total,
-                reason=f"fresh index on col{c}: equality col{c} == {v!r} "
-                       f"resolves positions from the sidecar and reads "
-                       f"only matching pages; " + why)
+                reason=f"fresh index on col{c}: {cond} resolves "
+                       f"positions from the sidecar and reads only "
+                       f"matching pages; " + why)
         if direct:
             reason = ("table above the direct-scan threshold and backing "
                       "eligible; " + why)
@@ -845,10 +932,14 @@ class Query:
         cols, limit, offset = self._select
         if cols is None:
             cols = list(range(self.schema.n_cols))
-        # value None = the normalized literal can match no row (e.g. 7.5
-        # against an int column) — same empty answer the seqscan gives
-        pos = idx.lookup([self._eq[1]]) if self._eq[1] is not None \
-            else np.zeros(0, np.int64)
+        if self._eq is not None:
+            # value None = the normalized literal can match no row (e.g.
+            # 7.5 against an int column) — the seqscan's empty answer
+            pos = idx.lookup([self._eq[1]]) if self._eq[1] is not None \
+                else np.zeros(0, np.int64)
+        else:
+            _c, lo, hi = self._range
+            pos = idx.range(lo, hi)
         end = None if limit is None else offset + limit
         pos = pos[offset:end]
         out = self.fetch(pos, cols=cols, session=session, device=device)

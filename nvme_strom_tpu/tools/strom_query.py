@@ -22,10 +22,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import struct
 import sys
 
 import numpy as np
 
+from ..api import StromError
 from ..scan.heap import HeapSchema
 
 __all__ = ["main", "cli"]
@@ -151,6 +153,9 @@ def main(argv=None) -> int:
                     help="structured equality filter the planner can see: "
                          "with a fresh --build-index sidecar, --select "
                          "runs as an index scan (check with --explain)")
+    ap.add_argument("--where-range", default=None, metavar="COL:LO:HI",
+                    help="structured range filter (empty LO or HI = open "
+                         "bound); index-scan capable like --where-eq")
     ap.add_argument("--group-by", default=None, metavar="EXPR",
                     help='int32 group key, e.g. "c1 % 8"')
     ap.add_argument("--groups", type=int, default=None,
@@ -247,7 +252,8 @@ def main(argv=None) -> int:
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.build_index is not None or args.index_lookup:
         from ..scan.index import build_index, open_index
-        if terminals or args.where or args.where_eq or args.fetch:
+        if terminals or args.where or args.where_eq or args.where_range \
+                or args.fetch:
             ap.error("--build-index/--index-lookup are exclusive index "
                      "operations")
         for flag, given in (("--explain", args.explain),
@@ -275,7 +281,10 @@ def main(argv=None) -> int:
         except FileNotFoundError:
             ap.error(f"no index at {src}.idx{colspec}; build it with "
                      f"--build-index {colspec}")
-        except Exception as e:   # stale/corrupt: rebuild hint, no trace
+        except (StromError, OSError, ValueError, KeyError,
+                struct.error) as e:
+            # the actual stale/corrupt shapes from open_index — a bare
+            # Exception here would send genuine bugs on a rebuild loop
             ap.error(f"{src}.idx{colspec}: {e}; rebuild with "
                      f"--build-index {colspec}")
         out = idx.fetch(q, values=vals)
@@ -290,8 +299,8 @@ def main(argv=None) -> int:
         if terminals:
             ap.error(f"--fetch is a point lookup, exclusive of "
                      f"{terminals[0]}")
-        if args.where or args.where_eq:
-            ap.error("--fetch reads rows by position; --where/--where-eq "
+        if args.where or args.where_eq or args.where_range:
+            ap.error("--fetch reads rows by position; --where filters "
                      "do not apply (filter with a scan terminal instead)")
         for flag, given in (("--explain", args.explain),
                             ("--having", args.having),
@@ -312,10 +321,21 @@ def main(argv=None) -> int:
             for k, v in out.items():
                 print(f"{k}: {np.array2string(np.asarray(v), threshold=32)}")
         return 0
-    if args.where and args.where_eq:
-        ap.error("--where and --where-eq are exclusive")
+    if sum(bool(x) for x in (args.where, args.where_eq,
+                             args.where_range)) > 1:
+        ap.error("--where, --where-eq and --where-range are exclusive")
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
+    elif args.where_range:
+        parts = args.where_range.split(":")
+        if len(parts) != 3 or not parts[0].isdigit():
+            ap.error("--where-range takes COL:LO:HI (empty = open bound)")
+        try:
+            rlo = _parse_number(parts[1]) if parts[1] else None
+            rhi = _parse_number(parts[2]) if parts[2] else None
+        except ValueError:
+            ap.error("--where-range: bounds must be numbers")
+        q = q.where_range(int(parts[0]), rlo, rhi)
     elif args.where_eq:
         colspec, _, vspec = args.where_eq.partition(":")
         if not colspec.isdigit() or not vspec:

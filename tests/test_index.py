@@ -226,8 +226,76 @@ def test_where_eq_float_and_nonintegral_semantics(tmp_path):
 
     # non-integral literal vs int column: empty on BOTH paths
     build_index(path, schema, 1)
-    for want_path in ("index",):
-        q2 = Query(path, schema).where_eq(1, 7.5).select()
-        assert int(q2.run()["count"]) == 0
+    q2 = Query(path, schema).where_eq(1, 7.5).select()
+    assert q2.explain().access_path == "index"
+    assert int(q2.run()["count"]) == 0
     assert int(Query(path, schema).where(lambda c: c[1] == 7.5)
                .select().run()["count"]) == 0
+    # out-of-range int literal: empty, never a wraparound match
+    q3 = Query(path, schema).where_eq(1, 2**32).select()  # wraps to 0
+    assert int(q3.run()["count"]) == 0
+    # out-of-range range bounds clamp to open/empty, no overflow
+    full = Query(path, schema).where_range(1, -2**40, 2**40).select().run()
+    assert int(full["count"]) == schema.tuples_per_page
+    empty = Query(path, schema).where_range(1, 2**40, None).select().run()
+    assert int(empty["count"]) == 0
+
+
+def test_where_range_index_and_seqscan_agree(table):
+    """where_range: index range scan and filtered seqscan return the
+    same rows, including open bounds and a fractional bound against an
+    int column (7.5 selects >= 8 on both paths)."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    cases = [(50, 60), (None, 10), (190, None), (7.5, 60.5)]
+
+    def run_both():
+        outs = []
+        for lo, hi in cases:
+            q = Query(path, schema).where_range(0, lo, hi).select()
+            outs.append((q.explain().access_path,
+                         np.sort(q.run()["positions"])))
+        return outs
+
+    seq = run_both()
+    assert all(p != "index" for p, _ in seq)
+    build_index(path, schema, 0)
+    idx = run_both()
+    assert all(p == "index" for p, _ in idx)
+    for (lo, hi), (_, s), (_, i) in zip(cases, seq, idx):
+        m = np.ones(len(c0), bool)
+        if lo is not None:
+            m &= c0 >= lo
+        if hi is not None:
+            m &= c0 <= hi
+        np.testing.assert_array_equal(s, np.flatnonzero(m)), (lo, hi)
+        np.testing.assert_array_equal(i, np.flatnonzero(m)), (lo, hi)
+    # a non-select terminal still seqscans with the range filter
+    agg = Query(path, schema).where_range(0, 50, 60).aggregate(
+        cols=[1]).run()
+    m = (c0 >= 50) & (c0 <= 60)
+    assert int(agg["count"]) == int(m.sum())
+    with pytest.raises(StromError):
+        Query(path, schema).where_range(0)   # no bounds
+
+
+def test_where_range_float_boundary_agrees_across_paths(tmp_path):
+    """Float bounds normalize to the column dtype: 0.1 against float32
+    keys includes float32(0.1) on the index AND the seqscan (review
+    finding: raw float64 bounds excluded the boundary row on the index
+    only)."""
+    schema = HeapSchema(n_cols=1, visibility=False, dtypes=("float32",))
+    n = schema.tuples_per_page
+    f = np.linspace(-1, 1, n).astype(np.float32)
+    f[7] = np.float32(0.1)
+    path = str(tmp_path / "fb.heap")
+    build_heap_file(path, [f], schema)
+    config.set("debug_no_threshold", True)
+    q = Query(path, schema).where_range(0, None, 0.1)
+    seq = np.sort(q.select().run()["positions"])
+    build_index(path, schema, 0)
+    q2 = Query(path, schema).where_range(0, None, 0.1).select()
+    assert q2.explain().access_path == "index"
+    idx = np.sort(q2.run()["positions"])
+    np.testing.assert_array_equal(seq, idx)
+    assert 7 in idx   # the boundary row itself is included on both

@@ -417,3 +417,30 @@ def test_strom_query_cli_where_eq_index_plan(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
                "--where", "c0 > 1", "--where-eq", "0:9")
     assert out.returncode != 0 and "exclusive" in out.stderr
+
+
+def test_strom_query_cli_where_range(tmp_path):
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=1, visibility=False)
+    n = schema.tuples_per_page
+    c0 = np.arange(n, dtype=np.int32)
+    path = str(tmp_path / "r.heap")
+    build_heap_file(path, [c0], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
+               "--where-range", "0:5:9", "--select", "all", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert sorted(res["positions"]) == list(range(5, 10))
+    # open upper bound
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
+               "--where-range", f"0:{n - 3}:", "--select", "all", "--json")
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert sorted(res["positions"]) == list(range(n - 3, n))
+    # exclusive with --where
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
+               "--where", "c0 > 1", "--where-range", "0:1:2")
+    assert out.returncode != 0 and "exclusive" in out.stderr
