@@ -410,6 +410,13 @@ class Query:
             except ValueError as e:
                 # EXPLAIN must show the problem, not raise; run() refuses
                 return "invalid", str(e)
+        if self._op == "aggregate" and self._agg_cols is not None:
+            bad = [c for c in self._agg_cols
+                   if not 0 <= c < self.schema.n_cols]
+            if bad:   # both access paths must refuse identically
+                return "invalid", (f"aggregate column {bad[0]} out of "
+                                   f"range (schema has "
+                                   f"{self.schema.n_cols})")
         if self._op == "select":
             bad = [c for c in (self._select[0] or [])
                    if not 0 <= c < self.schema.n_cols]
@@ -492,7 +499,7 @@ class Query:
         kernel, why = self._kernel_choice(mode)
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
-        if (self._op == "select" and mode == "local"
+        if (self._op in ("select", "aggregate") and mode == "local"
                 and kernel != "invalid" and self._index_fresh_for_eq()):
             if self._eq is not None:
                 c, v = self._eq
@@ -630,19 +637,22 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
-        if self._op == "select":
-            if plan.access_path == "index":
-                idx = self._index_for_eq()
-                if idx is not None:
+        if self._op in ("select", "aggregate") \
+                and plan.access_path == "index":
+            idx = self._index_for_eq()
+            if idx is not None:
+                if self._op == "select":
                     return self._run_select_indexed(idx, device, session)
-                # index raced away since explain: recompute the SCAN
-                # path choice (falling into the vfs branch unconditionally
-                # would demote large tables off the direct DMA path)
-                path, size = self._source_facts()
-                plan = dataclasses.replace(
-                    plan, access_path="direct"
-                    if path is not None and should_use_direct_scan(
-                        path, table_size=size) else "vfs")
+                return self._run_aggregate_indexed(idx, device, session)
+            # index raced away since explain: recompute the SCAN path
+            # choice (falling into the vfs branch unconditionally would
+            # demote large tables off the direct DMA path)
+            path, size = self._source_facts()
+            plan = dataclasses.replace(
+                plan, access_path="direct"
+                if path is not None and should_use_direct_scan(
+                    path, table_size=size) else "vfs")
+        if self._op == "select":
             return self._run_select(plan, device, session)
         if self._op == "join" and self._join[3]:   # materialize=True
             return self._run_join_rows(plan, device, session)
@@ -932,14 +942,7 @@ class Query:
         cols, limit, offset = self._select
         if cols is None:
             cols = list(range(self.schema.n_cols))
-        if self._eq is not None:
-            # value None = the normalized literal can match no row (e.g.
-            # 7.5 against an int column) — the seqscan's empty answer
-            pos = idx.lookup([self._eq[1]]) if self._eq[1] is not None \
-                else np.zeros(0, np.int64)
-        else:
-            _c, lo, hi = self._range
-            pos = idx.range(lo, hi)
+        pos = self._index_positions(idx)
         end = None if limit is None else offset + limit
         pos = pos[offset:end]
         out = self.fetch(pos, cols=cols, session=session, device=device)
@@ -950,6 +953,41 @@ class Query:
         res["positions"] = pos[keep]
         res["count"] = np.int64(len(res["positions"]))
         return res
+
+    def _index_positions(self, idx) -> np.ndarray:
+        """Positions matching the structured filter via the sidecar."""
+        if self._eq is not None:
+            # value None = the normalized literal can match no row (e.g.
+            # 7.5 against an int column) — the seqscan's empty answer
+            if self._eq[1] is None:
+                return np.zeros(0, np.int64)
+            return idx.lookup([self._eq[1]])
+        _c, lo, hi = self._range
+        return idx.range(lo, hi)
+
+    def _run_aggregate_indexed(self, idx, device, session) -> dict:
+        """COUNT/SUM over index-resolved rows — the most common index
+        query shape: only matching pages are read, and the sums
+        reproduce the kernel path's accumulation dtypes exactly (column
+        dtype for floats; 4-byte int accumulate without x64, 8-byte
+        with — the same wrap semantics the MXU contraction has)."""
+        import jax
+
+        agg_cols = list(self._agg_cols) if self._agg_cols is not None \
+            else list(range(self.schema.n_cols))
+        pos = self._index_positions(idx)
+        out = self.fetch(pos, cols=agg_cols, session=session,
+                         device=device)
+        keep = out["valid"]
+        x64 = jax.config.jax_enable_x64
+        sums = []
+        for c in agg_cols:
+            v = out[f"col{c}"][keep]
+            dt = self.schema.col_dtype(c)
+            acc = dt if dt.kind == "f" or not x64 \
+                else np.dtype(dt.kind + "8")
+            sums.append(np.sum(v, dtype=acc))
+        return {"count": np.int32(int(keep.sum())), "sums": sums}
 
     def _run_select(self, plan: QueryPlan, device, session) -> dict:
         """SELECT: stream the scan and hand the matching rows back —

@@ -140,12 +140,13 @@ def test_where_eq_planner_picks_index_scan(table):
     assert int(lim["count"]) == 3
     assert (c0[lim["positions"]] == 42).all()
 
-    # a non-select terminal keeps the scan path but uses the equality
-    agg = Query(path, schema).where_eq(0, 42).aggregate(cols=[1]).run()
-    assert Query(path, schema).where_eq(0, 42).aggregate(
-        cols=[1]).explain().access_path == "direct"
-    assert int(agg["count"]) == int((c0 == 42).sum())
-    assert int(agg["sums"][0]) == int(c1[c0 == 42].sum())
+    # aggregate also rides the index (see its dedicated test); terminals
+    # without an index route (group_by) keep the scan path + equality
+    gb = Query(path, schema).where_eq(0, 42) \
+        .group_by(lambda c: c[1] % 2, 2, agg_cols=[1])
+    assert gb.explain().access_path == "direct"
+    gout = gb.run()
+    assert int(np.asarray(gout["count"]).sum()) == int((c0 == 42).sum())
 
     # stale index: silent seqscan fallback, same answer
     build_heap_file(path, [c0, c1 + 1], schema)   # rewrite table
@@ -299,3 +300,39 @@ def test_where_range_float_boundary_agrees_across_paths(tmp_path):
     idx = np.sort(q2.run()["positions"])
     np.testing.assert_array_equal(seq, idx)
     assert 7 in idx   # the boundary row itself is included on both
+
+
+def test_aggregate_rides_index_and_matches_seqscan(table):
+    """COUNT/SUM with a structured filter plan as index scans; answers
+    (incl. sum dtypes/wrap semantics) are identical to the kernel path,
+    and I/O is proportional to matches."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    q = Query(path, schema).where_eq(0, 42).aggregate(cols=[1])
+    assert q.explain().access_path == "direct"
+    seq = q.run()
+    build_index(path, schema, 0)
+    q2 = Query(path, schema).where_eq(0, 42).aggregate(cols=[1])
+    assert q2.explain().access_path == "index"
+    fd = os.open(path, os.O_RDONLY)
+    os.fsync(fd)
+    os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    os.close(fd)
+    with Session() as sess:
+        before = sess.stat_info().counters["total_dma_length"]
+        idx_out = q2.run(session=sess)
+        after = sess.stat_info().counters["total_dma_length"]
+    assert int(idx_out["count"]) == int(seq["count"])
+    assert int(idx_out["sums"][0]) == int(seq["sums"][0])
+    assert type(idx_out["sums"][0]) is type(np.sum(c1[:1], dtype=np.int32))
+    t = schema.tuples_per_page
+    n_pages = len(np.unique(np.flatnonzero(c0 == 42) // t))
+    assert after - before <= n_pages * 8192
+    # range filter aggregates through the index too
+    r = Query(path, schema).where_range(0, 10, 20).aggregate(cols=[0, 1])
+    assert r.explain().access_path == "index"
+    rout = r.run()
+    m = (c0 >= 10) & (c0 <= 20)
+    assert int(rout["count"]) == int(m.sum())
+    assert int(rout["sums"][0]) == int(c0[m].sum())
+    assert int(rout["sums"][1]) == int(c1[m].sum())

@@ -905,3 +905,15 @@ def test_fetch_projection_bounds_and_empty(heap):
         Query(path, schema).fetch([10**9])
     with pytest.raises(StromError, match="out of range"):
         Query(path, schema).fetch([0], cols=[9])
+
+
+def test_aggregate_bad_columns_invalid_plan_both_paths(heap):
+    """aggregate(cols=...) validation happens at plan time, so the
+    refusal is identical whether or not an index exists (review
+    finding: the seqscan silently returned the LAST column for -1)."""
+    path, schema, *_ = heap
+    for bad in ([-1], [9]):
+        plan = Query(path, schema).aggregate(cols=bad).explain()
+        assert plan.kernel == "invalid" and "out of range" in plan.reason
+        with pytest.raises(StromError, match="out of range"):
+            Query(path, schema).aggregate(cols=bad).run()
