@@ -25,9 +25,51 @@ from ..scan.heap import HeapSchema, PAGE_SIZE
 from .filter_xla import DEFAULT_SCHEMA, decode_pages, \
     global_row_positions
 
-__all__ = ["make_topk_fn", "combine_topk", "scan_topk_step"]
+__all__ = ["make_topk_fn", "combine_topk", "scan_topk_step",
+           "worst_sentinel", "topk_key", "rank_topk"]
 
 _WORDS = PAGE_SIZE // 4
+
+
+def worst_sentinel(dt: np.dtype, largest: bool) -> np.ndarray:
+    """The pad value that can never beat a real candidate."""
+    if dt.kind == "f":
+        return np.array(-np.inf if largest else np.inf, dt)
+    info = np.iinfo(dt)
+    return np.array(info.min if largest else info.max, dt)
+
+
+def topk_key(v, dt: np.dtype, largest: bool):
+    """Order-reversing key for smallest-k that cannot overflow: unary
+    minus wraps for uint32 and INT32_MIN, bitwise NOT (~v = -v-1 /
+    MAX-v) reverses order safely for both int kinds."""
+    if largest:
+        return v
+    return -v if dt.kind == "f" else ~v
+
+
+def rank_topk(flat_v, flat_p, k: int, dt: np.dtype, largest: bool):
+    """The kernel's exact select/pad/squash on flat candidate arrays —
+    ONE implementation shared by the page kernel and the index access
+    path, so the two cannot drift on tie-breaking (lax.top_k keeps the
+    first occurrence), NaN ranking (maximal), or the sentinel squash."""
+    worst = worst_sentinel(dt, largest)
+    kk = min(k, int(flat_v.size))
+    if kk:
+        _, idx = jax.lax.top_k(topk_key(flat_v, dt, largest), kk)
+        vals = flat_v[idx]
+        positions = flat_p[idx]
+    else:
+        vals = jnp.zeros((0,), dt)
+        positions = jnp.zeros((0,), flat_p.dtype)
+    if kk < k:  # fewer candidates than k: pad to the contract
+        vals = jnp.concatenate([vals, jnp.full((k - kk,), worst, dt)])
+        positions = jnp.concatenate(
+            [positions, jnp.full((k - kk,), -1, positions.dtype)])
+    # slots filled only by sentinels read position -1 (NB a real row
+    # whose value equals the sentinel is indistinguishable from one)
+    positions = jnp.where(vals == worst, -1, positions)
+    return vals, positions
 
 
 def make_topk_fn(schema: HeapSchema, col: int, k: int, *,
@@ -41,19 +83,10 @@ def make_topk_fn(schema: HeapSchema, col: int, k: int, *,
     sentinel and position -1.
     """
     dt = schema.col_dtype(col)
-    if dt.kind == "f":
-        worst = np.array(-np.inf if largest else np.inf, dt)
-    else:
-        info = np.iinfo(dt)
-        worst = np.array(info.min if largest else info.max, dt)
+    worst = worst_sentinel(dt, largest)
 
     def key_of(v):
-        # order-reversing key for smallest-k that cannot overflow: unary
-        # minus wraps for uint32 and INT32_MIN, bitwise NOT (~v = -v-1 /
-        # MAX-v) reverses order safely for both int kinds
-        if largest:
-            return v
-        return -v if dt.kind == "f" else ~v
+        return topk_key(v, dt, largest)
 
     @jax.jit
     def run(pages_u8, *params):
@@ -65,17 +98,7 @@ def make_topk_fn(schema: HeapSchema, col: int, k: int, *,
         pos = global_row_positions(pages_u8, schema)
         flat_v = jnp.where(sel, v, worst).reshape(-1)
         flat_p = jnp.where(sel, pos, -1).reshape(-1)
-        kk = min(k, flat_v.size)
-        _, idx = jax.lax.top_k(key_of(flat_v), kk)
-        vals = flat_v[idx]
-        positions = flat_p[idx]
-        if kk < k:  # tiny batch: pad to the k contract
-            vals = jnp.concatenate([vals, jnp.full((k - kk,), worst, dt)])
-            positions = jnp.concatenate(
-                [positions, jnp.full((k - kk,), -1, positions.dtype)])
-        # slots filled only by sentinels read position -1 (NB a real row
-        # whose value equals the sentinel is indistinguishable from one)
-        positions = jnp.where(vals == worst, -1, positions)
+        vals, positions = rank_topk(flat_v, flat_p, k, dt, largest)
         return {"values": vals, "positions": positions}
 
     run.k = k

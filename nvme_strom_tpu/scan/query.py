@@ -417,6 +417,10 @@ class Query:
                 return "invalid", (f"aggregate column {bad[0]} out of "
                                    f"range (schema has "
                                    f"{self.schema.n_cols})")
+        if self._op == "top_k" \
+                and not 0 <= self._topk[0] < self.schema.n_cols:
+            return "invalid", (f"top_k column {self._topk[0]} out of "
+                               f"range (schema has {self.schema.n_cols})")
         if self._op == "select":
             bad = [c for c in (self._select[0] or [])
                    if not 0 <= c < self.schema.n_cols]
@@ -499,7 +503,8 @@ class Query:
         kernel, why = self._kernel_choice(mode)
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
-        if (self._op in ("select", "aggregate") and mode == "local"
+        if (self._op in ("select", "aggregate", "top_k")
+                and mode == "local"
                 and kernel != "invalid" and self._index_fresh_for_eq()):
             if self._eq is not None:
                 c, v = self._eq
@@ -637,12 +642,14 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
-        if self._op in ("select", "aggregate") \
+        if self._op in ("select", "aggregate", "top_k") \
                 and plan.access_path == "index":
             idx = self._index_for_eq()
             if idx is not None:
                 if self._op == "select":
                     return self._run_select_indexed(idx, device, session)
+                if self._op == "top_k":
+                    return self._run_topk_indexed(idx, device, session)
                 return self._run_aggregate_indexed(idx, device, session)
             # index raced away since explain: recompute the SCAN path
             # choice (falling into the vfs branch unconditionally would
@@ -988,6 +995,28 @@ class Query:
                 else np.dtype(dt.kind + "8")
             sums.append(np.sum(v, dtype=acc))
         return {"count": np.int32(int(keep.sum())), "sums": sums}
+
+    def _run_topk_indexed(self, idx, device, session) -> dict:
+        """top_k over index-resolved rows: fetch only matching pages,
+        then rank through the SAME kernel ranking (``ops.topk.rank_topk``)
+        the page path uses — one implementation, so the two access paths
+        cannot drift on tie-breaking, NaN ranking, or the sentinel
+        squash.  Candidates are pre-sorted by ascending position so
+        first-occurrence tie-breaking means lowest position, exactly the
+        scan-order contract."""
+        import jax.numpy as jnp
+
+        from ..ops.topk import rank_topk
+        col, k, largest = self._topk
+        dt = self.schema.col_dtype(col)
+        pos = np.sort(self._index_positions(idx))
+        out = self.fetch(pos, cols=[col], session=session, device=device)
+        keep = np.asarray(out["valid"]).astype(bool)
+        vals = out[f"col{col}"][keep]
+        pos = pos[keep].astype(self._pos_dtype())
+        v, p = rank_topk(jnp.asarray(vals), jnp.asarray(pos), k, dt,
+                         largest)
+        return {"values": np.asarray(v), "positions": np.asarray(p)}
 
     def _run_select(self, plan: QueryPlan, device, session) -> dict:
         """SELECT: stream the scan and hand the matching rows back —

@@ -336,3 +336,92 @@ def test_aggregate_rides_index_and_matches_seqscan(table):
     assert int(rout["count"]) == int(m.sum())
     assert int(rout["sums"][0]) == int(c0[m].sum())
     assert int(rout["sums"][1]) == int(c1[m].sum())
+
+
+def test_topk_rides_index_and_matches_seqscan(table):
+    """top_k with a structured filter plans as an index scan and agrees
+    with the kernel path, including the padding contract when fewer than
+    k rows match."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    q = Query(path, schema).where_range(0, 40, 44).top_k(1, 8)
+    seq = q.run()
+    build_index(path, schema, 0)
+    q2 = Query(path, schema).where_range(0, 40, 44).top_k(1, 8)
+    assert q2.explain().access_path == "index"
+    idx_out = q2.run()
+    np.testing.assert_array_equal(np.sort(idx_out["values"]),
+                                  np.sort(seq["values"]))
+    m = (c0 >= 40) & (c0 <= 44)
+    want = np.sort(c1[m])[::-1][:8]
+    np.testing.assert_array_equal(np.sort(idx_out["values"])[::-1], want)
+    # fewer matches than k: worst/-1 padding, same as the kernel path
+    few = Query(path, schema).where_eq(0, 42).top_k(1, 10**4)
+    assert few.explain().access_path == "index"
+    fout = few.run()
+    n = int((c0 == 42).sum())
+    assert (fout["positions"][n:] == -1).all()
+    assert (fout["values"][n:] == np.iinfo(np.int32).min).all()
+    # smallest-k
+    s = Query(path, schema).where_range(0, 40, 44) \
+        .top_k(1, 5, largest=False).run()
+    np.testing.assert_array_equal(np.sort(s["values"]), np.sort(c1[m])[:5])
+
+
+def test_topk_index_tie_break_and_sentinel_match_kernel(tmp_path):
+    """Ties at the k boundary pick the LOWEST positions on both access
+    paths (shared rank_topk), and a real row holding the sentinel value
+    squashes to position -1 on both (review findings)."""
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page
+    c0 = np.full(n, 3, np.int32)               # filter key
+    c1 = np.full(n, 77, np.int32)              # all tied
+    c1[10] = np.iinfo(np.int32).min            # a real sentinel value
+    path = str(tmp_path / "tie.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", True)
+    q = Query(path, schema).where_eq(0, 3).top_k(1, 5)
+    seq = q.run()
+    build_index(path, schema, 0)
+    q2 = Query(path, schema).where_eq(0, 3).top_k(1, 5)
+    assert q2.explain().access_path == "index"
+    idx_out = q2.run()
+    np.testing.assert_array_equal(idx_out["positions"], seq["positions"])
+    np.testing.assert_array_equal(idx_out["values"], seq["values"])
+    # ties resolve to the lowest positions on both
+    np.testing.assert_array_equal(seq["positions"], [0, 1, 2, 3, 4])
+    # sentinel-valued real row squashes to -1 on both paths
+    few = Query(path, schema).where_eq(0, 3).top_k(1, n + 5,
+                                                   largest=True)
+    sentinels = few.run()["positions"] == -1
+    idx_sent = Query(path, schema).where_eq(0, 3) \
+        .top_k(1, n + 5, largest=True).run()["positions"] == -1
+    np.testing.assert_array_equal(sentinels, idx_sent)
+    assert int(sentinels.sum()) == 5 + 1   # padding + the INT32_MIN row
+
+
+def test_nan_filter_keys_excluded_on_both_paths(tmp_path):
+    """NaN rows of the FILTER column never match an open-ended range on
+    either path: the seqscan predicate masks them and the index drops
+    NaN keys at build (review scenario)."""
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("float32", "int32"))
+    n = schema.tuples_per_page
+    f = np.linspace(0, 100, n).astype(np.float32)
+    f[3] = np.nan
+    f[17] = np.nan
+    i = np.arange(n, dtype=np.int32)
+    path = str(tmp_path / "nf.heap")
+    build_heap_file(path, [f, i], schema)
+    config.set("debug_no_threshold", True)
+    q = Query(path, schema).where_range(0, 10, None)
+    seq = q.aggregate(cols=[1]).run()
+    assert np.isfinite(seq["sums"][0])
+    build_index(path, schema, 0)
+    q2 = Query(path, schema).where_range(0, 10, None).aggregate(cols=[1])
+    assert q2.explain().access_path == "index"
+    idx_out = q2.run()
+    assert int(idx_out["count"]) == int(seq["count"])
+    assert int(idx_out["sums"][0]) == int(seq["sums"][0])
+    m = np.nan_to_num(f, nan=-1) >= 10
+    assert int(seq["count"]) == int(m.sum())

@@ -917,3 +917,11 @@ def test_aggregate_bad_columns_invalid_plan_both_paths(heap):
         assert plan.kernel == "invalid" and "out of range" in plan.reason
         with pytest.raises(StromError, match="out of range"):
             Query(path, schema).aggregate(cols=bad).run()
+
+
+def test_topk_bad_column_invalid_plan(heap):
+    path, schema, *_ = heap
+    plan = Query(path, schema).top_k(9, 4).explain()
+    assert plan.kernel == "invalid" and "out of range" in plan.reason
+    with pytest.raises(StromError, match="out of range"):
+        Query(path, schema).top_k(9, 4).run()
