@@ -421,6 +421,12 @@ class Query:
                 and not 0 <= self._topk[0] < self.schema.n_cols:
             return "invalid", (f"top_k column {self._topk[0]} out of "
                                f"range (schema has {self.schema.n_cols})")
+        if self._op in ("order_by", "quantiles", "count_distinct"):
+            for c in self._order[0]:
+                try:
+                    self._check_sortable_col(c, self._op)
+                except StromError as e:
+                    return "invalid", str(e)
         if self._op == "select":
             bad = [c for c in (self._select[0] or [])
                    if not 0 <= c < self.schema.n_cols]
@@ -503,7 +509,8 @@ class Query:
         kernel, why = self._kernel_choice(mode)
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
-        if (self._op in ("select", "aggregate", "top_k")
+        if (self._op in ("select", "aggregate", "top_k", "quantiles",
+                         "count_distinct")
                 and mode == "local"
                 and kernel != "invalid" and self._index_fresh_for_eq()):
             if self._eq is not None:
@@ -642,15 +649,19 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
-        if self._op in ("select", "aggregate", "top_k") \
-                and plan.access_path == "index":
+        if plan.access_path == "index":
             idx = self._index_for_eq()
-            if idx is not None:
-                if self._op == "select":
-                    return self._run_select_indexed(idx, device, session)
-                if self._op == "top_k":
-                    return self._run_topk_indexed(idx, device, session)
-                return self._run_aggregate_indexed(idx, device, session)
+            # explicit per-op dispatch: an op added to the planner's
+            # index-capable list but not here must fall to the (always
+            # correct) scan path, never to another op's result shape
+            runner = {"select": self._run_select_indexed,
+                      "top_k": self._run_topk_indexed,
+                      "quantiles": self._run_column_indexed,
+                      "count_distinct": self._run_column_indexed,
+                      "aggregate": self._run_aggregate_indexed,
+                      }.get(self._op)
+            if idx is not None and runner is not None:
+                return runner(idx, device, session)
             # index raced away since explain: recompute the SCAN path
             # choice (falling into the vfs branch unconditionally would
             # demote large tables off the direct DMA path)
@@ -972,6 +983,32 @@ class Query:
         _c, lo, hi = self._range
         return idx.range(lo, hi)
 
+    @staticmethod
+    def _nearest_ranks(qs, n: int):
+        """Nearest-rank indices into a sorted order of *n* elements."""
+        return [min(n - 1, max(0, int(np.ceil(q * n)) - 1)) for q in qs]
+
+    def _run_column_indexed(self, idx, device, session) -> dict:
+        """quantiles / count_distinct over index-resolved rows (p99
+        WHERE key = X): only matching pages are read; the math is the
+        local path's exactly."""
+        col = self._order[0][0]
+        self._check_sortable_col(col, self._op)
+        pos = self._index_positions(idx)
+        out = self.fetch(pos, cols=[col], session=session, device=device)
+        vals = out[f"col{col}"][np.asarray(out["valid"]).astype(bool)]
+        if self._op == "count_distinct":
+            return {"distinct": np.int32(len(
+                np.unique(vals, equal_nan=False)))}
+        qs = self._quantiles
+        n = len(vals)
+        if n == 0:
+            return {"quantiles": np.full(len(qs), np.nan, np.float64),
+                    "n": np.int64(0)}
+        svals = np.sort(vals)
+        return {"quantiles": svals[self._nearest_ranks(qs, n)],
+                "n": np.int64(n)}
+
     def _run_aggregate_indexed(self, idx, device, session) -> dict:
         """COUNT/SUM over index-resolved rows — the most common index
         query shape: only matching pages are read, and the sums
@@ -1088,7 +1125,7 @@ class Query:
             return {"quantiles": np.full(len(qs), np.nan, np.float64),
                     "n": np.int64(0)}
         # nearest-rank: index = ceil(q*n) - 1, clamped into the order
-        ranks = [min(n - 1, max(0, int(np.ceil(q * n)) - 1)) for q in qs]
+        ranks = self._nearest_ranks(qs, n)
         if mesh is None:
             svals = np.sort(vals)
             return {"quantiles": svals[ranks], "n": np.int64(n)}

@@ -425,3 +425,36 @@ def test_nan_filter_keys_excluded_on_both_paths(tmp_path):
     assert int(idx_out["sums"][0]) == int(seq["sums"][0])
     m = np.nan_to_num(f, nan=-1) >= 10
     assert int(seq["count"]) == int(m.sum())
+
+
+def test_quantiles_and_distinct_ride_index(table):
+    """quantiles / count_distinct with a structured filter plan as index
+    scans and agree with the seqscan path (p99 WHERE key = X)."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    qs = [0.0, 0.5, 0.99]
+    qq = Query(path, schema).where_range(0, 40, 60).quantiles(1, qs)
+    seq_q = qq.run()
+    dd = Query(path, schema).where_range(0, 40, 60).count_distinct(1)
+    seq_d = dd.run()
+    build_index(path, schema, 0)
+    q2 = Query(path, schema).where_range(0, 40, 60).quantiles(1, qs)
+    assert q2.explain().access_path == "index"
+    idx_q = q2.run()
+    np.testing.assert_array_equal(idx_q["quantiles"], seq_q["quantiles"])
+    assert int(idx_q["n"]) == int(seq_q["n"])
+    d2 = Query(path, schema).where_range(0, 40, 60).count_distinct(1)
+    assert d2.explain().access_path == "index"
+    assert int(d2.run()["distinct"]) == int(seq_d["distinct"])
+    # oracle
+    m = (c0 >= 40) & (c0 <= 60)
+    assert int(seq_d["distinct"]) == len(np.unique(c1[m]))
+    sv = np.sort(c1[m])
+    want = sv[[min(len(sv) - 1, max(0, int(np.ceil(q * len(sv))) - 1))
+               for q in qs]]
+    np.testing.assert_array_equal(idx_q["quantiles"], want)
+    # empty selection via index
+    e = Query(path, schema).where_eq(0, 10**6 % 1000 + 500) \
+        .quantiles(1, [0.5])
+    eout = e.run()
+    assert int(eout["n"]) == 0 and np.isnan(eout["quantiles"]).all()

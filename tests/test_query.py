@@ -925,3 +925,16 @@ def test_topk_bad_column_invalid_plan(heap):
     assert plan.kernel == "invalid" and "out of range" in plan.reason
     with pytest.raises(StromError, match="out of range"):
         Query(path, schema).top_k(9, 4).run()
+
+
+def test_sort_family_bad_columns_invalid_plan(heap):
+    """order_by/quantiles/count_distinct column problems surface in
+    EXPLAIN as invalid plans, not only at run time (review finding)."""
+    path, schema, *_ = heap
+    for q in (Query(path, schema).order_by(9),
+              Query(path, schema).quantiles(9, [0.5]),
+              Query(path, schema).count_distinct(9)):
+        plan = q.explain()
+        assert plan.kernel == "invalid" and "out of range" in plan.reason
+        with pytest.raises(StromError):
+            q.run()
