@@ -938,3 +938,36 @@ def test_sort_family_bad_columns_invalid_plan(heap):
         assert plan.kernel == "invalid" and "out of range" in plan.reason
         with pytest.raises(StromError):
             q.run()
+
+
+def test_query_results_identical_across_io_backends(heap):
+    """The io backend (io_uring / threadpool / pure python) is invisible
+    to query results — same rows, same aggregates (the engine-level
+    differential test lifted to the query surface)."""
+    import os
+
+    from nvme_strom_tpu import Session
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    config.set("chunk_size", "64k")
+    config.set("buffer_size", "1m")
+    outs = {}
+    for backend in ("io_uring", "threadpool", "python"):
+        fd = os.open(path, os.O_RDONLY)
+        os.fsync(fd)
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        os.close(fd)
+        try:
+            with Session(io_backend=backend) as sess:
+                outs[backend] = Query(path, schema) \
+                    .where(lambda c: c[0] > 0).select([0]) \
+                    .run(session=sess)
+        except StromError:
+            continue   # backend unavailable on this host
+    assert "python" in outs and len(outs) >= 2
+    base = outs["python"]
+    for name, out in outs.items():
+        np.testing.assert_array_equal(
+            np.sort(out["positions"]), np.sort(base["positions"]), name)
+        np.testing.assert_array_equal(
+            np.sort(out["col0"]), np.sort(base["col0"]), name)
