@@ -44,6 +44,27 @@ _I32_MIN = np.int32(-(1 << 31))
 _I32_MAX = np.int32((1 << 31) - 1)
 
 
+def acc_dtypes(agg_dt: np.dtype):
+    """THE accumulation convention, in one place: ``(sum accumulator
+    dtype, sumsq dtype, min-sentinel hi, max-sentinel lo)``.  Float sums
+    stay at the column dtype; int sums widen to 8 bytes only under x64
+    (the MXU contraction's preferred_element_type); sumsqs are floating
+    (f64 under x64).  Both the page kernels and the index-path host
+    emulations (`scan/query._run_*_indexed`) derive from this, so the
+    access paths cannot drift."""
+    import jax
+    x64 = jax.config.jax_enable_x64
+    is_f = agg_dt.kind == "f"
+    acc = agg_dt if is_f or not x64 else np.dtype(agg_dt.kind + "8")
+    sq = np.dtype(np.float64 if x64 else np.float32)
+    if is_f:
+        lo, hi = agg_dt.type(-np.inf), agg_dt.type(np.inf)
+    else:
+        info = np.iinfo(agg_dt)
+        lo, hi = agg_dt.type(info.min), agg_dt.type(info.max)
+    return acc, sq, lo, hi
+
+
 def _check_agg_cols(schema: HeapSchema, agg_cols):
     """Validate + resolve aggregation columns: one shared dtype, int32 or
     float32.  Returns (indices, dtype)."""

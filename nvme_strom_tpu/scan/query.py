@@ -510,7 +510,7 @@ class Query:
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
         if (self._op in ("select", "aggregate", "top_k", "quantiles",
-                         "count_distinct")
+                         "count_distinct", "group_by")
                 and mode == "local"
                 and kernel != "invalid" and self._index_fresh_for_eq()):
             if self._eq is not None:
@@ -659,6 +659,7 @@ class Query:
                       "quantiles": self._run_column_indexed,
                       "count_distinct": self._run_column_indexed,
                       "aggregate": self._run_aggregate_indexed,
+                      "group_by": self._run_groupby_indexed,
                       }.get(self._op)
             if idx is not None and runner is not None:
                 return runner(idx, device, session)
@@ -1009,27 +1010,59 @@ class Query:
         return {"quantiles": svals[self._nearest_ranks(qs, n)],
                 "n": np.int64(n)}
 
+    def _run_groupby_indexed(self, idx, device, session) -> dict:
+        """GROUP BY over index-resolved rows (GROUP BY x WHERE key = v):
+        only matching pages are read; per-group accumulation reproduces
+        the kernel contract exactly — count int32, sums in the kernel's
+        accumulator dtype (exact via ufunc.at, never float bincount),
+        sumsqs floating, min/max sentinels for empty groups — and the
+        shared :meth:`_finalize` adds avgs/vars/HAVING on top."""
+        from ..ops.groupby import _check_agg_cols, acc_dtypes
+        key_fn, g, agg, _having = self._group
+        cols_idx, agg_dt = _check_agg_cols(self.schema, agg)
+        pos = self._index_positions(idx)
+        # key_fn is an opaque lambda over ALL columns: fetch every column
+        out = self.fetch(pos, session=session, device=device)
+        keep = np.asarray(out["valid"]).astype(bool)
+        cols = [np.asarray(out[f"col{c}"])[keep].reshape(1, -1)
+                for c in range(self.schema.n_cols)]
+        keys = np.asarray(key_fn(cols)).reshape(-1).astype(np.int64)
+        sel = (keys >= 0) & (keys < g)
+        keys = keys[sel]
+        acc_t, sq_t, lo, hi = acc_dtypes(agg_dt)
+        count = np.bincount(keys, minlength=g).astype(np.int32)
+        V = len(cols_idx)
+        sums = np.zeros((V, g), acc_t)
+        sumsqs = np.zeros((V, g), sq_t)
+        mins = np.full((V, g), hi, agg_dt)
+        maxs = np.full((V, g), lo, agg_dt)
+        for vi, ci in enumerate(cols_idx):
+            v = cols[ci].reshape(-1)[sel]
+            np.add.at(sums[vi], keys, v.astype(acc_t))
+            np.add.at(sumsqs[vi], keys, v.astype(sq_t) * v.astype(sq_t))
+            np.minimum.at(mins[vi], keys, v)
+            np.maximum.at(maxs[vi], keys, v)
+        return self._finalize({"count": count, "sums": sums,
+                               "sumsqs": sumsqs, "mins": mins,
+                               "maxs": maxs})
+
     def _run_aggregate_indexed(self, idx, device, session) -> dict:
         """COUNT/SUM over index-resolved rows — the most common index
         query shape: only matching pages are read, and the sums
         reproduce the kernel path's accumulation dtypes exactly (column
         dtype for floats; 4-byte int accumulate without x64, 8-byte
         with — the same wrap semantics the MXU contraction has)."""
-        import jax
-
+        from ..ops.groupby import acc_dtypes
         agg_cols = list(self._agg_cols) if self._agg_cols is not None \
             else list(range(self.schema.n_cols))
         pos = self._index_positions(idx)
         out = self.fetch(pos, cols=agg_cols, session=session,
                          device=device)
         keep = out["valid"]
-        x64 = jax.config.jax_enable_x64
         sums = []
         for c in agg_cols:
             v = out[f"col{c}"][keep]
-            dt = self.schema.col_dtype(c)
-            acc = dt if dt.kind == "f" or not x64 \
-                else np.dtype(dt.kind + "8")
+            acc = acc_dtypes(self.schema.col_dtype(c))[0]
             sums.append(np.sum(v, dtype=acc))
         return {"count": np.int32(int(keep.sum())), "sums": sums}
 

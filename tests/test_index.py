@@ -140,13 +140,15 @@ def test_where_eq_planner_picks_index_scan(table):
     assert int(lim["count"]) == 3
     assert (c0[lim["positions"]] == 42).all()
 
-    # aggregate also rides the index (see its dedicated test); terminals
-    # without an index route (group_by) keep the scan path + equality
-    gb = Query(path, schema).where_eq(0, 42) \
-        .group_by(lambda c: c[1] % 2, 2, agg_cols=[1])
-    assert gb.explain().access_path == "direct"
-    gout = gb.run()
-    assert int(np.asarray(gout["count"]).sum()) == int((c0 == 42).sum())
+    # aggregating terminals ride the index too (dedicated tests);
+    # terminals without an index route (join) keep the scan + equality
+    jq = Query(path, schema).where_eq(0, 42) \
+        .join(1, np.arange(0, 1000, dtype=np.int32),
+              np.arange(0, 1000, dtype=np.int32))
+    assert jq.explain().access_path == "direct"
+    jout = jq.run()
+    assert int(jout["matched"]) == int(((c0 == 42)
+                                        & (c1 >= 0) & (c1 < 1000)).sum())
 
     # stale index: silent seqscan fallback, same answer
     build_heap_file(path, [c0, c1 + 1], schema)   # rewrite table
@@ -458,3 +460,40 @@ def test_quantiles_and_distinct_ride_index(table):
         .quantiles(1, [0.5])
     eout = e.run()
     assert int(eout["n"]) == 0 and np.isnan(eout["quantiles"]).all()
+
+
+def test_group_by_rides_index_and_matches_seqscan(table):
+    """GROUP BY with a structured filter plans as an index scan; every
+    result key (count/sums/mins/maxs/avgs/vars) matches the kernel
+    path, HAVING included."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+
+    def make_q():
+        return Query(path, schema).where_range(0, 40, 60) \
+            .group_by(lambda c: c[1] % 4, 4, agg_cols=[1],
+                      having=lambda gr: gr["count"] > 0)
+
+    seq = make_q().run()
+    build_index(path, schema, 0)
+    q2 = make_q()
+    assert q2.explain().access_path == "index"
+    idx_out = q2.run()
+    np.testing.assert_array_equal(idx_out["groups"], seq["groups"])
+    np.testing.assert_array_equal(idx_out["count"], seq["count"])
+    np.testing.assert_array_equal(idx_out["sums"], seq["sums"])
+    np.testing.assert_array_equal(idx_out["mins"], seq["mins"])
+    np.testing.assert_array_equal(idx_out["maxs"], seq["maxs"])
+    np.testing.assert_allclose(idx_out["avgs"], seq["avgs"], rtol=1e-6)
+    np.testing.assert_allclose(idx_out["vars"], seq["vars"], rtol=1e-4)
+    # oracle spot check
+    m = (c0 >= 40) & (c0 <= 60)
+    for grp in range(4):
+        mm = m & (c1 % 4 == grp)
+        assert idx_out["count"][grp] == int(mm.sum())
+        assert idx_out["sums"][0][grp] == int(c1[mm].sum())
+    # empty selection: all-empty groups with sentinel mins/maxs + having
+    e = Query(path, schema).where_eq(0, 2**30) \
+        .group_by(lambda c: c[1] % 4, 4, agg_cols=[1]).run()
+    assert (np.asarray(e["count"]) == 0).all()
+    assert np.isnan(e["avgs"]).all()

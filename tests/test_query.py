@@ -958,13 +958,17 @@ def test_query_results_identical_across_io_backends(heap):
         os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
         os.close(fd)
         try:
-            with Session(io_backend=backend) as sess:
-                outs[backend] = Query(path, schema) \
-                    .where(lambda c: c[0] > 0).select([0]) \
-                    .run(session=sess)
+            sess = Session(io_backend=backend)
         except StromError:
             continue   # backend unavailable on this host
-    assert "python" in outs and len(outs) >= 2
+        # a query failure must FAIL the test, not drop the backend
+        with sess:
+            outs[backend] = Query(path, schema) \
+                .where(lambda c: c[0] > 0).select([0]) \
+                .run(session=sess)
+    assert "python" in outs
+    if len(outs) < 2:
+        pytest.skip("no native backend on this host")
     base = outs["python"]
     for name, out in outs.items():
         np.testing.assert_array_equal(
