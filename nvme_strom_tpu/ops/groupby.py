@@ -52,7 +52,6 @@ def acc_dtypes(agg_dt: np.dtype):
     (f64 under x64).  Both the page kernels and the index-path host
     emulations (`scan/query._run_*_indexed`) derive from this, so the
     access paths cannot drift."""
-    import jax
     x64 = jax.config.jax_enable_x64
     is_f = agg_dt.kind == "f"
     acc = agg_dt if is_f or not x64 else np.dtype(agg_dt.kind + "8")

@@ -1012,11 +1012,12 @@ class Query:
 
     def _run_groupby_indexed(self, idx, device, session) -> dict:
         """GROUP BY over index-resolved rows (GROUP BY x WHERE key = v):
-        only matching pages are read; per-group accumulation reproduces
-        the kernel contract exactly — count int32, sums in the kernel's
-        accumulator dtype (exact via ufunc.at, never float bincount),
-        sumsqs floating, min/max sentinels for empty groups — and the
-        shared :meth:`_finalize` adds avgs/vars/HAVING on top."""
+        only matching pages are read; per-group accumulation follows the
+        kernel contract — count int32, integer sums EXACT in the shared
+        accumulator dtype (ufunc.at, never float bincount), float sums/
+        sumsqs equal up to summation order (sequential here, tree-reduced
+        on device), min/max sentinels for empty groups — and the shared
+        :meth:`_finalize` adds avgs/vars/HAVING on top."""
         from ..ops.groupby import _check_agg_cols, acc_dtypes
         key_fn, g, agg, _having = self._group
         cols_idx, agg_dt = _check_agg_cols(self.schema, agg)

@@ -14,7 +14,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
                                     "03_distributed.py"])
 def test_example_runs_clean(script, tmp_path):
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # cpu means cpu: this host's TPU plugin (injected via PYTHONPATH)
+    # initializes its tunnel even under JAX_PLATFORMS=cpu and HANGS the
+    # subprocess outright when the tunnel is wedged — strip it so the
+    # examples test the framework, not the host's transport state
+    inherited = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                 if p and not any(seg.startswith(".axon")
+                                  for seg in p.split(os.sep))]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + inherited)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     args = [sys.executable, os.path.join(REPO, "examples", script)]

@@ -497,3 +497,33 @@ def test_group_by_rides_index_and_matches_seqscan(table):
         .group_by(lambda c: c[1] % 4, 4, agg_cols=[1]).run()
     assert (np.asarray(e["count"]) == 0).all()
     assert np.isnan(e["avgs"]).all()
+
+
+def test_group_by_indexed_float_agg_close(tmp_path):
+    """Float agg columns on the indexed group_by match the kernel path
+    within summation-order tolerance (sequential vs tree reduction)."""
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("int32", "float32"))
+    rng = np.random.default_rng(41)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 50, n).astype(np.int32)
+    c1 = rng.standard_normal(n).astype(np.float32)
+    path = str(tmp_path / "fg.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", True)
+
+    def make_q():
+        return Query(path, schema).where_range(0, 10, 30) \
+            .group_by(lambda c: c[0] % 4, 4, agg_cols=[1])
+
+    seq = make_q().run()
+    build_index(path, schema, 0)
+    q2 = make_q()
+    assert q2.explain().access_path == "index"
+    idx_out = q2.run()
+    np.testing.assert_array_equal(idx_out["count"], seq["count"])
+    np.testing.assert_allclose(idx_out["sums"], seq["sums"], rtol=1e-5)
+    np.testing.assert_allclose(idx_out["sumsqs"], seq["sumsqs"],
+                               rtol=1e-5)
+    np.testing.assert_array_equal(idx_out["mins"], seq["mins"])
+    np.testing.assert_array_equal(idx_out["maxs"], seq["maxs"])
