@@ -1,0 +1,37 @@
+"""Host-TPU-plugin path hygiene for CPU-only validation.
+
+This host injects its TPU PJRT plugin via PYTHONPATH (a ``.axon*``
+directory).  The plugin initializes its device tunnel at jax backend-init
+even under ``JAX_PLATFORMS=cpu`` and hangs outright when that tunnel is
+wedged — so every CPU-only validation context (the multichip dry run,
+example subprocess tests) must drop the plugin's path entries BEFORE the
+first jax import.  One implementation, imported by all of them (this
+module deliberately imports nothing heavy: it must be loadable before
+jax).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["is_tpu_plugin_path", "strip_tpu_plugin"]
+
+
+def is_tpu_plugin_path(p: str) -> bool:
+    """Exact path-segment match — a repo under e.g. ``.../taxonomy/``
+    must never be stripped by a substring test."""
+    return any(seg.startswith(".axon") for seg in p.split(os.sep))
+
+
+def strip_tpu_plugin(env: Optional[dict] = None,
+                     sys_path: Optional[list] = None) -> None:
+    """Remove plugin entries from *env*'s PYTHONPATH (default:
+    ``os.environ`` — child processes inherit it) and, if given, from
+    *sys_path* in place (the current process's import path)."""
+    e = os.environ if env is None else env
+    e["PYTHONPATH"] = os.pathsep.join(
+        p for p in e.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not is_tpu_plugin_path(p))
+    if sys_path is not None:
+        sys_path[:] = [p for p in sys_path if not is_tpu_plugin_path(p)]

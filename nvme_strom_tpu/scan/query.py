@@ -120,15 +120,20 @@ class Query:
         self._quantiles: Optional[List[float]] = None
         self._eq: Optional[tuple] = None     # structured equality (col, v)
         self._range: Optional[tuple] = None  # structured range (col, lo, hi)
+        self._in: Optional[tuple] = None     # structured IN (col, members)
 
     # -- builders -----------------------------------------------------------
     def where(self, predicate: Callable) -> "Query":
         """Row filter: ``predicate(cols) -> (B, T) bool`` (jnp ops only)."""
         self._pred = predicate
-        # an opaque predicate supersedes any structured one
-        self._eq = None
-        self._range = None
+        self._set_structured()   # an opaque predicate supersedes any
         return self
+
+    def _set_structured(self, *, eq=None, rng=None, members=None) -> None:
+        """Install exactly one structured filter (the others clear)."""
+        self._eq = eq
+        self._range = rng
+        self._in = members
 
     def where_eq(self, col: int, value) -> "Query":
         """Structured equality filter: ``col == value``.  Unlike the
@@ -154,11 +159,44 @@ class Query:
             # (non-integral or out-of-range vs int, e.g. 7.5 or 2**40):
             # SQL says no row matches — on BOTH paths, never a wraparound
             self._pred = lambda cols: cols[col] != cols[col]
-            self._eq = (int(col), None)   # index path: empty result
+            self._set_structured(eq=(int(col), None))  # index: empty
         else:
             self._pred = lambda cols: cols[col] == v
-            self._eq = (int(col), v)
-        self._range = None
+            self._set_structured(eq=(int(col), v))
+        return self
+
+    def where_in(self, col: int, values) -> "Query":
+        """Structured membership filter: ``col IN values`` (SQL IN).
+        Planner-visible like :meth:`where_eq`; with a fresh sidecar the
+        index resolves every member's positions.  Members with no exact
+        representative in the column dtype (7.5 against int32) can match
+        no row and simply drop out."""
+        if not 0 <= col < self.schema.n_cols:
+            raise StromError(22, f"where_in column {col} out of range")
+        dt = self.schema.col_dtype(col)
+        reps = [self._representable(dt, v) for v in values]
+        members = np.unique(np.array([r for r in reps if r is not None],
+                                     dt))
+        if dt.kind == "f":
+            # a NaN member can never equal any row (IEEE; the seqscan's
+            # isin agrees) — drop it so the index path cannot disagree
+            # either (searchsorted would bracket NaN keys if a sidecar
+            # ever carried them, e.g. one built outside build_index)
+            members = members[~np.isnan(members)]
+        if len(members) == 0:
+            # identically False even for NaN rows (x != x alone would
+            # select NaN on a float column)
+            self._pred = lambda cols: (cols[col] == cols[col]) \
+                & (cols[col] != cols[col])
+            self._set_structured(members=(int(col), np.zeros(0, dt)))
+            return self
+
+        def pred(cols):
+            import jax.numpy as jnp
+            return jnp.isin(cols[col], members)
+
+        self._pred = pred
+        self._set_structured(members=(int(col), members))
         return self
 
     @staticmethod
@@ -228,8 +266,7 @@ class Query:
             return m
 
         self._pred = pred
-        self._eq = None
-        self._range = (int(col), nlo, nhi)
+        self._set_structured(rng=(int(col), nlo, nhi))
         return self
 
     def select(self, cols: Optional[Sequence[int]] = None, *,
@@ -464,11 +501,10 @@ class Query:
         return "xla", f"{self._op} runs on lax.top_k/searchsorted (XLA)"
 
     def _index_col(self) -> Optional[int]:
-        """The column a structured (eq or range) filter targets."""
-        if self._eq is not None:
-            return self._eq[0]
-        if self._range is not None:
-            return self._range[0]
+        """The column a structured (eq/range/in) filter targets."""
+        for f in (self._eq, self._range, self._in):
+            if f is not None:
+                return f[0]
         return None
 
     def _index_path_for_eq(self) -> Optional[str]:
@@ -516,6 +552,9 @@ class Query:
             if self._eq is not None:
                 c, v = self._eq
                 cond = f"equality col{c} == {v!r}"
+            elif self._in is not None:
+                c, members = self._in
+                cond = f"membership col{c} IN ({len(members)} values)"
             else:
                 c, lo, hi = self._range
                 cond = f"range {lo!r} <= col{c} <= {hi!r}"
@@ -981,6 +1020,8 @@ class Query:
             if self._eq[1] is None:
                 return np.zeros(0, np.int64)
             return idx.lookup([self._eq[1]])
+        if self._in is not None:
+            return idx.lookup(self._in[1])
         _c, lo, hi = self._range
         return idx.range(lo, hi)
 

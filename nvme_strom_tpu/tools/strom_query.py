@@ -156,6 +156,9 @@ def main(argv=None) -> int:
     ap.add_argument("--where-range", default=None, metavar="COL:LO:HI",
                     help="structured range filter (empty LO or HI = open "
                          "bound); index-scan capable like --where-eq")
+    ap.add_argument("--where-in", default=None, metavar="COL:V[,V...]",
+                    help="structured membership filter (SQL IN); "
+                         "index-scan capable like --where-eq")
     ap.add_argument("--group-by", default=None, metavar="EXPR",
                     help='int32 group key, e.g. "c1 % 8"')
     ap.add_argument("--groups", type=int, default=None,
@@ -252,7 +255,7 @@ def main(argv=None) -> int:
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.build_index is not None or args.index_lookup:
         from ..scan.index import build_index, open_index
-        if terminals or args.where or args.where_eq or args.where_range \
+        if terminals or args.where or args.where_eq or args.where_range or args.where_in \
                 or args.fetch:
             ap.error("--build-index/--index-lookup are exclusive index "
                      "operations")
@@ -299,7 +302,7 @@ def main(argv=None) -> int:
         if terminals:
             ap.error(f"--fetch is a point lookup, exclusive of "
                      f"{terminals[0]}")
-        if args.where or args.where_eq or args.where_range:
+        if args.where or args.where_eq or args.where_range or args.where_in:
             ap.error("--fetch reads rows by position; --where filters "
                      "do not apply (filter with a scan terminal instead)")
         for flag, given in (("--explain", args.explain),
@@ -322,10 +325,20 @@ def main(argv=None) -> int:
                 print(f"{k}: {np.array2string(np.asarray(v), threshold=32)}")
         return 0
     if sum(bool(x) for x in (args.where, args.where_eq,
-                             args.where_range)) > 1:
-        ap.error("--where, --where-eq and --where-range are exclusive")
+                             args.where_range, args.where_in)) > 1:
+        ap.error("--where, --where-eq, --where-range and --where-in "
+                 "are exclusive")
     if args.where:
         q = q.where(_expr_fn(args.where, args.cols))
+    elif args.where_in:
+        colspec, _, vspec = args.where_in.partition(":")
+        if not colspec.isdigit() or not vspec:
+            ap.error("--where-in takes COL:V[,V...]")
+        try:
+            ivals = [_parse_number(x) for x in vspec.split(",")]
+        except ValueError:
+            ap.error("--where-in: values must be numbers")
+        q = q.where_in(int(colspec), ivals)
     elif args.where_range:
         parts = args.where_range.split(":")
         if len(parts) != 3 or not parts[0].isdigit():

@@ -13,15 +13,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.mark.parametrize("script", ["01_direct_load.py", "02_query.py",
                                     "03_distributed.py"])
 def test_example_runs_clean(script, tmp_path):
+    from nvme_strom_tpu._pluginpath import strip_tpu_plugin
     env = dict(os.environ)
-    # cpu means cpu: this host's TPU plugin (injected via PYTHONPATH)
-    # initializes its tunnel even under JAX_PLATFORMS=cpu and HANGS the
-    # subprocess outright when the tunnel is wedged — strip it so the
-    # examples test the framework, not the host's transport state
-    inherited = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-                 if p and not any(seg.startswith(".axon")
-                                  for seg in p.split(os.sep))]
-    env["PYTHONPATH"] = os.pathsep.join([REPO] + inherited)
+    # cpu means cpu: a wedged host-TPU-plugin tunnel must not hang the
+    # example subprocesses (shared rationale in _pluginpath)
+    strip_tpu_plugin(env)
+    env["PYTHONPATH"] = REPO + os.pathsep + env["PYTHONPATH"]
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     args = [sys.executable, os.path.join(REPO, "examples", script)]

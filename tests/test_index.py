@@ -527,3 +527,66 @@ def test_group_by_indexed_float_agg_close(tmp_path):
                                rtol=1e-5)
     np.testing.assert_array_equal(idx_out["mins"], seq["mins"])
     np.testing.assert_array_equal(idx_out["maxs"], seq["maxs"])
+
+
+def test_where_in_rides_index_and_matches_seqscan(table):
+    """where_in (SQL IN): index scan and seqscan agree for select and
+    aggregate; unrepresentable members drop out; empty member set
+    matches nothing (even NaN rows on float columns)."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    members = [3, 57, 199, 7.5, 10**12]   # last two cannot match
+    q = Query(path, schema).where_in(0, members).select()
+    seq = q.run()
+    build_index(path, schema, 0)
+    q2 = Query(path, schema).where_in(0, members).select()
+    plan = q2.explain()
+    assert plan.access_path == "index" and "IN (3 values)" in plan.reason
+    idx_out = q2.run()
+    m = np.isin(c0, [3, 57, 199])
+    np.testing.assert_array_equal(np.sort(idx_out["positions"]),
+                                  np.flatnonzero(m))
+    np.testing.assert_array_equal(np.sort(seq["positions"]),
+                                  np.flatnonzero(m))
+    agg = Query(path, schema).where_in(0, [3, 57]).aggregate(cols=[1])
+    assert agg.explain().access_path == "index"
+    aout = agg.run()
+    mm = np.isin(c0, [3, 57])
+    assert int(aout["count"]) == int(mm.sum())
+    assert int(aout["sums"][0]) == int(c1[mm].sum())
+    # empty member set
+    e = Query(path, schema).where_in(0, []).select().run()
+    assert int(e["count"]) == 0
+
+
+def test_where_in_empty_members_float_nan(tmp_path):
+    """where_in with no representable members is identically False even
+    for NaN rows of a float column (x != x alone would match NaN)."""
+    schema = HeapSchema(n_cols=1, visibility=False, dtypes=("float32",))
+    n = schema.tuples_per_page
+    f = np.zeros(n, np.float32)
+    f[5] = np.nan
+    path = str(tmp_path / "inn.heap")
+    build_heap_file(path, [f], schema)
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).where_in(0, []).select().run()
+    assert int(out["count"]) == 0
+
+
+def test_where_in_nan_member_matches_nothing(tmp_path):
+    """A NaN member never matches on either access path (IEEE !=)."""
+    schema = HeapSchema(n_cols=1, visibility=False, dtypes=("float32",))
+    n = schema.tuples_per_page
+    f = np.zeros(n, np.float32)
+    f[3] = np.nan
+    f[7] = np.float32(1.5)
+    path = str(tmp_path / "nanin.heap")
+    build_heap_file(path, [f], schema)
+    config.set("debug_no_threshold", True)
+    seq = Query(path, schema).where_in(0, [np.nan, 1.5]).select().run()
+    assert int(seq["count"]) == 1 and seq["positions"][0] == 7
+    build_index(path, schema, 0)
+    q = Query(path, schema).where_in(0, [np.nan, 1.5]).select()
+    assert q.explain().access_path == "index"
+    out = q.run()
+    assert int(out["count"]) == 1 and out["positions"][0] == 7
