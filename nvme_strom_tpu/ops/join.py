@@ -65,15 +65,18 @@ def make_join_fn(schema: HeapSchema, probe_col: int,
 
 def _sorted_build(build_keys: np.ndarray, build_values: np.ndarray,
                   schema: HeapSchema, probe_col: int):
-    """Shared build-side prep: unique-key check, sort, device constants."""
+    """Shared build-side prep: unique-key check + sort.  Returns HOST
+    arrays — the jitted kernels capture them as constants (jnp ops accept
+    np operands), and the index path's host emulation avoids a pointless
+    H2D/D2H round trip."""
     if len(np.unique(build_keys)) != len(build_keys):
         raise ValueError("build_keys must be unique (inner join on a "
                          "dimension key)")
     if schema.col_dtype(probe_col) != np.dtype(np.int32):
         raise ValueError("probe column must be int32")
     order = np.argsort(build_keys, kind="stable")
-    return (jnp.asarray(np.asarray(build_keys, np.int32)[order]),
-            jnp.asarray(np.asarray(build_values, np.int32)[order]))
+    return (np.asarray(build_keys, np.int32)[order],
+            np.asarray(build_values, np.int32)[order])
 
 
 def _probe(keys, vals, probe, sel):
@@ -81,6 +84,9 @@ def _probe(keys, vals, probe, sel):
     joins nothing instead of tripping a zero-size gather."""
     if keys.shape[0] == 0:
         return jnp.zeros_like(sel), jnp.zeros_like(probe)
+    # host build arrays become captured constants here (a np array cannot
+    # be indexed by the traced idx below)
+    keys, vals = jnp.asarray(keys), jnp.asarray(vals)
     idx = jnp.clip(jnp.searchsorted(keys, probe), 0, keys.shape[0] - 1)
     return sel & (keys[idx] == probe), vals[idx]
 

@@ -546,7 +546,7 @@ class Query:
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
         if (self._op in ("select", "aggregate", "top_k", "quantiles",
-                         "count_distinct", "group_by")
+                         "count_distinct", "group_by", "join")
                 and mode == "local"
                 and kernel != "invalid" and self._index_fresh_for_eq()):
             if self._eq is not None:
@@ -699,6 +699,7 @@ class Query:
                       "count_distinct": self._run_column_indexed,
                       "aggregate": self._run_aggregate_indexed,
                       "group_by": self._run_groupby_indexed,
+                      "join": self._run_join_indexed,
                       }.get(self._op)
             if idx is not None and runner is not None:
                 return runner(idx, device, session)
@@ -1087,6 +1088,74 @@ class Query:
         return self._finalize({"count": count, "sums": sums,
                                "sumsqs": sumsqs, "mins": mins,
                                "maxs": maxs})
+
+    def _run_join_indexed(self, idx, device, session) -> dict:
+        """Join over index-resolved rows (JOIN ... WHERE key = v): only
+        matching fact pages are read; the probe is the same sorted-
+        searchsorted discipline as the page kernel, and the aggregate
+        face reproduces its accumulation dtypes via ``acc_dtypes``."""
+        from ..ops.groupby import acc_dtypes
+        from ..ops.join import _sorted_build
+        probe_col, bk, bv, materialize, limit, offset = self._join
+        # the kernel path's exact build-side validation + sort (host
+        # arrays; the probe column is int32 by that validation)
+        keys, vals = _sorted_build(bk, bv, self.schema, probe_col)
+        pos_all = np.sort(self._index_positions(idx))
+
+        def probe_host(probe):
+            if len(keys) == 0:
+                return (np.zeros(len(probe), bool),
+                        np.zeros(len(probe), np.int32))
+            i = np.clip(np.searchsorted(keys, probe), 0, len(keys) - 1)
+            return keys[i] == probe, vals[i]
+
+        if materialize:
+            # batched fetch of ONLY the probe column, stopping once
+            # offset+limit joined rows are found (the early DMA cut-off
+            # the seqscan face has)
+            end = None if limit is None else offset + limit
+            parts, got = [], 0
+            batch = 65536
+            for b0 in range(0, len(pos_all), batch):
+                pb = pos_all[b0:b0 + batch]
+                out = self.fetch(pb, cols=[probe_col], session=session,
+                                 device=device)
+                keep = np.asarray(out["valid"]).astype(bool)
+                probe = np.asarray(out[f"col{probe_col}"])[keep]
+                pb = pb[keep]
+                hit, pay = probe_host(probe)
+                parts.append((pb[hit], probe[hit], pay[hit]))
+                got += int(hit.sum())
+                if end is not None and got >= end:
+                    break
+            if parts:
+                pos_c = np.concatenate([p[0] for p in parts])
+                key_c = np.concatenate([p[1] for p in parts])
+                pay_c = np.concatenate([p[2] for p in parts])
+            else:
+                pos_c = np.zeros(0, np.int64)
+                key_c = pay_c = np.zeros(0, np.int32)
+            sl = slice(offset, end)
+            res = {"positions": pos_c[sl].astype(self._pos_dtype()),
+                   "keys": key_c[sl].astype(np.int32),
+                   "payload": pay_c[sl].astype(np.int32)}
+            res["count"] = np.int64(len(res["positions"]))
+            return res
+        # aggregate face: matched count + sums over the int32 fact
+        # columns (the kernel's run.sum_cols set, ascending) + payload
+        cols = [c for c in range(self.schema.n_cols)
+                if self.schema.col_dtype(c) == np.dtype(np.int32)]
+        out = self.fetch(pos_all, cols=cols, session=session,
+                         device=device)
+        keep = np.asarray(out["valid"]).astype(bool)
+        probe = np.asarray(out[f"col{probe_col}"])[keep]
+        hit, pay = probe_host(probe)
+        acc = acc_dtypes(np.dtype(np.int32))[0]
+        sums = [np.sum(np.asarray(out[f"col{c}"])[keep][hit], dtype=acc)
+                for c in cols]
+        return {"matched": np.int32(int(hit.sum())),
+                "sums": np.array(sums, acc),
+                "payload_sum": np.sum(pay[hit], dtype=acc)}
 
     def _run_aggregate_indexed(self, idx, device, session) -> dict:
         """COUNT/SUM over index-resolved rows — the most common index

@@ -140,12 +140,12 @@ def test_where_eq_planner_picks_index_scan(table):
     assert int(lim["count"]) == 3
     assert (c0[lim["positions"]] == 42).all()
 
-    # aggregating terminals ride the index too (dedicated tests);
-    # terminals without an index route (join) keep the scan + equality
+    # every terminal rides the index with a structured filter now —
+    # join included (see its dedicated test)
     jq = Query(path, schema).where_eq(0, 42) \
         .join(1, np.arange(0, 1000, dtype=np.int32),
               np.arange(0, 1000, dtype=np.int32))
-    assert jq.explain().access_path == "direct"
+    assert jq.explain().access_path == "index"
     jout = jq.run()
     assert int(jout["matched"]) == int(((c0 == 42)
                                         & (c1 >= 0) & (c1 < 1000)).sum())
@@ -590,3 +590,43 @@ def test_where_in_nan_member_matches_nothing(tmp_path):
     assert q.explain().access_path == "index"
     out = q.run()
     assert int(out["count"]) == 1 and out["positions"][0] == 7
+
+
+def test_join_rides_index_both_faces(table):
+    """Both join faces (aggregate + materialize) over the index match
+    the seqscan path exactly, including sums order and limit slicing."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    keys = np.arange(-500, 500, dtype=np.int32)
+    vals = (keys * 3).astype(np.int32)
+
+    def agg_q():
+        return Query(path, schema).where_range(0, 40, 60) \
+            .join(1, keys, vals)
+
+    def mat_q(**kw):
+        return Query(path, schema).where_range(0, 40, 60) \
+            .join(1, keys, vals, materialize=True, **kw)
+
+    seq_a, seq_m = agg_q().run(), mat_q().run()
+    build_index(path, schema, 0)
+    qa, qm = agg_q(), mat_q()
+    assert qa.explain().access_path == "index"
+    assert qm.explain().access_path == "index"
+    ia, im = qa.run(), qm.run()
+    assert int(ia["matched"]) == int(seq_a["matched"])
+    np.testing.assert_array_equal(ia["sums"], seq_a["sums"])
+    assert int(ia["payload_sum"]) == int(seq_a["payload_sum"])
+    np.testing.assert_array_equal(np.sort(im["positions"]),
+                                  np.sort(seq_m["positions"]))
+    np.testing.assert_array_equal(np.sort(im["payload"]),
+                                  np.sort(seq_m["payload"]))
+    # limit on the materializing face through the index
+    lm = mat_q(limit=5).run()
+    assert int(lm["count"]) == 5
+    m = (c0 >= 40) & (c0 <= 60) & (c1 >= -500) & (c1 < 500)
+    assert np.isin(lm["positions"], np.flatnonzero(m)).all()
+    np.testing.assert_array_equal(lm["payload"], c1[lm["positions"]] * 3)
+    # oracle for the aggregate face
+    assert int(ia["matched"]) == int(m.sum())
+    assert int(ia["payload_sum"]) == int((c1[m] * 3).sum())
