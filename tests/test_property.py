@@ -315,3 +315,53 @@ def test_query_select_order_join_match_oracle(tmp_path_factory, n_pages,
                                 None if limit is None else offset + limit]
     np.testing.assert_array_equal(j["positions"], jpos)
     np.testing.assert_array_equal(j["payload"], c1[jpos] * 7)
+
+
+@given(n_pages=st.integers(1, 4),
+       kind=st.sampled_from(["eq", "range", "in"]),
+       a=st.integers(-60, 60), b=st.integers(-60, 60),
+       seed=st.integers(0, 2**31 - 1))
+@settings(max_examples=20, deadline=None)
+def test_index_and_seqscan_answers_identical(tmp_path_factory, n_pages,
+                                             kind, a, b, seed):
+    """For ANY random table and structured filter, the index scan and
+    the filtered seqscan return identical select rows and aggregate
+    sums — the transparency contract, property-tested."""
+    import numpy as np
+
+    from nvme_strom_tpu import config
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.index import build_index
+    from nvme_strom_tpu.scan.query import Query
+
+    rng = np.random.default_rng(seed)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * n_pages
+    c0 = rng.integers(-50, 50, n).astype(np.int32)
+    c1 = rng.integers(-1000, 1000, n).astype(np.int32)
+    d = tmp_path_factory.mktemp("ix")
+    path = str(d / "p.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", True)
+
+    def q():
+        qq = Query(path, schema)
+        if kind == "eq":
+            return qq.where_eq(0, a)
+        if kind == "range":
+            lo, hi = min(a, b), max(a, b)
+            return qq.where_range(0, lo, hi)
+        return qq.where_in(0, [a, b, a])
+
+    seq_sel = q().select().run()
+    seq_agg = q().aggregate(cols=[1]).run()
+    build_index(path, schema, 0)
+    assert q().select().explain().access_path == "index"
+    idx_sel = q().select().run()
+    idx_agg = q().aggregate(cols=[1]).run()
+    np.testing.assert_array_equal(np.sort(idx_sel["positions"]),
+                                  np.sort(seq_sel["positions"]))
+    np.testing.assert_array_equal(np.sort(idx_sel["col1"]),
+                                  np.sort(seq_sel["col1"]))
+    assert int(idx_agg["count"]) == int(seq_agg["count"])
+    assert int(idx_agg["sums"][0]) == int(seq_agg["sums"][0])
