@@ -9,7 +9,7 @@ debug counters (`:116-166`).
 The counter source is the JSON snapshot exported by running tools/sessions
 (``stats.start_export()``), standing in for the reference's /proc reads.
 
-Usage: tpu_stat [-v] [-f STAT_FILE] [interval]
+Usage: tpu_stat [-v] [--json] [-f STAT_FILE] [interval]
 """
 
 from __future__ import annotations
@@ -83,13 +83,22 @@ def main(argv=None) -> int:
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("-f", "--file", default=DEFAULT_STAT_EXPORT,
                     help="stat export file to watch")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one-shot machine-readable snapshot (counters + "
+                         "per-member breakdown) for scripts/monitoring")
     args = ap.parse_args(argv)
+    if args.as_json and args.interval is not None:
+        ap.error("--json is one-shot; drop the interval")
 
     snap = _read(args.file)
     if snap is None:
         print(f"no stats at {args.file} — is a tool/session running with "
               f"stats export on?", file=sys.stderr)
         return 1
+
+    if args.as_json:
+        print(json.dumps(snap))
+        return 0
 
     if args.interval is None:
         c = snap["counters"]

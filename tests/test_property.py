@@ -359,9 +359,15 @@ def test_index_and_seqscan_answers_identical(tmp_path_factory, n_pages,
     assert q().select().explain().access_path == "index"
     idx_sel = q().select().run()
     idx_agg = q().aggregate(cols=[1]).run()
-    np.testing.assert_array_equal(np.sort(idx_sel["positions"]),
-                                  np.sort(seq_sel["positions"]))
-    np.testing.assert_array_equal(np.sort(idx_sel["col1"]),
-                                  np.sort(seq_sel["col1"]))
+    # compare ROWS, not per-column multisets: values must stay paired
+    # with their positions on both paths
+    io = np.argsort(idx_sel["positions"])
+    so = np.argsort(seq_sel["positions"])
+    np.testing.assert_array_equal(idx_sel["positions"][io],
+                                  seq_sel["positions"][so])
+    np.testing.assert_array_equal(idx_sel["col1"][io],
+                                  seq_sel["col1"][so])
+    np.testing.assert_array_equal(idx_sel["col1"][io],
+                                  c1[idx_sel["positions"][io]])
     assert int(idx_agg["count"]) == int(seq_agg["count"])
     assert int(idx_agg["sums"][0]) == int(seq_agg["sums"][0])

@@ -444,3 +444,24 @@ def test_strom_query_cli_where_range(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
                "--where", "c0 > 1", "--where-range", "0:1:2")
     assert out.returncode != 0 and "exclusive" in out.stderr
+
+
+def test_tpu_stat_json_snapshot(data_file, tmp_path):
+    """tpu_stat --json: the full snapshot (counters + members) as one
+    machine-readable line."""
+    import json
+
+    export = str(tmp_path / "st.json")
+    gen = _run("nvme_strom_tpu.tools.ssd2ram_test", data_file,
+               env_extra={"STROM_TPU_STAT_EXPORT": export})
+    assert gen.returncode == 0, gen.stderr   # blame the generator, not
+    assert os.path.getsize(export) > 0       # tpu_stat, when it fails
+    out = _run("nvme_strom_tpu.tools.tpu_stat", "-f", export, "--json")
+    assert out.returncode == 0, out.stderr
+    snap = json.loads(out.stdout.strip().splitlines()[-1])
+    assert snap["counters"]["nr_submit_dma"] >= 1
+    assert "pid" in snap and "version" in snap
+    # --json with an interval is a usage error
+    out = _run("nvme_strom_tpu.tools.tpu_stat", "-f", export, "--json",
+               "1")
+    assert out.returncode != 0
