@@ -198,6 +198,55 @@ for _ in range(3):
 print(f"GBPS={{n_pages * PAGE_SIZE * ITERS / dt / (1<<30):.3f}}")
 """
 
+_GROUPBY_CHIP = _COMMON + """
+# on-chip GROUP BY microbench, FLOAT aggregation column (VERDICT r2 #5):
+# pallas single-pass SMEM kernel vs the XLA segment-sum path on the
+# identical HBM-resident batch.  Same single-dispatch fori_loop discipline
+# as the filter chip rows (ratio is the metric, not absolute GB/s).
+import jax, jax.numpy as jnp
+from jax import lax
+from nvme_strom_tpu.scan.heap import HeapSchema, build_pages, PAGE_SIZE
+schema = HeapSchema(n_cols=2, visibility=True,
+                    dtypes=("float32", "int32"))
+batch_bytes = min(size, 32 << 20)
+n_pages = batch_bytes // PAGE_SIZE
+rng = np.random.default_rng(0)
+n = schema.tuples_per_page * n_pages
+G = 16
+pages = build_pages(
+    [(rng.standard_normal(n) * 50 + 100).astype(np.float32),
+     rng.integers(0, G, n).astype(np.int32)], schema)
+key = lambda cols, th: cols[1]
+pred = lambda cols, th: cols[0] > th.astype(jnp.float32)
+if {use_pallas}:
+    from nvme_strom_tpu.ops.groupby_pallas import make_groupby_fn_pallas
+    fn = make_groupby_fn_pallas(schema, key, G, agg_cols=[0],
+                                predicate=pred)
+else:
+    from nvme_strom_tpu.ops.groupby import make_groupby_fn
+    fn = make_groupby_fn(schema, key, G, agg_cols=[0], predicate=pred)
+ITERS = 16
+pad = np.zeros((ITERS, PAGE_SIZE), np.uint8)
+big = np.concatenate([pages, pad], 0)
+@jax.jit
+def loop(bp):
+    def body(i, acc):
+        p = lax.dynamic_slice(bp, (i, 0), (n_pages, PAGE_SIZE))
+        out = fn(p, i)
+        return acc + out["sums"][0, 0]
+    return lax.fori_loop(0, ITERS, body, jnp.float32(0))
+dp = jax.device_put(big)
+jax.block_until_ready(dp)
+jax.block_until_ready(loop(dp))  # compile + warm
+dt = None
+for _ in range(3):
+    t0 = time.monotonic()
+    jax.block_until_ready(loop(dp))
+    d = time.monotonic() - t0
+    dt = d if dt is None else min(dt, d)
+print(f"GBPS={{n_pages * PAGE_SIZE * ITERS / dt / (1<<30):.3f}}")
+"""
+
 _RAW = _COMMON + """
 # fio-style raw denominator: sequential O_DIRECT pread, no framework at
 # all — the "raw NVMe bandwidth" every BASELINE target is a percentage of
@@ -360,6 +409,10 @@ def main() -> int:
          _FILTER_CHIP.format(size=size, use_pallas=1), None),
         ("filter_xla_chip", "on-chip XLA filter (same batch)",
          _FILTER_CHIP.format(size=size, use_pallas=0), None),
+        ("groupbyf_pallas_chip", "on-chip pallas float GROUP BY",
+         _GROUPBY_CHIP.format(size=size, use_pallas=1), None),
+        ("groupbyf_xla_chip", "on-chip XLA float GROUP BY (same batch)",
+         _GROUPBY_CHIP.format(size=size, use_pallas=0), None),
         ("ckpt_restore", "checkpoint -> HBM direct restore",
          _CKPT.format(size=size, path=base), None),
     ]
@@ -424,6 +477,11 @@ def main() -> int:
                            results["filter_xla_chip"], 3)
                      if results.get("filter_xla_chip")
                      and results.get("filter_pallas_chip") else None)
+    pallas_vs_xla_groupby = (round(results["groupbyf_pallas_chip"] /
+                                   results["groupbyf_xla_chip"], 3)
+                             if results.get("groupbyf_xla_chip")
+                             and results.get("groupbyf_pallas_chip")
+                             else None)
     path = os.path.join(REPO, "BENCH_MATRIX.json")
     with open(path, "w") as f:
         json.dump({"size_mb": size_mb, "unit": "GB/s",
@@ -443,7 +501,8 @@ def main() -> int:
                    "results": results,
                    "pct_of_raw": pct_of_raw,
                    "overlap_efficiency": overlap_efficiency,
-                   "pallas_vs_xla": pallas_vs_xla}, f,
+                   "pallas_vs_xla": pallas_vs_xla,
+                   "pallas_vs_xla_groupby": pallas_vs_xla_groupby}, f,
                   indent=2)
         f.write("\n")
     print(f"wrote {path}")

@@ -44,6 +44,9 @@ class HbmBuffer:
         self.owner_uid = owner_uid
         self.refcount = 0
         self._lock = threading.Lock()
+        # Signalled whenever refcount drops; unmap() waits on it instead of
+        # polling (same CV drain Session.unmap_buffer uses in engine.py).
+        self._drained = threading.Condition(self._lock)
         self._revoked = False
 
     @property
@@ -121,6 +124,8 @@ class HbmRegistry:
     def release(self, buf: HbmBuffer) -> None:
         with buf._lock:
             buf.refcount -= 1
+            if buf.refcount == 0:
+                buf._drained.notify_all()
 
     # -- UNMAP_GPU_MEMORY (revocation) -------------------------------------
     def unmap(self, handle: int, *, timeout: float = 30.0) -> None:
@@ -129,15 +134,18 @@ class HbmRegistry:
         (kmod/pmemmap.c:149-208)."""
         buf = self.get(handle)
         deadline = time.monotonic() + timeout
-        while True:
-            with buf._lock:
-                if buf.refcount == 0:
-                    buf._revoked = True
-                    break
-            if time.monotonic() > deadline:
-                raise StromError(_errno.ETIMEDOUT,
-                                f"buffer {handle} busy past revocation timeout")
-            time.sleep(0.001)
+        with buf._lock:
+            # standard CV idiom: re-test the predicate after every wake,
+            # including a timed-out one — a release landing exactly at the
+            # deadline must still win
+            while buf.refcount != 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise StromError(
+                        _errno.ETIMEDOUT,
+                        f"buffer {handle} busy past revocation timeout")
+                buf._drained.wait(timeout=remaining)
+            buf._revoked = True
         with self._lock:
             self._buffers.pop(handle, None)
 

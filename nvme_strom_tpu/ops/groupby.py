@@ -37,16 +37,11 @@ def combine_groupby(acc: dict, out: dict) -> dict:
             "mins": jnp.minimum(acc["mins"], out["mins"]),
             "maxs": jnp.maximum(acc["maxs"], out["maxs"])}
 
-# np, not jnp: a module-level jnp constant would initialize the JAX
-# backend at import time, pinning the platform before jax_platforms /
-# XLA_FLAGS virtual-mesh configuration can take effect
-_I32_MIN = np.int32(-(1 << 31))
-_I32_MAX = np.int32((1 << 31) - 1)
-
-
 def acc_dtypes(agg_dt: np.dtype):
-    """THE accumulation convention, in one place: ``(sum accumulator
-    dtype, sumsq dtype, min-sentinel hi, max-sentinel lo)``.  Float sums
+    """THE accumulation convention, in one place — returns
+    ``(sum accumulator dtype, sumsq dtype, lo, hi)`` where ``lo`` is the
+    dtype's worst/lowest value (initializes MAX accumulators) and ``hi``
+    its best/highest (initializes MIN accumulators).  Float sums
     stay at the column dtype; int sums widen to 8 bytes only under x64
     (the MXU contraction's preferred_element_type); sumsqs are floating
     (f64 under x64).  Both the page kernels and the index-path host
@@ -65,8 +60,8 @@ def acc_dtypes(agg_dt: np.dtype):
 
 
 def _check_agg_cols(schema: HeapSchema, agg_cols):
-    """Validate + resolve aggregation columns: one shared dtype, int32 or
-    float32.  Returns (indices, dtype)."""
+    """Validate + resolve aggregation columns: one shared dtype — int32,
+    uint32, or float32.  Returns (indices, dtype)."""
     cols_idx = list(agg_cols) if agg_cols is not None else \
         list(range(schema.n_cols))
     if not cols_idx:
@@ -81,10 +76,10 @@ def _check_agg_cols(schema: HeapSchema, agg_cols):
                          f"dtype, got {sorted(str(d) for d in dts)}; "
                          f"split into one groupby per dtype")
     dt = dts.pop()
-    if dt not in (np.dtype(np.int32), np.dtype(np.float32)):
-        raise ValueError(f"groupby aggregates int32 or float32 columns "
-                         f"(got {dt}); bitcast uint32 data to int32 or "
-                         f"filter it via make_filter_fn")
+    if dt not in (np.dtype(np.int32), np.dtype(np.uint32),
+                  np.dtype(np.float32)):
+        raise ValueError(f"groupby aggregates int32, uint32, or float32 "
+                         f"columns (got {dt})")
     return cols_idx, dt
 
 
@@ -103,15 +98,13 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
     path — int32 squares overflow long before sums do, and variance is a
     statistical quantity, so float semantics are the honest contract.
 
-    Aggregation columns must share one dtype — int32 or float32 (uniform
-    ``(V, G)`` result arrays; the reference's per-tuple walk had the same
-    one-type-at-a-time shape).  uint32/mixed sets raise.
+    Aggregation columns must share one dtype — int32, uint32, or float32
+    (uniform ``(V, G)`` result arrays; the reference's per-tuple walk had
+    the same one-type-at-a-time shape).  Mixed sets raise.
     """
     cols_idx, agg_dt = _check_agg_cols(schema, agg_cols)
     G = int(n_groups)
-    is_f = agg_dt.kind == "f"
-    lo = np.float32(-np.inf) if is_f else _I32_MIN
-    hi = np.float32(np.inf) if is_f else _I32_MAX
+    acc_np, sq_np, lo, hi = acc_dtypes(agg_dt)
 
     @jax.jit
     def run(pages_u8, *params):
@@ -127,29 +120,32 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
                          axis=-1)                       # (N, V)
         count = jnp.sum(onehot, axis=0)                 # (G,)
         flat_sel = sel.reshape(-1)
-        if is_f:
-            # per-group scatter sum, NOT the matmul: 0*NaN = NaN, so one
-            # selected NaN row would poison EVERY group's sum through the
-            # contraction — segment_sum confines it to its own group,
-            # matching the pallas twin's per-group masking
-            sums = jnp.stack([
-                jax.ops.segment_sum(jnp.where(flat_sel, v, 0.0), flat_keys,
-                                    num_segments=G + 1)[:G]
-                for v in vals.T])
-        else:
+        if agg_dt.kind == "i":
             # the MXU path: (N,G)x(N,V)->(G,V) integer contraction.  Exact
             # per batch within int32; under x64 the accumulator (and the
             # cross-batch fold) widens to int64, matching scan_filter_step's
             # convention — without x64, sums past 2^31 wrap (as any int32
             # engine would)
-            acc_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
             sums = jax.lax.dot_general(
                 onehot, vals, (((0,), (0,)), ((), ())),
-                preferred_element_type=acc_t).T         # (V, G)
+                preferred_element_type=jnp.dtype(acc_np)).T   # (V, G)
+        else:
+            # per-group scatter sum, NOT the matmul.  float: 0*NaN = NaN,
+            # so one selected NaN row would poison EVERY group's sum
+            # through the contraction — segment_sum confines it to its own
+            # group, matching the pallas twin's per-group masking.  uint:
+            # keeps the modular uint32 (u64 under x64) accumulation exact
+            # without relying on unsigned dot support
+            zero = agg_dt.type(0)
+            sums = jnp.stack([
+                jax.ops.segment_sum(
+                    jnp.where(flat_sel, v, zero).astype(jnp.dtype(acc_np)),
+                    flat_keys, num_segments=G + 1)[:G]
+                for v in vals.T])
         # sum of squares for VAR/STDDEV: always floating (int32 squares
         # wrap far earlier than sums; f64 under x64, else f32) and
         # per-group confined like the float sums (NaN stays in its group)
-        sq_t = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        sq_t = jnp.dtype(sq_np)
         sumsqs = jnp.stack([
             jax.ops.segment_sum(
                 jnp.where(flat_sel, v.astype(sq_t) * v.astype(sq_t), 0.0),

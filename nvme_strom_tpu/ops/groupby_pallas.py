@@ -13,13 +13,24 @@ Group reduction inside the kernel is a **statically unrolled per-group
 masked reduction** over the 2-D ``(pages, tuples)`` block: Mosaic does not
 lower the flatten an ``(N, G)`` one-hot needs, and its int32 matmul
 support is narrower than XLA's — so the MXU contraction stays the XLA
-path's specialty (use it for large ``G``), while this kernel's worth is
-the fused single pass at small group counts (``G`` ≲ 64; compile time and
-SMEM both scale with ``G·V``).
+path's specialty, while this kernel's worth is the fused single pass at
+small group counts.
 
-Contract-identical to :func:`.groupby.make_groupby_fn` (int32 agg columns,
-same refusal for typed columns), so the two are differentially testable.
-On non-TPU backends the kernel runs in interpreter mode.
+**Large-``G`` strategy (why the planner caps pallas at G <= 64,
+``scan/query._PALLAS_MAX_GROUPS``):** the unroll emits ``O(G·V)`` scalar
+SMEM updates per block, so both compile time and SMEM footprint scale
+linearly with ``G·V``.  Tiling the unroll (grid over 64-group blocks)
+would fix SMEM but re-stream every page ``G/64`` times from HBM — strictly
+worse than the XLA one-hot contraction, whose MXU matmul amortizes all
+``G`` groups in one pass over the data.  Above the cap the XLA path is
+therefore the *designed* answer, not a fallback; EXPLAIN reports the
+routing and reason.
+
+Contract-identical to :func:`.groupby.make_groupby_fn` (int32 / uint32 /
+float32 agg columns, accumulator dtypes and min/max sentinels all derived
+from :func:`.groupby.acc_dtypes` — THE shared accumulation convention),
+so the two are differentially testable.  On non-TPU backends the kernel
+runs in interpreter mode.
 """
 
 from __future__ import annotations
@@ -39,8 +50,6 @@ from .filter_pallas import _BLOCK_PAGES, _decode_block, _pad_pages, \
 __all__ = ["make_groupby_fn_pallas"]
 
 _WORDS = PAGE_SIZE // 4
-_I32_MIN = np.int32(-(1 << 31))
-_I32_MAX = np.int32((1 << 31) - 1)
 
 
 def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
@@ -55,19 +64,23 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
     (out-of-range ids fall into no group); scalar ``*params`` are staged
     through SMEM as int32.  Returns per group: ``count (G,)`` and
     ``sums / mins / maxs`` of shape ``(len(agg_cols), G)``.  Aggregation
-    columns share one dtype, int32 or float32 (same contract as the XLA
-    twin)."""
-    from .groupby import _check_agg_cols
+    columns share one dtype — int32, uint32, or float32 (same contract as
+    the XLA twin; accumulator/sentinel dtypes from ``acc_dtypes``)."""
+    from .groupby import _check_agg_cols, acc_dtypes
     cols_idx, agg_dt = _check_agg_cols(schema, agg_cols)
     G = int(n_groups)
     V = len(cols_idx)
-    is_f = agg_dt.kind == "f"
-    acc_t = jnp.float32 if is_f else jnp.int32
+    # THE accumulation convention (groupby.acc_dtypes): sum accumulator,
+    # sumsq dtype, and min/max sentinels — derived, not hard-coded, so the
+    # pallas and XLA paths cannot drift (x64 included).
+    acc_np, sq_np, lo, hi = acc_dtypes(agg_dt)
+    acc_t = jnp.dtype(acc_np)
+    sq_t = jnp.dtype(sq_np)
+    col_t = jnp.dtype(agg_dt)
     # np scalars, not jnp: traced values would be captured constants
     # inside the pallas kernel closure
-    zero = np.float32(0.0) if is_f else np.int32(0)
-    lo = np.float32(-np.inf) if is_f else _I32_MIN
-    hi = np.float32(np.inf) if is_f else _I32_MAX
+    zero = acc_np.type(0)
+    sq_zero = sq_np.type(0)
 
     def make_kernel(n_params: int):
       def kernel(params_ref, w_ref, count_ref, sums_ref, sumsqs_ref,
@@ -80,7 +93,7 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                 count_ref[0, g] = 0
                 for vi in range(V):
                     sums_ref[vi, g] = zero
-                    sumsqs_ref[vi, g] = 0.0
+                    sumsqs_ref[vi, g] = sq_zero
                     mins_ref[vi, g] = hi
                     maxs_ref[vi, g] = lo
 
@@ -97,11 +110,12 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             count_ref[0, g] += jnp.sum(m.astype(jnp.int32))
             for vi, ci in enumerate(cols_idx):
                 v = cols[ci]
-                vf = v.astype(jnp.float32)
-                sums_ref[vi, g] += jnp.sum(jnp.where(m, v, zero))
-                # float accumulator (shared sumsqs contract: int32
+                vf = v.astype(sq_t)
+                sums_ref[vi, g] += jnp.sum(
+                    jnp.where(m, v, agg_dt.type(0)).astype(acc_t))
+                # floating accumulator (shared sumsqs contract: int32
                 # squares would wrap far earlier than the sums do)
-                sumsqs_ref[vi, g] += jnp.sum(jnp.where(m, vf * vf, 0.0))
+                sumsqs_ref[vi, g] += jnp.sum(jnp.where(m, vf * vf, sq_zero))
                 mins_ref[vi, g] = jnp.minimum(
                     mins_ref[vi, g], jnp.min(jnp.where(m, v, hi)))
                 maxs_ref[vi, g] = jnp.maximum(
@@ -133,9 +147,9 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             out_shape=[
                 jax.ShapeDtypeStruct((1, G), jnp.int32),
                 jax.ShapeDtypeStruct((V, G), acc_t),
-                jax.ShapeDtypeStruct((V, G), jnp.float32),
-                jax.ShapeDtypeStruct((V, G), acc_t),
-                jax.ShapeDtypeStruct((V, G), acc_t),
+                jax.ShapeDtypeStruct((V, G), sq_t),
+                jax.ShapeDtypeStruct((V, G), col_t),
+                jax.ShapeDtypeStruct((V, G), col_t),
             ],
             interpret=_should_interpret() if interpret is None else interpret,
         )(pvec, words)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 import os
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
@@ -232,9 +233,12 @@ class Query:
         # predicate agree (and never overflow):
         #  - float column: bounds cast to the column dtype (the seqscan's
         #    weak-typing would compare at float32, so the index must too)
-        #  - int column: fractional in-range bounds stay raw (7.5 means
-        #    ">= 8" / "<= 7" on both paths); bounds beyond the dtype's
-        #    range clamp to open / empty instead of wrapping or raising
+        #  - int column: fractional bounds tighten to the nearest integer
+        #    (7.5 means ">= 8" / "<= 7") as exact dt scalars, so the
+        #    seqscan (float32 weak typing) and the index searchsorted
+        #    (float64) can never disagree at magnitudes > 2^24; bounds
+        #    beyond the dtype's range clamp to open / empty instead of
+        #    wrapping or raising
         never = False
         if dt.kind == "f":
             nlo = None if lo is None else dt.type(float(lo))
@@ -245,13 +249,17 @@ class Query:
             if lo is not None:
                 if float(lo) > info.max:
                     never = True           # nothing can be >= lo
-                elif float(lo) > info.min:
-                    nlo = dt.type(int(lo)) if float(lo) == int(lo) else lo
+                else:
+                    ilo = int(math.ceil(float(lo)))
+                    if ilo > info.min:
+                        nlo = dt.type(min(ilo, info.max))
             if hi is not None and not never:
                 if float(hi) < info.min:
                     never = True           # nothing can be <= hi
-                elif float(hi) < info.max:
-                    nhi = dt.type(int(hi)) if float(hi) == int(hi) else hi
+                else:
+                    ihi = int(math.floor(float(hi)))
+                    if ihi < info.max:
+                        nhi = dt.type(max(ihi, info.min))
         if never:
             # an empty range encodes "never": lo > hi on both paths
             nlo, nhi = dt.type(1), dt.type(0)
@@ -488,6 +496,11 @@ class Query:
                           "be pure overhead"
         if self._op == "group_by":
             _, g, agg, _hv = self._group
+            if jax.config.jax_enable_x64:
+                # acc_dtypes widens sums/sumsqs to i64/f64 under x64 —
+                # dtypes Mosaic cannot hold in SMEM on real hardware
+                return "xla", "x64 accumulators (i64/f64) exceed the " \
+                              "pallas kernel's SMEM dtype support"
             if on_tpu and g <= _PALLAS_MAX_GROUPS:
                 return "pallas", f"G={g} within the static-unroll bound " \
                                  f"({_PALLAS_MAX_GROUPS})"
@@ -1002,14 +1015,36 @@ class Query:
         if cols is None:
             cols = list(range(self.schema.n_cols))
         pos = self._index_positions(idx)
-        end = None if limit is None else offset + limit
-        pos = pos[offset:end]
-        out = self.fetch(pos, cols=cols, session=session, device=device)
         # index rows were valid at build time and the table is stamped
-        # unchanged; keep the defensive mask anyway
-        keep = out.pop("valid")
-        res = {f"col{c}": out[f"col{c}"][keep] for c in cols}
-        res["positions"] = pos[keep]
+        # unchanged; keep the defensive mask anyway — applied BEFORE the
+        # offset/limit window, matching the seqscan's filter-then-slice
+        # ordering (_collect_rows), so a hypothetical invalid row can only
+        # shrink the candidate set, never shift the window.  The early
+        # cut-off limit promises is preserved by fetching in batches and
+        # stopping once offset+limit VALID rows are in hand (the batched
+        # fetch-with-early-stop discipline, not fetch-everything).
+        need = None if limit is None else offset + limit
+        got_cols: dict = {f"col{c}": [] for c in cols}
+        got_pos: list = []
+        n_valid = 0
+        step = max(1, len(pos)) if need is None else max(need, 1024)
+        for b0 in range(0, len(pos), step):
+            batch = pos[b0:b0 + step]
+            out = self.fetch(batch, cols=cols, session=session,
+                             device=device)
+            keep = out.pop("valid")
+            for c in cols:
+                got_cols[f"col{c}"].append(out[f"col{c}"][keep])
+            got_pos.append(batch[keep])
+            n_valid += int(keep.sum())
+            if need is not None and n_valid >= need:
+                break
+        end = None if limit is None else offset + limit
+        res = {k: np.concatenate(v)[offset:end] if v else
+               np.zeros(0, self.schema.col_dtype(int(k[3:])))
+               for k, v in got_cols.items()}
+        res["positions"] = (np.concatenate(got_pos)[offset:end]
+                            if got_pos else np.zeros(0, np.int64))
         res["count"] = np.int64(len(res["positions"]))
         return res
 

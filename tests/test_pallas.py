@@ -237,12 +237,77 @@ def test_float_groupby_both_paths_match_oracle():
     assert np.isfinite(out2["sums"]).all()
 
 
-def test_groupby_uint32_and_empty_agg_refused():
+def test_uint32_groupby_both_paths_match_oracle():
+    """uint32 aggregation columns GROUP BY: pallas == XLA == numpy, with
+    modular uint32 sums (values near 2^32 exercise the wrap) and
+    0 / UINT32_MAX sentinels for empty groups."""
+    from nvme_strom_tpu.ops.groupby import make_groupby_fn
+    from nvme_strom_tpu.ops.groupby_pallas import make_groupby_fn_pallas
+
+    rng = np.random.default_rng(53)
+    schema = HeapSchema(n_cols=2, visibility=True,
+                        dtypes=("uint32", "int32"))
+    n = schema.tuples_per_page * 5 + 7
+    big = rng.integers(1 << 30, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+    cat = rng.integers(-1, 9, n).astype(np.int32)
+    vis = (rng.random(n) > 0.2).astype(np.int32)
+    pages = build_pages([big, cat], schema, visibility=vis)
+    G = 8
+
+    key = lambda cols: cols[1]
+    outs = []
+    for make in (make_groupby_fn, make_groupby_fn_pallas):
+        run = make(schema, key, G, agg_cols=[0])
+        out = {k: np.asarray(v) for k, v in run(pages).items()}
+        assert out["sums"].dtype == np.uint32
+        assert out["mins"].dtype == np.uint32
+        sel = (vis != 0) & (cat >= 0) & (cat < G)
+        for g in range(G):
+            m = sel & (cat == g)
+            assert out["count"][g] == int(m.sum())
+            # modular uint32 accumulation, the documented convention
+            assert out["sums"][0][g] == np.uint32(
+                big[m].sum(dtype=np.uint64) & 0xFFFFFFFF)
+            if m.any():
+                assert out["mins"][0][g] == big[m].min()
+                assert out["maxs"][0][g] == big[m].max()
+            else:
+                assert out["mins"][0][g] == np.uint32(0xFFFFFFFF)
+                assert out["maxs"][0][g] == np.uint32(0)
+        outs.append(out)
+    for k in ("count", "sums", "mins", "maxs"):
+        np.testing.assert_array_equal(outs[0][k], outs[1][k], err_msg=k)
+    # f32 sumsqs: the two paths reduce in different orders
+    np.testing.assert_allclose(outs[0]["sumsqs"], outs[1]["sumsqs"],
+                               rtol=1e-6)
+
+
+def test_groupby_sumsqs_dtype_follows_x64_on_both_paths():
+    """acc_dtypes is THE accumulation convention: under x64 the sumsqs
+    accumulator is f64 on the pallas path too (it used to pin f32 and
+    drift from XLA — ADVICE r2)."""
+    import jax
+
+    from nvme_strom_tpu.ops.groupby import make_groupby_fn
+    from nvme_strom_tpu.ops.groupby_pallas import make_groupby_fn_pallas
+
+    schema = HeapSchema(n_cols=1, visibility=False)
+    vals = np.arange(100, dtype=np.int32)
+    pages = build_pages([vals], schema)
+    key = lambda cols: cols[0] % 4
+    jax.config.update("jax_enable_x64", True)
+    try:
+        for make in (make_groupby_fn, make_groupby_fn_pallas):
+            out = make(schema, key, 4)(pages)
+            assert np.asarray(out["sumsqs"]).dtype == np.float64
+            assert np.asarray(out["sums"]).dtype == np.int64
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_groupby_empty_agg_refused():
     from nvme_strom_tpu.ops.groupby import make_groupby_fn
 
-    schema = HeapSchema(n_cols=1, visibility=False, dtypes=("uint32",))
-    with pytest.raises(ValueError):
-        make_groupby_fn(schema, lambda cols: cols[0], 4)
     schema2 = HeapSchema(n_cols=1, visibility=False)
     with pytest.raises(ValueError):
         make_groupby_fn(schema2, lambda cols: cols[0], 4, agg_cols=[])
