@@ -52,6 +52,9 @@ enum {
   NSTPU_CTR_NR_WRITE_DMA,       /* write requests submitted (RAM2SSD leg) */
   NSTPU_CTR_TOTAL_WRITE_LENGTH, /* bytes submitted as writes */
   NSTPU_CTR_NR_FIXED_DMA,       /* requests that rode a registered buffer */
+  NSTPU_CTR_NR_ENTER_DMA,       /* io_uring_enter submit syscalls (batched:
+                                 * one covers a whole task's SQE batch, so
+                                 * nr_enter_dma / nr_submit_dma ~ 1/N) */
   NSTPU_CTR__COUNT
 };
 

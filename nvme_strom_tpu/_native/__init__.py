@@ -39,6 +39,7 @@ NATIVE_COUNTERS = (
     "nr_write_dma",
     "total_write_length",
     "nr_fixed_dma",
+    "nr_enter_dma",
 )
 
 REQ_WRITE = 0x1        # NSTPU_REQ_WRITE

@@ -148,6 +148,12 @@ class Config:
                 help="io_uring submission queue depth / outstanding requests"))
         reg(Var("staging_buffers", 3, "int", minval=2, maxval=16,
                 help="pinned host staging buffers for the SSD->HBM pipeline (triple-buffered default)"))
+        reg(Var("join_broadcast_max", 64 << 20, "size", minval=1 << 10,
+                help="largest build side (keys+values bytes) the join "
+                     "replicates to every device; above it the planner "
+                     "switches to the partitioned hash join (hash-"
+                     "repartition both sides, local sorted-probe per "
+                     "partition) instead of OOMing the broadcast"))
         reg(Var("pin_memory", False, "bool",
                 help="mlock/hugepage-back staging buffers; right for bare-metal "
                      "PCIe DMA, but measurably slows both the O_DIRECT fill and "

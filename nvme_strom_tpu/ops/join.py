@@ -27,7 +27,35 @@ import numpy as np
 from ..scan.heap import HeapSchema
 from .filter_xla import DEFAULT_SCHEMA, decode_pages
 
-__all__ = ["make_join_fn", "make_join_rows_fn"]
+__all__ = ["make_join_fn", "make_join_rows_fn", "key_hash32",
+           "hash_split_build"]
+
+# Knuth multiplicative constant: scrambles int32 keys so hash % P spreads
+# adjacent/striped key spaces evenly across partitions
+_KNUTH = np.uint32(2654435761)
+
+
+def key_hash32(k):
+    """Order-scrambling uint32 hash of int32 keys — same expression for
+    host numpy (build split) and traced jnp (fact-side routing), so both
+    sides of the partitioned join agree on ownership."""
+    if isinstance(k, np.ndarray) or np.isscalar(k):
+        return (np.asarray(k).astype(np.uint32, casting="unsafe")
+                * _KNUTH)
+    return k.astype(jnp.uint32) * _KNUTH
+
+
+def hash_split_build(build_keys, build_values, n_parts: int):
+    """Host-side hash partitioning of the build table: returns a list of
+    ``(keys, vals)`` per partition.  Every key lands in exactly one
+    partition, so per-partition join results ADD to the broadcast
+    answer — the degrade-instead-of-OOM path for build sides above
+    ``config join_broadcast_max`` (Grace-style multi-pass locally, one
+    partition per device over a mesh)."""
+    bk = np.asarray(build_keys, np.int32)
+    bv = np.asarray(build_values, np.int32)
+    part = (key_hash32(bk) % np.uint32(n_parts)).astype(np.int64)
+    return [(bk[part == p], bv[part == p]) for p in range(n_parts)]
 
 
 def make_join_fn(schema: HeapSchema, probe_col: int,

@@ -22,6 +22,16 @@ Header json: ``{version, col, dtype, count, table_size, table_mtime_ns}``.
 ``table_size``/``table_mtime_ns`` let :func:`open_index` detect a stale
 index after the table changed (the syscache-invalidation analog,
 `pgsql/nvme_strom.c:217-348`).
+
+**Composite keys**: ``col`` may be a pair ``(c0, c1)`` of integer (int32 /
+uint32) columns.  The sidecar then stores one ``uint64`` key per row —
+the two values packed **lexicographically order-preservingly** (each
+mapped to uint32 by an order-preserving bias, then ``c0`` in the high
+word) — so equality on the pair is a single searchsorted probe, exactly
+like the single-column case.  Float columns are refused (IEEE bits do
+not pack order-preservingly without sign-flip tricks; build one index
+per float column instead).  The header gains ``key_dtypes`` recording
+the pair's column dtypes.
 """
 
 from __future__ import annotations
@@ -37,7 +47,8 @@ import numpy as np
 
 from ..api import StromError
 
-__all__ = ["build_index", "open_index", "probe_index", "SortedIndex"]
+__all__ = ["build_index", "open_index", "probe_index", "SortedIndex",
+           "pack_pair", "index_path_for"]
 
 _MAGIC = 0x53545258_49445831  # "STRX" "IDX1"
 _VERSION = 1
@@ -49,7 +60,31 @@ def _table_stamp(path: str) -> Tuple[int, int]:
     return int(st.st_size), int(st.st_mtime_ns)
 
 
-def build_index(table_path: str, schema, col: int, *,
+def _to_u32_order(a: np.ndarray, dt: np.dtype) -> np.ndarray:
+    """Order-preserving map of a 4-byte integer column onto uint64 in
+    [0, 2^32): int32 biases by +2^31, uint32 passes through."""
+    if dt == np.dtype(np.int32):
+        return (a.astype(np.int64) + (1 << 31)).astype(np.uint64)
+    return a.astype(np.uint64)
+
+
+def pack_pair(a0, a1, dt0: np.dtype, dt1: np.dtype) -> np.ndarray:
+    """Lexicographic uint64 packing of an integer column pair: compares
+    like ``(a0, a1)`` tuple order.  Arrays or scalars."""
+    u0 = _to_u32_order(np.asarray(a0), np.dtype(dt0))
+    u1 = _to_u32_order(np.asarray(a1), np.dtype(dt1))
+    return (u0 << np.uint64(32)) | u1
+
+
+def index_path_for(table_path: str, col) -> str:
+    """Default sidecar path: ``.idx{c}`` single, ``.idx{c0}_{c1}``
+    composite."""
+    if isinstance(col, (tuple, list)):
+        return f"{table_path}.idx{int(col[0])}_{int(col[1])}"
+    return f"{table_path}.idx{int(col)}"
+
+
+def build_index(table_path: str, schema, col, *,
                 index_path: Optional[str] = None,
                 session=None, device=None, mesh=None) -> str:
     """One scan of the table -> a sorted (key, position) sidecar.
@@ -58,28 +93,59 @@ def build_index(table_path: str, schema, col: int, *,
     keys are excluded (they compare unordered; SQL indexes skip NULLs the
     same way).  With *mesh*, the sort runs as the distributed sample
     sort over the device mesh — index builds over large tables scale
-    the same way ORDER BY does."""
+    the same way ORDER BY does.
+
+    *col* may be a pair ``(c0, c1)`` of integer columns: the sidecar then
+    holds lexicographically packed uint64 keys (module docstring), built
+    from one projection scan + a stable host argsort."""
     from .query import Query
 
     # stamp BEFORE the scan: a table modified mid-build then mismatches
     # the stamp and open_index fails stale (stamping after would bless an
     # index holding pre-modification data)
     size, mtime = _table_stamp(table_path)
-    q = Query(table_path, schema).order_by(col)
-    out = q.run(session=session, device=device, mesh=mesh)
-    keys = np.asarray(out["values"])
-    poss = np.asarray(out["positions"], np.int64)
-    if keys.dtype.kind == "f":
-        finite = ~np.isnan(keys)
-        keys, poss = keys[finite], poss[finite]
+    key_dtypes = None
+    if isinstance(col, (tuple, list)):
+        if len(col) != 2:
+            raise StromError(_errno.EINVAL,
+                            "composite index keys are column PAIRS")
+        c0, c1 = int(col[0]), int(col[1])
+        dt0, dt1 = schema.col_dtype(c0), schema.col_dtype(c1)
+        for c, dt in ((c0, dt0), (c1, dt1)):
+            if dt.kind not in "iu":
+                raise StromError(
+                    _errno.EINVAL,
+                    f"composite index col{c} is {dt}: only integer "
+                    f"columns pack order-preservingly (build a single-"
+                    f"column index for float keys)")
+        out = Query(table_path, schema).select([c0, c1]).run(
+            session=session, device=device)
+        packed = pack_pair(out[f"col{c0}"], out[f"col{c1}"], dt0, dt1)
+        # stable: duplicates keep build (physical) order, same contract
+        # as the single-column sort path
+        order = np.argsort(packed, kind="stable")
+        keys = packed[order]
+        poss = np.asarray(out["positions"], np.int64)[order]
+        col_field = [c0, c1]
+        key_dtypes = [dt0.str, dt1.str]
+    else:
+        q = Query(table_path, schema).order_by(col)
+        out = q.run(session=session, device=device, mesh=mesh)
+        keys = np.asarray(out["values"])
+        poss = np.asarray(out["positions"], np.int64)
+        if keys.dtype.kind == "f":
+            finite = ~np.isnan(keys)
+            keys, poss = keys[finite], poss[finite]
+        col_field = int(col)
     header = json.dumps({
-        "version": _VERSION, "col": int(col), "dtype": keys.dtype.str,
+        "version": _VERSION, "col": col_field, "dtype": keys.dtype.str,
         "count": int(len(keys)),
         "table_size": size,
         "table_mtime_ns": mtime,
+        **({"key_dtypes": key_dtypes} if key_dtypes else {}),
     }).encode()
     hlen = (16 + len(header) + _ALIGN - 1) // _ALIGN * _ALIGN
-    path = index_path or f"{table_path}.idx{col}"
+    path = index_path or index_path_for(table_path, col)
     tmp = path + ".tmp"
     try:
         with open(tmp, "wb") as f:
@@ -105,22 +171,51 @@ class SortedIndex:
     """An opened sidecar: dense sorted keys + row positions."""
 
     path: str
-    col: int
+    col: object             # int, or (c0, c1) tuple for composite keys
     keys: np.ndarray        # sorted, ascending
     positions: np.ndarray   # int64 global row positions, aligned to keys
+    key_dtypes: Optional[Tuple[np.dtype, np.dtype]] = None  # composite only
+
+    @property
+    def composite(self) -> bool:
+        return self.key_dtypes is not None
+
+    def _pack_probes(self, values) -> np.ndarray:
+        """(v0, v1) probe pairs -> packed uint64 keys; pairs with a value
+        the column dtype cannot represent exactly match nothing."""
+        dt0, dt1 = self.key_dtypes
+        out = []
+        for pair in values:
+            v0, v1 = pair
+            ok = True
+            for v, dt in ((v0, dt0), (v1, dt1)):
+                f = float(v)
+                info = np.iinfo(dt)
+                if f != int(f) or not info.min <= int(v) <= info.max:
+                    ok = False
+            if ok:
+                out.append(int(pack_pair(dt0.type(int(v0)),
+                                         dt1.type(int(v1)), dt0, dt1)))
+        return np.asarray(out, np.uint64)
 
     def lookup(self, values) -> np.ndarray:
         """Row positions of rows whose key equals any of *values*
         (duplicates in the table all match; order: ascending key, then
         index order within equal keys).  A probe the key dtype cannot
         represent exactly (e.g. 7.5 against int32 keys) matches nothing
-        — SQL equality semantics, not silent truncation."""
-        raw = np.asarray(values).reshape(-1)
-        vals = raw.astype(self.keys.dtype)
-        exact = vals.astype(raw.dtype) == raw if raw.dtype != vals.dtype \
-            else np.ones(len(raw), bool)
+        — SQL equality semantics, not silent truncation.
+
+        Composite index: *values* is a sequence of ``(v0, v1)`` pairs."""
+        if self.composite:
+            vals = self._pack_probes(values)
+        else:
+            raw = np.asarray(values).reshape(-1)
+            vals = raw.astype(self.keys.dtype)
+            exact = vals.astype(raw.dtype) == raw \
+                if raw.dtype != vals.dtype else np.ones(len(raw), bool)
+            vals = vals[exact]
         parts = []
-        for v in vals[exact]:
+        for v in vals:
             lo = int(np.searchsorted(self.keys, v, side="left"))
             hi = int(np.searchsorted(self.keys, v, side="right"))
             if hi > lo:
@@ -204,5 +299,10 @@ def open_index(index_path: str, *, table_path: Optional[str] = None,
         poss = np.frombuffer(f.read(n * 8), np.int64)
     if len(keys) != n or len(poss) != n:
         raise StromError(_errno.EIO, f"{index_path}: truncated index")
-    return SortedIndex(path=index_path, col=meta["col"],
-                       keys=keys, positions=poss)
+    col = meta["col"]
+    kdts = meta.get("key_dtypes")
+    return SortedIndex(path=index_path,
+                       col=tuple(col) if isinstance(col, list) else col,
+                       keys=keys, positions=poss,
+                       key_dtypes=(np.dtype(kdts[0]), np.dtype(kdts[1]))
+                       if kdts else None)

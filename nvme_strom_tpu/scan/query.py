@@ -81,6 +81,7 @@ class QueryPlan:
     cost_direct: float
     cost_vfs: float
     reason: str
+    join_strategy: Optional[str] = None  # broadcast | partitioned(N)
 
     def __str__(self) -> str:
         return (f"{self.operator} scan  [{self.access_path} path, "
@@ -150,7 +151,31 @@ class Query:
         access paths agree: a float literal against a float32 column
         compares as float32 (``0.1`` matches stored ``float32(0.1)``),
         and a non-integral literal against an integer column matches
-        nothing — on the seqscan AND the index."""
+        nothing — on the seqscan AND the index.
+
+        **Composite equality**: *col* may be a pair ``(c0, c1)`` with
+        *value* a matching pair ``(v0, v1)`` — SQL's
+        ``c0 = v0 AND c1 = v1``.  With a fresh composite sidecar
+        (``build_index(..., (c0, c1))``) the pair resolves in ONE packed-
+        key probe; otherwise it seqscans with the conjunction."""
+        if isinstance(col, (tuple, list)):
+            if len(col) != 2 or not isinstance(value, (tuple, list)) \
+                    or len(value) != 2:
+                raise StromError(22, "composite where_eq takes a column "
+                                     "PAIR and a value PAIR")
+            c0, c1 = int(col[0]), int(col[1])
+            for c in (c0, c1):
+                if not 0 <= c < self.schema.n_cols:
+                    raise StromError(22, f"where_eq column {c} out of range")
+            v0 = self._representable(self.schema.col_dtype(c0), value[0])
+            v1 = self._representable(self.schema.col_dtype(c1), value[1])
+            if v0 is None or v1 is None:
+                self._pred = lambda cols: cols[c0] != cols[c0]
+                self._set_structured(eq=((c0, c1), None))  # index: empty
+            else:
+                self._pred = lambda cols: (cols[c0] == v0) & (cols[c1] == v1)
+                self._set_structured(eq=((c0, c1), (v0, v1)))
+            return self
         if not 0 <= col < self.schema.n_cols:
             raise StromError(22, f"where_eq column {col} out of range")
         dt = self.schema.col_dtype(col)
@@ -513,6 +538,22 @@ class Query:
                            else "single-device lax sort")
         return "xla", f"{self._op} runs on lax.top_k/searchsorted (XLA)"
 
+    def _join_strategy(self) -> Optional[tuple]:
+        """(strategy, n_parts) for a join terminal: "broadcast" while the
+        build side (keys+values bytes) fits ``config join_broadcast_max``
+        per device; above it, "partitioned" with the part count that
+        bounds resident build memory to the cap — hash-repartition both
+        sides, sorted-probe per partition, degrade instead of OOM."""
+        if self._join is None:
+            return None
+        from ..config import config
+        bk, bv = self._join[1], self._join[2]
+        nbytes = (np.asarray(bk).nbytes + np.asarray(bv).nbytes)
+        cap = int(config.get("join_broadcast_max"))
+        if nbytes <= cap:
+            return ("broadcast", 1)
+        return ("partitioned", max(2, -(-nbytes // cap)))
+
     def _index_col(self) -> Optional[int]:
         """The column a structured (eq/range/in) filter targets."""
         for f in (self._eq, self._range, self._in):
@@ -524,7 +565,8 @@ class Query:
         col = self._index_col()
         if col is None or not isinstance(self.source, str):
             return None
-        return f"{self.source}.idx{col}"
+        from .index import index_path_for
+        return index_path_for(self.source, col)
 
     def _index_fresh_for_eq(self) -> bool:
         """Header-only planner probe (no key/position load — EXPLAIN
@@ -549,6 +591,27 @@ class Query:
             return None
 
     def explain(self, *, mesh=None) -> QueryPlan:
+        plan = self._explain_inner(mesh=mesh)
+        js = self._join_strategy()
+        if js is not None:
+            strat, n_parts = js
+            label = "broadcast" if strat == "broadcast" else \
+                f"partitioned({n_parts})"
+            how = ("build side replicated per device"
+                   if strat == "broadcast" else
+                   (f"build side above join_broadcast_max: hash-"
+                    f"repartitioned over the mesh dp axis, all_to_all "
+                    f"row exchange, local sorted-probe"
+                    if mesh is not None else
+                    f"build side above join_broadcast_max: {n_parts} "
+                    f"hash partitions probed as sequential passes "
+                    f"(Grace join), resident build bounded to the cap"))
+            plan = dataclasses.replace(
+                plan, join_strategy=label,
+                reason=plan.reason + f"; join strategy {label}: {how}")
+        return plan
+
+    def _explain_inner(self, *, mesh=None) -> QueryPlan:
         path, size = self._source_facts()
         n_pages = size // PAGE_SIZE
         t = self.schema.tuples_per_page
@@ -726,8 +789,14 @@ class Query:
                     path, table_size=size) else "vfs")
         if self._op == "select":
             return self._run_select(plan, device, session)
-        if self._op == "join" and self._join[3]:   # materialize=True
-            return self._run_join_rows(plan, device, session)
+        if self._op == "join":
+            js = self._join_strategy()
+            if js is not None and js[0] == "partitioned":
+                return self._run_join_partitioned(plan, mesh, device,
+                                                  session, js[1],
+                                                  batch_pages)
+            if self._join[3]:   # materialize=True
+                return self._run_join_rows(plan, device, session)
         if self._op == "order_by":
             return self._run_order_by(plan, mesh, device, session)
         if self._op == "count_distinct":
@@ -1055,6 +1124,8 @@ class Query:
             # 7.5 against an int column) — the seqscan's empty answer
             if self._eq[1] is None:
                 return np.zeros(0, np.int64)
+            # composite pair and single value both arrive as ONE probe;
+            # SortedIndex.lookup handles the packing when composite
             return idx.lookup([self._eq[1]])
         if self._in is not None:
             return idx.lookup(self._in[1])
@@ -1269,6 +1340,117 @@ class Query:
             device, session, limit=limit, offset=offset)
         return {"positions": poss, "keys": keyv, "payload": payl,
                 "count": np.int64(len(poss))}
+
+    def _run_join_partitioned(self, plan: QueryPlan, mesh, device,
+                              session, n_parts: int,
+                              batch_pages: Optional[int] = None) -> dict:
+        """Partitioned hash join — the build side is too large to
+        broadcast (EXPLAIN's ``join_strategy``).
+
+        Mesh: one scan; the build lives hash-sharded 1/dp per device and
+        every batch all_to_all-routes rows to their key's owner
+        (:mod:`..parallel.pjoin`).  Local: Grace-style sequential passes,
+        one hash partition of the build resident at a time (n_parts
+        scans, build memory bounded by ``join_broadcast_max``).  Results
+        add across partitions because every build key lives in exactly
+        one.  Materialized row order is per-partition arrival order —
+        unspecified, like SQL without ORDER BY; parity with broadcast is
+        set-equality."""
+        probe_col, bk, bv, materialize, limit, offset = self._join
+        pred = self._pred
+        from .executor import fold_results
+        if mesh is not None and not materialize:
+            import jax
+
+            from ..parallel.pjoin import make_partitioned_join_step
+            from ..parallel.stream import distributed_scan_filter
+            step = make_partitioned_join_step(
+                mesh, self.schema, probe_col, bk, bv,
+                predicate=(lambda cols: pred(cols)) if pred else None)
+            src, own = self._open_owned()
+            try:
+                n_shards = mesh.shape["dp"]
+                n_pages = src.size // PAGE_SIZE
+                # same batch-size rounding discipline as run()'s generic
+                # mesh path, caller's batch_pages honored
+                bp = batch_pages or max(
+                    n_shards, (1 << 20) // PAGE_SIZE * n_shards)
+                bp = max(bp // n_shards * n_shards, n_shards)
+                bp = min(bp, n_pages // n_shards * n_shards)
+                acc = None
+                covered = 0
+                if bp >= n_shards:
+                    out = distributed_scan_filter(src, mesh, step,
+                                                  batch_pages=bp,
+                                                  session=session)
+                    if out:
+                        acc = out
+                    covered = (n_pages // bp) * bp
+                # tail: batched like the generic path (never one giant
+                # alloc), zero-padded to a dp multiple per batch (zero
+                # pages decode as 0 tuples) so the shard_map'ed step
+                # covers it too
+                tail_batch = max((8 << 20) // PAGE_SIZE, n_shards)
+                for p0 in range(covered, n_pages, tail_batch):
+                    npg = min(tail_batch, n_pages - p0)
+                    raw = bytearray(npg * PAGE_SIZE)
+                    src.read_buffered(p0 * PAGE_SIZE, memoryview(raw))
+                    pages = np.frombuffer(raw, np.uint8).reshape(
+                        -1, PAGE_SIZE)
+                    padn = (-npg) % n_shards
+                    if padn:
+                        pages = np.concatenate(
+                            [pages, np.zeros((padn, PAGE_SIZE), np.uint8)])
+                    acc = fold_results(acc, step(pages), None)
+                return {} if acc is None else \
+                    {k: np.asarray(v) for k, v in acc.items()}
+            finally:
+                if own:
+                    src.close()
+        # local (and any materialize face): Grace sequential passes
+        from ..ops.join import (hash_split_build, make_join_fn,
+                                make_join_rows_fn)
+        parts = hash_split_build(bk, bv, n_parts)
+        if materialize:
+            poss, keyv, payl = [], [], []
+            for pk, pv in parts:
+                run = make_join_rows_fn(
+                    self.schema, probe_col, pk, pv,
+                    predicate=(lambda cols: pred(cols)) if pred else None)
+                p_, k_, y_ = self._collect_rows(
+                    plan, run, "hit", ["positions", "key", "payload"],
+                    [self._pos_dtype(), np.int32, np.int32],
+                    device, session)
+                poss.append(p_)
+                keyv.append(k_)
+                payl.append(y_)
+            end = None if limit is None else offset + limit
+            poss = np.concatenate(poss)[offset:end]
+            keyv = np.concatenate(keyv)[offset:end]
+            payl = np.concatenate(payl)[offset:end]
+            return {"positions": poss, "keys": keyv, "payload": payl,
+                    "count": np.int64(len(poss))}
+        acc = None
+        for pk, pv in parts:
+            run = make_join_fn(
+                self.schema, probe_col, pk, pv,
+                predicate=(lambda cols: pred(cols)) if pred else None)
+            fn = lambda pages, run=run: run(pages)
+            if plan.access_path == "direct":
+                from .executor import TableScanner
+                src, own = self._open_owned()
+                try:
+                    with TableScanner(src, self.schema,
+                                      session=session) as sc:
+                        out = sc.scan_filter(fn, device=device)
+                finally:
+                    if own:
+                        src.close()
+            else:
+                out = self._vfs_scan(fn, None, device)
+            acc = fold_results(acc, out, None)
+        return {} if acc is None else \
+            {k: np.asarray(v) for k, v in acc.items()}
 
     @staticmethod
     def _mesh_sort_loop(mesh, factory, *arrays):
