@@ -35,13 +35,20 @@ def _env(extra=None):
     return env
 
 
-def _run(code: str, extra_env=None) -> float:
-    """Run a python snippet in a subprocess; it must print GBPS=<float>."""
+def _run(code: str, extra_env=None):
+    """Run a python snippet in a subprocess; it must print GBPS=<float>,
+    or SKIP=<reason> for a row whose precondition this runtime lacks
+    (returned as None and left out of the matrix — a silently-degraded
+    measurement must never masquerade as the real one)."""
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, cwd=REPO, env=_env(extra_env), timeout=3600)
     if out.returncode != 0:
         sys.stderr.write(out.stdout + out.stderr)
         raise SystemExit("bench config failed")
+    m = re.search(r"SKIP=(.+)", out.stdout)
+    if m:
+        sys.stderr.write(f"row skipped: {m.group(1).strip()}\n")
+        return None
     m = re.search(r"GBPS=([0-9.]+)", out.stdout)
     if not m:
         sys.stderr.write(out.stdout + out.stderr)
@@ -344,6 +351,44 @@ dt = time.monotonic() - t0
 print(f"GBPS={{size/dt/(1<<30):.3f}}")
 """
 
+_H2D_PINNED = _COMMON + """
+# A/B against h2d_peak (VERDICT r2 #2): the same transfer volume through
+# the two-stage pinned_host path — device_put into the PJRT pinned_host
+# memory space, jitted pinned->device DMA — sourced from the engine's own
+# page-aligned pinned staging buffer, i.e. exactly what the staging
+# pipeline moves.  h2d_pinned_peak ~ h2d_peak means plain device_put
+# already consumes the pinned buffer without an extra staging copy on
+# this runtime (PJRT zero-copy case); h2d_pinned_peak > h2d_peak means
+# the pinned_host space earns its keep and config h2d_path=pinned_host
+# should be the deployed default.
+import jax
+from nvme_strom_tpu import Session, config
+from nvme_strom_tpu.hbm.staging import h2d_transfer, _pinned_shardings
+config.set("h2d_path", "pinned_host")
+dev = jax.devices()[0]
+if _pinned_shardings(dev) is None:
+    # h2d_transfer would fall back to plain device_put and this row would
+    # report an artifact "parity" that never exercised pinned_host
+    print("SKIP=no usable pinned_host memory space on", dev.platform)
+    raise SystemExit(0)
+step = 16 << 20
+with Session() as s:
+    h, buf = s.alloc_dma_buffer(step)
+    host = np.frombuffer(buf.view(), np.uint8)
+    host[:] = np.random.randint(0, 255, step, dtype=np.uint8)
+    d0, f0 = h2d_transfer(host[: 1 << 20], dev)
+    jax.block_until_ready(d0)
+    t0 = time.monotonic()
+    done = 0
+    while done < size:
+        d, f = h2d_transfer(host, dev)
+        jax.block_until_ready(d)
+        done += step
+    dt = time.monotonic() - t0
+    s.unmap_buffer(h); buf.close()
+print(f"GBPS={{size/dt/(1<<30):.3f}}")
+"""
+
 _CKPT = _COMMON + """
 import jax
 from nvme_strom_tpu.data import save_checkpoint, restore_checkpoint
@@ -386,6 +431,8 @@ def main() -> int:
          _RAW.format(size=size, path=base + ".bin"), None),
         ("h2d_peak", "host->HBM device_put (transport ceiling)",
          _H2D.format(size=size), None),
+        ("h2d_pinned_peak", "host->HBM via pinned_host space (A/B)",
+         _H2D_PINNED.format(size=size), None),
         ("ssd2ram_seq", "SSD->pinned RAM, O_DIRECT seq",
          _SSD2RAM.format(size=size, path=base + ".bin"), None),
         ("raw_seq_write", "raw O_DIRECT pwrite (write denominator)",
@@ -450,6 +497,9 @@ def main() -> int:
             time.sleep(cooldown)
         ran += 1
         gbps = _run(code, env)
+        if gbps is None:
+            results.pop(key, None)   # skipped: drop any stale prior row
+            continue
         results[key] = gbps
         print(f"{key:<14} {desc:<34} {gbps:7.3f} GB/s")
     # derived ratios (VERDICT r1 #2): every BASELINE ">=90% of raw" target

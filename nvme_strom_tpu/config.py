@@ -91,6 +91,12 @@ def _check_io_backend(val: str, _cfg: "Config") -> None:
         raise ConfigError(f"io_backend must be auto|io_uring|threadpool|python, got {val!r}")
 
 
+def _check_h2d_path(val: str, _cfg: "Config") -> None:
+    if val not in ("auto", "plain", "pinned_host"):
+        raise ConfigError(f"h2d_path must be auto|plain|pinned_host, "
+                          f"got {val!r}")
+
+
 def _check_buffer_multiple(val: int, cfg: "Config") -> None:
     chunk = cfg.get("chunk_size")
     if chunk and val % chunk:
@@ -148,6 +154,21 @@ class Config:
                 help="io_uring submission queue depth / outstanding requests"))
         reg(Var("staging_buffers", 3, "int", minval=2, maxval=16,
                 help="pinned host staging buffers for the SSD->HBM pipeline (triple-buffered default)"))
+        reg(Var("h2d_depth_max", 4, "int", minval=1, maxval=64,
+                help="ceiling for the ADAPTIVE H2D pipeline depth: the "
+                     "scan executor and checkpoint restore start 2-deep "
+                     "and deepen while the consumer observes itself "
+                     "blocking on transfer readiness, so consumer-tier "
+                     "paths ride H2D bursts the way the mq32 loader does "
+                     "instead of paying a fence per batch"))
+        reg(Var("h2d_path", "auto", "str",
+                help="host->HBM transfer path: 'plain' device_put from "
+                     "the page-aligned pinned staging buffer (PJRT zero-"
+                     "copies when alignment allows), 'pinned_host' two-"
+                     "stage DMA through the PJRT pinned_host memory "
+                     "space, 'auto' picks plain; A/B measured by "
+                     "bench_matrix h2d_pinned_peak vs h2d_peak",
+                validate=_check_h2d_path))
         reg(Var("join_broadcast_max", 64 << 20, "size", minval=1 << 10,
                 help="largest build side (keys+values bytes) the join "
                      "replicates to every device; above it the planner "

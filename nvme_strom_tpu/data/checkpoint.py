@@ -406,17 +406,25 @@ def _leaf_sharding(shardings, key: str):
 
 
 class _PinnedRing:
-    """Two alternating pinned buffers + H2D fencing for checkpoint restore."""
+    """Rotating pinned buffers + H2D fencing for checkpoint restore.
+
+    Width comes from config ``h2d_depth_max`` (min 2): a deeper ring keeps
+    that many H2D reads in flight before the rotation fences the oldest —
+    the same deferred-fence discipline as the scan executor's pipeline
+    (VERDICT r2 #3)."""
 
     def __init__(self, sess: Session, staging_bytes: int):
+        from ..config import config
         self.sess = sess
-        self.bufs = [sess.alloc_dma_buffer(staging_bytes) for _ in range(2)]
-        self.fences: List[list] = [[], []]
+        self.cap = staging_bytes
+        n = max(2, int(config.get("h2d_depth_max")))
+        self.bufs = [sess.alloc_dma_buffer(staging_bytes) for _ in range(n)]
+        self.fences: List[list] = [[] for _ in range(n)]
         self.cur = 0
 
     def next_buf(self):
-        """Rotate to the other pinned buffer; fence its previous H2D reads."""
-        self.cur ^= 1
+        """Rotate to the next pinned buffer; fence its previous H2D reads."""
+        self.cur = (self.cur + 1) % len(self.bufs)
         for f in self.fences[self.cur]:
             f.block_until_ready()
         self.fences[self.cur] = []
@@ -474,6 +482,39 @@ def _read_span(sess, source, file_off: int, nbytes: int,
     return out if out is not None else view[:nbytes]
 
 
+_INT32_MAX = (1 << 31) - 1
+
+
+def _restore_streamed(sess, source, base: int, dtype: np.dtype,
+                      shape, dev, ring: _PinnedRing):
+    """Stream a leaf larger than one staging buffer straight onto the
+    device: each staged sub-span lands with a donated
+    ``dynamic_update_slice`` into the preallocated device leaf — no
+    owned-host assembly copy (the old path materialized the whole leaf on
+    the host a second time before one giant device_put)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..hbm.staging import _write_slice
+    nbytes = int(dtype.itemsize * np.prod(shape, dtype=np.int64)) \
+        if shape else dtype.itemsize
+    with jax.default_device(dev):
+        dest = jnp.zeros(nbytes // dtype.itemsize, dtype)
+    done = 0
+    while done < nbytes:
+        take = min(ring.cap, nbytes - done)
+        # element-align every take (a staging buffer not divisible by the
+        # itemsize must not split an element across sub-spans); the final
+        # take is nbytes - done, already element-aligned by induction
+        take -= take % dtype.itemsize
+        view = _read_span(sess, source, base + done, take, ring)
+        chunk = ring.put(view.view(dtype), dev)
+        dest = _write_slice(dest, chunk,
+                            np.int32(done // dtype.itemsize))
+        done += take
+    return dest.reshape(shape)
+
+
 def restore_checkpoint(path: str, *, shardings=None, like=None,
                        session: Optional[Session] = None,
                        device=None, staging_bytes: int = 64 << 20):
@@ -510,9 +551,18 @@ def restore_checkpoint(path: str, *, shardings=None, like=None,
                     sh = _leaf_sharding(shardings, key)
                     if sh is None:
                         dev = device or default_device()
-                        host = _read_span(sess, source, base, e["nbytes"],
-                                          ring).view(dtype).reshape(shape)
-                        out[key] = ring.put(host, dev)
+                        n_elems = int(e["nbytes"]) // dtype.itemsize
+                        if (e["nbytes"] > ring.cap
+                                and ring.cap >= dtype.itemsize
+                                and n_elems <= _INT32_MAX):
+                            out[key] = _restore_streamed(
+                                sess, source, base, dtype, shape, dev,
+                                ring)
+                        else:
+                            host = _read_span(sess, source, base,
+                                              e["nbytes"],
+                                              ring).view(dtype)
+                            out[key] = ring.put(host.reshape(shape), dev)
                     else:
                         out[key] = _restore_sharded(sess, source, base, dtype,
                                                     shape, sh, ring)

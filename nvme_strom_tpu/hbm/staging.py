@@ -82,6 +82,77 @@ def safe_device_put(host: np.ndarray, devlike) -> jax.Array:
     return jax.device_put(owned_if_cpu(host, devlike), devlike)
 
 
+# -- H2D transfer paths (VERDICT r2 #2: kill the second host copy) ---------
+#
+# The reference's whole point is zero extra copies (PRPs aim at GPU BAR1,
+# kmod/nvme_strom.c:1518-1589).  On TPU the SSD leg lands in OUR pinned
+# mmap; the question is what the pinned->HBM leg costs:
+#
+#  * "plain": jax.device_put(numpy_view).  PJRT's BufferFromHostBuffer
+#    DMAs straight from the caller's buffer when alignment/layout allow —
+#    our staging buffers are page-aligned mmaps, exactly the zero-copy
+#    case — but falls back to an internal staging copy when they don't.
+#  * "pinned_host": two-stage through the PJRT pinned_host memory space:
+#    device_put into page-locked PJRT memory, then a jitted
+#    pinned->device copy that is pure DMA.  One explicit host copy, but
+#    the DMA leg can overlap with compute under XLA's scheduler, and the
+#    staging buffer frees as soon as the FIRST leg completes.
+#
+# Which wins is a hardware/runtime property, so it is a config knob
+# ("h2d_path": auto|plain|pinned_host) and a bench A/B row
+# (h2d_pinned_peak vs h2d_peak in bench_matrix.py), not an assumption.
+# "auto" = plain, today's measured-best default on this host.
+
+_pinned_sharding_cache: dict = {}
+
+
+def _pinned_shardings(dev):
+    """(pinned_host sharding, device sharding) for *dev*, or None when the
+    runtime exposes no pinned_host memory space."""
+    got = _pinned_sharding_cache.get(dev)
+    if got is None:
+        try:
+            kinds = {m.kind for m in dev.addressable_memories()}
+            if "pinned_host" not in kinds:
+                raise RuntimeError("no pinned_host memory space")
+            from jax.sharding import SingleDeviceSharding
+            s_pin = SingleDeviceSharding(dev, memory_kind="pinned_host")
+            s_dev = SingleDeviceSharding(dev, memory_kind="device")
+            # one jitted pinned->device copy per device, cached (the
+            # DMA leg XLA can overlap with compute).  Probe it end to end:
+            # some backends LIST pinned_host but cannot lower the memory-
+            # space copy (CPU: annotate_device_placement unimplemented) —
+            # capability is what runs, not what enumerates.
+            to_dev = jax.jit(lambda x: x, out_shardings=s_dev)
+            probe = jax.device_put(np.zeros(16, np.uint8), s_pin)
+            jax.block_until_ready(to_dev(probe))
+            got = (s_pin, to_dev)
+        except Exception:
+            got = False
+        _pinned_sharding_cache[dev] = got
+    return got or None
+
+
+def h2d_transfer(host: np.ndarray, dev) -> tuple:
+    """Move one staged batch host->device on the configured path.
+
+    Returns ``(dev_chunk, reuse_fence)``: the device array to land, and
+    the array whose readiness means the SOURCE buffer is safe to reuse
+    (on the pinned_host path that is the first leg, so the staging buffer
+    frees before the DMA to HBM even completes)."""
+    how = config.get("h2d_path")
+    if how in ("auto", "plain"):
+        dev_chunk = safe_device_put(host, dev)
+        return dev_chunk, dev_chunk
+    sh = _pinned_shardings(dev)
+    if sh is None:   # configured pinned_host but runtime has none
+        dev_chunk = safe_device_put(host, dev)
+        return dev_chunk, dev_chunk
+    s_pin, to_dev = sh
+    pinned = jax.device_put(owned_if_cpu(host, dev), s_pin)
+    return to_dev(pinned), pinned
+
+
 def default_device(index: int = 0) -> jax.Device:
     """Prefer an accelerator, like the reference preferring Tesla/Quadro
     (`utils/ssd2gpu_test.c:632-656`); fall back to CPU.  Only this
@@ -184,12 +255,14 @@ class StagingPipeline:
                 _, dbuf = self._bufs[bufidx]
                 dev = list(hbm.array.devices())[0]
                 host = np.frombuffer(dbuf.view()[:nbytes], dtype=device_dtype)
-                dev_chunk = safe_device_put(host, dev)
+                dev_chunk, fence = h2d_transfer(host, dev)
                 _land(hbm, dev_chunk, elem_start, grid_elems)
                 # the staging buffer is reusable once the H2D *read* of it
-                # completes — fence on the device chunk, not the landing
-                # (on CPU the chunk is an owned copy, so this stays safe)
-                self._barriers[bufidx] = dev_chunk
+                # completes — fence on the transfer's first leg, not the
+                # landing (on the pinned_host path the buffer frees before
+                # the DMA to HBM finishes; on CPU the chunk is an owned
+                # copy, so this stays safe)
+                self._barriers[bufidx] = fence
                 stats.count_clock("debug3", time.monotonic_ns() - t0)
 
             for batch in batches:

@@ -132,9 +132,13 @@ class TableScanner:
             self._tail_pages = 0
         self.cursor = cursor or LocalCursor(self.n_chunks + (1 if self._tail_pages else 0))
         self._own_pool = pool is None
-        self.pool = pool or DmaBufferPool(chunk_size=self.chunk_size,
-                                          total_size=self.chunk_size *
-                                          max(self.async_depth + 1, 2))
+        # + h2d_depth_max: scan_filter keeps that many batches alive with
+        # their H2D transfers in flight (deferred-fence pipelining), on
+        # top of the DMA ring and the batch being consumed
+        self.pool = pool or DmaBufferPool(
+            chunk_size=self.chunk_size,
+            total_size=self.chunk_size *
+            max(self.async_depth + int(config.get("h2d_depth_max")) + 1, 2))
         self._numa_bound = False
         self._prev_affinity = None
         if numa_bind:
@@ -151,11 +155,17 @@ class TableScanner:
                 pass
 
     # -- core ring ----------------------------------------------------------
-    def batches(self, owner: Optional[ResourceOwner] = None) -> Iterator[Batch]:
+    def batches(self, owner: Optional[ResourceOwner] = None, *,
+                auto_recycle: bool = True) -> Iterator[Batch]:
         """Yield completed batches, keeping ``async_depth`` DMAs in flight.
 
-        The previous batch's pool chunk is recycled when the next batch is
-        requested."""
+        With ``auto_recycle`` (default) the previous batch's pool chunk is
+        recycled when the next batch is requested — the one-live-batch
+        DB-cursor discipline.  ``auto_recycle=False`` hands recycling to
+        the consumer (call :meth:`recycle` on each batch when its bytes
+        are no longer needed), which lets the consumer keep several
+        batches alive with H2D transfers in flight; the pool is sized for
+        up to ``h2d_depth_max`` such batches."""
         # ring entries: (task_id, chunk, handle, first_chunk, MemCopyResult);
         # task_id == 0 marks the buffered tail read (real ids start at 1)
         ring: List[Tuple[int, DmaChunk, int, int, object]] = []
@@ -211,8 +221,10 @@ class TableScanner:
                 # next DMA: at steady state the pool holds ring(depth) +
                 # current + previous, so the freed chunk is what the next
                 # submission allocates — submitting first deadlocks on a
-                # depth+1-sized pool
-                if prev is not None:
+                # depth+1-sized pool.  (Consumer-recycled mode: the
+                # consumer must release before drawing past its own depth
+                # budget for the same reason.)
+                if auto_recycle and prev is not None:
                     self._recycle(prev)
                     prev = None
                 submit_next()
@@ -222,7 +234,8 @@ class TableScanner:
                               first_page=first * self.pages_per_chunk,
                               nr_ssd=nr_ssd, nr_wb=nr_wb,
                               _chunk=chunk, _handle=handle)
-                prev = batch
+                if auto_recycle:
+                    prev = batch
                 yield batch
         finally:
             if prev is not None:
@@ -242,6 +255,11 @@ class TableScanner:
         self.session.unmap_buffer(batch._handle)
         batch._chunk.release()
 
+    def recycle(self, batch: Batch) -> None:
+        """Return a consumer-held batch's chunk to the pool
+        (``batches(auto_recycle=False)`` mode)."""
+        self._recycle(batch)
+
     def rescan(self) -> None:
         """Rewind the cursor so the table can be scanned again from page 0
         (ExecReScanNVMEStrom, `pgsql/nvme_strom.c:1047-1055`).  Only valid
@@ -254,16 +272,44 @@ class TableScanner:
         """Stream every batch to the device and fold ``filter_fn`` over it.
 
         ``filter_fn(pages_u8_device) -> dict of scalars``; results are
-        summed (or combined with *combine*).  Device work for batch *k*
-        overlaps the DMA of batch *k+1* — XLA dispatch is async, so the only
-        synchronization is the final fetch."""
+        summed (or combined with *combine*).
+
+        ADAPTIVE H2D pipelining (VERDICT r2 #3): several batches keep
+        their device transfers in flight at once — the fence on batch *k*
+        is deferred until *k + depth* has been dispatched, so the H2D hop
+        rides transfer bursts the way the 32-deep loader does instead of
+        paying a synchronous fence per 16MB.  The depth starts at 2 and
+        deepens (up to config ``h2d_depth_max`` / pool headroom) whenever
+        the consumer observes itself actually blocking on a transfer —
+        i.e. exactly when more overlap would have helped."""
+        import time as _time
+
         import jax
 
         from ..hbm.staging import safe_device_put
         dev = device or jax.devices()[0]
         acc: Optional[dict] = None
+        # pool must hold: DMA ring (async_depth) + the batch being drawn
+        # + every consumer-held in-flight batch
+        depth_cap = max(1, min(int(config.get("h2d_depth_max")),
+                               self.pool.n_chunks - self.async_depth - 1))
+        depth = min(2, depth_cap)
+        inflight: List[tuple] = []   # (dev_pages, batch), oldest first
+
+        def retire_oldest() -> None:
+            nonlocal acc, depth
+            dev_pages, b = inflight.pop(0)
+            t0 = _time.monotonic_ns()
+            # safe_device_put copied on CPU; on accelerators the H2D read
+            # of the pinned chunk must finish before the chunk refills
+            dev_pages.block_until_ready()
+            blocked = _time.monotonic_ns() - t0 > 200_000   # >0.2ms wait
+            self.recycle(b)
+            acc = fold_results(acc, filter_fn(dev_pages), combine)
+            if blocked and depth < depth_cap:
+                depth += 1
         with ResourceOwner("scan_filter") as owner:
-            gen = self.batches(owner=owner)
+            gen = self.batches(owner=owner, auto_recycle=False)
             try:
                 for batch in gen:
                     # safe_device_put, NOT jax.device_put: batch.pages is a
@@ -271,15 +317,25 @@ class TableScanner:
                     # zero-copy ALIASES it — the async filter compute would
                     # read the chunk after recycle+refill (silent wrong
                     # aggregates; caught by a cold-file 64KB-chunk scan)
-                    dev_pages = safe_device_put(batch.pages, dev)
-                    # fence: device_put is async and batch.pages is recycled
-                    # (and re-filled by the next SSD DMA) as soon as the next
-                    # batch is drawn — the H2D read must complete first.  The
-                    # DMA ring keeps progressing in native threads while we
-                    # wait, so overlap is preserved.
-                    dev_pages.block_until_ready()
-                    acc = fold_results(acc, filter_fn(dev_pages), combine)
+                    inflight.append((safe_device_put(batch.pages, dev),
+                                     batch))
+                    # release below the depth budget BEFORE drawing the
+                    # next batch, or the generator's pool alloc deadlocks
+                    while len(inflight) >= depth:
+                        retire_oldest()
+                while inflight:
+                    retire_oldest()
             finally:
+                # consumer-held batches: fence + recycle before the ring
+                # drain, so abort recovery never frees a chunk an H2D
+                # read is still consuming
+                for dev_pages, b in inflight:
+                    try:
+                        dev_pages.block_until_ready()
+                    except Exception:   # noqa: BLE001 - teardown path
+                        pass
+                    self.recycle(b)
+                inflight.clear()
                 # drain the ring INSIDE the owner scope: when filter_fn
                 # raises (e.g. a LIMIT early-exit), the generator's finally
                 # must wait out in-flight SSD DMA before ResourceOwner

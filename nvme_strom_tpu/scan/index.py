@@ -97,7 +97,9 @@ def build_index(table_path: str, schema, col, *,
 
     *col* may be a pair ``(c0, c1)`` of integer columns: the sidecar then
     holds lexicographically packed uint64 keys (module docstring), built
-    from one projection scan + a stable host argsort."""
+    from one projection scan + a stable host argsort.  Composite builds
+    run HOST-side (the packed key is not a table column the distributed
+    sort can scan); a *mesh* argument is ignored with a warning."""
     from .query import Query
 
     # stamp BEFORE the scan: a table modified mid-build then mismatches
@@ -110,6 +112,10 @@ def build_index(table_path: str, schema, col, *,
             raise StromError(_errno.EINVAL,
                             "composite index keys are column PAIRS")
         c0, c1 = int(col[0]), int(col[1])
+        if mesh is not None:
+            from ..log import pr_warn
+            pr_warn("build_index: composite (%d, %d) keys build "
+                    "host-side; mesh argument ignored", c0, c1)
         dt0, dt1 = schema.col_dtype(c0), schema.col_dtype(c1)
         for c, dt in ((c0, dt0), (c1, dt1)):
             if dt.kind not in "iu":
@@ -191,7 +197,10 @@ class SortedIndex:
             for v, dt in ((v0, dt0), (v1, dt1)):
                 f = float(v)
                 info = np.iinfo(dt)
-                if f != int(f) or not info.min <= int(v) <= info.max:
+                # isfinite FIRST: int(nan)/int(inf) raise, and a probe no
+                # int column can hold must match nothing, never crash
+                if (not np.isfinite(f) or f != int(f)
+                        or not info.min <= int(v) <= info.max):
                     ok = False
             if ok:
                 out.append(int(pack_pair(dt0.type(int(v0)),

@@ -536,3 +536,30 @@ def test_save_checkpoint_sharded_roundtrip(tmp_path):
     wc = jax.make_array_from_callback(w.shape, csh, lambda i: w[i])
     with pytest.raises(StromError, match="leading-axis"):
         save_checkpoint_sharded(str(tmp_path / "c.strom"), {"w": wc})
+
+
+def test_checkpoint_streamed_restore_mixed_dtypes(tmp_path):
+    """The donated-slice streaming path (leaf > staging buffer) restores
+    bit-identical leaves across dtypes, with the ring width taken from
+    h2d_depth_max (VERDICT r2 #3)."""
+    from nvme_strom_tpu import config
+    rng = np.random.default_rng(17)
+    tree = {
+        "f32": rng.standard_normal((911, 130)).astype(np.float32),
+        "i32": rng.integers(-2**31, 2**31, (3001, 41),
+                            dtype=np.int64).astype(np.int32),
+        "u8": rng.integers(0, 255, 700_001, dtype=np.uint8),
+        "tiny": np.arange(7, dtype=np.float32),   # stays on the put path
+    }
+    path = str(tmp_path / "ckmix.strom")
+    save_checkpoint(path, tree)
+    old = config.get("h2d_depth_max")
+    config.set("h2d_depth_max", 5)
+    try:
+        out = restore_checkpoint(path, staging_bytes=64 << 10)
+    finally:
+        config.set("h2d_depth_max", old)
+    for k, v in tree.items():
+        got = np.asarray(out[f"['{k}']"])
+        assert got.dtype == v.dtype and got.shape == v.shape
+        np.testing.assert_array_equal(got, v, err_msg=k)

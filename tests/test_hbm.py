@@ -175,3 +175,45 @@ def test_load_as_int32(tmp_path, reg):
     assert arr.shape == ((1 << 20) // 4,)
     want = np.frombuffer(expected_bytes(0, 1 << 20), dtype=np.int32)
     np.testing.assert_array_equal(np.asarray(arr), want)
+
+
+def test_h2d_transfer_paths_agree_and_fall_back():
+    """h2d_path plain/pinned_host/auto move identical bytes; a runtime
+    whose pinned_host space cannot lower the memory copy (CPU backend)
+    falls back transparently (VERDICT r2 #2)."""
+    import jax
+
+    from nvme_strom_tpu import config
+    from nvme_strom_tpu.hbm.staging import h2d_transfer
+
+    dev = jax.devices()[0]
+    a = np.arange(1 << 14, dtype=np.uint8)
+    old = config.get("h2d_path")
+    try:
+        for path in ("plain", "pinned_host", "auto"):
+            config.set("h2d_path", path)
+            d, fence = h2d_transfer(a, dev)
+            np.testing.assert_array_equal(np.asarray(d), a)
+            jax.block_until_ready(fence)
+    finally:
+        config.set("h2d_path", old)
+
+
+def test_staging_pipeline_under_pinned_host_config(tmp_path):
+    """The full staging pipeline stays byte-correct with
+    h2d_path=pinned_host configured (falls back where unsupported)."""
+    from nvme_strom_tpu import Session, config, open_source
+    from nvme_strom_tpu.hbm.staging import load_file_to_device
+    from nvme_strom_tpu.testing.fake import expected_bytes, make_test_file
+
+    p = str(tmp_path / "pin.bin")
+    make_test_file(p, 2 << 20)
+    old = config.get("h2d_path")
+    config.set("h2d_path", "pinned_host")
+    try:
+        with open_source(p) as src, Session() as s:
+            arr = load_file_to_device(src, chunk_size=256 << 10, session=s)
+            got = bytes(np.asarray(arr)[: 64 << 10])
+            assert got == expected_bytes(0, 64 << 10)
+    finally:
+        config.set("h2d_path", old)
