@@ -37,7 +37,10 @@ int main(int argc, char** argv) {
   const int reqs_per_task = 8;
   uint64_t span = (uint64_t)st.st_size / req_sz;
 
-  uint64_t eng = nstpu_engine_create(NSTPU_BACKEND_AUTO, 32);
+  // 4 rings explicitly: the stress exists to exercise the multi-queue
+  // machinery (per-member submit/reap/window) even though the library
+  // default is 1 ring on shared-backing-disk hosts
+  uint64_t eng = nstpu_engine_create2(NSTPU_BACKEND_AUTO, 32, 4);
   if (!eng) {
     fprintf(stderr, "engine create failed\n");
     return 1;

@@ -295,16 +295,21 @@ struct Engine {
            probe_one_op(ring, IORING_OP_WRITE);
   }
 
-  // ring count: one queue per stripe member up to this cap (the BASELINE
-  // multi-queue row is a 4-member RAID-0; a single-file source just uses
-  // ring 0).  Overridable for experiments via NSTPU_RINGS.
+  // ring count when the caller does not fix one (nstpu_engine_create /
+  // create2 with nrings <= 0): env NSTPU_RINGS, else 1.  Default is ONE
+  // queue because extra rings only pay off when stripe members are
+  // distinct physical devices — on a shared backing disk a 4x32-deep A/B
+  // measured ~30% below 1x32 (they just multiply in-flight and seek).
+  // Multi-device deployments raise it (config engine_rings / env).
   static unsigned want_rings() {
     const char* env = getenv("NSTPU_RINGS");
-    long v = env ? atol(env) : 4;
+    long v = env ? atol(env) : 1;
     if (v < 1) v = 1;
     if (v > 16) v = 16;
     return (unsigned)v;
   }
+
+  unsigned nrings_want = 0;  // 0 = want_rings() default; set by create2
 
   ~Engine() {
     shutdown();
@@ -322,7 +327,7 @@ struct Engine {
     depth = queue_depth > 0 ? (unsigned)queue_depth : 32u;
     if (want_backend == NSTPU_BACKEND_AUTO ||
         want_backend == NSTPU_BACKEND_IO_URING) {
-      unsigned nr = want_rings();
+      unsigned nr = nrings_want ? nrings_want : want_rings();
       bool ok = true;
       for (unsigned i = 0; i < nr; i++) {
         auto* rx = new RingCtx();
@@ -968,8 +973,9 @@ const char* nstpu_signature(void) {
       ;
 }
 
-uint64_t nstpu_engine_create(int backend, int queue_depth) {
+uint64_t nstpu_engine_create2(int backend, int queue_depth, int nrings) {
   auto* e = new Engine();
+  if (nrings > 0) e->nrings_want = std::min(nrings, 16);
   if (!e->init(backend, queue_depth)) {
     delete e;
     return 0;
@@ -978,6 +984,10 @@ uint64_t nstpu_engine_create(int backend, int queue_depth) {
   uint64_t h = g_next++;
   g_engines[h] = e;
   return h;
+}
+
+uint64_t nstpu_engine_create(int backend, int queue_depth) {
+  return nstpu_engine_create2(backend, queue_depth, 0);
 }
 
 void nstpu_engine_destroy(uint64_t engine) {

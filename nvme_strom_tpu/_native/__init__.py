@@ -77,6 +77,12 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         lib.nstpu_engine_create.restype = ctypes.c_uint64
         lib.nstpu_engine_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        try:
+            lib.nstpu_engine_create2.restype = ctypes.c_uint64
+            lib.nstpu_engine_create2.argtypes = [ctypes.c_int, ctypes.c_int,
+                                                 ctypes.c_int]
+        except AttributeError:  # pragma: no cover - older .so
+            pass
         lib.nstpu_engine_destroy.argtypes = [ctypes.c_uint64]
         lib.nstpu_engine_backend.argtypes = [ctypes.c_uint64]
         lib.nstpu_submit.restype = ctypes.c_int64
@@ -132,14 +138,18 @@ def native_signature() -> Optional[str]:
 class NativeEngine:
     """One native engine instance (the 'loaded kernel module' analog)."""
 
-    def __init__(self, backend: str = "auto", queue_depth: int = 32):
+    def __init__(self, backend: str = "auto", queue_depth: int = 32,
+                 rings: int = 0):
         lib = _load()
         if lib is None:
             raise StromError(38, "native engine unavailable (libstrom_tpu.so)")  # ENOSYS
         want = {"auto": BACKEND_AUTO, "io_uring": BACKEND_IO_URING,
                 "threadpool": BACKEND_THREADPOOL}[backend]
         self._lib = lib
-        self._h = lib.nstpu_engine_create(want, queue_depth)
+        if rings > 0 and hasattr(lib, "nstpu_engine_create2"):
+            self._h = lib.nstpu_engine_create2(want, queue_depth, rings)
+        else:
+            self._h = lib.nstpu_engine_create(want, queue_depth)
         if not self._h:
             raise StromError(5, f"native engine init failed (backend={backend})")
         self.backend_name = _BACKEND_NAMES.get(

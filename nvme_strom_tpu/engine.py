@@ -856,9 +856,14 @@ class Session:
             from . import _native as _nat
             if _nat.native_available():
                 try:
+                    # NSTPU_RINGS env keeps working as the experiment
+                    # override; the config var is the durable setting
+                    env_rings = os.environ.get("NSTPU_RINGS")
                     self._native = _nat.NativeEngine(
                         want if want in ("io_uring", "threadpool") else "auto",
-                        config.get("queue_depth"))
+                        config.get("queue_depth"),
+                        rings=int(env_rings) if env_rings
+                        else config.get("engine_rings"))
                 except StromError:
                     if want != "auto":
                         raise

@@ -561,6 +561,28 @@ class Query:
                 return f[0]
         return None
 
+    def _order_index_path(self) -> Optional[str]:
+        """Sidecar path that can serve this ORDER BY directly (the sorted
+        order IS the index order): unfiltered local order_by over one
+        column, or over exactly the two integer columns of a composite
+        sidecar.  None when no index could apply."""
+        if (self._op != "order_by" or self._pred is not None
+                or not isinstance(self.source, str)):
+            return None
+        cols = self._order[0]
+        if len(cols) not in (1, 2):
+            return None
+        for c in cols:
+            if not 0 <= c < self.schema.n_cols \
+                    or self.schema.col_dtype(c).kind not in "iu":
+                # float sidecars strip NaN keys (index.py build), so they
+                # cannot reproduce the full row set an ORDER BY owes —
+                # index presence must never change query results
+                return None
+        from .index import index_path_for
+        key = cols[0] if len(cols) == 1 else (cols[0], cols[1])
+        return index_path_for(self.source, key)
+
     def _index_path_for_eq(self) -> Optional[str]:
         col = self._index_col()
         if col is None or not isinstance(self.source, str):
@@ -621,6 +643,20 @@ class Query:
         kernel, why = self._kernel_choice(mode)
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
+        if self._op == "order_by" and mode == "local" and kernel != "invalid":
+            oip = self._order_index_path()
+            if oip is not None:
+                from .index import probe_index
+                if probe_index(oip, self.source):
+                    cols_ = self._order[0]
+                    return QueryPlan(
+                        operator=self._op, access_path="index",
+                        kernel=kernel, mode=mode, n_pages=n_pages,
+                        cost_direct=cd.total, cost_vfs=cv.total,
+                        reason=f"fresh index on col{cols_}: the sorted "
+                               f"order IS the index order — positions "
+                               f"read from the sidecar, no sort, and "
+                               f"LIMIT reads only the head; " + why)
         if (self._op in ("select", "aggregate", "top_k", "quantiles",
                          "count_distinct", "group_by", "join")
                 and mode == "local"
@@ -764,6 +800,22 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
+        if plan.access_path == "index" and self._op == "order_by":
+            oip = self._order_index_path()
+            idx = None
+            if oip is not None:
+                from .index import open_index
+                try:
+                    idx = open_index(oip, table_path=self.source)
+                except Exception:   # raced away: fall to the sort path
+                    idx = None
+            if idx is not None:
+                return self._run_order_by_indexed(idx, device, session)
+            path, size = self._source_facts()
+            plan = dataclasses.replace(
+                plan, access_path="direct"
+                if path is not None and should_use_direct_scan(
+                    path, table_size=size) else "vfs")
         if plan.access_path == "index":
             idx = self._index_for_eq()
             # explicit per-op dispatch: an op added to the planner's
@@ -1340,6 +1392,45 @@ class Query:
             device, session, limit=limit, offset=offset)
         return {"positions": poss, "keys": keyv, "payload": payl,
                 "count": np.int64(len(poss))}
+
+    def _run_order_by_indexed(self, idx, device, session) -> dict:
+        """ORDER BY served from a fresh sidecar: the index order IS the
+        answer — no sort, no full-column gather; a LIMIT touches only the
+        head of the sidecar (and, for composite keys, only the head's
+        pages).  Result contract matches :meth:`_run_order_by` local mode
+        (``values`` = primary column, ``positions``); duplicate ordering
+        is the build's physical order, same as the stable seqscan sort."""
+        cols, descending, limit, offset = self._order
+        self._check_sortable_col(cols[0], "order_by")
+        n = len(idx.positions)
+        end = n if limit is None else min(n, offset + limit)
+        lo_i, hi_i = min(offset, n), min(end, n)
+        if descending:
+            # STABLE descending: key groups reverse, but rows WITHIN an
+            # equal-key group keep ascending physical order — matching
+            # the seqscan's stable lexsort over negated keys (a plain
+            # array reversal would flip duplicate groups internally and
+            # make index presence change the answer)
+            ka = idx.keys
+            g = np.cumsum(np.concatenate(
+                ([0], (ka[1:] != ka[:-1]).astype(np.int64))))
+            perm = np.argsort(-g, kind="stable")[lo_i:hi_i]
+            pos = idx.positions[perm]
+            keys = ka[perm]
+        else:
+            pos = idx.positions[lo_i:hi_i]
+            keys = idx.keys[lo_i:hi_i]
+        pos = np.ascontiguousarray(pos)
+        if not idx.composite:
+            return {"values": np.ascontiguousarray(keys),
+                    "positions": pos.astype(self._pos_dtype())}
+        # composite sidecar: keys are packed pairs — fetch the primary
+        # column's values for the (already sliced) head only
+        out = self.fetch(pos, cols=[cols[0]], session=session,
+                         device=device)
+        keep = np.asarray(out["valid"]).astype(bool)
+        return {"values": out[f"col{cols[0]}"][keep],
+                "positions": pos[keep].astype(self._pos_dtype())}
 
     def _run_join_partitioned(self, plan: QueryPlan, mesh, device,
                               session, n_parts: int,

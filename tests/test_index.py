@@ -726,3 +726,77 @@ def test_composite_index_staleness_and_lookup_batch(tmp_path):
     os.utime(path, ns=(1, 1))
     with pytest.raises(StromError):
         open_index(ipath, table_path=path)
+
+
+def test_order_by_rides_single_and_composite_index(table, tmp_path):
+    """Unfiltered ORDER BY over indexed columns serves from the sidecar:
+    EXPLAIN shows the index path, results equal the sorted seqscan
+    exactly (stable duplicate order), limit/offset/descending included;
+    dropping the index falls back to the sort silently."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+
+    def q(**kw):
+        return Query(path, schema).order_by(0, **kw)
+
+    seq_full = q().run()
+    seq_head = q(limit=7, offset=3).run()
+    seq_desc = q(descending=True, limit=5).run()
+
+    build_index(path, schema, 0)
+    assert q().explain().access_path == "index"
+    assert "no sort" in q().explain().reason
+    r_full = q().run()
+    np.testing.assert_array_equal(r_full["values"], seq_full["values"])
+    np.testing.assert_array_equal(r_full["positions"],
+                                  seq_full["positions"])
+    r_head = q(limit=7, offset=3).run()
+    np.testing.assert_array_equal(r_head["values"], seq_head["values"])
+    np.testing.assert_array_equal(r_head["positions"],
+                                  seq_head["positions"])
+    r_desc = q(descending=True, limit=5).run()
+    np.testing.assert_array_equal(r_desc["values"], seq_desc["values"])
+    # stable descending: duplicate keys keep ascending PHYSICAL order,
+    # exactly like the seqscan's stable lexsort — positions too
+    np.testing.assert_array_equal(r_desc["positions"],
+                                  seq_desc["positions"])
+
+    # a filter disables the index ORDER BY (row set differs)
+    qf = Query(path, schema).where(lambda c: c[0] > 0).order_by(0)
+    assert qf.explain().access_path != "index"
+
+    # composite: ORDER BY (c0, c1) rides the packed sidecar
+    q2 = lambda **kw: Query(path, schema).order_by([0, 1], **kw)
+    seq2 = q2(limit=11).run()
+    build_index(path, schema, (0, 1))
+    plan2 = q2(limit=11).explain()
+    assert plan2.access_path == "index"
+    r2 = q2(limit=11).run()
+    np.testing.assert_array_equal(r2["values"], seq2["values"])
+    np.testing.assert_array_equal(r2["positions"], seq2["positions"])
+    # three-column orderings have no sidecar shape: seqscan sort
+    assert Query(path, schema).order_by([0, 1, 1]).explain() \
+        .access_path != "index"
+
+
+def test_order_by_never_serves_float_index(tmp_path):
+    """Float sidecars strip NaN keys, so an indexed ORDER BY would DROP
+    NaN rows — the planner must keep float ORDER BY on the sort path
+    even when a fresh index exists (index transparency)."""
+    schema = HeapSchema(n_cols=1, visibility=False, dtypes=("float32",))
+    vals = np.array([3.0, np.nan, 1.0, 2.0, np.nan, 0.5] * 50, np.float32)
+    path = str(tmp_path / "f.heap")
+    build_heap_file(path, [vals], schema)
+    config.set("debug_no_threshold", True)
+    seq = Query(path, schema).order_by(0).run()
+    assert len(seq["values"]) == len(vals)   # NaN rows included
+    build_index(path, schema, 0)
+    q = Query(path, schema).order_by(0)
+    assert q.explain().access_path != "index"
+    r = q.run()
+    assert len(r["values"]) == len(vals)
+    np.testing.assert_array_equal(r["positions"], seq["positions"])
+    # but equality probes still ride the float index (NaN never matches)
+    qe = Query(path, schema).where_eq(0, 2.0).select([0])
+    assert qe.explain().access_path == "index"
+    assert int(qe.run()["count"]) == 50
