@@ -323,7 +323,7 @@ def _emit_cpu_fallback(path: str, device_error: str) -> int:
 
 
 def main() -> int:
-    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")
     _ensure_file(path, size_mb << 20)

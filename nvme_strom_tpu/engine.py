@@ -857,13 +857,16 @@ class Session:
             if _nat.native_available():
                 try:
                     # NSTPU_RINGS env keeps working as the experiment
-                    # override; the config var is the durable setting
-                    env_rings = os.environ.get("NSTPU_RINGS")
+                    # override; the config var is the durable setting.
+                    # Malformed values fall back (the C side's atol was
+                    # just as tolerant) — a typo must not kill Session().
+                    try:
+                        rings = int(os.environ.get("NSTPU_RINGS", ""))
+                    except ValueError:
+                        rings = int(config.get("engine_rings"))
                     self._native = _nat.NativeEngine(
                         want if want in ("io_uring", "threadpool") else "auto",
-                        config.get("queue_depth"),
-                        rings=int(env_rings) if env_rings
-                        else config.get("engine_rings"))
+                        config.get("queue_depth"), rings=rings)
                 except StromError:
                     if want != "auto":
                         raise

@@ -562,15 +562,19 @@ class Query:
         return None
 
     def _order_index_path(self) -> Optional[str]:
-        """Sidecar path that can serve this ORDER BY directly (the sorted
-        order IS the index order): unfiltered local order_by over one
-        column, or over exactly the two integer columns of a composite
-        sidecar.  None when no index could apply."""
-        if (self._op != "order_by" or self._pred is not None
+        """Sidecar path that can serve this ordered terminal directly:
+        unfiltered local ``order_by`` (the sorted order IS the index
+        order), ``quantiles`` (nearest-rank reads of the sorted keys),
+        or ``count_distinct`` (adjacent-diff over the sorted keys) —
+        single integer column, or the two integer columns of a composite
+        sidecar for order_by.  None when no index could apply."""
+        if (self._op not in ("order_by", "quantiles", "count_distinct")
+                or self._pred is not None
                 or not isinstance(self.source, str)):
             return None
         cols = self._order[0]
-        if len(cols) not in (1, 2):
+        want = (1, 2) if self._op == "order_by" else (1,)
+        if len(cols) not in want:
             return None
         for c in cols:
             if not 0 <= c < self.schema.n_cols \
@@ -643,20 +647,29 @@ class Query:
         kernel, why = self._kernel_choice(mode)
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
-        if self._op == "order_by" and mode == "local" and kernel != "invalid":
+        if mode == "local" and kernel != "invalid":
             oip = self._order_index_path()
             if oip is not None:
                 from .index import probe_index
                 if probe_index(oip, self.source):
                     cols_ = self._order[0]
+                    what = {
+                        "order_by": "the sorted order IS the index "
+                                    "order — positions read from the "
+                                    "sidecar, no sort, and LIMIT reads "
+                                    "only the head",
+                        "quantiles": "nearest-rank reads of the sorted "
+                                     "sidecar keys — no table I/O at all",
+                        "count_distinct": "adjacent-diff over the sorted "
+                                          "sidecar keys — no table I/O "
+                                          "at all",
+                    }[self._op]
                     return QueryPlan(
                         operator=self._op, access_path="index",
                         kernel=kernel, mode=mode, n_pages=n_pages,
                         cost_direct=cd.total, cost_vfs=cv.total,
-                        reason=f"fresh index on col{cols_}: the sorted "
-                               f"order IS the index order — positions "
-                               f"read from the sidecar, no sort, and "
-                               f"LIMIT reads only the head; " + why)
+                        reason=f"fresh index on col{cols_}: {what}; "
+                               + why)
         if (self._op in ("select", "aggregate", "top_k", "quantiles",
                          "count_distinct", "group_by", "join")
                 and mode == "local"
@@ -800,7 +813,9 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
-        if plan.access_path == "index" and self._op == "order_by":
+        if plan.access_path == "index" and self._op in (
+                "order_by", "quantiles", "count_distinct") \
+                and self._index_col() is None:
             oip = self._order_index_path()
             idx = None
             if oip is not None:
@@ -810,7 +825,11 @@ class Query:
                 except Exception:   # raced away: fall to the sort path
                     idx = None
             if idx is not None:
-                return self._run_order_by_indexed(idx, device, session)
+                if self._op == "order_by":
+                    return self._run_order_by_indexed(idx, device, session)
+                if self._op == "quantiles":
+                    return self._run_quantiles_sidecar(idx)
+                return self._run_count_distinct_sidecar(idx)
             path, size = self._source_facts()
             plan = dataclasses.replace(
                 plan, access_path="direct"
@@ -1393,6 +1412,26 @@ class Query:
         return {"positions": poss, "keys": keyv, "payload": payl,
                 "count": np.int64(len(poss))}
 
+    def _run_quantiles_sidecar(self, idx) -> dict:
+        """Unfiltered exact quantiles with ZERO table I/O: the sidecar's
+        sorted keys ARE the order, nearest-rank picks read straight from
+        it (integer columns only — float sidecars strip NaN)."""
+        qs = self._quantiles
+        n = len(idx.keys)
+        if n == 0:
+            return {"quantiles": np.full(len(qs), np.nan, np.float64),
+                    "n": np.int64(0)}
+        ranks = self._nearest_ranks(qs, n)
+        return {"quantiles": np.ascontiguousarray(idx.keys[ranks]),
+                "n": np.int64(n)}
+
+    def _run_count_distinct_sidecar(self, idx) -> dict:
+        """Unfiltered COUNT(DISTINCT) with ZERO table I/O: adjacent-diff
+        over the sidecar's sorted keys."""
+        k = idx.keys
+        d = 0 if len(k) == 0 else int((k[1:] != k[:-1]).sum()) + 1
+        return {"distinct": np.int32(d)}
+
     def _run_order_by_indexed(self, idx, device, session) -> dict:
         """ORDER BY served from a fresh sidecar: the index order IS the
         answer — no sort, no full-column gather; a LIMIT touches only the
@@ -1412,9 +1451,27 @@ class Query:
             # array reversal would flip duplicate groups internally and
             # make index presence change the answer)
             ka = idx.keys
-            g = np.cumsum(np.concatenate(
-                ([0], (ka[1:] != ka[:-1]).astype(np.int64))))
-            perm = np.argsort(-g, kind="stable")[lo_i:hi_i]
+            starts = np.flatnonzero(
+                np.concatenate(([True], ka[1:] != ka[:-1])))
+            group_ends = np.append(starts[1:], n)
+            if hi_i <= 4096:
+                # small head: walk key groups from the tail, stop once
+                # offset+limit rows are in hand — honoring the plan's
+                # "LIMIT reads only the head" without an O(n log n) sort
+                parts = []
+                got = 0
+                for gi in range(len(starts) - 1, -1, -1):
+                    parts.append(np.arange(starts[gi], group_ends[gi]))
+                    got += group_ends[gi] - starts[gi]
+                    if got >= hi_i:
+                        break
+                perm = np.concatenate(parts)[lo_i:hi_i]
+            else:
+                # large/unbounded output: one vectorized stable argsort
+                # over the group ids beats a Python walk of every group
+                g = np.cumsum(np.concatenate(
+                    ([0], (ka[1:] != ka[:-1]).astype(np.int64))))
+                perm = np.argsort(-g, kind="stable")[lo_i:hi_i]
             pos = idx.positions[perm]
             keys = ka[perm]
         else:

@@ -193,10 +193,12 @@ def main(argv=None) -> int:
     ap.add_argument("--fetch", default=None, metavar="POS[,POS...]",
                     help="point lookup by global row position: reads only "
                          "the pages containing those rows (no scan)")
-    ap.add_argument("--build-index", default=None, metavar="COL", type=int,
+    ap.add_argument("--build-index", default=None, metavar="COL|C0,C1",
                     help="one scan -> sorted (key, position) sidecar at "
                          "FILE.idxCOL; later --index-lookup reads only "
-                         "matching pages")
+                         "matching pages.  C0,C1 builds a composite "
+                         "packed-pair sidecar (FILE.idxC0_C1) probed by "
+                         "--where-eq C0,C1:V0,V1")
     ap.add_argument("--index-lookup", default=None, metavar="COL:V[,V...]",
                     help="index scan: resolve positions from the sidecar, "
                          "fetch only their pages (build with --build-index "
@@ -268,7 +270,15 @@ def main(argv=None) -> int:
         if not isinstance(src, str):
             ap.error("index operations take a single table file")
         if args.build_index is not None:
-            ipath = build_index(src, schema, args.build_index)
+            spec = args.build_index
+            try:
+                key = tuple(int(c) for c in spec.split(",")) \
+                    if "," in spec else int(spec)
+                if isinstance(key, tuple) and len(key) != 2:
+                    raise ValueError
+            except ValueError:
+                ap.error("--build-index takes COL or C0,C1")
+            ipath = build_index(src, schema, key)
             print(f"built {ipath}")
             if not args.index_lookup:
                 return 0
@@ -351,13 +361,20 @@ def main(argv=None) -> int:
         q = q.where_range(int(parts[0]), rlo, rhi)
     elif args.where_eq:
         colspec, _, vspec = args.where_eq.partition(":")
-        if not colspec.isdigit() or not vspec:
-            ap.error("--where-eq takes COL:VALUE")
+        if not vspec:
+            ap.error("--where-eq takes COL:VALUE or C0,C1:V0,V1")
         try:
-            val = _parse_number(vspec)
+            if "," in colspec:
+                cpair = tuple(int(c) for c in colspec.split(","))
+                vpair = tuple(_parse_number(v) for v in vspec.split(","))
+                if len(cpair) != 2 or len(vpair) != 2:
+                    raise ValueError
+                q = q.where_eq(cpair, vpair)
+            else:
+                q = q.where_eq(int(colspec), _parse_number(vspec))
         except ValueError:
-            ap.error("--where-eq: VALUE must be a number")
-        q = q.where_eq(int(colspec), val)
+            ap.error("--where-eq takes COL:VALUE or C0,C1:V0,V1 "
+                     "(numbers)")
     if args.having and not args.group_by:
         ap.error("--having requires --group-by")
     if args.select:

@@ -800,3 +800,34 @@ def test_order_by_never_serves_float_index(tmp_path):
     qe = Query(path, schema).where_eq(0, 2.0).select([0])
     assert qe.explain().access_path == "index"
     assert int(qe.run()["count"]) == 50
+
+
+def test_quantiles_and_count_distinct_from_sidecar(table):
+    """Unfiltered quantiles / COUNT(DISTINCT) over an indexed integer
+    column serve from the sorted sidecar with zero table I/O, matching
+    the scan answers exactly; filtered variants keep their existing
+    index/scan paths."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    qs = [0.1, 0.5, 0.99]
+    seq_q = Query(path, schema).quantiles(0, qs).run()
+    seq_d = Query(path, schema).count_distinct(0).run()
+    build_index(path, schema, 0)
+
+    pq = Query(path, schema).quantiles(0, qs)
+    assert pq.explain().access_path == "index"
+    assert "no table I/O" in pq.explain().reason
+    rq = pq.run()
+    np.testing.assert_array_equal(rq["quantiles"], seq_q["quantiles"])
+    assert int(rq["n"]) == int(seq_q["n"])
+
+    pd_ = Query(path, schema).count_distinct(0)
+    assert pd_.explain().access_path == "index"
+    rd = pd_.run()
+    assert int(rd["distinct"]) == int(seq_d["distinct"]) \
+        == len(np.unique(c0))
+
+    # filtered quantiles still ride the structured-filter index runner
+    fq = Query(path, schema).where_eq(0, int(c0[0])).quantiles(0, [0.5])
+    assert fq.explain().access_path == "index"
+    assert int(fq.run()["n"]) == int((c0 == c0[0]).sum())

@@ -371,3 +371,68 @@ def test_index_and_seqscan_answers_identical(tmp_path_factory, n_pages,
                                   c1[idx_sel["positions"][io]])
     assert int(idx_agg["count"]) == int(seq_agg["count"])
     assert int(idx_agg["sums"][0]) == int(seq_agg["sums"][0])
+
+
+@given(
+    a0=st.lists(st.integers(-(1 << 31), (1 << 31) - 1), min_size=1,
+                max_size=40),
+    a1=st.lists(st.integers(0, (1 << 32) - 1), min_size=1, max_size=40),
+)
+@settings(max_examples=60, deadline=None)
+def test_pack_pair_is_order_isomorphic(a0, a1):
+    """pack_pair is a strict order isomorphism from (int32, uint32)
+    tuple ordering onto uint64: packed comparisons agree with tuple
+    comparisons for EVERY pair of pairs, including extremes."""
+    from nvme_strom_tpu.scan.index import pack_pair
+    m = min(len(a0), len(a1))
+    x0 = np.array(a0[:m], np.int32)
+    x1 = np.array(a1[:m], np.uint32)
+    packed = pack_pair(x0, x1, np.dtype(np.int32), np.dtype(np.uint32))
+    tuples = list(zip(x0.astype(np.int64), x1.astype(np.int64)))
+    for i in range(m):
+        for j in range(m):
+            assert (packed[i] < packed[j]) == (tuples[i] < tuples[j])
+            assert (packed[i] == packed[j]) == (tuples[i] == tuples[j])
+
+
+@given(
+    n_rows=st.integers(20, 400),
+    n_vals=st.integers(1, 6),
+    seed=st.integers(0, 10**6),
+)
+@settings(max_examples=25, deadline=None)
+def test_composite_eq_index_equals_seqscan_random(tmp_path_factory,
+                                                  n_rows, n_vals, seed):
+    """Random tables + random composite probes: index scan and seqscan
+    return identical row sets for where_eq((c0, c1), ...) across select
+    and aggregate terminals."""
+    from nvme_strom_tpu import config
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.index import build_index
+    from nvme_strom_tpu.scan.query import Query
+
+    rng = np.random.default_rng(seed)
+    schema = HeapSchema(n_cols=3, visibility=False,
+                        dtypes=("int32", "uint32", "int32"))
+    c0 = rng.integers(-5, 5, n_rows).astype(np.int32)
+    c1 = rng.integers(0, max(1, n_vals), n_rows).astype(np.uint32)
+    c2 = np.arange(n_rows, dtype=np.int32)
+    d = tmp_path_factory.mktemp("comp")
+    path = str(d / "t.heap")
+    build_heap_file(path, [c0, c1, c2], schema)
+    config.set("debug_no_threshold", True)
+
+    probe = (int(c0[rng.integers(0, n_rows)]),
+             int(c1[rng.integers(0, n_rows)]))
+    seq = Query(path, schema).where_eq((0, 1), probe).select([2]).run()
+    build_index(path, schema, (0, 1))
+    q = Query(path, schema).where_eq((0, 1), probe).select([2])
+    assert q.explain().access_path == "index"
+    idxr = q.run()
+    oracle = np.flatnonzero((c0 == probe[0]) & (c1 == probe[1]))
+    np.testing.assert_array_equal(np.sort(idxr["positions"]), oracle)
+    np.testing.assert_array_equal(np.sort(idxr["positions"]),
+                                  np.sort(seq["positions"]))
+    agg = Query(path, schema).where_eq((0, 1), probe).aggregate([2]).run()
+    assert int(agg["count"]) == len(oracle)
+    assert int(agg["sums"][0]) == int(c2[oracle].sum())
