@@ -154,6 +154,10 @@ STAT_FIELDS: Tuple[str, ...] = (
     "total_dma_length",
     "cur_dma_count",
     "max_dma_count",
+    # beyond the reference's 26: batched-submission syscall count (one
+    # io_uring_enter covers a whole task's SQE batch per ring, so
+    # nr_enter_dma / nr_submit_dma ~ 1/batch)
+    "nr_enter_dma",
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

@@ -1389,9 +1389,9 @@ class Session:
             "nr_submit_dma": d.get("nr_submit_dma", 0),
             "clk_submit_dma": d.get("clk_submit_dma", 0),
             "total_dma_length": d.get("total_dma_length", 0),
+            "nr_enter_dma": d.get("nr_enter_dma", 0),
             "nr_debug1": d.get("nr_resubmit", 0),
             "nr_debug2": d.get("nr_sq_full", 0),
-            "nr_debug3": d.get("nr_enter_dma", 0),
             "nr_debug4": d.get("nr_fixed_dma", 0),
         })
         # per-member deltas fold into the registry the same way

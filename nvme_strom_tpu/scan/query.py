@@ -564,15 +564,17 @@ class Query:
     def _order_index_path(self) -> Optional[str]:
         """Sidecar path that can serve this ordered terminal directly:
         unfiltered local ``order_by`` (the sorted order IS the index
-        order), ``quantiles`` (nearest-rank reads of the sorted keys),
-        or ``count_distinct`` (adjacent-diff over the sorted keys) —
+        order), ``top_k`` (the k best keys are the sidecar's head/tail),
+        ``quantiles`` (nearest-rank reads of the sorted keys), or
+        ``count_distinct`` (adjacent-diff over the sorted keys) —
         single integer column, or the two integer columns of a composite
         sidecar for order_by.  None when no index could apply."""
-        if (self._op not in ("order_by", "quantiles", "count_distinct")
+        if (self._op not in ("order_by", "quantiles", "count_distinct",
+                             "top_k")
                 or self._pred is not None
                 or not isinstance(self.source, str)):
             return None
-        cols = self._order[0]
+        cols = [self._topk[0]] if self._op == "top_k" else self._order[0]
         want = (1, 2) if self._op == "order_by" else (1,)
         if len(cols) not in want:
             return None
@@ -652,7 +654,8 @@ class Query:
             if oip is not None:
                 from .index import probe_index
                 if probe_index(oip, self.source):
-                    cols_ = self._order[0]
+                    cols_ = [self._topk[0]] if self._op == "top_k" \
+                        else self._order[0]
                     what = {
                         "order_by": "the sorted order IS the index "
                                     "order — positions read from the "
@@ -663,6 +666,8 @@ class Query:
                         "count_distinct": "adjacent-diff over the sorted "
                                           "sidecar keys — no table I/O "
                                           "at all",
+                        "top_k": "the k best keys are the sidecar's "
+                                 "head/tail — no scan, no table I/O",
                     }[self._op]
                     return QueryPlan(
                         operator=self._op, access_path="index",
@@ -814,7 +819,7 @@ class Query:
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
         if plan.access_path == "index" and self._op in (
-                "order_by", "quantiles", "count_distinct") \
+                "order_by", "quantiles", "count_distinct", "top_k") \
                 and self._index_col() is None:
             oip = self._order_index_path()
             idx = None
@@ -829,6 +834,8 @@ class Query:
                     return self._run_order_by_indexed(idx, device, session)
                 if self._op == "quantiles":
                     return self._run_quantiles_sidecar(idx)
+                if self._op == "top_k":
+                    return self._run_topk_sidecar(idx)
                 return self._run_count_distinct_sidecar(idx)
             path, size = self._source_facts()
             plan = dataclasses.replace(
@@ -1412,6 +1419,61 @@ class Query:
         return {"positions": poss, "keys": keyv, "payload": payl,
                 "count": np.int64(len(poss))}
 
+    @staticmethod
+    def _sidecar_descending_perm(ka: np.ndarray, lo_i: int,
+                                 hi_i: int) -> np.ndarray:
+        """[lo_i, hi_i) of the STABLE descending permutation of an
+        ascending-sorted key array: key groups reverse, rows WITHIN an
+        equal-key group keep ascending (physical) order — matching the
+        seqscan's stable lexsort over negated keys (a plain array
+        reversal would flip duplicate groups internally and make index
+        presence change the answer)."""
+        n = len(ka)
+        starts = np.flatnonzero(
+            np.concatenate(([True], ka[1:] != ka[:-1])))
+        group_ends = np.append(starts[1:], n)
+        if hi_i <= 4096:
+            # small head: walk key groups from the tail, stop once
+            # offset+limit rows are in hand — honoring the plan's
+            # "reads only the head" without an O(n log n) sort
+            parts = []
+            got = 0
+            for gi in range(len(starts) - 1, -1, -1):
+                parts.append(np.arange(starts[gi], group_ends[gi]))
+                got += group_ends[gi] - starts[gi]
+                if got >= hi_i:
+                    break
+            return np.concatenate(parts)[lo_i:hi_i]
+        # large/unbounded output: one vectorized stable argsort over the
+        # group ids beats a Python walk of every group
+        g = np.cumsum(np.concatenate(
+            ([0], (ka[1:] != ka[:-1]).astype(np.int64))))
+        return np.argsort(-g, kind="stable")[lo_i:hi_i]
+
+    def _run_topk_sidecar(self, idx) -> dict:
+        """Unfiltered top_k over an indexed integer column: the k best
+        keys are the sidecar's head (smallest) or stable-descending tail
+        (largest) — no scan.  Candidates then pass through the SAME
+        ``rank_topk`` as every other access path, so padding (worst
+        sentinel, position -1) and the sentinel squash cannot drift."""
+        import jax.numpy as jnp
+
+        from ..ops.topk import rank_topk
+        col, k, largest = self._topk
+        dt = self.schema.col_dtype(col)
+        n = len(idx.keys)
+        take = min(k, n)
+        if largest:
+            perm = self._sidecar_descending_perm(idx.keys, 0, take)
+            vals, pos = idx.keys[perm], idx.positions[perm]
+        else:
+            vals, pos = idx.keys[:take], idx.positions[:take]
+        v, p = rank_topk(jnp.asarray(np.ascontiguousarray(vals)),
+                         jnp.asarray(np.ascontiguousarray(pos)
+                                     .astype(self._pos_dtype())),
+                         k, dt, largest)
+        return {"values": np.asarray(v), "positions": np.asarray(p)}
+
     def _run_quantiles_sidecar(self, idx) -> dict:
         """Unfiltered exact quantiles with ZERO table I/O: the sidecar's
         sorted keys ARE the order, nearest-rank picks read straight from
@@ -1445,35 +1507,9 @@ class Query:
         end = n if limit is None else min(n, offset + limit)
         lo_i, hi_i = min(offset, n), min(end, n)
         if descending:
-            # STABLE descending: key groups reverse, but rows WITHIN an
-            # equal-key group keep ascending physical order — matching
-            # the seqscan's stable lexsort over negated keys (a plain
-            # array reversal would flip duplicate groups internally and
-            # make index presence change the answer)
-            ka = idx.keys
-            starts = np.flatnonzero(
-                np.concatenate(([True], ka[1:] != ka[:-1])))
-            group_ends = np.append(starts[1:], n)
-            if hi_i <= 4096:
-                # small head: walk key groups from the tail, stop once
-                # offset+limit rows are in hand — honoring the plan's
-                # "LIMIT reads only the head" without an O(n log n) sort
-                parts = []
-                got = 0
-                for gi in range(len(starts) - 1, -1, -1):
-                    parts.append(np.arange(starts[gi], group_ends[gi]))
-                    got += group_ends[gi] - starts[gi]
-                    if got >= hi_i:
-                        break
-                perm = np.concatenate(parts)[lo_i:hi_i]
-            else:
-                # large/unbounded output: one vectorized stable argsort
-                # over the group ids beats a Python walk of every group
-                g = np.cumsum(np.concatenate(
-                    ([0], (ka[1:] != ka[:-1]).astype(np.int64))))
-                perm = np.argsort(-g, kind="stable")[lo_i:hi_i]
+            perm = self._sidecar_descending_perm(idx.keys, lo_i, hi_i)
             pos = idx.positions[perm]
-            keys = ka[perm]
+            keys = idx.keys[perm]
         else:
             pos = idx.positions[lo_i:hi_i]
             keys = idx.keys[lo_i:hi_i]

@@ -60,6 +60,7 @@ def _row(cur: dict, prev: dict, verbose: bool) -> str:
         cols += [
             show_avg(d.get("clk_setup_prps", 0), d.get("nr_setup_prps", 0)),
             show_avg(d.get("clk_submit_dma", 0), d.get("nr_submit_dma", 0)),
+            f"{d.get('nr_enter_dma', 0):6d}",
             f"{d.get('nr_debug1', 0):6d}",
             f"{d.get('nr_debug2', 0):6d}",
             f"{d.get('nr_debug3', 0):6d}",
@@ -71,7 +72,8 @@ def _row(cur: dict, prev: dict, verbose: bool) -> str:
 def _header(verbose: bool) -> str:
     cols = ["submit ", "wait   ", "dma-lat", " avg-sz", " wrong", "  cur", "  max"]
     if verbose:
-        cols += ["plan   ", "sq-sub ", "resub ", "sqfull", "h2d   ", "fixed "]
+        cols += ["plan   ", "sq-sub ", "enters", "resub ", "sqfull",
+                 "h2d   ", "fixed "]
     return " ".join(cols)
 
 

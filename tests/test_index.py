@@ -831,3 +831,32 @@ def test_quantiles_and_count_distinct_from_sidecar(table):
     fq = Query(path, schema).where_eq(0, int(c0[0])).quantiles(0, [0.5])
     assert fq.explain().access_path == "index"
     assert int(fq.run()["n"]) == int((c0 == c0[0]).sum())
+
+
+def test_topk_from_sidecar_matches_scan(table):
+    """Unfiltered top_k over an indexed integer column serves from the
+    sidecar head/tail with zero table I/O — values, positions, ties and
+    k>n padding all identical to the scan kernel's answer."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    # SCAN answers first — once the sidecar exists, every unfiltered
+    # top_k would ride it and the comparison would be index == index
+    big_k = len(c0) + 5
+    scan_ans = {
+        (9, True): Query(path, schema).top_k(0, 9).run(),
+        (9, False): Query(path, schema).top_k(0, 9, largest=False).run(),
+        (big_k, True): Query(path, schema).top_k(0, big_k).run(),
+    }
+    for (k, largest), seq in scan_ans.items():
+        assert Query(path, schema).top_k(0, k, largest=largest) \
+            .explain().access_path != "index"
+    build_index(path, schema, 0)
+    for (k, largest), seq in scan_ans.items():
+        q = Query(path, schema).top_k(0, k, largest=largest)
+        assert q.explain().access_path == "index"
+        assert "no table I/O" in q.explain().reason
+        r = q.run()
+        np.testing.assert_array_equal(r["values"], seq["values"],
+                                      err_msg=f"k={k} largest={largest}")
+        np.testing.assert_array_equal(r["positions"], seq["positions"],
+                                      err_msg=f"k={k} largest={largest}")
