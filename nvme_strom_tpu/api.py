@@ -158,6 +158,9 @@ STAT_FIELDS: Tuple[str, ...] = (
     # io_uring_enter covers a whole task's SQE batch per ring, so
     # nr_enter_dma / nr_submit_dma ~ 1/batch)
     "nr_enter_dma",
+    # deepest ADAPTIVE H2D pipeline reached by a scan (gauge; grows only
+    # when the consumer observed itself blocking on transfer readiness)
+    "h2d_depth_reached",
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",
@@ -184,7 +187,7 @@ class StatInfo:
     def delta(new: "StatInfo", old: "StatInfo") -> "StatInfo":
         d = {k: new.counters.get(k, 0) - old.counters.get(k, 0) for k in new.counters}
         # gauges are point-in-time, not deltas
-        for g in ("cur_dma_count", "max_dma_count"):
+        for g in ("cur_dma_count", "max_dma_count", "h2d_depth_reached"):
             if g in new.counters:
                 d[g] = new.counters[g]
         return StatInfo(version=new.version, has_debug=new.has_debug,

@@ -27,6 +27,7 @@ import numpy as np
 from ..api import StromError
 from ..config import config
 from ..engine import Session, Source, open_source
+from ..stats import stats
 from ..numa import bind_to_node
 from .heap import PAGE_SIZE, HeapSchema
 from .planner import capability_cache
@@ -294,6 +295,7 @@ class TableScanner:
         depth_cap = max(1, min(int(config.get("h2d_depth_max")),
                                self.pool.n_chunks - self.async_depth - 1))
         depth = min(2, depth_cap)
+        self.last_h2d_depth = depth   # per-scan observability (ANALYZE)
         inflight: List[tuple] = []   # (dev_pages, batch), oldest first
 
         def retire_oldest() -> None:
@@ -308,6 +310,8 @@ class TableScanner:
             acc = fold_results(acc, filter_fn(dev_pages), combine)
             if blocked and depth < depth_cap:
                 depth += 1
+                self.last_h2d_depth = depth
+                stats.gauge_max("h2d_depth_reached", depth)
         with ResourceOwner("scan_filter") as owner:
             gen = self.batches(owner=owner, auto_recycle=False)
             try:

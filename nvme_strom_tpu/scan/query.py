@@ -795,6 +795,9 @@ class Query:
 
             _fold(session)
             before = _stats.snapshot(reset_max=False).counters
+            # per-run attribution: an index-served run must report 0, not
+            # a previous scan's depth
+            self._last_scan_h2d_depth = 0
             t0 = _time.monotonic()
             out = self.run(mesh=mesh, device=device, kernel=kernel,
                            batch_pages=batch_pages, session=session)
@@ -803,7 +806,8 @@ class Query:
             after = _stats.snapshot(reset_max=False).counters
             d = {k: after.get(k, 0) - before.get(k, 0)
                  for k in ("total_dma_length", "nr_submit_dma",
-                           "nr_ioctl_memcpy_wait", "nr_wrong_wakeup")}
+                           "nr_ioctl_memcpy_wait", "nr_wrong_wakeup",
+                           "nr_enter_dma")}
             nsub = max(d["nr_submit_dma"], 1)
             out["_analyze"] = {
                 "elapsed_s": round(dt, 6),
@@ -811,6 +815,12 @@ class Query:
                 "requests": int(d["nr_submit_dma"]),
                 "avg_dma_bytes": int(d["total_dma_length"] // nsub),
                 "waits": int(d["nr_ioctl_memcpy_wait"]),
+                "submit_syscalls": int(d["nr_enter_dma"]),
+                # per-RUN value from this run's scanner (the registry
+                # gauge is process-lifetime and would misattribute a
+                # previous scan's pipelining to an index-served query)
+                "h2d_depth_reached": int(
+                    getattr(self, "_last_scan_h2d_depth", 0)),
                 "scan_GBps": round(d["total_dma_length"] / dt / (1 << 30), 3)
                 if dt > 0 else None,
             }
@@ -938,8 +948,11 @@ class Query:
             try:
                 with TableScanner(src, self.schema,
                                   session=session) as sc:
-                    return self._finalize(
-                        sc.scan_filter(fn, device=device, combine=combine))
+                    out = sc.scan_filter(fn, device=device,
+                                         combine=combine)
+                    self._last_scan_h2d_depth = getattr(
+                        sc, "last_h2d_depth", 0)
+                    return self._finalize(out)
             finally:
                 if own:
                     src.close()

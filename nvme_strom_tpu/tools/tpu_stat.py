@@ -61,6 +61,9 @@ def _row(cur: dict, prev: dict, verbose: bool) -> str:
             show_avg(d.get("clk_setup_prps", 0), d.get("nr_setup_prps", 0)),
             show_avg(d.get("clk_submit_dma", 0), d.get("nr_submit_dma", 0)),
             f"{d.get('nr_enter_dma', 0):6d}",
+            # spare debug pairs, current writers: 1 = engine short-I/O
+            # resubmits, 2 = SQ-full stalls, 3 = staging-pipeline H2D
+            # landings (hbm/staging.py retire()), 4 = fixed-buffer rides
             f"{d.get('nr_debug1', 0):6d}",
             f"{d.get('nr_debug2', 0):6d}",
             f"{d.get('nr_debug3', 0):6d}",

@@ -846,6 +846,8 @@ def test_topk_from_sidecar_matches_scan(table):
         (9, True): Query(path, schema).top_k(0, 9).run(),
         (9, False): Query(path, schema).top_k(0, 9, largest=False).run(),
         (big_k, True): Query(path, schema).top_k(0, big_k).run(),
+        (big_k, False): Query(path, schema)
+        .top_k(0, big_k, largest=False).run(),
     }
     for (k, largest), seq in scan_ans.items():
         assert Query(path, schema).top_k(0, k, largest=largest) \
