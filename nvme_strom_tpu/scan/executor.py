@@ -296,6 +296,10 @@ class TableScanner:
                                self.pool.n_chunks - self.async_depth - 1))
         depth = min(2, depth_cap)
         self.last_h2d_depth = depth   # per-scan observability (ANALYZE)
+        # seed the process gauge with the starting depth so the registry
+        # and ANALYZE agree whenever any pipelined scan ran (the gauge
+        # otherwise only moved on deepening and could never read 2)
+        stats.gauge_max("h2d_depth_reached", depth)
         inflight: List[tuple] = []   # (dev_pages, batch), oldest first
 
         def retire_oldest() -> None:

@@ -1088,6 +1088,8 @@ class Query:
                     with TableScanner(src, self.schema,
                                       session=session) as sc:
                         sc.scan_filter(collect, device=device)
+                        self._last_scan_h2d_depth = getattr(
+                            sc, "last_h2d_depth", 0)
                 finally:
                     if own:
                         src.close()
@@ -1640,6 +1642,8 @@ class Query:
                     with TableScanner(src, self.schema,
                                       session=session) as sc:
                         out = sc.scan_filter(fn, device=device)
+                        self._last_scan_h2d_depth = getattr(
+                            sc, "last_h2d_depth", 0)
                 finally:
                     if own:
                         src.close()

@@ -1033,3 +1033,60 @@ def test_partitioned_join_parity_local_and_mesh(heap):
         assert int(mesh_out["payload_sum"]) == int(base["payload_sum"])
     finally:
         config.set("join_broadcast_max", old)
+
+
+def test_partitioned_join_surfaces_injected_faults(tmp_path):
+    """A mid-pass read fault in the local partitioned join surfaces as
+    StromError (first-error latch), the session stays usable, and the
+    mesh exchange path surfaces the same fault class."""
+    import jax
+    import pytest as _pytest
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE as _PS
+    from nvme_strom_tpu.testing import FakeNvmeSource, FaultPlan
+
+    schema = HeapSchema(n_cols=2, visibility=True)
+    rng = np.random.default_rng(3)
+    n = schema.tuples_per_page * 32
+    c0 = rng.integers(-100, 100, n).astype(np.int32)
+    c1 = rng.integers(0, 50, n).astype(np.int32)
+    path = str(tmp_path / "pj.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", True)
+    keys = np.arange(-100, 100, dtype=np.int32)
+    vals = keys * 2
+
+    old = config.get("join_broadcast_max")
+    old_chunk = config.get("chunk_size")
+    config.set("join_broadcast_max", 1024)
+    # small chunks: the table must be larger than one chunk or every
+    # byte rides the buffered tail path and the DIRECT fault never fires
+    config.set("chunk_size", 64 << 10)
+    try:
+        src = FakeNvmeSource(path, force_cached_fraction=0.0,
+                             fault_plan=FaultPlan(
+                                 fail_offsets={4 * _PS}))
+        try:
+            with _pytest.raises(StromError):
+                Query(src, schema).join(0, keys, vals).run()
+        finally:
+            src.close()
+        # healthy source afterwards: same process keeps working
+        out = Query(path, schema).join(0, keys, vals).run()
+        oracle = np.isin(c0, keys)
+        # visibility defaults to all-ones in build_heap_file
+        assert int(out["matched"]) == int(oracle.sum())
+
+        src2 = FakeNvmeSource(path, force_cached_fraction=0.0,
+                              fault_plan=FaultPlan(fail_offsets={4 * _PS}))
+        try:
+            mesh = make_scan_mesh(jax.devices())
+            with _pytest.raises(StromError):
+                Query(src2, schema).join(0, keys, vals).run(
+                    mesh=mesh, batch_pages=8)
+        finally:
+            src2.close()
+    finally:
+        config.set("join_broadcast_max", old)
+        config.set("chunk_size", old_chunk)
