@@ -286,6 +286,8 @@ def _emit_cpu_fallback(path: str, device_error: str) -> int:
             "captured_at": cand.get("captured_at"),
             "stale_device_rows": True,
             "error_device": device_error,
+            **({"provenance": cand["provenance"]}
+               if cand.get("provenance") else {}),
             "note": why + "; ssd2tpu rows are the most recent healthy "
                     "capture journaled in BENCH_CANDIDATE.json"
                     + ("; cpu_live rows were measured now." if row
