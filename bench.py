@@ -145,23 +145,57 @@ def run_vfs():
             pass
     return size / (time.monotonic() - t0) / (1 << 30)
 
+def run_raw():
+    # raw O_DIRECT at the engine's own request size: the stable
+    # denominator (the buffered baseline is bimodal on virtio disks --
+    # readahead mode swings it 0.4-2.9 GB/s between windows)
+    import mmap
+    drop_page_cache(path)
+    blk = 1 << 20
+    buf = mmap.mmap(-1, blk)
+    try:
+        fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
+    except OSError:
+        return None
+    try:
+        t0 = time.monotonic()
+        off = 0
+        while off < size:
+            # short direct reads are legal; every byte must be read or
+            # the denominator inflates.  Any failure makes this row None
+            # without zeroing the direct/vfs rows already measured.
+            n = os.preadv(fd, [memoryview(buf)[:min(blk, size - off)]], off)
+            if n <= 0:
+                return None
+            off += n
+        dt = time.monotonic() - t0
+    except OSError:
+        return None
+    finally:
+        os.close(fd)
+    return size / dt / (1 << 30)
+
 # Interleaved alternation (VERDICT r2 #7): each round measures BOTH modes
 # back-to-back (order flipping every round so neither inherits a warm/cold
 # disk systematically) and the official ratio is the MEDIAN of the
 # per-round ratios — adjacent-in-time pairs cancel the shared host's
 # cross-run disk noise that best-of-N-per-mode could not.
-directs, vfss, ratios = [], [], []
+directs, vfss, ratios, raw_ratios = [], [], [], []
 for r in range(3):
     if r % 2 == 0:
         d, v = run_direct(), run_vfs()
     else:
         v, d = run_vfs(), run_direct()
+    rw = run_raw()
     directs.append(d)
     vfss.append(v)
     ratios.append(d / v)
+    if rw:
+        raw_ratios.append(d / rw)
 direct = max(directs)
 vfs = max(vfss)
 ratio = round(statistics.median(ratios), 3)
+raw_ratio = round(statistics.median(raw_ratios), 3) if raw_ratios else None
 raid0 = 0.0
 # 4-member RAID-0 stripe row (VERDICT r1 #1 asked the fallback to carry
 # the CPU-pinned rows, ssd2ram AND raid0).  Best-effort: a raid0-stage
@@ -204,6 +238,7 @@ finally:
 print("ROW=" + json.dumps({{"direct": round(direct, 3),
                             "vfs": round(vfs, 3),
                             "ratio": ratio,
+                            "vs_raw_odirect": raw_ratio,
                             "raid0": round(raid0, 3)
                             if raid0 else None}}))
 """
@@ -306,6 +341,7 @@ def _emit_cpu_fallback(path: str, device_error: str) -> int:
             "value": row["direct"],
             "unit": "GB/s",
             "vs_baseline": row.get("ratio"),
+            "vs_raw_odirect": row.get("vs_raw_odirect"),
             "error_device": device_error,
             "note": why + " and no healthy capture journaled; reporting "
                     "the CPU-pinned engine rows (SSD->RAM direct vs "
@@ -316,6 +352,7 @@ def _emit_cpu_fallback(path: str, device_error: str) -> int:
         out["cpu_live"] = {
             "ssd2ram_seq_GBps": row["direct"],
             "vs_baseline": row.get("ratio"),
+            "vs_raw_odirect": row.get("vs_raw_odirect"),
             "raid0_4x_GBps": row.get("raid0"),
         }
     elif cpu_error is not None:
