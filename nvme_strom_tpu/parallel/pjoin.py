@@ -69,8 +69,15 @@ def partition_build_sharded(build_keys, build_values, mesh: Mesh,
         vals_p[p, :n] = bv[sel]
     nreal = sizes.astype(np.int32).reshape(dp, 1)
     sh2 = NamedSharding(mesh, P("dp", None))
-    return (jax.device_put(keys_p, sh2), jax.device_put(vals_p, sh2),
-            jax.device_put(nreal, sh2))
+    # make_array_from_callback: every process computes the identical
+    # partition tables from the (replicated) host build side and places
+    # only its ADDRESSABLE rows.  device_put with a global sharding also
+    # works (jax replicates host data across processes); this form just
+    # states the per-process placement explicitly, matching the
+    # checkpoint harness's pattern.
+    return tuple(
+        jax.make_array_from_callback(a.shape, sh2, lambda i, a=a: a[i])
+        for a in (keys_p, vals_p, nreal))
 
 
 def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,

@@ -362,6 +362,21 @@ def _worker_main(process_id: int, num_processes: int, devices_per_proc: int,
                                       wsave[shard.index[0]])
     result["checks"]["ckpt_save_sharded"] = list(wshape)
 
+    # 7. partitioned hash join: build hash-sharded 1/dp per device,
+    #    all_to_all row routing to key owners across REAL process
+    #    boundaries, local sorted-probe, psum — the exchange-based join
+    #    strategy end to end in multi-process
+    from ..parallel.pjoin import make_partitioned_join_step
+    jkeys = np.arange(-60, 60, dtype=np.int32)
+    jstep = make_partitioned_join_step(mesh, schema, 0, jkeys,
+                                       (jkeys * 3).astype(np.int32))
+    jout = jstep(pages_np)
+    exp_m = int((np.asarray(valid)
+                 & np.isin(np.asarray(cols[0]), jkeys)).sum())
+    got_m = int(np.asarray(jout["matched"]))
+    assert got_m == exp_m, (got_m, exp_m)
+    result["checks"]["pjoin"] = got_m
+
     result["ok"] = True
     with open(os.path.join(workdir, f"result_{process_id}.json"), "w") as f:
         json.dump(result, f)

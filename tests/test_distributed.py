@@ -26,7 +26,8 @@ def test_multi_process_distributed(tmp_path, nproc, dpp):
         # every proof ran
         assert set(r["checks"]) == {"sharded_load", "scan_step",
                                     "stream_fold", "dist_sort",
-                                    "ckpt_restore", "ckpt_save_sharded"}
+                                    "ckpt_restore", "ckpt_save_sharded",
+                                    "pjoin"}
     # each process loaded exactly its share of the rows (2 pages/device)
     n_pages = 2 * nproc * dpp
     assert all(r["checks"]["sharded_load"] == n_pages // nproc
