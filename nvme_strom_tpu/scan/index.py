@@ -60,6 +60,20 @@ def _table_stamp(path: str) -> Tuple[int, int]:
     return int(st.st_size), int(st.st_mtime_ns)
 
 
+def exact_int(v, dt: np.dtype):
+    """*v* as an exact ``dt`` scalar, or None when no such value exists
+    (NaN/inf, fractional, or out of the dtype's range) — THE
+    representability check shared by every composite-key probe path, so
+    the index/seqscan transparency semantics cannot drift between
+    copies."""
+    f = float(v)
+    info = np.iinfo(dt)
+    if not np.isfinite(f) or f != int(f) \
+            or not info.min <= int(v) <= info.max:
+        return None
+    return dt.type(int(v))
+
+
 def _to_u32_order(a: np.ndarray, dt: np.dtype) -> np.ndarray:
     """Order-preserving map of a 4-byte integer column onto uint64 in
     [0, 2^32): int32 biases by +2^31, uint32 passes through."""
@@ -192,20 +206,37 @@ class SortedIndex:
         dt0, dt1 = self.key_dtypes
         out = []
         for pair in values:
-            v0, v1 = pair
-            ok = True
-            for v, dt in ((v0, dt0), (v1, dt1)):
-                f = float(v)
-                info = np.iinfo(dt)
-                # isfinite FIRST: int(nan)/int(inf) raise, and a probe no
-                # int column can hold must match nothing, never crash
-                if (not np.isfinite(f) or f != int(f)
-                        or not info.min <= int(v) <= info.max):
-                    ok = False
-            if ok:
-                out.append(int(pack_pair(dt0.type(int(v0)),
-                                         dt1.type(int(v1)), dt0, dt1)))
+            n0 = exact_int(pair[0], dt0)
+            n1 = exact_int(pair[1], dt1)
+            if n0 is not None and n1 is not None:
+                out.append(int(pack_pair(n0, n1, dt0, dt1)))
         return np.asarray(out, np.uint64)
+
+    def prefix_range(self, lo0=None, hi0=None) -> np.ndarray:
+        """Composite index only: positions of ALL rows whose FIRST key
+        column lies in ``[lo0, hi0]`` (either bound open) — the SQL
+        leftmost-prefix rule: a filter on c0 alone scans the contiguous
+        packed range ``[pack(lo0, min1), pack(hi0, max1)]``.  Equality is
+        ``prefix_range(v, v)``.  A bound c0 cannot represent exactly
+        matches nothing on that side (callers pass normalized integer
+        bounds; this is the defensive backstop)."""
+        dt0, dt1 = self.key_dtypes
+        i1 = np.iinfo(dt1)
+        a = 0
+        b = len(self.keys)
+        if lo0 is not None:
+            n0 = exact_int(lo0, dt0)
+            if n0 is None:
+                return np.zeros(0, np.int64)
+            lo = pack_pair(n0, dt1.type(i1.min), dt0, dt1)
+            a = int(np.searchsorted(self.keys, lo, side="left"))
+        if hi0 is not None:
+            n0 = exact_int(hi0, dt0)
+            if n0 is None:
+                return np.zeros(0, np.int64)
+            hi = pack_pair(n0, dt1.type(i1.max), dt0, dt1)
+            b = int(np.searchsorted(self.keys, hi, side="right"))
+        return self.positions[a:max(a, b)]
 
     def lookup(self, values) -> np.ndarray:
         """Row positions of rows whose key equals any of *values*

@@ -589,34 +589,67 @@ class Query:
         key = cols[0] if len(cols) == 1 else (cols[0], cols[1])
         return index_path_for(self.source, key)
 
-    def _index_path_for_eq(self) -> Optional[str]:
+    def _index_path_candidates(self) -> List[str]:
+        """Sidecars that could serve the structured filter, preferred
+        first: the filter column's own, then — for single-column filters
+        — any composite sidecar whose FIRST column is the filter column
+        (the SQL leftmost-prefix rule; its packed keys hold the filter
+        column's range contiguously).  The directory glob runs once per
+        Query (memoized): freshness is re-probed per use anyway, and the
+        planner path must stay I/O-cheap."""
         col = self._index_col()
         if col is None or not isinstance(self.source, str):
-            return None
+            return []
         from .index import index_path_for
-        return index_path_for(self.source, col)
+        out = [index_path_for(self.source, col)]
+        if not isinstance(col, (tuple, list)):
+            cached = getattr(self, "_prefix_cands", None)
+            if cached is None:
+                import glob as _glob
+                import re as _re
+                # escape the table path (metacharacter paths must not
+                # become character classes) and accept ONLY the exact
+                # .idx<c0>_<c1> shape — never .tmp litter or lookalikes
+                pat = _glob.escape(self.source) + f".idx{int(col)}_*"
+                rx = _re.compile(
+                    _re.escape(self.source) + rf"\.idx{int(col)}_\d+$")
+                cached = sorted(p for p in _glob.glob(pat)
+                                if rx.fullmatch(p))
+                self._prefix_cands = cached
+            out += cached
+        return out
 
     def _index_fresh_for_eq(self) -> bool:
         """Header-only planner probe (no key/position load — EXPLAIN
-        stays I/O-cheap); missing/stale/corrupt all mean False."""
-        ipath = self._index_path_for_eq()
-        if ipath is None:
-            return False
+        stays I/O-cheap); missing/stale/corrupt all mean False.  Any
+        candidate (own sidecar or a composite leftmost-prefix match)
+        counts."""
         from .index import probe_index
-        return probe_index(ipath, self.source)
+        return any(probe_index(p, self.source)
+                   for p in self._index_path_candidates())
 
     def _index_for_eq(self):
-        """A FRESH sorted-index sidecar for the where_eq column, or None
-        (missing/stale/corrupt all mean seqscan fallback, silently — the
-        planner never fails a query over an optional accelerator)."""
-        ipath = self._index_path_for_eq()
-        if ipath is None:
-            return None
+        """A FRESH sorted-index sidecar serving the structured filter, or
+        None (missing/stale/corrupt all mean seqscan fallback, silently —
+        the planner never fails a query over an optional accelerator).
+        Candidates in preference order: the filter column's own sidecar,
+        then composite ones usable via the leftmost-prefix rule."""
         from .index import open_index
-        try:
-            return open_index(ipath, table_path=self.source)
-        except Exception:   # corrupt sidecars included, not just Strom/OS
-            return None
+        col = self._index_col()
+        for ipath in self._index_path_candidates():
+            try:
+                idx = open_index(ipath, table_path=self.source)
+            except Exception:  # corrupt sidecars included, not just Strom/OS
+                continue
+            # the header is authoritative, not the filename: a sidecar
+            # built for other columns (index_path= override) must never
+            # serve this filter
+            want = tuple(col) if isinstance(col, (tuple, list)) else col
+            if idx.col == want or (idx.composite
+                                   and not isinstance(want, tuple)
+                                   and idx.col[0] == want):
+                return idx
+        return None
 
     def explain(self, *, mesh=None) -> QueryPlan:
         plan = self._explain_inner(mesh=mesh)
@@ -1212,17 +1245,28 @@ class Query:
 
     def _index_positions(self, idx) -> np.ndarray:
         """Positions matching the structured filter via the sidecar."""
+        prefix = idx.composite and not isinstance(self._index_col(),
+                                                  (tuple, list))
         if self._eq is not None:
             # value None = the normalized literal can match no row (e.g.
             # 7.5 against an int column) — the seqscan's empty answer
             if self._eq[1] is None:
                 return np.zeros(0, np.int64)
+            if prefix:   # c0-only equality over a (c0, c1) sidecar
+                v = self._eq[1]
+                return idx.prefix_range(v, v)
             # composite pair and single value both arrive as ONE probe;
             # SortedIndex.lookup handles the packing when composite
             return idx.lookup([self._eq[1]])
         if self._in is not None:
+            if prefix:
+                parts = [idx.prefix_range(m, m) for m in self._in[1]]
+                return np.concatenate(parts) if parts \
+                    else np.zeros(0, np.int64)
             return idx.lookup(self._in[1])
         _c, lo, hi = self._range
+        if prefix:
+            return idx.prefix_range(lo, hi)
         return idx.range(lo, hi)
 
     @staticmethod

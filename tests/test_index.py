@@ -862,3 +862,65 @@ def test_topk_from_sidecar_matches_scan(table):
                                       err_msg=f"k={k} largest={largest}")
         np.testing.assert_array_equal(r["positions"], seq["positions"],
                                       err_msg=f"k={k} largest={largest}")
+
+
+def test_leftmost_prefix_rule_over_composite_sidecar(table):
+    """With ONLY a composite (c0, c1) sidecar present, single-column
+    structured filters on c0 still ride the index via the leftmost-
+    prefix rule — eq, range, and IN all return the seqscan's row sets;
+    filters on c1 (not a prefix) stay on the scan path."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+
+    probes = {
+        "eq": lambda q: q.where_eq(0, 42),
+        "range": lambda q: q.where_range(0, 50, 70),
+        "range_frac": lambda q: q.where_range(0, 49.5, 70.5),
+        "in": lambda q: q.where_in(0, [3, 42, 199, 10**6]),
+    }
+    seq = {k: f(Query(path, schema)).select([1]).run()
+           for k, f in probes.items()}
+    for k, f in probes.items():
+        assert f(Query(path, schema)).select([1]).explain() \
+            .access_path != "index"
+
+    build_index(path, schema, (0, 1))   # composite ONLY — no .idx0
+    for k, f in probes.items():
+        q = f(Query(path, schema)).select([1])
+        assert q.explain().access_path == "index", k
+        r = q.run()
+        np.testing.assert_array_equal(np.sort(r["positions"]),
+                                      np.sort(seq[k]["positions"]),
+                                      err_msg=k)
+        np.testing.assert_array_equal(np.sort(r["col1"]),
+                                      np.sort(seq[k]["col1"]), err_msg=k)
+    # aggregate face too
+    sa = Query(path, schema).where_eq(0, 42).aggregate([1]).run()
+    assert int(sa["count"]) == int((c0 == 42).sum())
+    assert int(sa["sums"][0]) == int(c1[c0 == 42].sum())
+    # c1 is NOT a leftmost prefix of (c0, c1): seqscan
+    q1 = Query(path, schema).where_eq(1, 5).select([0])
+    assert q1.explain().access_path != "index"
+
+
+def test_prefix_candidate_hygiene(table, tmp_path):
+    """Candidate discovery is strict: a sidecar whose header names other
+    columns never serves the filter (filename is not authoritative), and
+    .tmp litter / lookalike names are ignored."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    # a REAL index for columns (1, 0) saved under the 0_* naming: the
+    # header says (1, 0), so a filter on col 0 must NOT use it via the
+    # prefix rule (c1 is its leading column)
+    build_index(path, schema, (1, 0), index_path=path + ".idx0_9")
+    q = Query(path, schema).where_eq(0, 42).select([1])
+    out = q.run()   # must be the seqscan answer regardless of plan
+    np.testing.assert_array_equal(np.sort(out["positions"]),
+                                  np.flatnonzero(c0 == 42))
+    os.unlink(path + ".idx0_9")
+    # .tmp litter is never a candidate
+    with open(path + ".idx0_1.tmp", "wb") as f:
+        f.write(b"garbage")
+    q2 = Query(path, schema).where_eq(0, 42).select([1])
+    assert q2.explain().access_path != "index"
+    assert int(q2.run()["count"]) == int((c0 == 42).sum())
