@@ -90,6 +90,15 @@ def pack_pair(a0, a1, dt0: np.dtype, dt1: np.dtype) -> np.ndarray:
     return (u0 << np.uint64(32)) | u1
 
 
+def unpack_second(keys: np.ndarray, dt1: np.dtype) -> np.ndarray:
+    """Second-column values back out of packed composite keys (inverse
+    of the low word of :func:`pack_pair`)."""
+    low = keys & np.uint64(0xFFFFFFFF)
+    if np.dtype(dt1) == np.dtype(np.int32):
+        return (low.astype(np.int64) - (1 << 31)).astype(np.int32)
+    return low.astype(np.uint32)
+
+
 def index_path_for(table_path: str, col) -> str:
     """Default sidecar path: ``.idx{c}`` single, ``.idx{c0}_{c1}``
     composite."""
@@ -212,14 +221,11 @@ class SortedIndex:
                 out.append(int(pack_pair(n0, n1, dt0, dt1)))
         return np.asarray(out, np.uint64)
 
-    def prefix_range(self, lo0=None, hi0=None) -> np.ndarray:
-        """Composite index only: positions of ALL rows whose FIRST key
-        column lies in ``[lo0, hi0]`` (either bound open) — the SQL
-        leftmost-prefix rule: a filter on c0 alone scans the contiguous
-        packed range ``[pack(lo0, min1), pack(hi0, max1)]``.  Equality is
-        ``prefix_range(v, v)``.  A bound c0 cannot represent exactly
-        matches nothing on that side (callers pass normalized integer
-        bounds; this is the defensive backstop)."""
+    def _prefix_bracket(self, lo0, hi0) -> Tuple[int, int]:
+        """[a, b) sidecar bracket of first-key-column range [lo0, hi0]
+        (either bound open; a bound c0 cannot represent exactly empties
+        the bracket on that side).  THE one implementation behind every
+        leftmost-prefix read."""
         dt0, dt1 = self.key_dtypes
         i1 = np.iinfo(dt1)
         a = 0
@@ -227,16 +233,32 @@ class SortedIndex:
         if lo0 is not None:
             n0 = exact_int(lo0, dt0)
             if n0 is None:
-                return np.zeros(0, np.int64)
+                return 0, 0
             lo = pack_pair(n0, dt1.type(i1.min), dt0, dt1)
             a = int(np.searchsorted(self.keys, lo, side="left"))
         if hi0 is not None:
             n0 = exact_int(hi0, dt0)
             if n0 is None:
-                return np.zeros(0, np.int64)
+                return 0, 0
             hi = pack_pair(n0, dt1.type(i1.max), dt0, dt1)
             b = int(np.searchsorted(self.keys, hi, side="right"))
-        return self.positions[a:max(a, b)]
+        return a, max(a, b)
+
+    def prefix_span(self, v0) -> Tuple[int, int]:
+        """Composite index only: the [a, b) sidecar span whose first key
+        column equals *v0* (empty when unrepresentable) — within it keys
+        are sorted by the SECOND column, which is what makes
+        ``WHERE c0 = v ORDER BY c1`` a single contiguous read."""
+        return self._prefix_bracket(v0, v0)
+
+    def prefix_range(self, lo0=None, hi0=None) -> np.ndarray:
+        """Composite index only: positions of ALL rows whose FIRST key
+        column lies in ``[lo0, hi0]`` (either bound open) — the SQL
+        leftmost-prefix rule: a filter on c0 alone scans the contiguous
+        packed range ``[pack(lo0, min1), pack(hi0, max1)]``.  Equality is
+        ``prefix_range(v, v)``."""
+        a, b = self._prefix_bracket(lo0, hi0)
+        return self.positions[a:b]
 
     def lookup(self, values) -> np.ndarray:
         """Row positions of rows whose key equals any of *values*
@@ -302,17 +324,34 @@ def _read_header(f, path: str) -> Tuple[dict, int]:
     return meta, (16 + jlen + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
-def probe_index(index_path: str, table_path: str) -> bool:
+def probe_index(index_path: str, table_path: str, *,
+                expect_col=None, allow_prefix: bool = True) -> bool:
     """Header-only freshness check for the PLANNER: one 4KB-class read,
     no key/position load.  Returns False for missing, stale, corrupt, or
     unreadable sidecars — the planner never fails a query over an
-    optional accelerator."""
+    optional accelerator.
+
+    *expect_col* additionally validates the header's column field (the
+    filename is NOT authoritative): an int accepts a single-column
+    sidecar on that column or — with ``allow_prefix`` (filters; NOT
+    terminals that read the keys as values) — a composite whose LEADING
+    column matches; a tuple demands that exact pair.  So EXPLAIN can
+    never claim an index path run() would then refuse."""
     try:
         with open(index_path, "rb") as f:
             meta, _ = _read_header(f, index_path)
         size, mtime = _table_stamp(table_path)
-        return (size == meta["table_size"]
-                and mtime == meta["table_mtime_ns"])
+        if size != meta["table_size"] or mtime != meta["table_mtime_ns"]:
+            return False
+        if expect_col is not None:
+            mcol = meta["col"]
+            if isinstance(expect_col, (tuple, list)):
+                return (isinstance(mcol, list)
+                        and tuple(mcol) == tuple(expect_col))
+            if isinstance(mcol, list):
+                return allow_prefix and mcol[0] == int(expect_col)
+            return mcol == int(expect_col)
+        return True
     except Exception:
         return False
 

@@ -561,6 +561,27 @@ class Query:
                 return f[0]
         return None
 
+    def _eq_order_combo_path(self) -> Optional[str]:
+        """Composite sidecar path serving ``WHERE c0 = v ORDER BY c1``
+        (single-column structured equality + single-column order_by over
+        a DIFFERENT integer column), or None."""
+        if (self._op != "order_by" or self._eq is None
+                or isinstance(self._eq[0], (tuple, list))
+                or not isinstance(self.source, str)):
+            return None
+        oc = self._order[0]
+        if len(oc) != 1:
+            return None
+        ce, c1 = int(self._eq[0]), int(oc[0])
+        if ce == c1:
+            return None
+        for c in (ce, c1):
+            if not 0 <= c < self.schema.n_cols \
+                    or self.schema.col_dtype(c).kind not in "iu":
+                return None
+        from .index import index_path_for
+        return index_path_for(self.source, (ce, c1))
+
     def _order_index_path(self) -> Optional[str]:
         """Sidecar path that can serve this ordered terminal directly:
         unfiltered local ``order_by`` (the sorted order IS the index
@@ -619,13 +640,25 @@ class Query:
             out += cached
         return out
 
+    def _replan_scan(self, plan: QueryPlan) -> QueryPlan:
+        """An index promised at EXPLAIN raced away before run(): choose
+        the SCAN access path afresh (falling into vfs unconditionally
+        would demote large tables off the direct DMA path)."""
+        path, size = self._source_facts()
+        return dataclasses.replace(
+            plan, access_path="direct"
+            if path is not None and should_use_direct_scan(
+                path, table_size=size) else "vfs")
+
     def _index_fresh_for_eq(self) -> bool:
         """Header-only planner probe (no key/position load — EXPLAIN
         stays I/O-cheap); missing/stale/corrupt all mean False.  Any
         candidate (own sidecar or a composite leftmost-prefix match)
-        counts."""
+        counts — validated against the HEADER's column field, so EXPLAIN
+        never claims an index path run() would refuse."""
         from .index import probe_index
-        return any(probe_index(p, self.source)
+        col = self._index_col()
+        return any(probe_index(p, self.source, expect_col=col)
                    for p in self._index_path_candidates())
 
     def _index_for_eq(self):
@@ -683,10 +716,35 @@ class Query:
         cd = cost_direct_scan(n_pages, n_pages * t)
         cv = cost_vfs_scan(n_pages, n_pages * t)
         if mode == "local" and kernel != "invalid":
+            comb = self._eq_order_combo_path()
+            if comb is not None and self._eq[1] is not None:
+                from .index import probe_index
+                if probe_index(comb, self.source,
+                               expect_col=(int(self._eq[0]),
+                                           int(self._order[0][0]))):
+                    ce, _v = self._eq
+                    oc = self._order[0][0]
+                    return QueryPlan(
+                        operator=self._op, access_path="index",
+                        kernel=kernel, mode=mode, n_pages=n_pages,
+                        cost_direct=cd.total, cost_vfs=cv.total,
+                        reason=f"fresh composite index on col({ce}, "
+                               f"{oc}): WHERE col{ce} = ... ORDER BY "
+                               f"col{oc} is ONE pinned-prefix span of "
+                               f"the sidecar (keys within the prefix "
+                               f"are already in col{oc} order) — no "
+                               f"sort, no table I/O; " + why)
             oip = self._order_index_path()
             if oip is not None:
                 from .index import probe_index
-                if probe_index(oip, self.source):
+                ocols = [self._topk[0]] if self._op == "top_k" \
+                    else self._order[0]
+                okey = ocols[0] if len(ocols) == 1 else tuple(ocols[:2])
+                # exact header match, no prefix: these terminals read
+                # the KEYS as values, so a composite sidecar can only
+                # serve the exact pair ordering
+                if probe_index(oip, self.source, expect_col=okey,
+                               allow_prefix=False):
                     cols_ = [self._topk[0]] if self._op == "top_k" \
                         else self._order[0]
                     what = {
@@ -861,6 +919,22 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
+        if plan.access_path == "index" and self._op == "order_by" \
+                and self._eq is not None:
+            comb = self._eq_order_combo_path()
+            idx = None
+            if comb is not None:
+                from .index import open_index
+                try:
+                    cand = open_index(comb, table_path=self.source)
+                    ce, oc = int(self._eq[0]), int(self._order[0][0])
+                    if cand.composite and cand.col == (ce, oc):
+                        idx = cand
+                except Exception:   # raced away: fall to the sort path
+                    idx = None
+            if idx is not None:
+                return self._run_order_by_prefix(idx)
+            plan = self._replan_scan(plan)
         if plan.access_path == "index" and self._op in (
                 "order_by", "quantiles", "count_distinct", "top_k") \
                 and self._index_col() is None:
@@ -873,6 +947,14 @@ class Query:
                 except Exception:   # raced away: fall to the sort path
                     idx = None
             if idx is not None:
+                # header authoritative (same contract as the probe):
+                # these terminals read keys as VALUES, exact match only
+                ocols = [self._topk[0]] if self._op == "top_k" \
+                    else self._order[0]
+                okey = ocols[0] if len(ocols) == 1 else tuple(ocols[:2])
+                if idx.col != okey:
+                    idx = None
+            if idx is not None:
                 if self._op == "order_by":
                     return self._run_order_by_indexed(idx, device, session)
                 if self._op == "quantiles":
@@ -880,11 +962,7 @@ class Query:
                 if self._op == "top_k":
                     return self._run_topk_sidecar(idx)
                 return self._run_count_distinct_sidecar(idx)
-            path, size = self._source_facts()
-            plan = dataclasses.replace(
-                plan, access_path="direct"
-                if path is not None and should_use_direct_scan(
-                    path, table_size=size) else "vfs")
+            plan = self._replan_scan(plan)
         if plan.access_path == "index":
             idx = self._index_for_eq()
             # explicit per-op dispatch: an op added to the planner's
@@ -900,14 +978,7 @@ class Query:
                       }.get(self._op)
             if idx is not None and runner is not None:
                 return runner(idx, device, session)
-            # index raced away since explain: recompute the SCAN path
-            # choice (falling into the vfs branch unconditionally would
-            # demote large tables off the direct DMA path)
-            path, size = self._source_facts()
-            plan = dataclasses.replace(
-                plan, access_path="direct"
-                if path is not None and should_use_direct_scan(
-                    path, table_size=size) else "vfs")
+            plan = self._replan_scan(plan)
         if self._op == "select":
             return self._run_select(plan, device, session)
         if self._op == "join":
@@ -1552,6 +1623,32 @@ class Query:
         k = idx.keys
         d = 0 if len(k) == 0 else int((k[1:] != k[:-1]).sum()) + 1
         return {"distinct": np.int32(d)}
+
+    def _run_order_by_prefix(self, idx) -> dict:
+        """``WHERE c0 = v ORDER BY c1`` from a composite (c0, c1)
+        sidecar: the matching rows are ONE contiguous sidecar span,
+        already sorted by c1 (packed-key low word) — no sort, no table
+        I/O; values unpack straight from the keys."""
+        from .index import unpack_second
+        _ce, v = self._eq
+        _cols, descending, limit, offset = self._order
+        a, b = idx.prefix_span(v) if v is not None else (0, 0)
+        span_keys = idx.keys[a:b]
+        span_pos = idx.positions[a:b]
+        n = b - a
+        end = n if limit is None else min(n, offset + limit)
+        lo_i, hi_i = min(offset, n), min(end, n)
+        vals1 = unpack_second(span_keys, idx.key_dtypes[1])
+        if descending:
+            perm = self._sidecar_descending_perm(vals1, lo_i, hi_i)
+            pos = span_pos[perm]
+            vals = vals1[perm]
+        else:
+            pos = span_pos[lo_i:hi_i]
+            vals = vals1[lo_i:hi_i]
+        return {"values": np.ascontiguousarray(vals),
+                "positions": np.ascontiguousarray(pos)
+                .astype(self._pos_dtype())}
 
     def _run_order_by_indexed(self, idx, device, session) -> dict:
         """ORDER BY served from a fresh sidecar: the index order IS the

@@ -924,3 +924,60 @@ def test_prefix_candidate_hygiene(table, tmp_path):
     q2 = Query(path, schema).where_eq(0, 42).select([1])
     assert q2.explain().access_path != "index"
     assert int(q2.run()["count"]) == int((c0 == 42).sum())
+
+
+def test_where_eq_order_by_rides_composite_prefix(table):
+    """WHERE c0 = v ORDER BY c1 over a composite (c0, c1) sidecar: one
+    pinned-prefix span, no sort, no table I/O — results equal the
+    filtered seqscan sort exactly (limit/offset/descending included)."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    v = int(c0[5])
+
+    variants = (dict(), dict(limit=3), dict(limit=4, offset=2),
+                dict(descending=True, limit=5))
+    seq = [Query(path, schema).where_eq(0, v).order_by(1, **kw).run()
+           for kw in variants]
+    for kw in variants:
+        assert Query(path, schema).where_eq(0, v).order_by(1, **kw) \
+            .explain().access_path != "index"
+
+    build_index(path, schema, (0, 1))
+    for kw, s in zip(variants, seq):
+        q = Query(path, schema).where_eq(0, v).order_by(1, **kw)
+        plan = q.explain()
+        assert plan.access_path == "index", kw
+        assert "pinned-prefix" in plan.reason
+        r = q.run()
+        np.testing.assert_array_equal(r["values"], s["values"],
+                                      err_msg=str(kw))
+        np.testing.assert_array_equal(r["positions"], s["positions"],
+                                      err_msg=str(kw))
+    # unrepresentable literal: empty on both paths (seqscan plan)
+    qe = Query(path, schema).where_eq(0, 7.5).order_by(1)
+    assert len(qe.run()["values"]) == 0
+    # ORDER BY the eq column itself: not the combo pattern
+    assert Query(path, schema).where_eq(0, v).order_by(0).explain() \
+        .reason.count("pinned-prefix") == 0
+
+
+def test_prefix_order_by_descending_tie_stability(tmp_path):
+    """Descending WHERE c0 = v ORDER BY c1 with HEAVY c1 duplicates:
+    equal-c1 rows keep ascending physical order exactly like the
+    seqscan's stable lexsort (a plain reversal would flip them)."""
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(77)
+    n = schema.tuples_per_page * 6
+    c0 = rng.integers(0, 3, n).astype(np.int32)
+    c1 = (rng.integers(0, 4, n)).astype(np.int32)   # 4 values: many ties
+    path = str(tmp_path / "tie.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", True)
+    seq = Query(path, schema).where_eq(0, 1) \
+        .order_by(1, descending=True).run()
+    build_index(path, schema, (0, 1))
+    q = Query(path, schema).where_eq(0, 1).order_by(1, descending=True)
+    assert q.explain().access_path == "index"
+    r = q.run()
+    np.testing.assert_array_equal(r["values"], seq["values"])
+    np.testing.assert_array_equal(r["positions"], seq["positions"])
