@@ -582,6 +582,14 @@ class Query:
         from .index import index_path_for
         return index_path_for(self.source, (ce, c1))
 
+    def _order_key(self):
+        """(order columns, sidecar key) for the op's ordered terminal —
+        THE single derivation explain() and run() both use, so the
+        EXPLAIN promise and run()'s acceptance check cannot drift."""
+        ocols = [self._topk[0]] if self._op == "top_k" else self._order[0]
+        okey = ocols[0] if len(ocols) == 1 else tuple(ocols[:2])
+        return ocols, okey
+
     def _order_index_path(self) -> Optional[str]:
         """Sidecar path that can serve this ordered terminal directly:
         unfiltered local ``order_by`` (the sorted order IS the index
@@ -595,7 +603,7 @@ class Query:
                 or self._pred is not None
                 or not isinstance(self.source, str)):
             return None
-        cols = [self._topk[0]] if self._op == "top_k" else self._order[0]
+        cols, _okey = self._order_key()
         want = (1, 2) if self._op == "order_by" else (1,)
         if len(cols) not in want:
             return None
@@ -737,16 +745,13 @@ class Query:
             oip = self._order_index_path()
             if oip is not None:
                 from .index import probe_index
-                ocols = [self._topk[0]] if self._op == "top_k" \
-                    else self._order[0]
-                okey = ocols[0] if len(ocols) == 1 else tuple(ocols[:2])
+                ocols, okey = self._order_key()
                 # exact header match, no prefix: these terminals read
                 # the KEYS as values, so a composite sidecar can only
                 # serve the exact pair ordering
                 if probe_index(oip, self.source, expect_col=okey,
                                allow_prefix=False):
-                    cols_ = [self._topk[0]] if self._op == "top_k" \
-                        else self._order[0]
+                    cols_ = ocols
                     what = {
                         "order_by": "the sorted order IS the index "
                                     "order — positions read from the "
@@ -949,9 +954,7 @@ class Query:
             if idx is not None:
                 # header authoritative (same contract as the probe):
                 # these terminals read keys as VALUES, exact match only
-                ocols = [self._topk[0]] if self._op == "top_k" \
-                    else self._order[0]
-                okey = ocols[0] if len(ocols) == 1 else tuple(ocols[:2])
+                _ocols, okey = self._order_key()
                 if idx.col != okey:
                     idx = None
             if idx is not None:
@@ -1638,14 +1641,16 @@ class Query:
         n = b - a
         end = n if limit is None else min(n, offset + limit)
         lo_i, hi_i = min(offset, n), min(end, n)
-        vals1 = unpack_second(span_keys, idx.key_dtypes[1])
         if descending:
+            # the group walk needs the whole span's key order
+            vals1 = unpack_second(span_keys, idx.key_dtypes[1])
             perm = self._sidecar_descending_perm(vals1, lo_i, hi_i)
             pos = span_pos[perm]
             vals = vals1[perm]
         else:
+            # LIMIT touches only the head: slice BEFORE unpacking
             pos = span_pos[lo_i:hi_i]
-            vals = vals1[lo_i:hi_i]
+            vals = unpack_second(span_keys[lo_i:hi_i], idx.key_dtypes[1])
         return {"values": np.ascontiguousarray(vals),
                 "positions": np.ascontiguousarray(pos)
                 .astype(self._pos_dtype())}

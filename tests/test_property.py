@@ -436,3 +436,26 @@ def test_composite_eq_index_equals_seqscan_random(tmp_path_factory,
     agg = Query(path, schema).where_eq((0, 1), probe).aggregate([2]).run()
     assert int(agg["count"]) == len(oracle)
     assert int(agg["sums"][0]) == int(c2[oracle].sum())
+
+    # leftmost-prefix rule over the same sidecar: single-col filters on
+    # c0 (eq + range) return the seqscan row sets
+    pr = Query(path, schema).where_eq(0, probe[0]).select([2])
+    assert pr.explain().access_path == "index"
+    np.testing.assert_array_equal(
+        np.sort(pr.run()["positions"]), np.flatnonzero(c0 == probe[0]))
+    rr = Query(path, schema).where_range(0, -2, 2).select([2]).run()
+    np.testing.assert_array_equal(
+        np.sort(rr["positions"]),
+        np.flatnonzero((c0 >= -2) & (c0 <= 2)))
+
+    # WHERE c0 = v ORDER BY c2 pinned-prefix (c2 int32 — the order_by
+    # terminal does not take uint32 keys): values/positions equal the
+    # stable seqscan sort (numpy lexsort oracle)
+    build_index(path, schema, (0, 2))
+    po = Query(path, schema).where_eq(0, probe[0]).order_by(2)
+    assert po.explain().access_path == "index"
+    ro = po.run()
+    sel = np.flatnonzero(c0 == probe[0])
+    order = sel[np.argsort(c2[sel], kind="stable")]
+    np.testing.assert_array_equal(ro["positions"], order)
+    np.testing.assert_array_equal(ro["values"], c2[order])
