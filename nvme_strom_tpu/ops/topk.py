@@ -66,9 +66,10 @@ def rank_topk(flat_v, flat_p, k: int, dt: np.dtype, largest: bool):
         vals = jnp.concatenate([vals, jnp.full((k - kk,), worst, dt)])
         positions = jnp.concatenate(
             [positions, jnp.full((k - kk,), -1, positions.dtype)])
-    # slots filled only by sentinels read position -1 (NB a real row
-    # whose value equals the sentinel is indistinguishable from one)
-    positions = jnp.where(vals == worst, -1, positions)
+    # pad slots and filtered-out rows already carry position -1 (the
+    # callers set it); a REAL row whose value happens to equal the worst
+    # sentinel keeps its position — value-based squashing would silently
+    # lose rows, and value 0 / UINT32_MAX are common in unsigned data
     return vals, positions
 
 

@@ -74,11 +74,19 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
     mesh = make_scan_mesh(devices, sp=1)
     dp = mesh.shape["dp"]
     dt = np.dtype(dtype)
-    if dt not in (np.dtype(np.int32), np.dtype(np.float32)):
-        raise ValueError(f"sort supports int32/float32 values, got {dt}")
+    if dt not in (np.dtype(np.int32), np.dtype(np.uint32),
+                  np.dtype(np.float32)):
+        raise ValueError(f"sort supports int32/uint32/float32 values, "
+                         f"got {dt}")
     is_f = dt.kind == "f"
-    worst = np.array((-np.inf if descending else np.inf) if is_f
-                     else (-(1 << 31) if descending else _I32_MAX), dt)
+    if is_f:
+        worst = np.array(-np.inf if descending else np.inf, dt)
+    else:
+        info = np.iinfo(dt)
+        worst = np.array(info.min if descending else info.max, dt)
+    # the all_to_all slab is int32; float AND uint values ride it as an
+    # order-free bitcast (restored on receive)
+    rebit = dt != np.dtype(np.int32)
 
     def key_of(v):
         # order-reversing transforms that cannot overflow (ops/topk.py)
@@ -108,7 +116,7 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
         bucket = jnp.searchsorted(splitters, key_of(values),
                                   side="right").astype(jnp.int32)
         vbits = jax.lax.bitcast_convert_type(values, jnp.int32) \
-            if is_f else values
+            if rebit else values
         cols = [vbits, payload] if with_payload else [vbits]
         recv, counts, keep = bucket_dispatch(
             jnp.stack(cols, -1), bucket, valid, dp, capacity)
@@ -120,8 +128,8 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
         src = jnp.arange(dp * capacity) // capacity
         got = slot < counts[src]
         rv = recv[:, 0]
-        if is_f:
-            rv = jax.lax.bitcast_convert_type(rv, jnp.float32)
+        if rebit:
+            rv = jax.lax.bitcast_convert_type(rv, jnp.dtype(dt))
         rv = jnp.where(got, rv, worst)
         out = {"count": jnp.sum(counts)[None],
                "n_dropped": jax.lax.psum(n_dropped, "dp")}

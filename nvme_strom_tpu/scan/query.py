@@ -1107,9 +1107,10 @@ class Query:
         if not 0 <= col < self.schema.n_cols:
             raise StromError(22, f"{opname} column {col} out of range")
         dt = self.schema.col_dtype(col)
-        if dt not in (np.dtype(np.int32), np.dtype(np.float32)):
-            raise StromError(22, f"{opname} supports int32/float32 "
-                                 f"columns (got {dt})")
+        if dt not in (np.dtype(np.int32), np.dtype(np.uint32),
+                      np.dtype(np.float32)):
+            raise StromError(22, f"{opname} supports int32/uint32/"
+                                 f"float32 columns (got {dt})")
         return dt
 
     @staticmethod

@@ -448,9 +448,10 @@ def test_composite_eq_index_equals_seqscan_random(tmp_path_factory,
         np.sort(rr["positions"]),
         np.flatnonzero((c0 >= -2) & (c0 <= 2)))
 
-    # WHERE c0 = v ORDER BY c2 pinned-prefix (c2 int32 — the order_by
-    # terminal does not take uint32 keys): values/positions equal the
-    # stable seqscan sort (numpy lexsort oracle)
+    # WHERE c0 = v ORDER BY c2 pinned-prefix (c2 is the int32 payload
+    # column, giving the oracle distinct values to order):
+    # values/positions equal the stable seqscan sort (numpy lexsort
+    # oracle)
     build_index(path, schema, (0, 2))
     po = Query(path, schema).where_eq(0, probe[0]).order_by(2)
     assert po.explain().access_path == "index"

@@ -155,3 +155,32 @@ def test_distinct_sentinel_valued_keys():
                                       dtype=np.float32)
     fout = fr(np.array([np.inf, np.inf, 1.0, np.inf], np.float32))
     assert int(fout["distinct"]) == 2
+
+
+def test_distributed_sort_uint32_values():
+    """uint32 keys ride the int32 slab as a bitcast and sort correctly,
+    including values above 2^31 (where a cast would corrupt order)."""
+    import jax
+
+    from nvme_strom_tpu.parallel.sort import (make_distributed_distinct,
+                                              make_distributed_sort)
+    rng = np.random.default_rng(13)
+    n_dev = len(jax.devices())
+    vals = rng.integers(0, 1 << 32, 64 * n_dev, dtype=np.uint64) \
+        .astype(np.uint32)
+    run, _mesh = make_distributed_sort(jax.devices(), capacity=len(vals),
+                                       dtype=np.uint32)
+    out = run(vals)
+    assert int(np.asarray(out["n_dropped"])) == 0
+    counts = np.asarray(out["count"]).reshape(-1)
+    got = np.concatenate([
+        np.asarray(out["values"])[b][:counts[b]]
+        for b in range(len(counts))])
+    np.testing.assert_array_equal(got, np.sort(vals))
+    assert got.dtype == np.uint32
+
+    drun, _m = make_distributed_distinct(jax.devices(),
+                                         capacity=len(vals),
+                                         dtype=np.uint32)
+    dout = drun(vals)
+    assert int(np.asarray(dout["distinct"])) == len(np.unique(vals))
