@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress test clean
+.PHONY: all native tsan stress test probe-loop clean
 
 all: native
 
@@ -32,6 +32,12 @@ test: native stress
 	    echo "$$out"; exit 1; \
 	  fi; \
 	fi
+
+# In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on
+# a cadence and runs the full device bench set in the first healthy window,
+# journaling to BENCH_CANDIDATE.json / BENCH_MATRIX.json / PROBE_LOOP.jsonl.
+probe-loop:
+	python bench.py --probe-loop
 
 clean:
 	$(MAKE) -C csrc clean

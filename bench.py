@@ -31,6 +31,18 @@ live CPU rows — the round's record keeps a real device number either way.
 Env knobs: BENCH_SIZE_MB (default 128), BENCH_FILE, BENCH_SMOKE=1 (64MB),
 BENCH_PROBE_ATTEMPTS (default 5), BENCH_REMEDIATE_IDLE (default 300s;
 0 disables the remediation stage).
+
+In-round capture loop (VERDICT r3 #1): ``python bench.py --probe-loop``
+(or ``make probe-loop``) probes the tunnel cheaply on a cadence
+(BENCH_PROBE_INTERVAL, default 600s) and, the moment a window is healthy,
+runs the FULL device capture set — the headline bench (which journals
+BENCH_CANDIDATE.json) followed by the tunnel-sensitive BENCH_MATRIX rows
+(h2d_peak, h2d_pinned_peak, ssd2tpu seq+mq32, scan_filter, ckpt_restore,
+chip-kernel ratios).  Every probe and capture is appended to
+PROBE_LOOP.jsonl with a timestamp, so the round's artifact trail shows
+*when* the window opened and what was measured in it — the round-end
+driver invocation then reports fresh rows instead of a journal replay.
+The loop exits 0 after one complete capture.
 """
 
 import json
@@ -175,23 +187,36 @@ def run_raw():
         os.close(fd)
     return size / dt / (1 << 30)
 
-# Interleaved alternation (VERDICT r2 #7): each round measures BOTH modes
+# Interleaved alternation (VERDICT r2 #7): each round measures the modes
 # back-to-back (order flipping every round so neither inherits a warm/cold
 # disk systematically) and the official ratio is the MEDIAN of the
 # per-round ratios — adjacent-in-time pairs cancel the shared host's
 # cross-run disk noise that best-of-N-per-mode could not.
-directs, vfss, ratios, raw_ratios = [], [], [], []
+# VERDICT r3 weak #1: the raw-O_DIRECT denominator is measured DIRECTLY
+# adjacent to the engine run (alternating which goes first) — in round 3
+# the vfs run sat between them, long enough for this disk's bimodal
+# readahead mode to flip between numerator and denominator, and the
+# official ratio recorded 0.61 while same-window A/Bs showed parity.
+# Every per-round (direct, raw, vfs) triple is embedded in the artifact
+# ("samples"), so an off ratio is auditable to a disk mode, not assumed.
+# even rounds run (direct, raw, vfs); odd rounds (vfs, raw, direct):
+# direct and raw stay ADJACENT in every round (the r3 fix) while the
+# direct/vfs pair still flips order round to round, so neither ratio's
+# denominator systematically inherits the other mode's cache state
+directs, vfss, ratios, raw_ratios, samples = [], [], [], [], []
 for r in range(3):
     if r % 2 == 0:
-        d, v = run_direct(), run_vfs()
+        d, rw, v = run_direct(), run_raw(), run_vfs()
     else:
-        v, d = run_vfs(), run_direct()
-    rw = run_raw()
+        v, rw, d = run_vfs(), run_raw(), run_direct()
     directs.append(d)
     vfss.append(v)
     ratios.append(d / v)
     if rw:
         raw_ratios.append(d / rw)
+    samples.append({{"direct": round(d, 3),
+                     "raw_odirect": round(rw, 3) if rw else None,
+                     "vfs": round(v, 3)}})
 direct = max(directs)
 vfs = max(vfss)
 ratio = round(statistics.median(ratios), 3)
@@ -239,6 +264,7 @@ print("ROW=" + json.dumps({{"direct": round(direct, 3),
                             "vfs": round(vfs, 3),
                             "ratio": ratio,
                             "vs_raw_odirect": raw_ratio,
+                            "samples": samples,
                             "raid0": round(raid0, 3)
                             if raid0 else None}}))
 """
@@ -353,6 +379,9 @@ def _emit_cpu_fallback(path: str, device_error: str) -> int:
             "ssd2ram_seq_GBps": row["direct"],
             "vs_baseline": row.get("ratio"),
             "vs_raw_odirect": row.get("vs_raw_odirect"),
+            # per-alternation (direct, raw, vfs) triples: the ratio's
+            # audit trail on this bimodal disk (VERDICT r3 weak #1)
+            "samples": row.get("samples"),
             "raid0_4x_GBps": row.get("raid0"),
         }
     elif cpu_error is not None:
@@ -361,7 +390,85 @@ def _emit_cpu_fallback(path: str, device_error: str) -> int:
     return 0
 
 
+# BENCH_MATRIX rows whose numbers depend on the device tunnel's state —
+# the set the in-round loop refreshes the moment a healthy window opens
+# (disk-only rows are re-measurable any time and are left alone)
+_TUNNEL_ROWS = ("h2d_peak,h2d_pinned_peak,ssd2tpu_seq,ssd2tpu_mq32,"
+                "scan_filter,ckpt_restore,filter_pallas_chip,"
+                "filter_xla_chip,groupbyf_pallas_chip,groupbyf_xla_chip")
+
+
+def _probe_loop() -> int:
+    """In-round capture daemon (VERDICT r3 #1): cheap probe on a cadence;
+    on the first healthy window run the full device capture set and
+    journal it.  Runs until one COMPLETE capture (headline + matrix rows)
+    lands, then exits 0 — restart it to refresh again."""
+    interval = int(os.environ.get("BENCH_PROBE_INTERVAL", "600"))
+    max_hours = float(os.environ.get("BENCH_PROBE_MAX_HOURS", "0"))
+    log_path = os.path.join(REPO, "PROBE_LOOP.jsonl")
+    matrix_size = os.environ.get("BENCH_SIZE_MB", "256")
+    t0 = time.monotonic()
+    headline_fresh = False
+
+    def logev(ev: dict) -> None:
+        ev = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **ev}
+        with open(log_path, "a") as f:
+            f.write(json.dumps(ev) + "\n")
+        sys.stderr.write(f"probe-loop: {json.dumps(ev)}\n")
+
+    while True:
+        ok = _probe_backend_once(90)
+        logev({"event": "probe", "ok": ok})
+        if ok:
+            if not headline_fresh:
+                # the headline capture journals BENCH_CANDIDATE.json itself
+                # on success; a mid-capture re-wedge degrades to the CPU
+                # fallback (rc 0, stale_device_rows) and we keep looping
+                env = _env()
+                env.update({"BENCH_PROBE_ATTEMPTS": "1",
+                            "BENCH_REMEDIATE_IDLE": "0"})
+                try:
+                    r = subprocess.run(
+                        [sys.executable, os.path.join(REPO, "bench.py")],
+                        capture_output=True, text=True, cwd=REPO, env=env,
+                        timeout=7200)
+                    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+                    parsed = json.loads(lines[-1]) if lines else {}
+                except (subprocess.TimeoutExpired, ValueError) as e:
+                    r = None
+                    parsed = {"error": str(e)[:500]}
+                headline_fresh = (r is not None and r.returncode == 0
+                                  and not parsed.get("stale_device_rows")
+                                  and not parsed.get("error_device")
+                                  and not parsed.get("error"))
+                logev({"event": "bench_capture", "fresh": headline_fresh,
+                       "out": parsed})
+            if headline_fresh:
+                env = _env()
+                env.update({"BENCH_ROWS": _TUNNEL_ROWS,
+                            "BENCH_SIZE_MB": matrix_size})
+                try:
+                    m = subprocess.run(
+                        [sys.executable, os.path.join(REPO, "bench_matrix.py")],
+                        capture_output=True, text=True, cwd=REPO, env=env,
+                        timeout=4 * 3600)
+                    mrc = m.returncode
+                    tail = (m.stdout + m.stderr)[-1500:]
+                except subprocess.TimeoutExpired as e:
+                    mrc, tail = -1, str(e)[:500]
+                logev({"event": "matrix_capture", "rc": mrc, "tail": tail})
+                if mrc == 0:
+                    logev({"event": "done"})
+                    return 0
+        if max_hours and time.monotonic() - t0 > max_hours * 3600:
+            logev({"event": "gave_up", "headline_fresh": headline_fresh})
+            return 0 if headline_fresh else 1
+        time.sleep(interval)
+
+
 def main() -> int:
+    if "--probe-loop" in sys.argv[1:]:
+        return _probe_loop()
     smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")
