@@ -180,6 +180,21 @@ class Config:
                      "space, 'auto' picks plain; A/B measured by "
                      "bench_matrix h2d_pinned_peak vs h2d_peak",
                 validate=_check_h2d_path))
+        reg(Var("backend_fence_timeout", 60.0, "float", minval=0.0,
+                help="seconds a device fence (block_until_ready) may "
+                     "block before the backend is declared LOST and "
+                     "in-flight staging fails with ENODEV instead of "
+                     "hanging (0 = unbounded; the reference's revocation "
+                     "callback blocks until DMA drains, kmod/pmemmap.c:"
+                     "149-208 — here the transport itself can die, so "
+                     "the drain must be bounded)"))
+        reg(Var("join_build_host_max", 256 << 20, "size", minval=1 << 12,
+                help="largest on-disk build-side table loaded whole "
+                     "(one projection scan) when partitioning a join "
+                     "build over the mesh; above it the build streams "
+                     "in partition-sized Grace passes so host RAM stays "
+                     "bounded to one partition + a scan batch "
+                     "(pgsql/nvme_strom.c:1186-1260 discipline)"))
         reg(Var("join_broadcast_max", 64 << 20, "size", minval=1 << 10,
                 help="largest build side (keys+values bytes) the join "
                      "replicates to every device; above it the planner "

@@ -26,6 +26,7 @@ framework's mesh collectives earn the capability.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import jax
@@ -33,12 +34,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.filter_xla import decode_pages
+from ..api import StromError
+
+from ..ops.filter_xla import decode_pages, global_row_positions
 from ..ops.join import _sorted_build, key_hash32
 from ..scan.heap import HeapSchema
 from .exchange import bucket_dispatch
 
-__all__ = ["make_partitioned_join_step", "partition_build_sharded"]
+__all__ = ["make_partitioned_join_step", "make_partitioned_join_rows_step",
+           "partition_build_sharded", "partition_build_sharded_from_table",
+           "combine_pos_words"]
 
 _I32_MAX = np.int32((1 << 31) - 1)
 
@@ -80,17 +85,117 @@ def partition_build_sharded(build_keys, build_values, mesh: Mesh,
         for a in (keys_p, vals_p, nreal))
 
 
+def partition_build_sharded_from_table(table_path: str, build_schema,
+                                       key_col: int, value_col: int,
+                                       mesh: Mesh, *,
+                                       session=None, device=None,
+                                       budget: Optional[int] = None):
+    """Hash-partitioned build side STREAMED from an on-disk heap table
+    (VERDICT r3 #8): host RAM during setup is bounded to one partition
+    plus a scan batch, not the dp x cap full-table materialization of
+    :func:`partition_build_sharded`.
+
+    When the build table is at most *budget* bytes (config
+    ``join_build_host_max`` by default), it is loaded with ONE projection
+    scan and handed to the in-memory partitioner (fast path — the extra
+    scans below buy nothing a budget-sized table needs).  Above the
+    budget, the Grace discipline the local join already applies to probe
+    passes is applied to the BUILD: one streamed counting scan sizes the
+    partitions, then each ADDRESSABLE partition is built by its own
+    predicate-pushdown scan (only rows hashing to that partition are
+    collected), sorted, padded, and placed directly on its owner device —
+    the bounded buffer-pool discipline of the reference's scan tier,
+    ``pgsql/nvme_strom.c:1186-1260``, applied to join setup.
+
+    Returns ``(keys_dev, vals_dev, nreal_dev)`` with the exact layout of
+    :func:`partition_build_sharded` (bit-identical partitions: same hash,
+    same sort, same padding), for ``build_parts=`` of the step factories.
+    """
+    from ..config import config
+    from ..scan.query import Query
+    dp = mesh.shape["dp"]
+    dt_k = build_schema.col_dtype(key_col)
+    if dt_k != np.dtype(np.int32):
+        raise ValueError("build key column must be int32")
+    if budget is None:
+        budget = int(config.get("join_build_host_max"))
+    table_bytes = os.path.getsize(table_path)
+    if table_bytes <= budget:
+        out = Query(table_path, build_schema) \
+            .select([key_col, value_col]).run(session=session,
+                                              device=device)
+        # in-memory partitioner (validates key uniqueness)
+        return partition_build_sharded(
+            out[f"col{key_col}"], out[f"col{value_col}"], mesh,
+            build_schema, key_col)
+
+    def owner(cols):
+        return (key_hash32(cols[key_col]) % jnp.uint32(dp)) \
+            .astype(jnp.int32)
+
+    # pass 0: partition sizes (streamed GROUP BY on the owner hash) —
+    # cap must be the GLOBAL max so every device's slab shape agrees
+    sizes_out = Query(table_path, build_schema).group_by(
+        owner, dp, agg_cols=[value_col]).run(session=session,
+                                             device=device)
+    sizes = np.asarray(sizes_out["count"]).reshape(-1).astype(np.int64)
+    cap = max(1, int(sizes.max()))
+
+    sh2 = NamedSharding(mesh, P("dp", None))
+    idx_map = sh2.addressable_devices_indices_map((dp, cap))
+    kshards, vshards, nshards = [], [], []
+    for dev, idx in idx_map.items():
+        p = idx[0].start or 0
+        # one bounded scan per addressable partition: ONLY rows hashing
+        # to p are collected (predicate pushdown), then sorted stably —
+        # identical ordering contract to the in-memory path
+        part = Query(table_path, build_schema) \
+            .where(lambda cols, p=p: owner(cols) == p) \
+            .select([key_col, value_col]) \
+            .run(session=session, device=device)
+        pk = np.asarray(part[f"col{key_col}"], np.int32)
+        pv = np.asarray(part[f"col{value_col}"], np.int32)
+        if len(np.unique(pk)) != len(pk):
+            raise ValueError("build_keys must be unique (inner join on "
+                             "a dimension key)")
+        order = np.argsort(pk, kind="stable")
+        n = len(pk)
+        if n != int(sizes[p]):
+            raise StromError(5, f"build table changed between passes "
+                                f"(partition {p}: {n} != {sizes[p]})")
+        kp = np.full(cap, _I32_MAX, np.int32)
+        vp = np.zeros(cap, np.int32)
+        kp[:n] = pk[order]
+        vp[:n] = pv[order]
+        kshards.append(jax.device_put(kp[None], dev))
+        vshards.append(jax.device_put(vp[None], dev))
+        nshards.append(jax.device_put(
+            np.array([[n]], np.int32), dev))
+    mk = jax.make_array_from_single_device_arrays
+    return (mk((dp, cap), sh2, kshards),
+            mk((dp, cap), sh2, vshards),
+            mk((dp, 1), sh2, nshards))
+
+
 def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
-                               probe_col: int, build_keys, build_values, *,
-                               predicate: Optional[Callable] = None):
+                               probe_col: int, build_keys=None,
+                               build_values=None, *,
+                               predicate: Optional[Callable] = None,
+                               build_parts=None):
     """Build ``step(global_pages) -> dict`` for
     :func:`..parallel.stream.distributed_scan_filter`: the partitioned
     join over one dp-sharded page batch.  Result contract matches
     :func:`..ops.join.make_join_fn` (``matched``/``sums``/``payload_sum``,
-    ``step.sum_cols``), so the two strategies are drop-in comparable."""
+    ``step.sum_cols``), so the two strategies are drop-in comparable.
+
+    ``build_parts`` — prebuilt ``(keys_dev, vals_dev, nreal_dev)`` from
+    :func:`partition_build_sharded_from_table` (the bounded-host-RAM
+    build); otherwise ``build_keys``/``build_values`` host arrays are
+    partitioned in memory."""
     dp = mesh.shape["dp"]
-    keys_dev, vals_dev, nreal_dev = partition_build_sharded(
-        build_keys, build_values, mesh, schema, probe_col)
+    keys_dev, vals_dev, nreal_dev = build_parts or \
+        partition_build_sharded(build_keys, build_values, mesh, schema,
+                                probe_col)
     sum_cols = [c for c in range(schema.n_cols)
                 if schema.col_dtype(c) == np.dtype(np.int32)]
     width = 1 + len(sum_cols)
@@ -133,4 +238,84 @@ def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
         return jitted(global_pages, keys_dev, vals_dev, nreal_dev)
 
     step.sum_cols = sum_cols
+    return step
+
+
+def combine_pos_words(lo: np.ndarray, hi: np.ndarray,
+                      dtype=np.int64) -> np.ndarray:
+    """Host-side reassembly of row positions routed through the int32
+    exchange as (lo, hi) words — the exchange slab is int32-wide, so an
+    int64 position (x64 mode) travels split and rejoins here; in int32
+    mode ``hi`` is all zeros and this is the identity."""
+    full = (lo.astype(np.uint32).astype(np.int64)
+            | (hi.astype(np.int64) << 32))
+    return full.astype(dtype)
+
+
+def make_partitioned_join_rows_step(mesh: Mesh, schema: HeapSchema,
+                                    probe_col: int, build_keys=None,
+                                    build_values=None, *,
+                                    predicate: Optional[Callable] = None,
+                                    build_parts=None):
+    """Row-materializing twin of :func:`make_partitioned_join_step`
+    (VERDICT r3 #3): same all_to_all routing, but instead of psum'ing
+    aggregates each owner device reports the per-routed-row join outcome
+    — ``hit`` mask, probed ``key``, matched build ``payload`` and the
+    row's global position as (``pos_lo``, ``pos_hi``) int32 words — so
+    the host compresses matched rows per batch exactly like the
+    broadcast row face (:func:`..ops.join.make_join_rows_fn`), and
+    ``join_broadcast_max`` never changes what a query can return (the
+    reference's scan always hands tuples back to the executor,
+    pgsql/nvme_strom.c:941-979).
+
+    Positions ride the exchange alongside the key: the probe outcome
+    lives on the key's owner device, not the scanning device, so the
+    position must travel with the row.  ``step(global_pages) -> dict``
+    of global ``(dp * dp * n_local,)`` arrays; rows where ``hit`` is
+    False are routing pads or non-matches.  ``build_parts`` as in
+    :func:`make_partitioned_join_step`."""
+    dp = mesh.shape["dp"]
+    keys_dev, vals_dev, nreal_dev = build_parts or \
+        partition_build_sharded(build_keys, build_values, mesh, schema,
+                                probe_col)
+
+    def _local(pages, keys_row, vals_row, nreal_row):
+        cols, valid = decode_pages(pages, schema)
+        sel = valid if predicate is None else valid & predicate(cols)
+        probe = cols[probe_col].reshape(-1)
+        sel_flat = sel.reshape(-1)
+        pos = global_row_positions(pages, schema).reshape(-1)
+        if pos.dtype == jnp.int64:
+            w = jax.lax.bitcast_convert_type(pos, jnp.int32)   # (N, 2)
+            pos_lo, pos_hi = w[:, 0], w[:, 1]
+        else:
+            pos_lo, pos_hi = pos, jnp.zeros_like(pos)
+        rows = jnp.stack([probe, pos_lo, pos_hi], axis=-1)
+        bucket = (key_hash32(probe) % jnp.uint32(dp)).astype(jnp.int32)
+        n = probe.shape[0]
+        # lossless exchange: capacity = the full local batch, as in the
+        # aggregate step (a join must never drop rows)
+        recv, recv_counts, _keep = bucket_dispatch(
+            rows, bucket, sel_flat, dp, n)
+        slot = jnp.arange(dp * n)
+        rvalid = (slot % n) < recv_counts[slot // n]
+        k = keys_row.reshape(-1)
+        v = vals_row.reshape(-1)
+        rk = recv[:, 0]
+        idx = jnp.clip(jnp.searchsorted(k, rk), 0, k.shape[0] - 1)
+        hit = rvalid & (idx < nreal_row[0]) & (k[idx] == rk)
+        return {"hit": hit, "key": rk, "payload": v[idx],
+                "pos_lo": recv[:, 1], "pos_hi": recv[:, 2]}
+
+    shard_mapped = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P("dp", None), P("dp", None), P("dp", None),
+                  P("dp", None)),
+        out_specs={"hit": P("dp"), "key": P("dp"), "payload": P("dp"),
+                   "pos_lo": P("dp"), "pos_hi": P("dp")})
+    jitted = jax.jit(shard_mapped)
+
+    def step(global_pages):
+        return jitted(global_pages, keys_dev, vals_dev, nreal_dev)
+
     return step

@@ -1705,75 +1705,66 @@ class Query:
         probe_col, bk, bv, materialize, limit, offset = self._join
         pred = self._pred
         from .executor import fold_results
+        if mesh is not None and materialize:
+            return self._run_join_partitioned_mesh_rows(
+                mesh, session, batch_pages, probe_col, bk, bv, limit,
+                offset)
         if mesh is not None and not materialize:
-            import jax
-
             from ..parallel.pjoin import make_partitioned_join_step
-            from ..parallel.stream import distributed_scan_filter
             step = make_partitioned_join_step(
                 mesh, self.schema, probe_col, bk, bv,
                 predicate=(lambda cols: pred(cols)) if pred else None)
             src, own = self._open_owned()
             try:
-                n_shards = mesh.shape["dp"]
-                n_pages = src.size // PAGE_SIZE
-                # same batch-size rounding discipline as run()'s generic
-                # mesh path, caller's batch_pages honored
-                bp = batch_pages or max(
-                    n_shards, (1 << 20) // PAGE_SIZE * n_shards)
-                bp = max(bp // n_shards * n_shards, n_shards)
-                bp = min(bp, n_pages // n_shards * n_shards)
                 acc = None
-                covered = 0
-                if bp >= n_shards:
-                    out = distributed_scan_filter(src, mesh, step,
-                                                  batch_pages=bp,
-                                                  session=session)
-                    if out:
-                        acc = out
-                    covered = (n_pages // bp) * bp
-                # tail: batched like the generic path (never one giant
-                # alloc), zero-padded to a dp multiple per batch (zero
-                # pages decode as 0 tuples) so the shard_map'ed step
-                # covers it too
-                tail_batch = max((8 << 20) // PAGE_SIZE, n_shards)
-                for p0 in range(covered, n_pages, tail_batch):
-                    npg = min(tail_batch, n_pages - p0)
-                    raw = bytearray(npg * PAGE_SIZE)
-                    src.read_buffered(p0 * PAGE_SIZE, memoryview(raw))
-                    pages = np.frombuffer(raw, np.uint8).reshape(
-                        -1, PAGE_SIZE)
-                    padn = (-npg) % n_shards
-                    if padn:
-                        pages = np.concatenate(
-                            [pages, np.zeros((padn, PAGE_SIZE), np.uint8)])
+                for pages in self._mesh_page_batches(src, mesh,
+                                                     batch_pages, session):
                     acc = fold_results(acc, step(pages), None)
                 return {} if acc is None else \
                     {k: np.asarray(v) for k, v in acc.items()}
             finally:
                 if own:
                     src.close()
-        # local (and any materialize face): Grace sequential passes
+        # local: Grace sequential passes (both faces)
         from ..ops.join import (hash_split_build, make_join_fn,
                                 make_join_rows_fn)
         parts = hash_split_build(bk, bv, n_parts)
         if materialize:
+            # LIMIT early-exit across Grace passes (VERDICT r3 #3): each
+            # partition scan stops issuing I/O at its remaining row
+            # budget, and partitions past the budget are never scanned
+            # at all — matching the broadcast row face's early DMA
+            # cut-off.  Row order is per-partition arrival order
+            # (unspecified, like SQL without ORDER BY), so taking the
+            # first offset+limit rows in partition order is a valid
+            # instance of the contract.
+            stop = None if limit is None else offset + limit
             poss, keyv, payl = [], [], []
+            gathered = 0
             for pk, pv in parts:
+                remaining = None if stop is None else stop - gathered
+                if remaining is not None and remaining <= 0:
+                    break
                 run = make_join_rows_fn(
                     self.schema, probe_col, pk, pv,
                     predicate=(lambda cols: pred(cols)) if pred else None)
                 p_, k_, y_ = self._collect_rows(
                     plan, run, "hit", ["positions", "key", "payload"],
                     [self._pos_dtype(), np.int32, np.int32],
-                    device, session)
+                    device, session, limit=remaining)
+                gathered += len(p_)
                 poss.append(p_)
                 keyv.append(k_)
                 payl.append(y_)
             end = None if limit is None else offset + limit
-            poss = np.concatenate(poss)[offset:end]
-            keyv = np.concatenate(keyv)[offset:end]
-            payl = np.concatenate(payl)[offset:end]
+            if poss:
+                poss = np.concatenate(poss)[offset:end]
+                keyv = np.concatenate(keyv)[offset:end]
+                payl = np.concatenate(payl)[offset:end]
+            else:   # limit=0 breaks before any partition scans
+                poss = np.zeros(0, self._pos_dtype())
+                keyv = np.zeros(0, np.int32)
+                payl = np.zeros(0, np.int32)
             return {"positions": poss, "keys": keyv, "payload": payl,
                     "count": np.int64(len(poss))}
         acc = None
@@ -1799,6 +1790,92 @@ class Query:
             acc = fold_results(acc, out, None)
         return {} if acc is None else \
             {k: np.asarray(v) for k, v in acc.items()}
+
+    def _mesh_page_batches(self, src, mesh, batch_pages, session):
+        """Yield dp-divisible page batches covering EVERY page of *src*:
+        the double-buffered sharded stream for the batch-aligned body,
+        then zero-padded host reads for the tail (zero pages decode as
+        no valid tuples, so the shard_map'ed step covers them too).
+        One implementation of the batch-rounding + tail discipline,
+        shared by the partitioned join's aggregate and row faces."""
+        from ..parallel.stream import ShardedBatchStream
+        n_shards = mesh.shape["dp"]
+        n_pages = src.size // PAGE_SIZE
+        bp = batch_pages or max(
+            n_shards, (1 << 20) // PAGE_SIZE * n_shards)
+        bp = max(bp // n_shards * n_shards, n_shards)
+        bp = min(bp, n_pages // n_shards * n_shards)
+        covered = 0
+        if bp >= n_shards:
+            with ShardedBatchStream(src, mesh, batch_pages=bp,
+                                    session=session) as stream:
+                for _first, arr in stream:
+                    yield arr
+            covered = (n_pages // bp) * bp
+        tail_batch = max((8 << 20) // PAGE_SIZE, n_shards)
+        for p0 in range(covered, n_pages, tail_batch):
+            npg = min(tail_batch, n_pages - p0)
+            raw = bytearray(npg * PAGE_SIZE)
+            src.read_buffered(p0 * PAGE_SIZE, memoryview(raw))
+            pages = np.frombuffer(raw, np.uint8).reshape(-1, PAGE_SIZE)
+            padn = (-npg) % n_shards
+            if padn:
+                pages = np.concatenate(
+                    [pages, np.zeros((padn, PAGE_SIZE), np.uint8)])
+            yield pages
+
+    def _run_join_partitioned_mesh_rows(self, mesh, session, batch_pages,
+                                        probe_col, bk, bv,
+                                        limit: Optional[int],
+                                        offset: int) -> dict:
+        """Mesh partitioned join, row face (VERDICT r3 #3): the build
+        lives hash-sharded 1/dp per device, every batch all_to_all-routes
+        rows (key + position words) to their owner, and each owner's
+        per-row outcomes come back for host-side compression — same
+        result contract as the broadcast row face, with the same LIMIT
+        early-exit (the stream stops issuing SSD DMA once offset+limit
+        matched rows are in hand)."""
+        from ..parallel.pjoin import (combine_pos_words,
+                                      make_partitioned_join_rows_step)
+        pred = self._pred
+        step = make_partitioned_join_rows_step(
+            mesh, self.schema, probe_col, bk, bv,
+            predicate=(lambda cols: pred(cols)) if pred else None)
+        stop = None if limit is None else offset + limit
+        chunks: List[tuple] = []
+        gathered = 0
+
+        def take(out) -> bool:
+            nonlocal gathered
+            hit = np.asarray(out["hit"]).astype(bool)
+            lo = np.asarray(out["pos_lo"])[hit]
+            hi = np.asarray(out["pos_hi"])[hit]
+            chunks.append((combine_pos_words(lo, hi, self._pos_dtype()),
+                           np.asarray(out["key"])[hit],
+                           np.asarray(out["payload"])[hit]))
+            gathered += int(hit.sum())
+            return stop is not None and gathered >= stop
+        src, own = self._open_owned()
+        try:
+            # LIMIT early-exit: the break closes the generator, which
+            # shuts the sharded stream down and stops issuing SSD DMA
+            for pages in self._mesh_page_batches(src, mesh, batch_pages,
+                                                 session):
+                if take(step(pages)):
+                    break
+        finally:
+            if own:
+                src.close()
+        if chunks:
+            poss = np.concatenate([c[0] for c in chunks])[offset:stop]
+            keyv = np.concatenate([c[1] for c in chunks])[offset:stop]
+            payl = np.concatenate([c[2] for c in chunks])[offset:stop]
+        else:
+            poss = np.zeros(0, self._pos_dtype())
+            keyv = np.zeros(0, np.int32)
+            payl = np.zeros(0, np.int32)
+        return {"positions": poss, "keys": keyv, "payload": payl,
+                "count": np.int64(len(poss))}
 
     @staticmethod
     def _mesh_sort_loop(mesh, factory, *arrays):

@@ -1031,6 +1031,30 @@ def test_partitioned_join_parity_local_and_mesh(heap):
         assert int(mesh_out["matched"]) == int(base["matched"])
         np.testing.assert_array_equal(mesh_out["sums"], base["sums"])
         assert int(mesh_out["payload_sum"]) == int(base["payload_sum"])
+
+        # mesh row face (VERDICT r3 #3): all_to_all-routed rows come back
+        # as the same row SET as broadcast (order is arrival order)
+        mesh_m = q(materialize=True).run(mesh=mesh, batch_pages=8)
+        assert int(mesh_m["count"]) == int(base_m["count"])
+        np.testing.assert_array_equal(np.sort(mesh_m["positions"]),
+                                      np.sort(base_m["positions"]))
+        np.testing.assert_array_equal(np.sort(mesh_m["keys"]),
+                                      np.sort(base_m["keys"]))
+        np.testing.assert_array_equal(np.sort(mesh_m["payload"]),
+                                      np.sort(base_m["payload"]))
+        # (position, key, payload) triples must agree row-for-row, not
+        # just column-sets: join each back through base's position order
+        bo = np.argsort(base_m["positions"])
+        mo = np.argsort(mesh_m["positions"])
+        np.testing.assert_array_equal(np.asarray(mesh_m["keys"])[mo],
+                                      np.asarray(base_m["keys"])[bo])
+        np.testing.assert_array_equal(np.asarray(mesh_m["payload"])[mo],
+                                      np.asarray(base_m["payload"])[bo])
+        # LIMIT/OFFSET early-exit on the mesh stream
+        mlm = q(materialize=True, limit=7, offset=2).run(mesh=mesh,
+                                                         batch_pages=8)
+        assert int(mlm["count"]) == 7
+        assert np.isin(mlm["positions"], base_m["positions"]).all()
     finally:
         config.set("join_broadcast_max", old)
 
@@ -1132,3 +1156,90 @@ def test_uint32_ordered_terminals(tmp_path):
     assert q2.explain().access_path == "index"
     np.testing.assert_array_equal(q2.run()["quantiles"],
                                   qt["quantiles"])
+
+
+def test_partitioned_build_streams_from_disk_bounded(tmp_path):
+    """VERDICT r3 #8: a join build side streamed from an on-disk table
+    larger than the host budget partitions in Grace passes — python-host
+    peak (tracemalloc; on the CPU test backend the PLACED device arrays
+    alias host numpy, so they appear in both paths and the measured
+    difference is exactly the dp x cap host materialization the streamed
+    path eliminates) stays a fraction of the in-memory partitioner's and
+    within one-partition transients over the placed bytes.  The placed
+    partitions are BIT-identical, and the join step consumes them
+    unchanged."""
+    import tracemalloc
+
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    from nvme_strom_tpu.parallel.pjoin import (
+        make_partitioned_join_step, partition_build_sharded,
+        partition_build_sharded_from_table)
+
+    config.set("debug_no_threshold", True)
+    bschema = HeapSchema(n_cols=2, visibility=False)
+    t = bschema.tuples_per_page
+    n_pages = 2048                     # 16MB build table
+    n = t * n_pages
+    rng = np.random.default_rng(23)
+    keys = rng.permutation(n).astype(np.int32)      # unique
+    vals = (keys * 3).astype(np.int32)
+    bpath = str(tmp_path / "build.heap")
+    build_heap_file(bpath, [keys, vals], bschema)
+    table_bytes = n_pages * 8192
+    mesh = make_scan_mesh(jax.devices())
+
+    # warm both code paths on a tiny table first: the FIRST XLA compile
+    # of the scan kernels allocates ~20MB python-side, which would
+    # otherwise swamp the data signal tracemalloc is here to measure
+    wpath = str(tmp_path / "warm.heap")
+    build_heap_file(wpath, [np.arange(t * 8, dtype=np.int32),
+                            np.arange(t * 8, dtype=np.int32)], bschema)
+    for budget in (1 << 12, 1 << 30):   # streamed AND fast path
+        partition_build_sharded_from_table(wpath, bschema, 0, 1, mesh,
+                                           budget=budget)
+
+    # in-memory path peak: full-table projection + dp x cap host tables
+    tracemalloc.start()
+    out = Query(bpath, bschema).select([0, 1]).run()
+    ref = partition_build_sharded(out["col0"], out["col1"], mesh,
+                                  bschema, 0)
+    inmem_peak = tracemalloc.get_traced_memory()[1]
+    tracemalloc.stop()
+    placed = sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in ref)
+    ref_np = [np.asarray(a) for a in ref]
+    del out, ref
+
+    tracemalloc.start()
+    parts = partition_build_sharded_from_table(
+        bpath, bschema, 0, 1, mesh, budget=1 << 20)
+    streamed_peak = tracemalloc.get_traced_memory()[1]
+    tracemalloc.stop()
+    # measured on this harness: ~0.33x (32MB vs 96MB on a 16MB table)
+    assert streamed_peak < inmem_peak * 0.55, (streamed_peak, inmem_peak)
+    assert streamed_peak < placed + 1.25 * table_bytes, \
+        (streamed_peak, placed)
+
+    for got, want in zip(parts, ref_np):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    # under-budget tables take the single-scan fast path, same result
+    fast = partition_build_sharded_from_table(
+        bpath, bschema, 0, 1, mesh, budget=table_bytes + 1)
+    for got, want in zip(fast, ref_np):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    # the step consumes prebuilt parts: every fact row probes its own
+    # key, so matched == fact row count
+    fpath = str(tmp_path / "fact.heap")
+    fn = t * 16
+    fkeys = rng.integers(0, n, fn).astype(np.int32)
+    build_heap_file(fpath, [fkeys, np.ones(fn, np.int32)], bschema)
+    step = make_partitioned_join_step(mesh, bschema, 0,
+                                      build_parts=parts)
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE
+    raw = open(fpath, "rb").read()
+    pages = np.frombuffer(raw, np.uint8).reshape(-1, PAGE_SIZE)
+    out = step(pages)
+    assert int(np.asarray(out["matched"])) == fn
