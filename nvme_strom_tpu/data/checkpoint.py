@@ -408,26 +408,46 @@ def _leaf_sharding(shardings, key: str):
 class _PinnedRing:
     """Rotating pinned buffers + H2D fencing for checkpoint restore.
 
-    Width comes from config ``h2d_depth_max`` (min 2): a deeper ring keeps
-    that many H2D reads in flight before the rotation fences the oldest —
-    the same deferred-fence discipline as the scan executor's pipeline
-    (VERDICT r2 #3)."""
+    Max width comes from config ``h2d_depth_max`` (min 2); the ACTIVE
+    rotation window is :class:`..hbm.staging.AdaptiveH2DDepth` — it
+    starts at 2, widens whenever the rotation actually blocks on a fence
+    (a wider window would have hidden that wait) and decays back when
+    fences stop blocking, the same deferred-fence policy as the scan
+    executor's pipeline (VERDICT r2 #3 + r3 #6).  Out-of-window buffers
+    keep their pending fences; they are fenced when the window grows back
+    over them or at close()."""
 
     def __init__(self, sess: Session, staging_bytes: int):
         from ..config import config
+        from ..hbm.staging import AdaptiveH2DDepth
         self.sess = sess
         self.cap = staging_bytes
         n = max(2, int(config.get("h2d_depth_max")))
-        self.bufs = [sess.alloc_dma_buffer(staging_bytes) for _ in range(n)]
-        self.fences: List[list] = [[] for _ in range(n)]
-        self.cur = 0
+        self.adaptive = AdaptiveH2DDepth(n)
+        # buffers allocate LAZILY as the window grows: pinned memory
+        # tracks the high-water of the window actually used, not
+        # h2d_depth_max (an 8-deep config on a never-blocking transport
+        # pins 2 buffers, not 8)
+        self.bufs: List[tuple] = []
+        self.fences: List[list] = []
+        self.cur = -1
 
     def next_buf(self):
-        """Rotate to the next pinned buffer; fence its previous H2D reads."""
-        self.cur = (self.cur + 1) % len(self.bufs)
+        """Rotate to the next in-window pinned buffer; fence its previous
+        H2D reads, feeding the observed wait back to the depth policy."""
+        import time as _time
+
+        from ..hbm.staging import bounded_fence
+        self.cur = (self.cur + 1) % self.adaptive.depth
+        while self.cur >= len(self.bufs):   # window grew: alloc lazily
+            self.bufs.append(self.sess.alloc_dma_buffer(self.cap))
+            self.fences.append([])
+        t0 = _time.monotonic_ns()
         for f in self.fences[self.cur]:
-            f.block_until_ready()
+            bounded_fence(f, "ckpt-h2d")   # ENODEV on a dead backend
+        blocked_ns = _time.monotonic_ns() - t0
         self.fences[self.cur] = []
+        self.adaptive.observe(blocked_ns)
         return self.bufs[self.cur]
 
     def put(self, host: np.ndarray, dev):
@@ -438,9 +458,14 @@ class _PinnedRing:
         return arr
 
     def close(self):
-        for fl in self.fences:
-            for f in fl:
-                f.block_until_ready()
+        from ..api import StromError as _SE
+        from ..hbm.staging import bounded_fence
+        try:
+            for fl in self.fences:
+                for f in fl:
+                    bounded_fence(f, "ckpt-drain")
+        except _SE:
+            pass   # backend lost: nothing to drain; free host buffers
         for handle, buf in self.bufs:
             try:
                 self.sess.unmap_buffer(handle)

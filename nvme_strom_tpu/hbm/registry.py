@@ -43,6 +43,7 @@ class HbmBuffer:
         self._array = array
         self.owner_uid = owner_uid
         self.refcount = 0
+        self.revoke_reason: Optional[str] = None   # set by revoke_all
         self._lock = threading.Lock()
         # Signalled whenever refcount drops; unmap() waits on it instead of
         # polling (same CV drain Session.unmap_buffer uses in engine.py).
@@ -131,14 +132,23 @@ class HbmRegistry:
     def unmap(self, handle: int, *, timeout: float = 30.0) -> None:
         """Revoke a handle, blocking until in-flight transfers drain — the
         ``callback_release_mapped_gpu_memory`` contract
-        (kmod/pmemmap.c:149-208)."""
+        (kmod/pmemmap.c:149-208).  A buffer already revoked by backend
+        loss unregisters immediately (its transfers died with the
+        backend; there is nothing left to drain)."""
         buf = self.get(handle)
         deadline = time.monotonic() + timeout
         with buf._lock:
+            already = buf._revoked
+        if already:   # outside buf._lock: registry lock nests self->buf
+            with self._lock:
+                self._buffers.pop(handle, None)
+            return
+        with buf._lock:
             # standard CV idiom: re-test the predicate after every wake,
             # including a timed-out one — a release landing exactly at the
-            # deadline must still win
-            while buf.refcount != 0:
+            # deadline must still win.  A concurrent revoke_all also ends
+            # the drain: the refcount can never drop once the backend died
+            while buf.refcount != 0 and not buf._revoked:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise StromError(
@@ -148,6 +158,27 @@ class HbmRegistry:
             buf._revoked = True
         with self._lock:
             self._buffers.pop(handle, None)
+
+    def revoke_all(self, why: str) -> int:
+        """Backend-loss revocation (VERDICT r3 #5): mark every registered
+        buffer revoked with ENODEV semantics — WITHOUT waiting for
+        refcounts (the in-flight transfers died with the backend), waking
+        any ``unmap`` drains so they observe the revocation instead of
+        waiting out a refcount that can no longer drop.  Buffers stay in
+        the table (listed, ``info`` works) until their owner unmaps them;
+        ``array``/``swap``/``acquire`` fail with ENODEV.  Returns the
+        number of buffers revoked."""
+        with self._lock:
+            bufs = list(self._buffers.values())
+        n = 0
+        for buf in bufs:
+            with buf._lock:
+                if not buf._revoked:
+                    buf._revoked = True
+                    buf.revoke_reason = why
+                    n += 1
+                buf._drained.notify_all()
+        return n
 
     # -- LIST / INFO -------------------------------------------------------
     def list(self) -> List[int]:
@@ -165,3 +196,9 @@ class HbmRegistry:
 
 #: process-global registry (one per process, like the module's handle table)
 registry = HbmRegistry()
+
+# backend loss revokes the global table's buffers (VERDICT r3 #5); private
+# registries opt in via monitor.register_registry
+from .backend import monitor as _monitor  # noqa: E402 - needs HbmRegistry
+
+_monitor.register_registry(registry)

@@ -36,7 +36,56 @@ from ..engine import Session, Source
 from ..stats import stats
 from .registry import HbmRegistry, registry as global_registry
 
-__all__ = ["StagingPipeline", "load_file_to_device"]
+__all__ = ["StagingPipeline", "load_file_to_device", "AdaptiveH2DDepth"]
+
+
+class AdaptiveH2DDepth:
+    """Depth controller for deferred-fence H2D pipelining, shared by the
+    scan executor and the checkpoint restore ring (VERDICT r2 #3 + r3 #6).
+
+    Grow by one whenever the consumer actually blocked on a transfer
+    fence (more overlap would have helped — the reference's ring deepens
+    the same way its 32-deep queue absorbs bursts,
+    ``pgsql/nvme_strom.c:862-936``); DECAY by one after ``decay_after``
+    consecutive fence-free retirements.  On a token-bucket transport the
+    two regimes alternate: a deepened pipeline that never shrinks keeps
+    pinned chunks out of the pool long after the burst window closed,
+    which is exactly backwards for the sustained regime — decay tracks
+    the closing window.
+
+    ``observe(blocked_ns)`` after each fence; read ``depth`` before each
+    dispatch."""
+
+    BLOCK_NS = 200_000    # a fence wait above 0.2ms counts as blocking
+
+    def __init__(self, cap: int, *, start: int = 2, floor: int = 2,
+                 decay_after: int = 4):
+        self.cap = max(1, int(cap))
+        self.floor = min(max(1, floor), self.cap)
+        self.depth = min(max(1, start), self.cap)
+        self.decay_after = max(1, decay_after)
+        self._streak = 0
+
+    def observe(self, blocked_ns: int) -> int:
+        if blocked_ns > self.BLOCK_NS:
+            self._streak = 0
+            if self.depth < self.cap:
+                self.depth += 1
+        else:
+            self._streak += 1
+            if self._streak >= self.decay_after and self.depth > self.floor:
+                self.depth -= 1
+                self._streak = 0
+        return self.depth
+
+
+def bounded_fence(arr, what: str = "h2d"):
+    """``block_until_ready`` through the backend monitor: bounded by
+    config ``backend_fence_timeout``; a deadline miss or runtime error
+    latches backend loss and raises ENODEV (VERDICT r3 #5).  Returns
+    *arr*."""
+    from .backend import monitor
+    return monitor.fence(arr, what=what)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -265,28 +314,46 @@ class StagingPipeline:
                 self._barriers[bufidx] = fence
                 stats.count_clock("debug3", time.monotonic_ns() - t0)
 
-            for batch in batches:
-                # if every staging buffer is in flight, retire the oldest
-                # first (the submit-ahead/wait-behind ring discipline of
-                # ssd2ram_test, utils/ssd2ram_test.c:139-226)
-                if len(inflight) >= self.n_buffers:
+            try:
+                for batch in batches:
+                    # if every staging buffer is in flight, retire the
+                    # oldest first (the submit-ahead/wait-behind ring
+                    # discipline of ssd2ram_test, utils/ssd2ram_test.c:
+                    # 139-226)
+                    if len(inflight) >= self.n_buffers:
+                        retire(inflight.pop(0))
+                    used = {s[0] for s in inflight}
+                    bufidx = next(i for i in range(self.n_buffers)
+                                  if i not in used)
+                    # bounded fence (VERDICT r3 #5): the device op that
+                    # last consumed this buffer must be done before the
+                    # SSD engine overwrites it — and a dead backend must
+                    # fail the command, not hang it
+                    if self._barriers[bufidx] is not None:
+                        bounded_fence(self._barriers[bufidx],
+                                      "staging-reuse")
+                        self._barriers[bufidx] = None
+                    handle, _ = self._bufs[bufidx]
+                    nbytes = len(batch) * chunk_size
+                    task = self.session.memcpy_ssd2ram(source, handle,
+                                                       batch, chunk_size)
+                    inflight.append((bufidx, task.dma_task_id, batch,
+                                     elem_cursor, nbytes))
+                    elem_cursor += nbytes // itemsize
+                while inflight:
                     retire(inflight.pop(0))
-                used = {s[0] for s in inflight}
-                bufidx = next(i for i in range(self.n_buffers) if i not in used)
-                # fence: the device op that last consumed this buffer must be
-                # done before the SSD engine overwrites it
-                if self._barriers[bufidx] is not None:
-                    self._barriers[bufidx].block_until_ready()
-                    self._barriers[bufidx] = None
-                handle, _ = self._bufs[bufidx]
-                nbytes = len(batch) * chunk_size
-                task = self.session.memcpy_ssd2ram(source, handle, batch,
-                                                   chunk_size)
-                inflight.append((bufidx, task.dma_task_id, batch,
-                                 elem_cursor, nbytes))
-                elem_cursor += nbytes // itemsize
-            while inflight:
-                retire(inflight.pop(0))
+            except BaseException:
+                # backend loss (or any mid-command failure): reap the
+                # in-flight SSD tasks, bounded, so the task table retains
+                # no orphans — then surface the FIRST error (the
+                # reference's first-error latch + retention discipline,
+                # kmod/nvme_strom.c:770-776)
+                for slot in inflight:
+                    try:
+                        self.session.memcpy_wait(slot[1], timeout=5.0)
+                    except StromError:
+                        pass
+                raise
             return MemCopyResult(dma_task_id=0, nr_chunks=len(out_ids),
                                  nr_ssd2dev=nr_ssd, nr_ram2dev=nr_ram,
                                  chunk_ids=out_ids)
@@ -294,14 +361,20 @@ class StagingPipeline:
             self.registry.release(hbm)
 
     def drain(self) -> None:
-        """Block until every outstanding device op has completed."""
+        """Block until every outstanding device op has completed (bounded
+        — a dead backend raises ENODEV instead of hanging)."""
         for i, b in enumerate(self._barriers):
             if b is not None:
-                b.block_until_ready()
+                bounded_fence(b, "staging-drain")
                 self._barriers[i] = None
 
     def close(self) -> None:
-        self.drain()
+        try:
+            self.drain()
+        except StromError:
+            # backend lost: nothing left to drain; the pinned host
+            # buffers below still free normally
+            self._barriers = [None] * self.n_buffers
         for handle, buf in self._bufs:
             try:
                 self.session.unmap_buffer(handle)

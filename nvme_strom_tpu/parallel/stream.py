@@ -125,9 +125,12 @@ class ShardedBatchStream:
         self._fence: List[Optional[jax.Array]] = [None, None]
 
     def _submit(self, b: int):
+        from ..hbm.staging import bounded_fence
         ring = b % 2
         if self._fence[ring] is not None:
-            self._fence[ring].block_until_ready()
+            # bounded: a dead backend fails the stream with ENODEV
+            # instead of hanging the double-buffer rotation
+            bounded_fence(self._fence[ring], "mesh-h2d")
             self._fence[ring] = None
         tasks = []
         base = b * self.batch_pages

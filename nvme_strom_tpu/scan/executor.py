@@ -275,47 +275,53 @@ class TableScanner:
         ``filter_fn(pages_u8_device) -> dict of scalars``; results are
         summed (or combined with *combine*).
 
-        ADAPTIVE H2D pipelining (VERDICT r2 #3): several batches keep
-        their device transfers in flight at once — the fence on batch *k*
-        is deferred until *k + depth* has been dispatched, so the H2D hop
-        rides transfer bursts the way the 32-deep loader does instead of
-        paying a synchronous fence per 16MB.  The depth starts at 2 and
-        deepens (up to config ``h2d_depth_max`` / pool headroom) whenever
-        the consumer observes itself actually blocking on a transfer —
-        i.e. exactly when more overlap would have helped."""
+        ADAPTIVE H2D pipelining (VERDICT r2 #3 + r3 #6): several batches
+        keep their device transfers in flight at once — the fence on
+        batch *k* is deferred until *k + depth* has been dispatched, so
+        the H2D hop rides transfer bursts the way the 32-deep loader does
+        instead of paying a synchronous fence per 16MB.  Depth policy is
+        :class:`..hbm.staging.AdaptiveH2DDepth`: start at 2, deepen (up
+        to config ``h2d_depth_max`` / pool headroom) whenever the
+        consumer actually blocks on a transfer, and DECAY after a streak
+        of fence-free retirements so a closed burst window releases its
+        pool chunks instead of pinning them for the rest of the scan."""
         import time as _time
 
         import jax
 
-        from ..hbm.staging import safe_device_put
+        from ..hbm.staging import (AdaptiveH2DDepth, bounded_fence,
+                                   safe_device_put)
         dev = device or jax.devices()[0]
         acc: Optional[dict] = None
         # pool must hold: DMA ring (async_depth) + the batch being drawn
         # + every consumer-held in-flight batch
         depth_cap = max(1, min(int(config.get("h2d_depth_max")),
                                self.pool.n_chunks - self.async_depth - 1))
-        depth = min(2, depth_cap)
-        self.last_h2d_depth = depth   # per-scan observability (ANALYZE)
+        ad = AdaptiveH2DDepth(depth_cap)
+        self.last_h2d_depth = ad.depth   # per-scan observability (ANALYZE)
         # seed the process gauge with the starting depth so the registry
         # and ANALYZE agree whenever any pipelined scan ran (the gauge
         # otherwise only moved on deepening and could never read 2)
-        stats.gauge_max("h2d_depth_reached", depth)
+        stats.gauge_max("h2d_depth_reached", ad.depth)
         inflight: List[tuple] = []   # (dev_pages, batch), oldest first
 
         def retire_oldest() -> None:
-            nonlocal acc, depth
+            nonlocal acc
             dev_pages, b = inflight.pop(0)
             t0 = _time.monotonic_ns()
             # safe_device_put copied on CPU; on accelerators the H2D read
-            # of the pinned chunk must finish before the chunk refills
-            dev_pages.block_until_ready()
-            blocked = _time.monotonic_ns() - t0 > 200_000   # >0.2ms wait
+            # of the pinned chunk must finish before the chunk refills.
+            # Bounded (VERDICT r3 #5): a dead backend fails the scan with
+            # ENODEV instead of hanging the fence
+            bounded_fence(dev_pages, "scan-h2d")
+            blocked_ns = _time.monotonic_ns() - t0
             self.recycle(b)
             acc = fold_results(acc, filter_fn(dev_pages), combine)
-            if blocked and depth < depth_cap:
-                depth += 1
-                self.last_h2d_depth = depth
-                stats.gauge_max("h2d_depth_reached", depth)
+            # last_h2d_depth = the PEAK this scan reached (ANALYZE's
+            # "h2d_depth_reached"); decay lowers ad.depth, not the peak
+            if ad.observe(blocked_ns) > self.last_h2d_depth:
+                self.last_h2d_depth = ad.depth
+                stats.gauge_max("h2d_depth_reached", ad.depth)
         with ResourceOwner("scan_filter") as owner:
             gen = self.batches(owner=owner, auto_recycle=False)
             try:
@@ -329,7 +335,7 @@ class TableScanner:
                                      batch))
                     # release below the depth budget BEFORE drawing the
                     # next batch, or the generator's pool alloc deadlocks
-                    while len(inflight) >= depth:
+                    while len(inflight) >= ad.depth:
                         retire_oldest()
                 while inflight:
                     retire_oldest()
@@ -339,7 +345,8 @@ class TableScanner:
                 # read is still consuming
                 for dev_pages, b in inflight:
                     try:
-                        dev_pages.block_until_ready()
+                        # bounded: post-loss teardown must not re-hang
+                        bounded_fence(dev_pages, "scan-teardown")
                     except Exception:   # noqa: BLE001 - teardown path
                         pass
                     self.recycle(b)

@@ -1,3 +1,4 @@
-from .fake import FakeNvmeSource, FaultPlan, make_test_file
+from .fake import FakeNvmeSource, FaultPlan, backend_fault, make_test_file
 
-__all__ = ["FakeNvmeSource", "FaultPlan", "make_test_file"]
+__all__ = ["FakeNvmeSource", "FaultPlan", "backend_fault",
+           "make_test_file"]

@@ -105,3 +105,40 @@ class FakeNvmeSource(PlainSource):
             from ..engine import Source
             return Source.hot_fraction(self, offset, length)
         return super().hot_fraction(offset, length)
+
+
+class backend_fault:
+    """Context manager injecting a device-backend failure at the H2D
+    fence (VERDICT r3 #5): ``mode="hang"`` makes the next fence exceed
+    its bounded timeout (the wedged-tunnel signature on this host);
+    ``mode="error"`` raises a PJRT-style runtime error from it.  Either
+    way the BackendMonitor latches loss, registered HBM buffers revoke
+    with ENODEV, and in-flight staging fails instead of hanging —
+    testable with no hardware at all.
+
+    On exit the monitor latch is RESET (buffers already revoked stay
+    revoked — loss is not retroactively undone, matching the reference's
+    one-way revocation callback, kmod/pmemmap.c:149-208)."""
+
+    def __init__(self, mode: str = "hang", *, hang_s: float = 30.0):
+        if mode not in ("hang", "error"):
+            raise ValueError(f"backend_fault mode {mode!r}")
+        self.mode = mode
+        self.hang_s = hang_s
+
+    def __enter__(self):
+        from ..hbm.backend import monitor
+
+        def hook(what: str) -> None:
+            if self.mode == "error":
+                raise RuntimeError(f"injected PJRT failure during {what}")
+            time.sleep(self.hang_s)   # the bounded fence times out first
+
+        monitor._set_fault(hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..hbm.backend import monitor
+        monitor._set_fault(None)
+        monitor.reset()
+        return False

@@ -196,6 +196,25 @@ def check_jax(timeout_s: float = 45.0) -> bool:
                    f"device probe failed: {stderr.strip()[-200:]}")
 
 
+def check_backend_latch() -> bool:
+    """In-process backend-loss latch (VERDICT r3 #5): reports whether
+    this process has declared the device backend LOST (bounded fence
+    timeout / PJRT error) and revoked its registered HBM buffers — the
+    state every subsequent staging call fails fast from (ENODEV)."""
+    from ..hbm.backend import monitor
+    from ..hbm.registry import registry
+    why = monitor.lost()
+    if why is None:
+        return _report("backend", OK,
+                       f"no loss latched; {len(registry.list())} HBM "
+                       f"buffer(s) registered")
+    return _report("backend", FAIL,
+                   f"LOST: {why}",
+                   "device fences now fail with ENODEV; re-register "
+                   "destinations after transport recovery (the latch "
+                   "clears via BackendMonitor.reset / a new process)")
+
+
 def check_backing(path: str) -> bool:
     """Backing-device eligibility (kmod/nvme_strom.c:229-438 analog):
     reports whether *path* sits on raw NVMe / md-RAID0-of-NVMe, with the
@@ -236,7 +255,7 @@ def main(argv=None) -> int:
                lambda: check_odirect(args.path),
                lambda: check_backing(args.path),
                check_hugepages, check_memlock, check_numa,
-               check_native_signature):
+               check_native_signature, check_backend_latch):
         ok = fn() and ok
     if args.jax:
         ok = check_jax() and ok
