@@ -199,12 +199,29 @@ def run_raw():
 # official ratio recorded 0.61 while same-window A/Bs showed parity.
 # Every per-round (direct, raw, vfs) triple is embedded in the artifact
 # ("samples"), so an off ratio is auditable to a disk mode, not assumed.
+# Host-cache warm pass, untimed: the guest's drop_page_cache cannot drop
+# the HYPERVISOR's cache, and the first touch of a long-idle file reads
+# real backing storage (~0.1-0.16 GB/s measured) while every later
+# "cold" pass rides the host cache (~2 GB/s) — raw O_DIRECT shows the
+# identical first-run cliff, so it is the disk state, not the engine.
+# One sweep puts all six measured passes in the same host-cache state;
+# without it, whichever mode runs first eats a 10x penalty unrelated to
+# anything this benchmark compares.
+with open(path, "rb") as _f:
+    while _f.read(16 << 20):
+        pass
+
 # even rounds run (direct, raw, vfs); odd rounds (vfs, raw, direct):
 # direct and raw stay ADJACENT in every round (the r3 fix) while the
 # direct/vfs pair still flips order round to round, so neither ratio's
 # denominator systematically inherits the other mode's cache state
+# 5 rounds: with the shared disk swinging ~2x between adjacent pairs,
+# a 3-round median still inherits one outlier draw; a characterization
+# A/B on this host (5 alternated rounds, host-cache warmed) measured
+# per-round engine/raw ratios 1.15/1.03/1.04/0.86/0.97 — median 1.03,
+# i.e. parity, with single rounds as low as 0.7 and as high as 1.15
 directs, vfss, ratios, raw_ratios, samples = [], [], [], [], []
-for r in range(3):
+for r in range(5):
     if r % 2 == 0:
         d, rw, v = run_direct(), run_raw(), run_vfs()
     else:
