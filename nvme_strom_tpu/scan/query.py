@@ -118,6 +118,7 @@ class Query:
         self._topk: Optional[tuple] = None
         self._order: Optional[tuple] = None
         self._join: Optional[tuple] = None
+        self._join_src: Optional[tuple] = None  # on-disk build side
         self._select: Optional[tuple] = None
         self._quantiles: Optional[List[float]] = None
         self._eq: Optional[tuple] = None     # structured equality (col, v)
@@ -439,6 +440,37 @@ class Query:
                       materialize, limit, int(offset))
         return self
 
+    def join_table(self, probe_col: int, build_table, build_schema,
+                   key_col: int, value_col: int, *,
+                   materialize: bool = False,
+                   limit: Optional[int] = None, offset: int = 0) -> "Query":
+        """Terminal: inner join whose build side is an ON-DISK heap
+        table instead of host arrays (the bounded-build face, VERDICT
+        r3 #8).  A build table that broadcasts (fits
+        ``config join_broadcast_max``) is loaded with one projection
+        scan and then behaves exactly like :meth:`join`.  A larger one
+        is NEVER fully materialized on the host: the mesh path streams
+        it into hash partitions in Grace passes
+        (:func:`..parallel.pjoin.partition_build_sharded_from_table`);
+        the local path streams one partition per probe pass — host RAM
+        stays bounded to one partition plus a scan batch either way."""
+        if isinstance(build_table, os.PathLike):
+            build_table = str(build_table)
+        # validate BEFORE claiming the terminal slot: a rejected call
+        # must leave the query reusable
+        for c in (key_col, value_col):
+            if not 0 <= int(c) < build_schema.n_cols:
+                raise StromError(22, f"join_table column {c} out of range")
+        for c in (key_col, value_col):
+            if build_schema.col_dtype(int(c)) != np.dtype(np.int32):
+                raise StromError(22, "join_table key and value columns "
+                                     "must be int32")
+        self.join(probe_col, None, None, materialize=materialize,
+                  limit=limit, offset=offset)
+        self._join_src = (build_table, build_schema, int(key_col),
+                          int(value_col))
+        return self
+
     def _require_no_terminal(self) -> None:
         if self._terminal_set:
             raise StromError(22, "one terminal operator per query "
@@ -538,6 +570,19 @@ class Query:
                            else "single-device lax sort")
         return "xla", f"{self._op} runs on lax.top_k/searchsorted (XLA)"
 
+    def _resolve_join_build(self, session, device) -> None:
+        """Load a broadcast-sized on-disk build side (one projection
+        scan) into the host-array form the broadcast paths consume;
+        idempotent across repeated run() calls."""
+        bt, bs, kc, vc = self._join_src
+        out = Query(bt, bs).select([kc, vc]).run(session=session,
+                                                 device=device)
+        pc, _bk, _bv, mat, lim, off = self._join
+        self._join = (pc, np.asarray(out[f"col{kc}"], np.int32),
+                      np.asarray(out[f"col{vc}"], np.int32), mat, lim,
+                      off)
+        self._join_src = None
+
     def _join_strategy(self) -> Optional[tuple]:
         """(strategy, n_parts) for a join terminal: "broadcast" while the
         build side (keys+values bytes) fits ``config join_broadcast_max``
@@ -547,8 +592,15 @@ class Query:
         if self._join is None:
             return None
         from ..config import config
-        bk, bv = self._join[1], self._join[2]
-        nbytes = (np.asarray(bk).nbytes + np.asarray(bv).nbytes)
+        if self._join_src is not None:
+            # on-disk build: estimate keys+values bytes from the row
+            # count (8 bytes/row — two int32 columns)
+            bt, bs, _kc, _vc = self._join_src
+            rows = (os.path.getsize(bt) // PAGE_SIZE) * bs.tuples_per_page
+            nbytes = rows * 8
+        else:
+            bk, bv = self._join[1], self._join[2]
+            nbytes = (np.asarray(bk).nbytes + np.asarray(bv).nbytes)
         cap = int(config.get("join_broadcast_max"))
         if nbytes <= cap:
             return ("broadcast", 1)
@@ -708,6 +760,10 @@ class Query:
                     f"build side above join_broadcast_max: {n_parts} "
                     f"hash partitions probed as sequential passes "
                     f"(Grace join), resident build bounded to the cap"))
+            if self._join_src is not None and strat == "partitioned":
+                how += ("; build side STREAMED from the on-disk table "
+                        "in partition passes (host RAM bounded by "
+                        "join_build_host_max)")
             plan = dataclasses.replace(
                 plan, join_strategy=label,
                 reason=plan.reason + f"; join strategy {label}: {how}")
@@ -924,6 +980,12 @@ class Query:
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
+        if self._op == "join" and self._join_src is not None \
+                and self._join_strategy()[0] == "broadcast":
+            # broadcast-sized on-disk build: one projection scan loads
+            # it, then every downstream join path (incl. indexed) sees
+            # plain host arrays
+            self._resolve_join_build(session, device)
         if plan.access_path == "index" and self._op == "order_by" \
                 and self._eq is not None:
             comb = self._eq_order_combo_path()
@@ -979,6 +1041,13 @@ class Query:
                       "group_by": self._run_groupby_indexed,
                       "join": self._run_join_indexed,
                       }.get(self._op)
+            if (self._op == "join" and self._join_src is not None
+                    and self._join_strategy()[0] == "partitioned"):
+                # index-served joins probe the build host-side; a
+                # partitioned-sized ON-DISK build must keep join_table's
+                # bounded-RAM contract, so it takes the scan path's
+                # streamed Grace passes instead of resolving here
+                runner = None
             if idx is not None and runner is not None:
                 return runner(idx, device, session)
             plan = self._replan_scan(plan)
@@ -1414,6 +1483,11 @@ class Query:
         face reproduces its accumulation dtypes via ``acc_dtypes``."""
         from ..ops.groupby import acc_dtypes
         from ..ops.join import _sorted_build
+        if self._join_src is not None:
+            # only broadcast-sized on-disk builds reach this runner (the
+            # dispatch routes partitioned-sized ones to the scan path's
+            # streamed passes); resolving here is therefore bounded
+            self._resolve_join_build(session, device)
         probe_col, bk, bv, materialize, limit, offset = self._join
         # the kernel path's exact build-side validation + sort (host
         # arrays; the probe column is int32 by that validation)
@@ -1707,13 +1781,15 @@ class Query:
         from .executor import fold_results
         if mesh is not None and materialize:
             return self._run_join_partitioned_mesh_rows(
-                mesh, session, batch_pages, probe_col, bk, bv, limit,
-                offset)
+                mesh, session, device, batch_pages, probe_col, bk, bv,
+                limit, offset)
         if mesh is not None and not materialize:
             from ..parallel.pjoin import make_partitioned_join_step
             step = make_partitioned_join_step(
                 mesh, self.schema, probe_col, bk, bv,
-                predicate=(lambda cols: pred(cols)) if pred else None)
+                predicate=(lambda cols: pred(cols)) if pred else None,
+                build_parts=self._streamed_build_parts(mesh, session,
+                                                       device))
             src, own = self._open_owned()
             try:
                 acc = None
@@ -1728,7 +1804,15 @@ class Query:
         # local: Grace sequential passes (both faces)
         from ..ops.join import (hash_split_build, make_join_fn,
                                 make_join_rows_fn)
-        parts = hash_split_build(bk, bv, n_parts)
+        if self._join_src is not None:
+            # on-disk build side: stream ONE partition per pass (hash
+            # predicate pushdown) — host RAM bounded to a partition, and
+            # a LIMIT early-exit below never even scans the build rows
+            # of the partitions it skips
+            parts = self._streamed_build_partitions(n_parts, session,
+                                                    device)
+        else:
+            parts = hash_split_build(bk, bv, n_parts)
         if materialize:
             # LIMIT early-exit across Grace passes (VERDICT r3 #3): each
             # partition scan stops issuing I/O at its remaining row
@@ -1824,7 +1908,60 @@ class Query:
                     [pages, np.zeros((padn, PAGE_SIZE), np.uint8)])
             yield pages
 
-    def _run_join_partitioned_mesh_rows(self, mesh, session, batch_pages,
+    def _streamed_build_parts(self, mesh, session, device):
+        """Mesh build parts for an on-disk build side (None when the
+        build is host arrays): partition-sized Grace passes bounded by
+        ``config join_build_host_max``."""
+        if self._join_src is None:
+            return None
+        from ..parallel.pjoin import partition_build_sharded_from_table
+        bt, bs, kc, vc = self._join_src
+        return partition_build_sharded_from_table(
+            bt, bs, kc, vc, mesh, session=session, device=device)
+
+    def _streamed_build_partitions(self, n_parts: int, session, device):
+        """Yield the local Grace passes' (keys, values) partitions from
+        the on-disk build side.  Under ``join_build_host_max`` the table
+        loads with ONE projection scan and partitions in memory (the
+        same budget fast path as the mesh builder); above it, one
+        hash-predicate scan per partition, host RAM bounded to a
+        partition — with a size+mtime stamp re-checked between passes so
+        a build table mutated mid-query fails (EIO) instead of silently
+        double-counting keys that moved partitions."""
+        import jax.numpy as jnp
+
+        from ..config import config
+        from ..ops.join import hash_split_build, key_hash32
+        bt, bs, kc, vc = self._join_src
+        if os.path.getsize(bt) <= int(config.get("join_build_host_max")):
+            out = Query(bt, bs).select([kc, vc]).run(session=session,
+                                                     device=device)
+            yield from hash_split_build(
+                np.asarray(out[f"col{kc}"], np.int32),
+                np.asarray(out[f"col{vc}"], np.int32), n_parts)
+            return
+
+        def owner(cols):
+            return (key_hash32(cols[kc]) % jnp.uint32(n_parts)) \
+                .astype(jnp.int32)
+
+        def stamp():
+            st = os.stat(bt)
+            return int(st.st_size), int(st.st_mtime_ns)
+
+        s0 = stamp()
+        for p in range(n_parts):
+            part = Query(bt, bs) \
+                .where(lambda cols, p=p: owner(cols) == p) \
+                .select([kc, vc]).run(session=session, device=device)
+            if stamp() != s0:
+                raise StromError(5, f"build table {bt} changed between "
+                                    f"partition passes")
+            yield (np.asarray(part[f"col{kc}"], np.int32),
+                   np.asarray(part[f"col{vc}"], np.int32))
+
+    def _run_join_partitioned_mesh_rows(self, mesh, session, device,
+                                        batch_pages,
                                         probe_col, bk, bv,
                                         limit: Optional[int],
                                         offset: int) -> dict:
@@ -1840,7 +1977,9 @@ class Query:
         pred = self._pred
         step = make_partitioned_join_rows_step(
             mesh, self.schema, probe_col, bk, bv,
-            predicate=(lambda cols: pred(cols)) if pred else None)
+            predicate=(lambda cols: pred(cols)) if pred else None,
+            build_parts=self._streamed_build_parts(mesh, session,
+                                                   device))
         stop = None if limit is None else offset + limit
         chunks: List[tuple] = []
         gathered = 0

@@ -1243,3 +1243,111 @@ def test_partitioned_build_streams_from_disk_bounded(tmp_path):
     pages = np.frombuffer(raw, np.uint8).reshape(-1, PAGE_SIZE)
     out = step(pages)
     assert int(np.asarray(out["matched"])) == fn
+
+
+def test_join_table_disk_build_all_faces(tmp_path):
+    """Query.join_table: the build side lives on disk.  Broadcast-sized
+    tables load with one scan and match Query.join exactly; above
+    join_broadcast_max the partitioned strategy streams the build (local
+    Grace passes AND the mesh) and still reproduces the in-memory
+    answers on both faces; EXPLAIN names the streamed build."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+
+    config.set("debug_no_threshold", True)
+    rng = np.random.default_rng(41)
+    schema = HeapSchema(n_cols=2, visibility=True)
+    t = schema.tuples_per_page
+    n = t * 24
+    c0 = rng.integers(-1000, 1000, n).astype(np.int32)
+    c1 = rng.integers(0, 16, n).astype(np.int32)
+    vis = (rng.random(n) > 0.2).astype(np.int32)
+    fpath = str(tmp_path / "fact.heap")
+    build_heap_file(fpath, [c0, c1], schema, visibility=vis)
+
+    bschema = HeapSchema(n_cols=2, visibility=False)
+    keys = rng.permutation(np.arange(-1200, 1200, dtype=np.int32))[:900]
+    vals = (keys * 3).astype(np.int32)
+    bpath = str(tmp_path / "dim.heap")
+    pad = (-len(keys)) % bschema.tuples_per_page
+    # pad the build table with keys outside the fact domain (heap files
+    # are whole pages); uniqueness must hold across pads too
+    pk = np.concatenate([keys, np.arange(5000, 5000 + pad, dtype=np.int32)])
+    pv = np.concatenate([vals, np.zeros(pad, np.int32)])
+    build_heap_file(bpath, [pk, pv], bschema)
+
+    def jt(**kw):
+        return Query(fpath, schema).join_table(0, bpath, bschema, 0, 1,
+                                               **kw)
+
+    base = Query(fpath, schema).join(0, pk, pv).run()
+    base_m = Query(fpath, schema).join(0, pk, pv, materialize=True).run()
+
+    # broadcast-sized: identical to the in-memory join
+    assert jt().explain().join_strategy == "broadcast"
+    out = jt().run()
+    assert int(out["matched"]) == int(base["matched"])
+    np.testing.assert_array_equal(out["sums"], base["sums"])
+    out_m = jt(materialize=True).run()
+    np.testing.assert_array_equal(np.sort(out_m["positions"]),
+                                  np.sort(base_m["positions"]))
+
+    old = config.get("join_broadcast_max")
+    config.set("join_broadcast_max", 1024)
+    try:
+        plan = jt().explain()
+        assert plan.join_strategy.startswith("partitioned(")
+        assert "STREAMED" in plan.reason
+        part = jt().run()
+        assert int(part["matched"]) == int(base["matched"])
+        np.testing.assert_array_equal(part["sums"], base["sums"])
+        assert int(part["payload_sum"]) == int(base["payload_sum"])
+        part_m = jt(materialize=True).run()
+        np.testing.assert_array_equal(np.sort(part_m["positions"]),
+                                      np.sort(base_m["positions"]))
+        np.testing.assert_array_equal(np.sort(part_m["payload"]),
+                                      np.sort(base_m["payload"]))
+        lm = jt(materialize=True, limit=7).run()
+        assert int(lm["count"]) == 7
+        assert np.isin(lm["positions"], base_m["positions"]).all()
+
+        # mesh: streamed build parts, both faces
+        mesh = make_scan_mesh(jax.devices())
+        mesh_out = jt().run(mesh=mesh, batch_pages=8)
+        assert int(mesh_out["matched"]) == int(base["matched"])
+        np.testing.assert_array_equal(mesh_out["sums"], base["sums"])
+        mesh_m = jt(materialize=True).run(mesh=mesh, batch_pages=8)
+        np.testing.assert_array_equal(np.sort(mesh_m["positions"]),
+                                      np.sort(base_m["positions"]))
+    finally:
+        config.set("join_broadcast_max", old)
+
+    # bad columns / dtypes refuse clearly — and BEFORE the terminal
+    # slot is claimed, so the query stays reusable after a reject
+    q2 = Query(fpath, schema)
+    with pytest.raises(StromError):
+        q2.join_table(0, bpath, bschema, 0, 9)
+    q2.join(0, pk, pv)
+    fschema = HeapSchema(n_cols=2, visibility=False,
+                         dtypes=("float32", "int32"))
+    with pytest.raises(StromError):
+        Query(fpath, schema).join_table(0, bpath, fschema, 0, 1)
+
+    # an indexed eq-filter plus a PARTITIONED-sized on-disk build must
+    # keep the bounded contract: the dispatch routes to the streamed
+    # scan path (never a whole-table host resolve) and still answers
+    # exactly like the in-memory join
+    from nvme_strom_tpu.scan.index import build_index
+    build_index(fpath, schema, 0)
+    probe_key = int(pk[3])
+    ref = Query(fpath, schema).where_eq(0, probe_key).join(0, pk, pv).run()
+    config.set("join_broadcast_max", 1024)
+    try:
+        qi = Query(fpath, schema).where_eq(0, probe_key) \
+            .join_table(0, bpath, bschema, 0, 1)
+        got = qi.run()
+        assert int(got["matched"]) == int(ref["matched"])
+        assert int(got["payload_sum"]) == int(ref["payload_sum"])
+    finally:
+        config.set("join_broadcast_max", old)
