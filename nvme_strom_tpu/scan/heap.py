@@ -28,7 +28,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 __all__ = ["PAGE_SIZE", "HEAP_MAGIC", "HEADER_BYTES", "HeapSchema",
-           "build_heap_file", "pages_from_bytes"]
+           "build_heap_file", "pages_from_bytes", "validate_heap_header"]
 
 PAGE_SIZE = 8192                  # BLCKSZ, matching the reference
 HEADER_BYTES = 64
@@ -124,6 +124,31 @@ def build_heap_file(path: str, columns: Sequence[np.ndarray],
     with open(path, "wb") as f:
         f.write(pages.tobytes())
     return len(pages)
+
+
+def validate_heap_header(path: str, schema: HeapSchema) -> None:
+    """One 64-byte read checks the first page header against *schema*:
+    magic, column count (header word 3), visibility mode (word 4) — the
+    cheap guard that turns a wrong column count or a non-heap file into
+    a clear error instead of silently garbled columns (pages carry their
+    schema facts exactly so consumers CAN check; the reference trusts
+    the catalog the same way, pgsql/nvme_strom.c:448-474).  Raises
+    OSError (unreadable) or ValueError (mismatch)."""
+    with open(path, "rb") as f:
+        head = f.read(HEADER_BYTES)
+    if len(head) < HEADER_BYTES:
+        raise ValueError(f"{path}: not a heap file (short header)")
+    w = np.frombuffer(head, np.int32)
+    if int(w[0]) != HEAP_MAGIC:
+        raise ValueError(f"{path}: bad heap magic "
+                         f"0x{int(w[0]) & 0xffffffff:08x}")
+    if int(w[3]) != schema.n_cols:
+        raise ValueError(f"{path}: file pages carry {int(w[3])} columns, "
+                         f"schema says {schema.n_cols}")
+    vm = 1 if schema.visibility else 0
+    if int(w[4]) != vm:
+        raise ValueError(f"{path}: file visibility_mode {int(w[4])} != "
+                         f"schema's {vm}")
 
 
 def pages_from_bytes(raw: bytes | np.ndarray) -> np.ndarray:

@@ -465,6 +465,16 @@ class Query:
             if build_schema.col_dtype(int(c)) != np.dtype(np.int32):
                 raise StromError(22, "join_table key and value columns "
                                      "must be int32")
+        # header check up front: a missing file, a non-heap file, or a
+        # schema whose column count disagrees with what the pages carry
+        # must fail HERE with a clear error, not surface later as a raw
+        # OSError or silently garbled keys
+        from .heap import validate_heap_header
+        try:
+            validate_heap_header(build_table, build_schema)
+        except (OSError, ValueError) as e:
+            raise StromError(getattr(e, "errno", None) or 22,
+                             f"join_table build table: {e}") from e
         self.join(probe_col, None, None, materialize=materialize,
                   limit=limit, offset=offset)
         self._join_src = (build_table, build_schema, int(key_col),

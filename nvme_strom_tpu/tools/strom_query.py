@@ -160,7 +160,7 @@ def main(argv=None) -> int:
                     help="structured membership filter (SQL IN); "
                          "index-scan capable like --where-eq")
     ap.add_argument("--group-by", default=None, metavar="EXPR",
-                    help='int32 group key, e.g. "c1 % 8"')
+                    help='int32 group key, e.g. "c1 %% 8"')
     ap.add_argument("--groups", type=int, default=None,
                     help="number of groups (required with --group-by)")
     ap.add_argument("--agg-cols", default=None,
@@ -208,6 +208,17 @@ def main(argv=None) -> int:
                          "table file (.npz with 'keys'/'values' int arrays, "
                          "or .npy of (N, 2) [key, value] rows); aggregates "
                          "joined rows")
+    ap.add_argument("--join-build-cols", type=int, default=2,
+                    metavar="N",
+                    help="with --join COL:TABLE.heap: column count of the "
+                         "on-disk dimension heap (int32 columns, no "
+                         "visibility); the build side streams in "
+                         "partition passes when it exceeds "
+                         "join_build_host_max")
+    ap.add_argument("--join-key-col", type=int, default=0, metavar="C",
+                    help="with --join COL:TABLE.heap: build key column")
+    ap.add_argument("--join-value-col", type=int, default=1, metavar="C",
+                    help="with --join COL:TABLE.heap: build payload column")
     ap.add_argument("--join-rows", action="store_true",
                     help="with --join: return the joined rows themselves "
                          "(positions/keys/payload; --limit/--offset apply)")
@@ -401,26 +412,42 @@ def main(argv=None) -> int:
         colspec, _, table = args.join.partition(":")
         if not table or not colspec.isdigit():
             ap.error("--join takes COL:TABLE (integer column index)")
-        try:
-            if table.endswith(".npz"):
-                z = np.load(table)
-                if "keys" not in z or "values" not in z:
-                    ap.error("--join .npz table needs 'keys' and "
-                             "'values' arrays")
-                jk = np.asarray(z["keys"], np.int32)
-                jv = np.asarray(z["values"], np.int32)
-            else:
-                a = np.load(table)
-                if a.ndim != 2 or a.shape[1] != 2:
-                    ap.error("--join .npy table must be (N, 2) "
-                             "[key, value]")
-                jk = np.asarray(a[:, 0], np.int32)
-                jv = np.asarray(a[:, 1], np.int32)
-        except (OSError, ValueError) as e:
-            ap.error(f"--join table {table!r} unreadable: {e}")
-        q = q.join(int(colspec), jk, jv, materialize=args.join_rows,
-                   limit=args.limit if args.join_rows else None,
-                   offset=args.offset if args.join_rows else 0)
+        if table.endswith(".heap"):
+            # on-disk dimension table: Query.join_table streams it when
+            # it exceeds the host budget (bounded-RAM build)
+            bschema = HeapSchema(n_cols=args.join_build_cols,
+                                 visibility=False)
+            try:
+                q = q.join_table(int(colspec), table, bschema,
+                                 args.join_key_col, args.join_value_col,
+                                 materialize=args.join_rows,
+                                 limit=args.limit if args.join_rows
+                                 else None,
+                                 offset=args.offset if args.join_rows
+                                 else 0)
+            except StromError as e:
+                ap.error(f"--join heap table: {e}")
+        else:
+            try:
+                if table.endswith(".npz"):
+                    z = np.load(table)
+                    if "keys" not in z or "values" not in z:
+                        ap.error("--join .npz table needs 'keys' and "
+                                 "'values' arrays")
+                    jk = np.asarray(z["keys"], np.int32)
+                    jv = np.asarray(z["values"], np.int32)
+                else:
+                    a = np.load(table)
+                    if a.ndim != 2 or a.shape[1] != 2:
+                        ap.error("--join .npy table must be (N, 2) "
+                                 "[key, value]")
+                    jk = np.asarray(a[:, 0], np.int32)
+                    jv = np.asarray(a[:, 1], np.int32)
+            except (OSError, ValueError) as e:
+                ap.error(f"--join table {table!r} unreadable: {e}")
+            q = q.join(int(colspec), jk, jv, materialize=args.join_rows,
+                       limit=args.limit if args.join_rows else None,
+                       offset=args.offset if args.join_rows else 0)
     elif args.quantiles:
         colspec, _, qspec = args.quantiles.partition(":")
         if not colspec.isdigit() or not qspec:

@@ -400,3 +400,46 @@ def test_backend_loss_fails_scan_not_hangs(tmp_path):
     finally:
         config.set("backend_fence_timeout", old_t)
         config.set("chunk_size", old_c)
+
+
+def test_backend_loss_fails_mesh_stream_and_restore(tmp_path):
+    """The remaining fence sites ride the bounded path too: an injected
+    wedge fails the sharded mesh stream and a checkpoint restore with
+    StromError (no hang), and both tear down cleanly."""
+    import jax
+    import numpy as np
+
+    from nvme_strom_tpu import config, open_source
+    from nvme_strom_tpu.data import restore_checkpoint, save_checkpoint
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    from nvme_strom_tpu.parallel.stream import ShardedBatchStream
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE
+    from nvme_strom_tpu.testing import backend_fault, make_test_file
+
+    old_t = config.get("backend_fence_timeout")
+    config.set("backend_fence_timeout", 0.2)
+    try:
+        # mesh stream: the double-buffer rotation fences from batch 2 on
+        mesh = make_scan_mesh(jax.devices())
+        dp = mesh.shape["dp"]
+        path = str(tmp_path / "stream.bin")
+        make_test_file(path, 8 * dp * 4 * PAGE_SIZE)
+        with backend_fault(mode="hang", hang_s=5.0):
+            with open_source(path) as src:
+                with ShardedBatchStream(src, mesh,
+                                        batch_pages=dp) as stream:
+                    with pytest.raises(StromError) as ei:
+                        for _first, _arr in stream:
+                            pass
+                    assert ei.value.errno == errno.ENODEV
+
+        # checkpoint restore: the pinned ring fences once a buffer is
+        # revisited (leaves larger than the window force rotation)
+        ck = str(tmp_path / "loss.strom")
+        save_checkpoint(ck, {"w": np.arange(1 << 16, dtype=np.float32)})
+        with backend_fault(mode="hang", hang_s=5.0):
+            with pytest.raises(StromError) as e2:
+                restore_checkpoint(ck, staging_bytes=4096)
+            assert e2.value.errno == errno.ENODEV
+    finally:
+        config.set("backend_fence_timeout", old_t)

@@ -465,3 +465,85 @@ def test_tpu_stat_json_snapshot(data_file, tmp_path):
     out = _run("nvme_strom_tpu.tools.tpu_stat", "-f", export, "--json",
                "1")
     assert out.returncode != 0
+
+
+def test_strom_query_help_renders():
+    """--help must render (a literal % in a help string crashed argparse's
+    formatter — regression)."""
+    out = _run("nvme_strom_tpu.tools.strom_query", "--help")
+    assert out.returncode == 0, out.stderr
+    assert "--join" in out.stdout
+
+
+def test_strom_query_join_heap_table(tmp_path):
+    """--join COL:TABLE.heap rides Query.join_table: broadcast-sized dims
+    answer like the npz path; forcing the partitioned strategy (tiny
+    join_broadcast_max) streams the build and agrees; --join-rows
+    returns the row face."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    rng = np.random.default_rng(9)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    t = schema.tuples_per_page
+    c0 = rng.integers(0, 2000, t * 8).astype(np.int32)
+    fpath = str(tmp_path / "f.heap")
+    build_heap_file(fpath, [c0, np.ones(t * 8, np.int32)], schema)
+    pk = rng.permutation(2000).astype(np.int32)[:t]
+    dpath = str(tmp_path / "d.heap")
+    build_heap_file(dpath, [pk, (pk * 2).astype(np.int32)], schema)
+    oracle = int(np.isin(c0, pk).sum())
+
+    base = ["nvme_strom_tpu.tools.strom_query", fpath, "--cols", "2",
+            "--join", f"0:{dpath}", "--json"]
+    env = {"STROM_TPU_DEBUG_NO_THRESHOLD": "1"}
+    out = _run(*base, env_extra=env)
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["matched"] == oracle
+
+    # streamed build (partitioned strategy) agrees
+    out = _run(*base, env_extra={**env,
+                                 "STROM_TPU_JOIN_BROADCAST_MAX": "1024"})
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["matched"] == oracle
+
+    # row face with a limit
+    out = _run("nvme_strom_tpu.tools.strom_query", fpath, "--cols", "2",
+               "--join", f"0:{dpath}", "--join-rows", "--limit", "5",
+               "--json", env_extra=env)
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["count"] == 5
+    assert all(p == 2 * k for k, p in zip(res["keys"], res["payload"]))
+
+
+def test_strom_query_join_heap_rejects_bad_table(tmp_path):
+    """A missing build table or a wrong --join-build-cols fails with a
+    clean one-line error (header-validated), never a traceback or
+    silently garbled results."""
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    t = schema.tuples_per_page
+    fpath = str(tmp_path / "f.heap")
+    build_heap_file(fpath, [np.arange(t, dtype=np.int32),
+                            np.arange(t, dtype=np.int32)], schema)
+    # 3-column dimension heap, CLI told 2 columns: header check refuses
+    d3 = HeapSchema(n_cols=3, visibility=False)
+    t3 = d3.tuples_per_page
+    dpath = str(tmp_path / "d3.heap")
+    build_heap_file(dpath, [np.arange(t3, dtype=np.int32)] * 3, d3)
+    out = _run("nvme_strom_tpu.tools.strom_query", fpath, "--cols", "2",
+               "--join", f"0:{dpath}", "--json")
+    assert out.returncode != 0
+    assert "columns" in out.stderr and "Traceback" not in out.stderr
+    # missing file: clean error too
+    out = _run("nvme_strom_tpu.tools.strom_query", fpath, "--cols", "2",
+               "--join", f"0:{tmp_path}/nope.heap", "--json")
+    assert out.returncode != 0
+    assert "Traceback" not in out.stderr
