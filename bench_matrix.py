@@ -470,6 +470,10 @@ def main() -> int:
     only = os.environ.get("BENCH_ROWS")
     only = set(only.split(",")) if only else None
     results = {}
+    # per-row capture time: a BENCH_ROWS merge keeps rows from earlier
+    # sessions, and derived ratios then cross sessions — the stamps make
+    # that auditable (rows with null predate the stamping mechanism)
+    captured_at = {}
     if only is not None:
         try:
             with open(os.path.join(REPO, "BENCH_MATRIX.json")) as f:
@@ -486,6 +490,8 @@ def main() -> int:
         known = {k for k, *_ in configs}
         results.update({k: v for k, v in prior.get("results", {}).items()
                         if k in known})   # drop stale rows
+        captured_at.update({k: prior.get("row_captured_at", {}).get(k)
+                            for k in results})
         unknown = only - known
         if unknown:
             raise SystemExit(f"BENCH_ROWS: unknown rows {sorted(unknown)}")
@@ -499,8 +505,11 @@ def main() -> int:
         gbps = _run(code, env)
         if gbps is None:
             results.pop(key, None)   # skipped: drop any stale prior row
+            captured_at.pop(key, None)
             continue
         results[key] = gbps
+        captured_at[key] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())
         print(f"{key:<14} {desc:<34} {gbps:7.3f} GB/s")
     # derived ratios (VERDICT r1 #2): every BASELINE ">=90% of raw" target
     # becomes checkable from this one JSON
@@ -549,6 +558,13 @@ def main() -> int:
                            "host's async dispatch timing, so pallas_vs_xla "
                            "(same-conditions ratio) is the metric",
                    "results": results,
+                   "row_captured_at": captured_at,
+                   "note_ratios": "pct_of_raw/overlap_efficiency divide "
+                                  "rows whose row_captured_at may differ "
+                                  "(BENCH_ROWS merges); ratios mixing "
+                                  "sessions are indicative only — "
+                                  "same-stamp rows are the measurements "
+                                  "of record",
                    "pct_of_raw": pct_of_raw,
                    "overlap_efficiency": overlap_efficiency,
                    "pallas_vs_xla": pallas_vs_xla,

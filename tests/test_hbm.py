@@ -443,3 +443,44 @@ def test_backend_loss_fails_mesh_stream_and_restore(tmp_path):
             assert e2.value.errno == errno.ENODEV
     finally:
         config.set("backend_fence_timeout", old_t)
+
+
+def test_h2d_plain_path_single_host_copy():
+    """Zero-extra-copy claim, host layer (VERDICT r3 #7 fallback): the
+    plain h2d path performs exactly ONE host-side allocation of the
+    transfer size — the CPU backend's deliberate owned copy
+    (safe_device_put; an accelerator PJRT consumes the pinned pages
+    directly via BufferFromHostBuffer, making even that one copy the DMA
+    itself).  A second host-side staging copy in OUR layer would show as
+    2x here; the on-device A/B (h2d_pinned_peak vs h2d_peak) is the
+    decisive device-side measurement when the tunnel allows it."""
+    import tracemalloc
+
+    from nvme_strom_tpu import config
+    from nvme_strom_tpu.hbm.staging import h2d_transfer
+
+    dev = jax.devices()[0]
+    size = 8 << 20
+    with Session() as s:
+        h, buf = s.alloc_dma_buffer(size)
+        host = np.frombuffer(buf.view(), np.uint8)
+        host[:] = 7
+        warm, _ = h2d_transfer(host[: 1 << 20], dev)   # compile/init
+        jax.block_until_ready(warm)
+        old = config.get("h2d_path")
+        try:
+            config.set("h2d_path", "plain")
+            tracemalloc.start()
+            d, fence = h2d_transfer(host, dev)
+            jax.block_until_ready(fence)
+            _cur, peak = tracemalloc.get_traced_memory()
+            # lower bound keeps the measurement honest: if the owned
+            # copy ever moves to an untraced allocator, this must FAIL
+            # (a dead instrument reading 0 is not a zero-copy proof)
+            assert size <= peak < size * 1.5, f"host copies: peak {peak}"
+            np.testing.assert_array_equal(np.asarray(d)[:16], host[:16])
+        finally:
+            tracemalloc.stop()
+            config.set("h2d_path", old)
+        s.unmap_buffer(h)
+        buf.close()
