@@ -27,7 +27,11 @@ def test_multi_process_distributed(tmp_path, nproc, dpp):
         assert set(r["checks"]) == {"sharded_load", "scan_step",
                                     "stream_fold", "dist_sort",
                                     "ckpt_restore", "ckpt_save_sharded",
-                                    "pjoin"}
+                                    "pjoin", "pjoin_rows"}
+    # the row-face outputs partition across processes: every process
+    # owns a disjoint subset and together they cover every matched row
+    assert sum(r["checks"]["pjoin_rows"] for r in results) \
+        == results[0]["checks"]["pjoin"]
     # each process loaded exactly its share of the rows (2 pages/device)
     n_pages = 2 * nproc * dpp
     assert all(r["checks"]["sharded_load"] == n_pages // nproc

@@ -460,3 +460,38 @@ def test_composite_eq_index_equals_seqscan_random(tmp_path_factory,
     order = sel[np.argsort(c2[sel], kind="stable")]
     np.testing.assert_array_equal(ro["positions"], order)
     np.testing.assert_array_equal(ro["values"], c2[order])
+
+
+@settings(max_examples=100, deadline=None)
+@given(blocks=st.lists(st.integers(0, 3_000_000), min_size=1,
+                       max_size=200),
+       cap=st.integers(1, 12), decay_after=st.integers(1, 8))
+def test_adaptive_depth_invariants(blocks, cap, decay_after):
+    """AdaptiveH2DDepth never leaves [floor, cap] and never moves more
+    than one step per observation, for arbitrary fence-wait sequences."""
+    from nvme_strom_tpu.hbm.staging import AdaptiveH2DDepth
+    ad = AdaptiveH2DDepth(cap, decay_after=decay_after)
+    floor = ad.floor
+    prev = ad.depth
+    assert floor <= ad.depth <= ad.cap
+    for ns in blocks:
+        d = ad.observe(ns)
+        assert floor <= d <= ad.cap
+        assert abs(d - prev) <= 1
+        prev = d
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.lists(st.integers(0, (1 << 62) - 1), min_size=0, max_size=64))
+def test_pos_word_roundtrip(vals):
+    """combine_pos_words inverts the int64 -> (lo, hi) int32 bitcast the
+    mesh join-row exchange uses, for arbitrary non-negative positions."""
+    from nvme_strom_tpu.parallel.pjoin import combine_pos_words
+    pos = np.asarray(vals, np.int64)
+    w = pos.view(np.int32).reshape(-1, 2)   # little-endian split
+    lo, hi = w[:, 0], w[:, 1]
+    np.testing.assert_array_equal(combine_pos_words(lo, hi), pos)
+    # int32-mode positions (hi absent) are the identity
+    p32 = pos[pos <= np.iinfo(np.int32).max].astype(np.int32)
+    np.testing.assert_array_equal(
+        combine_pos_words(p32, np.zeros_like(p32)).astype(np.int32), p32)
