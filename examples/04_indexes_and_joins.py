@@ -80,6 +80,20 @@ def main() -> int:
             assert int(a["matched"]) == int(b["matched"])
             print("parity       : broadcast == partitioned "
                   f"({int(a['matched'])} joined rows)")
+
+            # -- 6. on-disk build side (bounded host RAM) --------------
+            # the dimension table can live on disk: broadcast-sized dims
+            # load with one scan; above join_broadcast_max the build
+            # STREAMS in partition passes (host RAM = one partition)
+            dschema = HeapSchema(n_cols=2, visibility=False)
+            dt = dschema.tuples_per_page
+            dk = np.arange(dt, dtype=np.int32)
+            with tempfile.NamedTemporaryFile(suffix=".heap") as df:
+                build_heap_file(df.name, [dk, dk * 10], dschema)
+                jt = Query(f.name, schema).join_table(
+                    1, df.name, dschema, 0, 1)
+                print("disk build   :", jt.explain().join_strategy,
+                      "->", int(jt.run()["matched"]), "joined rows")
         finally:
             config.restore(snap)
     return 0

@@ -460,12 +460,16 @@ class _PinnedRing:
     def close(self):
         from ..api import StromError as _SE
         from ..hbm.staging import bounded_fence
-        try:
-            for fl in self.fences:
-                for f in fl:
+        for fl in self.fences:
+            for f in fl:
+                try:
                     bounded_fence(f, "ckpt-drain")
-        except _SE:
-            pass   # backend lost: nothing to drain; free host buffers
+                except _SE:
+                    # per-fence: a per-array ENOMEM must not abandon the
+                    # other buffers' drains (their transfers still read
+                    # pinned memory); a latched loss fails the rest
+                    # instantly anyway
+                    continue
         for handle, buf in self.bufs:
             try:
                 self.sess.unmap_buffer(handle)

@@ -137,7 +137,10 @@ class DeviceLoader:
 
     def _submit(self, ring: int, ids: Sequence[int]):
         if self._fence[ring] is not None:
-            self._fence[ring].block_until_ready()
+            # bounded (VERDICT r3 #5): a dead backend fails the epoch
+            # with ENODEV instead of hanging the prefetch rotation
+            from ..hbm.staging import bounded_fence
+            bounded_fence(self._fence[ring], "loader-h2d")
             self._fence[ring] = None
         handle, _ = self._bufs[ring]
         # plain ints: np.int64 ids would reach ctypes in the cache probe
@@ -219,9 +222,17 @@ class DeviceLoader:
         if self._closed:
             return
         self._closed = True
+        from ..hbm.staging import bounded_fence
         for f in self._fence:
             if f is not None:
-                f.block_until_ready()
+                try:
+                    bounded_fence(f, "loader-drain")
+                except StromError:
+                    # keep draining the OTHER rings: a per-array ENOMEM
+                    # leaves the backend healthy with transfers still
+                    # reading pinned memory, and a latched loss makes
+                    # every later fence fail instantly anyway
+                    continue
         self._fence = [None] * self.prefetch
         for handle, buf in self._bufs:
             try:

@@ -369,12 +369,16 @@ class StagingPipeline:
                 self._barriers[i] = None
 
     def close(self) -> None:
-        try:
-            self.drain()
-        except StromError:
-            # backend lost: nothing left to drain; the pinned host
-            # buffers below still free normally
-            self._barriers = [None] * self.n_buffers
+        for i, b in enumerate(self._barriers):
+            if b is not None:
+                try:
+                    bounded_fence(b, "staging-close")
+                except StromError:
+                    # per-barrier: an ENOMEM on one array must not skip
+                    # the other buffers' drains; a latched loss fails
+                    # the rest instantly anyway
+                    pass
+                self._barriers[i] = None
         for handle, buf in self._bufs:
             try:
                 self.session.unmap_buffer(handle)

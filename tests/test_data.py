@@ -592,3 +592,31 @@ def test_streamed_restore_surfaces_read_faults(tmp_path):
         ck.open_source = real_open
     out = restore_checkpoint(path, staging_bytes=64 << 10)
     np.testing.assert_array_equal(np.asarray(out["['w']"]), tree["w"])
+
+
+def test_backend_loss_fails_loader_not_hangs(tmp_path):
+    """The training loader's prefetch fences ride the bounded path: an
+    injected wedge fails the epoch with ENODEV (no hang) and close()
+    still frees the pinned ring."""
+    import errno
+
+    import numpy as np
+
+    from nvme_strom_tpu import config
+    from nvme_strom_tpu.data import DeviceLoader, write_records
+    from nvme_strom_tpu.testing import backend_fault
+
+    rec = np.random.default_rng(1).standard_normal((64, 64)) \
+        .astype(np.float32)
+    ds = write_records(str(tmp_path / "l.rec"), rec)
+    old = config.get("backend_fence_timeout")
+    config.set("backend_fence_timeout", 0.2)
+    try:
+        with backend_fault(mode="hang", hang_s=5.0):
+            with DeviceLoader(ds, batch_records=8, prefetch=2) as dl:
+                with pytest.raises(StromError) as ei:
+                    for _b in dl:
+                        pass
+                assert ei.value.errno == errno.ENODEV
+    finally:
+        config.set("backend_fence_timeout", old)
