@@ -114,8 +114,11 @@ def _probe_backend() -> bool:
     return False
 
 
-def _run_mode(path: str, extra_args, timeout: int = 1800) -> float:
-    """Run ssd2tpu_test in a subprocess, return GB/s."""
+def _run_mode(path: str, extra_args, timeout: int = 1800):
+    """Run ssd2tpu_test in a subprocess.  Returns ``(GB/s, meta)``;
+    *meta* carries the reference's companion metrics of record (avg DMA
+    size + request count, utils/ssd2gpu_test.c:227-280) when the mode
+    prints them (the direct path does; the VFS baseline has no DMA)."""
     cmd = [sys.executable, "-m", "nvme_strom_tpu.tools.ssd2tpu_test", path,
            *extra_args]
     out = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
@@ -127,7 +130,13 @@ def _run_mode(path: str, extra_args, timeout: int = 1800) -> float:
     if not m:
         sys.stderr.write(out.stdout + out.stderr)
         raise RuntimeError("bench: no throughput in output")
-    return float(m.group(1))
+    meta = {}
+    md = re.search(r"avg dma size: ([0-9.]+)KB\s+requests: (\d+)",
+                   out.stdout)
+    if md:
+        meta = {"avg_dma_kb": float(md.group(1)),
+                "requests": int(md.group(2))}
+    return float(m.group(1)), meta
 
 
 _CPU_ROW_CODE = """
@@ -364,8 +373,9 @@ def _emit_cpu_fallback(path: str, device_error: str) -> int:
             "captured_at": cand.get("captured_at"),
             "stale_device_rows": True,
             "error_device": device_error,
-            **({"provenance": cand["provenance"]}
-               if cand.get("provenance") else {}),
+            # companion metrics travel with the journaled capture
+            **{k: cand[k] for k in ("avg_dma_kb", "requests",
+                                    "provenance") if cand.get(k)},
             "note": why + "; ssd2tpu rows are the most recent healthy "
                     "capture journaled in BENCH_CANDIDATE.json"
                     + ("; cpu_live rows were measured now." if row
@@ -510,6 +520,7 @@ def main() -> int:
     direct_args = ["-n", "6", "-s", "16m"]
     vfs_args = ["-f", "16m"]
     direct = vfs = 0.0
+    direct_meta = {}
     failures = []
     for r in range(rounds):
         # true alternation: round 0 runs direct first, round 1 runs vfs
@@ -521,13 +532,15 @@ def main() -> int:
             if r or i:
                 time.sleep(cooldown)
             try:
-                got = _run_mode(path, margs)
+                got, meta = _run_mode(path, margs)
             except (RuntimeError, subprocess.TimeoutExpired) as e:
                 # a mid-run wedge must not zero the whole bench: keep
                 # whatever completed, note the failure
                 failures.append(f"{tag}: {e}")
                 continue
             if tag == "d":
+                if got > direct:
+                    direct_meta = meta   # meta of the best direct run
                 direct = max(direct, got)
             else:
                 vfs = max(vfs, got)
@@ -543,6 +556,9 @@ def main() -> int:
         "value": round(direct, 3),
         "unit": "GB/s",
         "vs_baseline": round(direct / vfs, 3) if vfs else None,
+        # the reference's companion metrics of record
+        # (utils/ssd2gpu_test.c:227-280)
+        **direct_meta,
     }
     if failures:
         out["partial_failures"] = failures
