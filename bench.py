@@ -447,6 +447,7 @@ def _probe_loop() -> int:
         ok = _probe_backend_once(90)
         logev({"event": "probe", "ok": ok})
         if ok:
+            just_captured = False
             if not headline_fresh:
                 # the headline capture journals BENCH_CANDIDATE.json itself
                 # on success; a mid-capture re-wedge degrades to the CPU
@@ -468,12 +469,33 @@ def _probe_loop() -> int:
                                   and not parsed.get("stale_device_rows")
                                   and not parsed.get("error_device")
                                   and not parsed.get("error"))
+                just_captured = headline_fresh
                 logev({"event": "bench_capture", "fresh": headline_fresh,
                        "out": parsed})
             if headline_fresh:
+                # a fresh headline capture just drained the transport's
+                # token bucket: idle before the matrix so h2d_peak (the
+                # first tunnel row) measures a refilled bucket — then
+                # RE-probe, because the tunnel can re-wedge during the
+                # idle and the matrix must not launch into a dead
+                # backend.  Retry iterations (headline already fresh
+                # from an earlier pass) skip the idle: their probe just
+                # ran and no capture drained the bucket since.
+                idle = int(os.environ.get("BENCH_PROBE_MATRIX_IDLE",
+                                          "480"))
+                if just_captured and idle:
+                    sys.stderr.write(f"probe-loop: idling {idle}s before "
+                                     f"matrix rows (bucket refill)\n")
+                    time.sleep(idle)
+                    if not _probe_backend_once(90):
+                        logev({"event": "probe", "ok": False,
+                               "when": "post-idle"})
+                        time.sleep(interval)
+                        continue
                 env = _env()
                 env.update({"BENCH_ROWS": _TUNNEL_ROWS,
                             "BENCH_SIZE_MB": matrix_size})
+                env.setdefault("BENCH_COOLDOWN_S", "180")
                 try:
                     m = subprocess.run(
                         [sys.executable, os.path.join(REPO, "bench_matrix.py")],

@@ -495,6 +495,16 @@ def main() -> int:
         unknown = only - known
         if unknown:
             raise SystemExit(f"BENCH_ROWS: unknown rows {sorted(unknown)}")
+    def maybe_write() -> None:
+        # INCREMENTAL writes apply to MERGE mode only: there the on-disk
+        # file is a superset being updated row by row, so a mid-capture
+        # death (the flaky-tunnel case the probe loop hits) keeps every
+        # completed row.  A FULL run starts from empty results — writing
+        # after row 1 would clobber a complete prior matrix with a
+        # 1-row file, so full runs keep the single end-of-run write.
+        if only is not None:
+            _write_matrix(size_mb, results, captured_at)
+
     ran = 0
     for key, desc, code, env in configs:
         if only is not None and key not in only:
@@ -506,11 +516,21 @@ def main() -> int:
         if gbps is None:
             results.pop(key, None)   # skipped: drop any stale prior row
             captured_at.pop(key, None)
+            maybe_write()            # the drop must persist too
             continue
         results[key] = gbps
         captured_at[key] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          time.gmtime())
         print(f"{key:<14} {desc:<34} {gbps:7.3f} GB/s")
+        maybe_write()
+    path = _write_matrix(size_mb, results, captured_at)
+    print(f"wrote {path}")
+    return 0
+
+
+def _write_matrix(size_mb: int, results: dict, captured_at: dict) -> str:
+    """Atomically (re)write BENCH_MATRIX.json with the derived blocks
+    recomputed — called after every completed row AND at the end."""
     # derived ratios (VERDICT r1 #2): every BASELINE ">=90% of raw" target
     # becomes checkable from this one JSON
     raw = results.get("raw_seq_read", 0.0)
@@ -542,7 +562,8 @@ def main() -> int:
                              and results.get("groupbyf_pallas_chip")
                              else None)
     path = os.path.join(REPO, "BENCH_MATRIX.json")
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"size_mb": size_mb, "unit": "GB/s",
                    "note": "h2d_peak is the host->HBM transport ceiling on "
                            "this host (device transfers are rate-limited "
@@ -571,8 +592,8 @@ def main() -> int:
                    "pallas_vs_xla_groupby": pallas_vs_xla_groupby}, f,
                   indent=2)
         f.write("\n")
-    print(f"wrote {path}")
-    return 0
+    os.replace(tmp, path)
+    return path
 
 
 if __name__ == "__main__":
