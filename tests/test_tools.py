@@ -547,3 +547,47 @@ def test_strom_query_join_heap_rejects_bad_table(tmp_path):
                "--join", f"0:{tmp_path}/nope.heap", "--json")
     assert out.returncode != 0
     assert "Traceback" not in out.stderr
+
+
+def test_bench_probe_loop_rows_match_matrix_configs():
+    """The probe loop's tunnel-row list must name real bench_matrix
+    configs — a renamed row would make the in-round capture die on
+    'unknown rows' exactly when the healthy window finally opens."""
+    import re
+
+    import bench
+    src = open(os.path.join(REPO, "bench_matrix.py")).read()
+    known = set(re.findall(r'\("([a-z0-9_]+)", "', src))
+    rows = set(bench._TUNNEL_ROWS.split(","))
+    assert rows <= known, rows - known
+
+
+def test_bench_fallback_carries_journal_metrics(tmp_path, monkeypatch):
+    """A wedged-round fallback must carry the journaled capture's
+    companion metrics (avg DMA size, request count, provenance) and the
+    live CPU row's alternation samples into the emitted artifact."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+
+    import bench
+    monkeypatch.setattr(bench, "CANDIDATE_PATH",
+                        str(tmp_path / "cand.json"))
+    _json.dump({"metric": "ssd2tpu_seq_GBps", "value": 1.5,
+                "vs_baseline": 1.2, "avg_dma_kb": 1024.0,
+                "requests": 96, "captured_at": "T", "provenance": "p"},
+               open(bench.CANDIDATE_PATH, "w"))
+    monkeypatch.setattr(bench, "_cpu_row", lambda path: {
+        "direct": 2.0, "vfs": 1.9, "ratio": 1.05, "vs_raw_odirect": 0.97,
+        "samples": [{"direct": 2.0, "raw_odirect": 2.1, "vfs": 1.9}],
+        "raid0": 2.2})
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench._emit_cpu_fallback("/nonexistent", "test wedge")
+    assert rc == 0
+    out = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["value"] == 1.5 and out["stale_device_rows"] is True
+    assert out["avg_dma_kb"] == 1024.0 and out["requests"] == 96
+    assert out["provenance"] == "p"
+    assert out["cpu_live"]["samples"][0]["raw_odirect"] == 2.1
+    assert out["cpu_live"]["vs_raw_odirect"] == 0.97
