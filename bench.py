@@ -45,6 +45,7 @@ driver invocation then reports fresh rows instead of a journal replay.
 The loop exits 0 after one complete capture.
 """
 
+import fcntl
 import json
 import os
 import re
@@ -54,6 +55,27 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CANDIDATE_PATH = os.path.join(REPO, "BENCH_CANDIDATE.json")
+LOCK_PATH = os.path.join(REPO, ".bench.lock")
+
+
+def hold_bench_lock(label: str):
+    """Exclusive inter-process lock serializing capture runs: a
+    concurrent bench.py and bench_matrix.py share the tunnel's token
+    bucket AND the disk, so overlapped runs corrupt each other's rows
+    (observed: a smoke run during the matrix's ssd2tpu row recorded
+    0.14 GB/s against an adjacent clean 1.01).  Blocking — the later
+    capture waits rather than failing; the lock lives until the holder
+    exits.  Callers keep the returned file object alive."""
+    f = open(LOCK_PATH, "w")
+    try:
+        fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        sys.stderr.write(f"bench: {label} waiting for {LOCK_PATH} "
+                         f"(another capture is running)\n")
+        fcntl.flock(f, fcntl.LOCK_EX)
+    f.write(f"{os.getpid()} {label}\n")
+    f.flush()
+    return f
 
 
 def _ensure_file(path: str, size: int) -> None:
@@ -521,6 +543,7 @@ def main() -> int:
     smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")
+    _lock = hold_bench_lock("bench.py")   # released on process exit
     _ensure_file(path, size_mb << 20)
 
     if not _probe_backend():
@@ -584,7 +607,13 @@ def main() -> int:
     }
     if failures:
         out["partial_failures"] = failures
-    _save_candidate(out)
+    if smoke:
+        # a smoke run's 64MB single-round geometry is NOT the
+        # measurement of record; journaling it would overwrite a
+        # full-geometry capture with a weaker one (observed round 4)
+        out["smoke"] = True
+    else:
+        _save_candidate(out)
     print(json.dumps(out))
     return 0
 

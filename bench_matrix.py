@@ -420,6 +420,8 @@ print(f"GBPS={{nbytes/dt/(1<<30):.3f}}")
 
 
 def main() -> int:
+    from bench import hold_bench_lock
+    _lock = hold_bench_lock("bench_matrix.py")   # released on exit
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "512"))
     cooldown = 0 if smoke else int(os.environ.get("BENCH_COOLDOWN_S", "30"))

@@ -28,11 +28,46 @@ from ..scan.heap import HeapSchema
 from .filter_xla import DEFAULT_SCHEMA, decode_pages
 
 __all__ = ["make_join_fn", "make_join_rows_fn", "key_hash32",
-           "hash_split_build"]
+           "hash_split_build", "check_join_how", "JOIN_HOWS"]
 
 # Knuth multiplicative constant: scrambles int32 keys so hash % P spreads
 # adjacent/striped key spaces evenly across partitions
 _KNUTH = np.uint32(2654435761)
+
+# The join faces every strategy serves (strategy choice must never change
+# the available semantics): inner = rows with a partner, plus its payload;
+# semi = EXISTS (rows with a partner, build payload not exposed); anti =
+# NOT EXISTS (rows without a partner); left = every selected probe row,
+# payload where partnered and a NULL indicator where not.
+JOIN_HOWS = ("inner", "left", "semi", "anti")
+
+
+def check_join_how(how: str) -> str:
+    if how not in JOIN_HOWS:
+        raise ValueError(f"join how={how!r} (expected one of {JOIN_HOWS})")
+    return how
+
+
+def _emit_mask(how, sel, hit):
+    """The rows a join emits under *how*, from the selected mask and the
+    has-a-partner mask — THE single derivation every strategy uses."""
+    if how in ("inner", "semi"):
+        return hit
+    if how == "anti":
+        return sel & ~hit
+    return sel            # left: every selected probe row
+
+
+def _owner_mask(probe, owner_part):
+    """Grace-pass ownership restriction: non-inner faces scanned in
+    sequential build partitions must consider each probe row in exactly
+    the pass that OWNS its key (else an anti/left row is emitted once per
+    pass).  ``owner_part=(n_parts, p)``; None = no restriction."""
+    if owner_part is None:
+        return None
+    n_parts, p = owner_part
+    return (key_hash32(probe) % jnp.uint32(n_parts)).astype(jnp.int32) \
+        == jnp.int32(p)
 
 
 def key_hash32(k):
@@ -60,17 +95,23 @@ def hash_split_build(build_keys, build_values, n_parts: int):
 
 def make_join_fn(schema: HeapSchema, probe_col: int,
                  build_keys: np.ndarray, build_values: np.ndarray, *,
-                 predicate: Optional[Callable] = None):
-    """Build a jitted ``run(pages_u8, *params) -> dict`` inner-join step.
+                 predicate: Optional[Callable] = None,
+                 how: str = "inner", owner_part=None):
+    """Build a jitted ``run(pages_u8, *params) -> dict`` join step.
 
     ``build_keys``/``build_values`` — the dimension table (int32, unique
-    keys; sorted internally).  A scanned row joins when column
-    ``probe_col`` equals some build key (and *predicate* passes).
+    keys; sorted internally).  A scanned row has a partner when column
+    ``probe_col`` equals some build key (and *predicate* passes); *how*
+    picks which rows the join emits (:data:`JOIN_HOWS`).
 
-    Returns per batch: ``matched`` (row count), ``sums`` (over joined
-    rows, for the int32 fact columns listed in ``run.sum_cols``),
-    ``payload_sum`` (sum of the matched build values).
+    Returns per batch: ``matched`` (count of EMITTED rows), ``sums``
+    (over emitted rows, for the int32 fact columns in ``run.sum_cols``).
+    inner/left add ``payload_sum`` (sum of matched build values — for
+    left that is SQL's ``SUM(payload)`` over the outer result, NULLs
+    ignored); left adds ``null_count`` (emitted rows without a partner).
+    ``owner_part`` — see :func:`_owner_mask` (Grace passes only).
     """
+    check_join_how(how)
     keys, vals = _sorted_build(build_keys, build_values, schema, probe_col)
     sum_cols = [c for c in range(schema.n_cols)
                 if schema.col_dtype(c) == np.dtype(np.int32)]
@@ -80,12 +121,19 @@ def make_join_fn(schema: HeapSchema, probe_col: int,
         cols, valid = decode_pages(pages_u8, schema)
         sel = valid if predicate is None else valid & predicate(cols, *params)
         probe = cols[probe_col]
+        own = _owner_mask(probe, owner_part)
+        if own is not None:
+            sel = sel & own
         hit, pay = _probe(keys, vals, probe, sel)
-        matched = jnp.sum(hit.astype(jnp.int32))
-        sums = jnp.stack([jnp.sum(jnp.where(hit, cols[c], 0))
-                          for c in sum_cols])
-        payload = jnp.sum(jnp.where(hit, pay, 0))
-        return {"matched": matched, "sums": sums, "payload_sum": payload}
+        emit = _emit_mask(how, sel, hit)
+        out = {"matched": jnp.sum(emit.astype(jnp.int32)),
+               "sums": jnp.stack([jnp.sum(jnp.where(emit, cols[c], 0))
+                                  for c in sum_cols])}
+        if how in ("inner", "left"):
+            out["payload_sum"] = jnp.sum(jnp.where(hit, pay, 0))
+        if how == "left":
+            out["null_count"] = jnp.sum((emit & ~hit).astype(jnp.int32))
+        return out
 
     run.sum_cols = sum_cols
     return run
@@ -121,15 +169,19 @@ def _probe(keys, vals, probe, sel):
 
 def make_join_rows_fn(schema: HeapSchema, probe_col: int,
                       build_keys: np.ndarray, build_values: np.ndarray, *,
-                      predicate: Optional[Callable] = None):
+                      predicate: Optional[Callable] = None,
+                      how: str = "inner", owner_part=None):
     """Row-materializing twin of :func:`make_join_fn`: instead of folding
     aggregates, each batch returns the per-row join outcome — ``hit``
-    mask, the probed ``key``, the matched build ``payload``, and the
-    rows' global ``positions`` — flattened for host-side compression
-    (the SELECT-with-JOIN face: joined tuples back to the executor,
-    like the reference scan hands tuples up, pgsql/nvme_strom.c:941-979).
+    (the EMIT mask under *how*), ``partner`` (has a build partner — only
+    differs from ``hit`` for left), the probed ``key``, the matched
+    build ``payload`` (zeros where unpartnered), and the rows' global
+    ``positions`` — flattened for host-side compression (the
+    SELECT-with-JOIN face: joined tuples back to the executor, like the
+    reference scan hands tuples up, pgsql/nvme_strom.c:941-979).
     """
     from .filter_xla import global_row_positions
+    check_join_how(how)
     keys, vals = _sorted_build(build_keys, build_values, schema, probe_col)
 
     @jax.jit
@@ -137,10 +189,15 @@ def make_join_rows_fn(schema: HeapSchema, probe_col: int,
         cols, valid = decode_pages(pages_u8, schema)
         sel = valid if predicate is None else valid & predicate(cols, *params)
         probe = cols[probe_col]
+        own = _owner_mask(probe, owner_part)
+        if own is not None:
+            sel = sel & own
         hit, pay = _probe(keys, vals, probe, sel)
-        return {"hit": hit.reshape(-1),
+        emit = _emit_mask(how, sel, hit)
+        return {"hit": emit.reshape(-1),
+                "partner": hit.reshape(-1),
                 "key": probe.reshape(-1),
-                "payload": pay.reshape(-1),
+                "payload": jnp.where(hit, pay, 0).reshape(-1),
                 "positions": global_row_positions(
                     pages_u8, schema).reshape(-1)}
 

@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..api import StromError
 
 from ..ops.filter_xla import decode_pages, global_row_positions
-from ..ops.join import _sorted_build, key_hash32
+from ..ops.join import _emit_mask, _sorted_build, check_join_how, key_hash32
 from ..scan.heap import HeapSchema
 from .exchange import bucket_dispatch
 
@@ -181,24 +181,27 @@ def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
                                probe_col: int, build_keys=None,
                                build_values=None, *,
                                predicate: Optional[Callable] = None,
-                               build_parts=None):
+                               build_parts=None, how: str = "inner"):
     """Build ``step(global_pages) -> dict`` for
     :func:`..parallel.stream.distributed_scan_filter`: the partitioned
     join over one dp-sharded page batch.  Result contract matches
-    :func:`..ops.join.make_join_fn` (``matched``/``sums``/``payload_sum``,
+    :func:`..ops.join.make_join_fn` for the same *how* (``matched`` /
+    ``sums`` / inner+left ``payload_sum`` / left ``null_count``,
     ``step.sum_cols``), so the two strategies are drop-in comparable.
+    Every routed row reaches its key's owner exactly once, so the
+    left/anti faces need no Grace ownership restriction here.
 
     ``build_parts`` — prebuilt ``(keys_dev, vals_dev, nreal_dev)`` from
     :func:`partition_build_sharded_from_table` (the bounded-host-RAM
     build); otherwise ``build_keys``/``build_values`` host arrays are
     partitioned in memory."""
+    check_join_how(how)
     dp = mesh.shape["dp"]
     keys_dev, vals_dev, nreal_dev = build_parts or \
         partition_build_sharded(build_keys, build_values, mesh, schema,
                                 probe_col)
     sum_cols = [c for c in range(schema.n_cols)
                 if schema.col_dtype(c) == np.dtype(np.int32)]
-    width = 1 + len(sum_cols)
 
     def _local(pages, keys_row, vals_row, nreal_row):
         cols, valid = decode_pages(pages, schema)
@@ -220,18 +223,32 @@ def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
         rk = recv[:, 0]
         idx = jnp.clip(jnp.searchsorted(k, rk), 0, k.shape[0] - 1)
         hit = rvalid & (idx < nreal_row[0]) & (k[idx] == rk)
-        matched = jax.lax.psum(jnp.sum(hit.astype(jnp.int32)), "dp")
-        sums = jax.lax.psum(
-            jnp.stack([jnp.sum(jnp.where(hit, recv[:, 1 + i], 0))
-                       for i in range(len(sum_cols))]), "dp")
-        payload = jax.lax.psum(jnp.sum(jnp.where(hit, v[idx], 0)), "dp")
-        return {"matched": matched, "sums": sums, "payload_sum": payload}
+        # only selected rows were dispatched, so among routed slots
+        # rvalid IS the selection mask the broadcast kernel calls sel
+        emit = _emit_mask(how, rvalid, hit)
+        out = {"matched": jax.lax.psum(
+                   jnp.sum(emit.astype(jnp.int32)), "dp"),
+               "sums": jax.lax.psum(
+                   jnp.stack([jnp.sum(jnp.where(emit, recv[:, 1 + i], 0))
+                              for i in range(len(sum_cols))]), "dp")}
+        if how in ("inner", "left"):
+            out["payload_sum"] = jax.lax.psum(
+                jnp.sum(jnp.where(hit, v[idx], 0)), "dp")
+        if how == "left":
+            out["null_count"] = jax.lax.psum(
+                jnp.sum((emit & ~hit).astype(jnp.int32)), "dp")
+        return out
 
+    out_specs = {"matched": P(), "sums": P()}
+    if how in ("inner", "left"):
+        out_specs["payload_sum"] = P()
+    if how == "left":
+        out_specs["null_count"] = P()
     shard_mapped = jax.shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp", None), P("dp", None), P("dp", None),
                   P("dp", None)),
-        out_specs={"matched": P(), "sums": P(), "payload_sum": P()})
+        out_specs=out_specs)
     jitted = jax.jit(shard_mapped)
 
     def step(global_pages):
@@ -256,7 +273,7 @@ def make_partitioned_join_rows_step(mesh: Mesh, schema: HeapSchema,
                                     probe_col: int, build_keys=None,
                                     build_values=None, *,
                                     predicate: Optional[Callable] = None,
-                                    build_parts=None):
+                                    build_parts=None, how: str = "inner"):
     """Row-materializing twin of :func:`make_partitioned_join_step`
     (VERDICT r3 #3): same all_to_all routing, but instead of psum'ing
     aggregates each owner device reports the per-routed-row join outcome
@@ -266,14 +283,18 @@ def make_partitioned_join_rows_step(mesh: Mesh, schema: HeapSchema,
     broadcast row face (:func:`..ops.join.make_join_rows_fn`), and
     ``join_broadcast_max`` never changes what a query can return (the
     reference's scan always hands tuples back to the executor,
-    pgsql/nvme_strom.c:941-979).
+    pgsql/nvme_strom.c:941-979).  *how* picks the emitted face exactly
+    as in the broadcast kernel: ``hit`` is the EMIT mask; inner/left
+    include ``payload``, and left adds ``partner`` (has-a-partner) —
+    dropped columns are never computed, psum'd, or transferred.
 
     Positions ride the exchange alongside the key: the probe outcome
     lives on the key's owner device, not the scanning device, so the
     position must travel with the row.  ``step(global_pages) -> dict``
     of global ``(dp * dp * n_local,)`` arrays; rows where ``hit`` is
-    False are routing pads or non-matches.  ``build_parts`` as in
+    False are routing pads or non-emitted rows.  ``build_parts`` as in
     :func:`make_partitioned_join_step`."""
+    check_join_how(how)
     dp = mesh.shape["dp"]
     keys_dev, vals_dev, nreal_dev = build_parts or \
         partition_build_sharded(build_keys, build_values, mesh, schema,
@@ -304,15 +325,28 @@ def make_partitioned_join_rows_step(mesh: Mesh, schema: HeapSchema,
         rk = recv[:, 0]
         idx = jnp.clip(jnp.searchsorted(k, rk), 0, k.shape[0] - 1)
         hit = rvalid & (idx < nreal_row[0]) & (k[idx] == rk)
-        return {"hit": hit, "key": rk, "payload": v[idx],
-                "pos_lo": recv[:, 1], "pos_hi": recv[:, 2]}
+        emit = _emit_mask(how, rvalid, hit)
+        out = {"hit": emit, "key": rk,
+               "pos_lo": recv[:, 1], "pos_hi": recv[:, 2]}
+        # faces that drop a column never psum/D2H-transfer it (the
+        # same per-how field set as Query._join_row_fields)
+        if how in ("inner", "left"):
+            out["payload"] = jnp.where(hit, v[idx], 0)
+        if how == "left":
+            out["partner"] = hit
+        return out
 
+    out_specs = {"hit": P("dp"), "key": P("dp"),
+                 "pos_lo": P("dp"), "pos_hi": P("dp")}
+    if how in ("inner", "left"):
+        out_specs["payload"] = P("dp")
+    if how == "left":
+        out_specs["partner"] = P("dp")
     shard_mapped = jax.shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp", None), P("dp", None), P("dp", None),
                   P("dp", None)),
-        out_specs={"hit": P("dp"), "key": P("dp"), "payload": P("dp"),
-                   "pos_lo": P("dp"), "pos_hi": P("dp")})
+        out_specs=out_specs)
     jitted = jax.jit(shard_mapped)
 
     def step(global_pages):

@@ -119,6 +119,7 @@ class Query:
         self._order: Optional[tuple] = None
         self._join: Optional[tuple] = None
         self._join_src: Optional[tuple] = None  # on-disk build side
+        self._join_how: str = "inner"           # inner | left | semi | anti
         self._select: Optional[tuple] = None
         self._quantiles: Optional[List[float]] = None
         self._eq: Optional[tuple] = None     # structured equality (col, v)
@@ -416,15 +417,29 @@ class Query:
 
     def join(self, probe_col: int, build_keys: np.ndarray,
              build_values: np.ndarray, *, materialize: bool = False,
-             limit: Optional[int] = None, offset: int = 0) -> "Query":
-        """Terminal: inner join against a host-side dimension table.
+             limit: Optional[int] = None, offset: int = 0,
+             how: str = "inner") -> "Query":
+        """Terminal: join against a host-side dimension table.
 
-        Default: fold aggregates over joined rows (count/sums/payload
-        sum).  ``materialize=True`` returns the joined rows themselves —
-        ``{"positions", "keys", "payload", "count"}`` — with
-        ``limit``/``offset`` slicing like :meth:`select` (the early
-        DMA cut-off included)."""
+        ``how`` — ``"inner"`` (default), ``"left"`` (every selected
+        probe row; unpartnered rows carry payload 0 and a False
+        ``matched`` NULL indicator), ``"semi"`` (EXISTS — partnered rows,
+        build payload not exposed), or ``"anti"`` (NOT EXISTS — rows
+        without a partner).  Every strategy (broadcast, Grace local
+        passes, mesh partitioned, index-served) serves every face.
+
+        Default: fold aggregates over emitted rows — ``matched``/
+        ``sums``, plus ``payload_sum`` (inner/left) and ``null_count``
+        (left).  ``materialize=True`` returns the rows themselves —
+        ``{"positions", "keys", "count"}`` plus ``payload`` (inner/left)
+        and ``matched`` (left) — with ``limit``/``offset`` slicing like
+        :meth:`select` (the early DMA cut-off included)."""
+        from ..ops.join import check_join_how
         self._require_no_terminal()
+        try:
+            check_join_how(how)
+        except ValueError as e:
+            raise StromError(22, str(e)) from None
         if limit is not None and limit < 0:
             raise StromError(22, "join limit must be >= 0")
         if offset < 0:
@@ -438,13 +453,16 @@ class Query:
         self._terminal_set = True
         self._join = (int(probe_col), build_keys, build_values,
                       materialize, limit, int(offset))
+        self._join_how = how
         return self
 
     def join_table(self, probe_col: int, build_table, build_schema,
                    key_col: int, value_col: int, *,
                    materialize: bool = False,
-                   limit: Optional[int] = None, offset: int = 0) -> "Query":
-        """Terminal: inner join whose build side is an ON-DISK heap
+                   limit: Optional[int] = None, offset: int = 0,
+                   how: str = "inner") -> "Query":
+        """Terminal: join (``how`` as in :meth:`join`) whose build side
+        is an ON-DISK heap
         table instead of host arrays (the bounded-build face, VERDICT
         r3 #8).  A build table that broadcasts (fits
         ``config join_broadcast_max``) is loaded with one projection
@@ -476,7 +494,7 @@ class Query:
             raise StromError(getattr(e, "errno", None) or 22,
                              f"join_table build table: {e}") from e
         self.join(probe_col, None, None, materialize=materialize,
-                  limit=limit, offset=offset)
+                  limit=limit, offset=offset, how=how)
         self._join_src = (build_table, build_schema, int(key_col),
                           int(value_col))
         return self
@@ -776,7 +794,8 @@ class Query:
                         "join_build_host_max)")
             plan = dataclasses.replace(
                 plan, join_strategy=label,
-                reason=plan.reason + f"; join strategy {label}: {how}")
+                reason=plan.reason + f"; join type {self._join_how}"
+                       f"; join strategy {label}: {how}")
         return plan
 
     def _explain_inner(self, *, mesh=None) -> QueryPlan:
@@ -926,7 +945,7 @@ class Query:
         probe_col, bk, bv = self._join[:3]
         run = make_join_fn(self.schema, probe_col, bk, bv,
                            predicate=(lambda cols: pred(cols))
-                           if pred else None)
+                           if pred else None, how=self._join_how)
         return (lambda pages: run(pages)), None
 
     # -- execution ----------------------------------------------------------
@@ -1499,6 +1518,7 @@ class Query:
             # streamed passes); resolving here is therefore bounded
             self._resolve_join_build(session, device)
         probe_col, bk, bv, materialize, limit, offset = self._join
+        how = self._join_how
         # the kernel path's exact build-side validation + sort (host
         # arrays; the probe column is int32 by that validation)
         keys, vals = _sorted_build(bk, bv, self.schema, probe_col)
@@ -1511,9 +1531,16 @@ class Query:
             i = np.clip(np.searchsorted(keys, probe), 0, len(keys) - 1)
             return keys[i] == probe, vals[i]
 
+        def emit_of(hit):
+            # THE kernel emit derivation (ops.join._emit_mask works on
+            # numpy operands too); rows here are already selected, so
+            # sel = all-ones
+            from ..ops.join import _emit_mask
+            return np.asarray(_emit_mask(how, np.ones_like(hit), hit))
+
         if materialize:
             # batched fetch of ONLY the probe column, stopping once
-            # offset+limit joined rows are found (the early DMA cut-off
+            # offset+limit emitted rows are found (the early DMA cut-off
             # the seqscan face has)
             end = None if limit is None else offset + limit
             parts, got = [], 0
@@ -1526,25 +1553,29 @@ class Query:
                 probe = np.asarray(out[f"col{probe_col}"])[keep]
                 pb = pb[keep]
                 hit, pay = probe_host(probe)
-                parts.append((pb[hit], probe[hit], pay[hit]))
-                got += int(hit.sum())
+                emit = emit_of(hit)
+                parts.append((pb[emit], probe[emit],
+                              np.where(hit, pay, 0)[emit], hit[emit]))
+                got += int(emit.sum())
                 if end is not None and got >= end:
                     break
             if parts:
                 pos_c = np.concatenate([p[0] for p in parts])
                 key_c = np.concatenate([p[1] for p in parts])
                 pay_c = np.concatenate([p[2] for p in parts])
+                hit_c = np.concatenate([p[3] for p in parts])
             else:
                 pos_c = np.zeros(0, np.int64)
                 key_c = pay_c = np.zeros(0, np.int32)
+                hit_c = np.zeros(0, bool)
             sl = slice(offset, end)
-            res = {"positions": pos_c[sl].astype(self._pos_dtype()),
-                   "keys": key_c[sl].astype(np.int32),
-                   "payload": pay_c[sl].astype(np.int32)}
-            res["count"] = np.int64(len(res["positions"]))
-            return res
-        # aggregate face: matched count + sums over the int32 fact
-        # columns (the kernel's run.sum_cols set, ascending) + payload
+            return self._join_rows_result(
+                how, pos_c[sl].astype(self._pos_dtype()),
+                key_c[sl].astype(np.int32), pay_c[sl].astype(np.int32),
+                hit_c[sl])
+        # aggregate face: emitted count + sums over the int32 fact
+        # columns (the kernel's run.sum_cols set, ascending) + the
+        # per-how extras (payload_sum inner/left, null_count left)
         cols = [c for c in range(self.schema.n_cols)
                 if self.schema.col_dtype(c) == np.dtype(np.int32)]
         out = self.fetch(pos_all, cols=cols, session=session,
@@ -1552,12 +1583,17 @@ class Query:
         keep = np.asarray(out["valid"]).astype(bool)
         probe = np.asarray(out[f"col{probe_col}"])[keep]
         hit, pay = probe_host(probe)
+        emit = emit_of(hit)
         acc = acc_dtypes(np.dtype(np.int32))[0]
-        sums = [np.sum(np.asarray(out[f"col{c}"])[keep][hit], dtype=acc)
+        sums = [np.sum(np.asarray(out[f"col{c}"])[keep][emit], dtype=acc)
                 for c in cols]
-        return {"matched": np.int32(int(hit.sum())),
-                "sums": np.array(sums, acc),
-                "payload_sum": np.sum(pay[hit], dtype=acc)}
+        res = {"matched": np.int32(int(emit.sum())),
+               "sums": np.array(sums, acc)}
+        if how in ("inner", "left"):
+            res["payload_sum"] = np.sum(pay[hit], dtype=acc)
+        if how == "left":
+            res["null_count"] = np.int32(int((emit & ~hit).sum()))
+        return res
 
     def _run_aggregate_indexed(self, idx, device, session) -> dict:
         """COUNT/SUM over index-resolved rows — the most common index
@@ -1622,20 +1658,49 @@ class Query:
 
     def _run_join_rows(self, plan: QueryPlan, device, session) -> dict:
         """SELECT-with-JOIN: stream the scan, probe the broadcast build
-        table per batch, and hand the joined rows back —
-        ``{"positions", "keys", "payload", "count"}``."""
+        table per batch, and hand the emitted rows back —
+        ``{"positions", "keys", "count"}`` plus ``payload`` (inner/left)
+        and ``matched`` (left)."""
         from ..ops.join import make_join_rows_fn
         probe_col, bk, bv, _mat, limit, offset = self._join
+        how = self._join_how
         pred = self._pred
         run = make_join_rows_fn(
             self.schema, probe_col, bk, bv,
-            predicate=(lambda cols: pred(cols)) if pred else None)
-        poss, keyv, payl = self._collect_rows(
-            plan, run, "hit", ["positions", "key", "payload"],
-            [self._pos_dtype(), np.int32, np.int32],
+            predicate=(lambda cols: pred(cols)) if pred else None,
+            how=how)
+        fields, dtypes = self._join_row_fields(how)
+        arrs = self._collect_rows(
+            plan, run, "hit", fields, dtypes,
             device, session, limit=limit, offset=offset)
-        return {"positions": poss, "keys": keyv, "payload": payl,
-                "count": np.int64(len(poss))}
+        return self._join_rows_result(how, *arrs)
+
+    def _join_row_fields(self, how: str):
+        """Kernel output fields the row face collects under *how* —
+        faces that drop a column (semi/anti: payload+partner; inner:
+        partner) never D2H-transfer or concatenate it."""
+        fields = ["positions", "key"]
+        dtypes = [self._pos_dtype(), np.int32]
+        if how in ("inner", "left"):
+            fields.append("payload")
+            dtypes.append(np.int32)
+        if how == "left":
+            fields.append("partner")
+            dtypes.append(np.bool_)
+        return fields, dtypes
+
+    def _join_rows_result(self, how: str, poss, keyv, payl=None,
+                          partner=None) -> dict:
+        """One row-face result contract for every join strategy: the
+        per-*how* key set (payload only where the face exposes the build
+        side; the left face's ``matched`` NULL indicator)."""
+        out = {"positions": poss, "keys": keyv,
+               "count": np.int64(len(poss))}
+        if how in ("inner", "left"):
+            out["payload"] = payl
+        if how == "left":
+            out["matched"] = np.asarray(partner).astype(bool)
+        return out
 
     @staticmethod
     def _sidecar_descending_perm(ka: np.ndarray, lo_i: int,
@@ -1783,10 +1848,14 @@ class Query:
         one hash partition of the build resident at a time (n_parts
         scans, build memory bounded by ``join_broadcast_max``).  Results
         add across partitions because every build key lives in exactly
-        one.  Materialized row order is per-partition arrival order —
+        one — and, for the left/anti faces, because each pass restricts
+        itself to the probe rows its partition OWNS (an unpartnered row
+        must be emitted by exactly one pass, not every pass).
+        Materialized row order is per-partition arrival order —
         unspecified, like SQL without ORDER BY; parity with broadcast is
         set-equality."""
         probe_col, bk, bv, materialize, limit, offset = self._join
+        how = self._join_how
         pred = self._pred
         from .executor import fold_results
         if mesh is not None and materialize:
@@ -1799,7 +1868,8 @@ class Query:
                 mesh, self.schema, probe_col, bk, bv,
                 predicate=(lambda cols: pred(cols)) if pred else None,
                 build_parts=self._streamed_build_parts(mesh, session,
-                                                       device))
+                                                       device),
+                how=how)
             src, own = self._open_owned()
             try:
                 acc = None
@@ -1833,39 +1903,38 @@ class Query:
             # first offset+limit rows in partition order is a valid
             # instance of the contract.
             stop = None if limit is None else offset + limit
-            poss, keyv, payl = [], [], []
+            fields, dtypes = self._join_row_fields(how)
+            cols_acc = [[] for _ in fields]
             gathered = 0
-            for pk, pv in parts:
+            own_needed = how in ("left", "anti")
+            for p, (pk, pv) in enumerate(parts):
                 remaining = None if stop is None else stop - gathered
                 if remaining is not None and remaining <= 0:
                     break
                 run = make_join_rows_fn(
                     self.schema, probe_col, pk, pv,
-                    predicate=(lambda cols: pred(cols)) if pred else None)
-                p_, k_, y_ = self._collect_rows(
-                    plan, run, "hit", ["positions", "key", "payload"],
-                    [self._pos_dtype(), np.int32, np.int32],
+                    predicate=(lambda cols: pred(cols)) if pred else None,
+                    how=how,
+                    owner_part=(n_parts, p) if own_needed else None)
+                got = self._collect_rows(
+                    plan, run, "hit", fields, dtypes,
                     device, session, limit=remaining)
-                gathered += len(p_)
-                poss.append(p_)
-                keyv.append(k_)
-                payl.append(y_)
+                gathered += len(got[0])
+                for acc, a in zip(cols_acc, got):
+                    acc.append(a)
             end = None if limit is None else offset + limit
-            if poss:
-                poss = np.concatenate(poss)[offset:end]
-                keyv = np.concatenate(keyv)[offset:end]
-                payl = np.concatenate(payl)[offset:end]
+            if cols_acc[0]:
+                arrs = [np.concatenate(a)[offset:end] for a in cols_acc]
             else:   # limit=0 breaks before any partition scans
-                poss = np.zeros(0, self._pos_dtype())
-                keyv = np.zeros(0, np.int32)
-                payl = np.zeros(0, np.int32)
-            return {"positions": poss, "keys": keyv, "payload": payl,
-                    "count": np.int64(len(poss))}
+                arrs = [np.zeros(0, dt) for dt in dtypes]
+            return self._join_rows_result(how, *arrs)
         acc = None
-        for pk, pv in parts:
+        own_needed = how in ("left", "anti")
+        for p, (pk, pv) in enumerate(parts):
             run = make_join_fn(
                 self.schema, probe_col, pk, pv,
-                predicate=(lambda cols: pred(cols)) if pred else None)
+                predicate=(lambda cols: pred(cols)) if pred else None,
+                how=how, owner_part=(n_parts, p) if own_needed else None)
             fn = lambda pages, run=run: run(pages)
             if plan.access_path == "direct":
                 from .executor import TableScanner
@@ -1981,28 +2050,35 @@ class Query:
         per-row outcomes come back for host-side compression — same
         result contract as the broadcast row face, with the same LIMIT
         early-exit (the stream stops issuing SSD DMA once offset+limit
-        matched rows are in hand)."""
+        emitted rows are in hand)."""
         from ..parallel.pjoin import (combine_pos_words,
                                       make_partitioned_join_rows_step)
+        how = self._join_how
         pred = self._pred
         step = make_partitioned_join_rows_step(
             mesh, self.schema, probe_col, bk, bv,
             predicate=(lambda cols: pred(cols)) if pred else None,
             build_parts=self._streamed_build_parts(mesh, session,
-                                                   device))
+                                                   device),
+            how=how)
         stop = None if limit is None else offset + limit
         chunks: List[tuple] = []
         gathered = 0
 
+        fields, dtypes = self._join_row_fields(how)
+        # positions arrive as exchange words; the remaining fields come
+        # straight off the step's per-how output set
+        tail_fields = fields[1:]
+
         def take(out) -> bool:
             nonlocal gathered
-            hit = np.asarray(out["hit"]).astype(bool)
-            lo = np.asarray(out["pos_lo"])[hit]
-            hi = np.asarray(out["pos_hi"])[hit]
-            chunks.append((combine_pos_words(lo, hi, self._pos_dtype()),
-                           np.asarray(out["key"])[hit],
-                           np.asarray(out["payload"])[hit]))
-            gathered += int(hit.sum())
+            emit = np.asarray(out["hit"]).astype(bool)
+            lo = np.asarray(out["pos_lo"])[emit]
+            hi = np.asarray(out["pos_hi"])[emit]
+            chunks.append(
+                (combine_pos_words(lo, hi, self._pos_dtype()),)
+                + tuple(np.asarray(out[f])[emit] for f in tail_fields))
+            gathered += int(emit.sum())
             return stop is not None and gathered >= stop
         src, own = self._open_owned()
         try:
@@ -2016,15 +2092,11 @@ class Query:
             if own:
                 src.close()
         if chunks:
-            poss = np.concatenate([c[0] for c in chunks])[offset:stop]
-            keyv = np.concatenate([c[1] for c in chunks])[offset:stop]
-            payl = np.concatenate([c[2] for c in chunks])[offset:stop]
+            arrs = [np.concatenate([c[i] for c in chunks])[offset:stop]
+                    for i in range(len(fields))]
         else:
-            poss = np.zeros(0, self._pos_dtype())
-            keyv = np.zeros(0, np.int32)
-            payl = np.zeros(0, np.int32)
-        return {"positions": poss, "keys": keyv, "payload": payl,
-                "count": np.int64(len(poss))}
+            arrs = [np.zeros(0, dt) for dt in dtypes]
+        return self._join_rows_result(how, *arrs)
 
     @staticmethod
     def _mesh_sort_loop(mesh, factory, *arrays):

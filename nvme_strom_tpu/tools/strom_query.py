@@ -204,10 +204,10 @@ def main(argv=None) -> int:
                          "fetch only their pages (build with --build-index "
                          "first; stale indexes are refused)")
     ap.add_argument("--join", default=None, metavar="COL:TABLE",
-                    help="inner join the probe column against a dimension "
+                    help="join the probe column against a dimension "
                          "table file (.npz with 'keys'/'values' int arrays, "
                          "or .npy of (N, 2) [key, value] rows); aggregates "
-                         "joined rows")
+                         "joined rows (face picked by --join-how)")
     ap.add_argument("--join-build-cols", type=int, default=2,
                     metavar="N",
                     help="with --join COL:TABLE.heap: column count of the "
@@ -219,6 +219,11 @@ def main(argv=None) -> int:
                     help="with --join COL:TABLE.heap: build key column")
     ap.add_argument("--join-value-col", type=int, default=1, metavar="C",
                     help="with --join COL:TABLE.heap: build payload column")
+    ap.add_argument("--join-how", default="inner",
+                    choices=("inner", "left", "semi", "anti"),
+                    help="join face: inner (default), left (every "
+                         "selected row, NULL-indicated payload), semi "
+                         "(EXISTS), anti (NOT EXISTS)")
     ap.add_argument("--join-rows", action="store_true",
                     help="with --join: return the joined rows themselves "
                          "(positions/keys/payload; --limit/--offset apply)")
@@ -424,7 +429,7 @@ def main(argv=None) -> int:
                                  limit=args.limit if args.join_rows
                                  else None,
                                  offset=args.offset if args.join_rows
-                                 else 0)
+                                 else 0, how=args.join_how)
             except StromError as e:
                 ap.error(f"--join heap table: {e}")
         else:
@@ -447,7 +452,8 @@ def main(argv=None) -> int:
                 ap.error(f"--join table {table!r} unreadable: {e}")
             q = q.join(int(colspec), jk, jv, materialize=args.join_rows,
                        limit=args.limit if args.join_rows else None,
-                       offset=args.offset if args.join_rows else 0)
+                       offset=args.offset if args.join_rows else 0,
+                       how=args.join_how)
     elif args.quantiles:
         colspec, _, qspec = args.quantiles.partition(":")
         if not colspec.isdigit() or not qspec:

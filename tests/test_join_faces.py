@@ -1,0 +1,262 @@
+"""Join faces (how=inner/left/semi/anti) across every strategy.
+
+The contract under test: strategy choice — broadcast, Grace local
+partitioned passes, mesh partitioned all_to_all, index-served — must
+never change the SEMANTICS a query can express (the reference scan hands
+whatever tuples the executor's join type needs, pgsql/nvme_strom.c:
+941-979; the face set here is the classic PG join-type set restricted to
+a unique-key dimension build side).  Every test checks against one numpy
+oracle.
+"""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.api import StromError
+from nvme_strom_tpu.config import config
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+from nvme_strom_tpu.scan.index import build_index
+from nvme_strom_tpu.scan.query import Query
+
+HOWS = ("inner", "left", "semi", "anti")
+
+
+@pytest.fixture()
+def heap(tmp_path):
+    rng = np.random.default_rng(11)
+    schema = HeapSchema(n_cols=2, visibility=True)
+    n = schema.tuples_per_page * 24
+    c0 = rng.integers(-1000, 1000, n).astype(np.int32)
+    c1 = rng.integers(0, 1024, n).astype(np.int32)
+    vis = (rng.random(n) > 0.2).astype(np.int32)
+    path = str(tmp_path / "t.heap")
+    build_heap_file(path, [c0, c1], schema, visibility=vis)
+    return path, schema, c0, c1, vis
+
+
+# build side: keys cover only HALF the probe key space, so every face
+# (matched / unmatched) is non-trivially populated
+KEYS = np.arange(0, 512, dtype=np.int32)
+VALS = (KEYS * 10).astype(np.int32)
+
+
+def oracle(c0, c1, vis, how, *, pred=True):
+    """(emit mask, partner mask, per-row payload) over all rows."""
+    sel = (vis != 0) & (True if pred is True else pred)
+    partner = sel & (c1 < 512)                     # keys are [0, 512)
+    payload = np.where(partner, c1 * 10, 0).astype(np.int32)
+    emit = {"inner": partner, "semi": partner,
+            "anti": sel & ~partner, "left": sel}[how]
+    return emit, partner, payload
+
+
+def check_agg(out, c0, c1, emit, partner, payload, how):
+    assert int(out["matched"]) == int(emit.sum())
+    assert int(out["sums"][0]) == int(c0[emit].sum())
+    assert int(out["sums"][1]) == int(c1[emit].sum())
+    if how in ("inner", "left"):
+        assert int(out["payload_sum"]) == int(payload[partner].sum())
+    else:
+        assert "payload_sum" not in out
+    if how == "left":
+        assert int(out["null_count"]) == int((emit & ~partner).sum())
+    else:
+        assert "null_count" not in out
+
+
+def check_rows(out, c1, emit, partner, payload, how):
+    pos = np.asarray(out["positions"])
+    order = np.argsort(pos)
+    np.testing.assert_array_equal(pos[order], np.flatnonzero(emit))
+    np.testing.assert_array_equal(np.asarray(out["keys"])[order],
+                                  c1[emit])
+    assert int(out["count"]) == int(emit.sum())
+    if how in ("inner", "left"):
+        np.testing.assert_array_equal(np.asarray(out["payload"])[order],
+                                      payload[emit])
+    else:
+        assert "payload" not in out
+    if how == "left":
+        np.testing.assert_array_equal(
+            np.asarray(out["matched"])[order], partner[emit])
+    else:
+        assert "matched" not in out
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_broadcast_faces_match_oracle(heap, how):
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    emit, partner, payload = oracle(c0, c1, vis, how)
+    agg = Query(path, schema).join(1, KEYS, VALS, how=how).run()
+    check_agg(agg, c0, c1, emit, partner, payload, how)
+    rows = Query(path, schema).join(1, KEYS, VALS, how=how,
+                                    materialize=True).run()
+    check_rows(rows, c1, emit, partner, payload, how)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_faces_with_predicate(heap, how):
+    """A residual WHERE composes with every face (left emits only
+    selected rows; anti means 'selected and unpartnered')."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    emit, partner, payload = oracle(c0, c1, vis, how, pred=c0 > 0)
+    q = Query(path, schema).where(lambda cols: cols[0] > 0)
+    agg = q.join(1, KEYS, VALS, how=how).run()
+    check_agg(agg, c0, c1, emit, partner, payload, how)
+    q2 = Query(path, schema).where(lambda cols: cols[0] > 0)
+    rows = q2.join(1, KEYS, VALS, how=how, materialize=True).run()
+    check_rows(rows, c1, emit, partner, payload, how)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_partitioned_local_parity(heap, how):
+    """Grace sequential passes emit the same face as broadcast — in
+    particular left/anti rows appear EXACTLY once (the per-pass
+    ownership restriction), not once per partition."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    emit, partner, payload = oracle(c0, c1, vis, how)
+    old = config.get("join_broadcast_max")
+    config.set("join_broadcast_max", 1024)  # force partitioned passes
+    try:
+        q = Query(path, schema).join(1, KEYS, VALS, how=how)
+        assert "partitioned" in q.explain().join_strategy
+        assert f"join type {how}" in q.explain().reason
+        agg = q.run()
+        check_agg(agg, c0, c1, emit, partner, payload, how)
+        rows = Query(path, schema).join(1, KEYS, VALS, how=how,
+                                       materialize=True).run()
+        check_rows(rows, c1, emit, partner, payload, how)
+    finally:
+        config.set("join_broadcast_max", old)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_mesh_partitioned_parity(heap, how):
+    """The all_to_all mesh strategy serves every face with the same
+    result contract (aggregate and row faces) as the local paths."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    emit, partner, payload = oracle(c0, c1, vis, how)
+    mesh = make_scan_mesh(jax.devices())
+    old = config.get("join_broadcast_max")
+    config.set("join_broadcast_max", 1024)  # force partitioned strategy
+    try:
+        agg = Query(path, schema).join(1, KEYS, VALS, how=how) \
+            .run(mesh=mesh, batch_pages=8)
+        check_agg(agg, c0, c1, emit, partner, payload, how)
+        rows = Query(path, schema).join(1, KEYS, VALS, how=how,
+                                       materialize=True) \
+            .run(mesh=mesh, batch_pages=8)
+        check_rows(rows, c1, emit, partner, payload, how)
+    finally:
+        config.set("join_broadcast_max", old)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_indexed_faces_parity(tmp_path, how):
+    """Index-served joins (structured filter + fresh sidecar) emit the
+    same face as the seqscan path."""
+    rng = np.random.default_rng(7)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 16
+    c0 = rng.integers(0, 200, n).astype(np.int32)
+    c1 = rng.integers(0, 1024, n).astype(np.int32)
+    path = str(tmp_path / "t.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", True)
+
+    def q(**kw):
+        return Query(path, schema).where_range(0, 40, 60) \
+            .join(1, KEYS, VALS, how=how, **kw)
+
+    seq_a, seq_m = q().run(), q(materialize=True).run()
+    build_index(path, schema, 0)
+    qa, qm = q(), q(materialize=True)
+    assert qa.explain().access_path == "index"
+    ia, im = qa.run(), qm.run()
+    assert int(ia["matched"]) == int(seq_a["matched"])
+    np.testing.assert_array_equal(ia["sums"], seq_a["sums"])
+    for k in ("payload_sum", "null_count"):
+        assert (k in ia) == (k in seq_a)
+        if k in ia:
+            assert int(ia[k]) == int(seq_a[k])
+    np.testing.assert_array_equal(np.sort(im["positions"]),
+                                  np.sort(seq_m["positions"]))
+    assert set(im) == set(seq_m)
+    if "payload" in im:
+        o_i, o_s = np.argsort(im["positions"]), \
+            np.argsort(seq_m["positions"])
+        np.testing.assert_array_equal(
+            np.asarray(im["payload"])[o_i],
+            np.asarray(seq_m["payload"])[o_s])
+
+
+def test_left_rows_null_indicator(heap):
+    """The left face's NULL indicator: unpartnered rows carry payload 0
+    and matched=False — and limit slicing keeps the triple aligned."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).join(1, KEYS, VALS, how="left",
+                                   materialize=True).run()
+    m = np.asarray(out["matched"])
+    assert m.dtype == np.bool_
+    assert (np.asarray(out["payload"])[~m] == 0).all()
+    assert (np.asarray(out["keys"])[~m] >= 512).all()
+    # limit keeps positions/keys/payload/matched aligned
+    part = Query(path, schema).join(1, KEYS, VALS, how="left",
+                                    materialize=True, limit=7,
+                                    offset=2).run()
+    full = Query(path, schema).join(1, KEYS, VALS, how="left",
+                                    materialize=True).run()
+    np.testing.assert_array_equal(part["positions"],
+                                  full["positions"][2:9])
+    np.testing.assert_array_equal(part["matched"], full["matched"][2:9])
+
+
+def test_invalid_how_refused(heap):
+    path, schema, *_ = heap
+    with pytest.raises(StromError):
+        Query(path, schema).join(1, KEYS, VALS, how="outer")
+    # a refused join leaves the query reusable
+    q = Query(path, schema)
+    with pytest.raises(StromError):
+        q.join(1, KEYS, VALS, how="full")
+    q.join(1, KEYS, VALS, how="anti")   # still accepts a terminal
+
+
+def test_join_table_faces(tmp_path):
+    """join_table (on-disk build side) serves every face, both
+    broadcast-sized and partitioned-sized builds."""
+    rng = np.random.default_rng(3)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 8
+    c0 = rng.integers(0, 100, n).astype(np.int32)
+    c1 = rng.integers(0, 1024, n).astype(np.int32)
+    fpath = str(tmp_path / "fact.heap")
+    build_heap_file(fpath, [c0, c1], schema)
+    bschema = HeapSchema(n_cols=2, visibility=False)
+    bpath = str(tmp_path / "dim.heap")
+    build_heap_file(bpath, [KEYS, VALS], bschema)
+    config.set("debug_no_threshold", True)
+    vis = np.ones(n, np.int32)
+    old = config.get("join_broadcast_max")
+    try:
+        for cap in (old, 1024):    # broadcast-sized, then partitioned
+            config.set("join_broadcast_max", cap)
+            for how in HOWS:
+                emit, partner, payload = oracle(c0, c1, vis, how)
+                agg = Query(fpath, schema) \
+                    .join_table(1, bpath, bschema, 0, 1, how=how).run()
+                check_agg(agg, c0, c1, emit, partner, payload, how)
+                rows = Query(fpath, schema) \
+                    .join_table(1, bpath, bschema, 0, 1, how=how,
+                                materialize=True).run()
+                check_rows(rows, c1, emit, partner, payload, how)
+    finally:
+        config.set("join_broadcast_max", old)

@@ -330,6 +330,23 @@ def test_strom_query_cli_join(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
                "--join-rows")
     assert out.returncode != 0 and "--join-rows" in out.stderr
+    # --join-how picks the face: anti aggregates the unpartnered rows,
+    # left rows carry the NULL indicator
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--join", f"1:{table}", "--join-how", "anti", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["matched"] == int((~sel).sum())
+    assert "payload_sum" not in res
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--join", f"1:{table}", "--join-how", "left",
+               "--join-rows", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["count"] == n
+    m = np.asarray(res["matched"], bool)
+    assert m.sum() == int(sel.sum())
+    assert all(v == 0 for v, mm in zip(res["payload"], m) if not mm)
 
 
 def test_strom_query_cli_fetch(tmp_path):
@@ -560,6 +577,53 @@ def test_bench_probe_loop_rows_match_matrix_configs():
     known = set(re.findall(r'\("([a-z0-9_]+)", "', src))
     rows = set(bench._TUNNEL_ROWS.split(","))
     assert rows <= known, rows - known
+
+
+def test_bench_lock_excludes_concurrent_capture(tmp_path, monkeypatch):
+    """Two capture runs must serialize on the bench lock: a smoke run
+    overlapping the matrix's ssd2tpu row once recorded 0.14 GB/s
+    against an adjacent clean 1.01 (round-4 contamination incident)."""
+    import fcntl
+
+    import bench
+    monkeypatch.setattr(bench, "LOCK_PATH", str(tmp_path / "b.lock"))
+    holder = bench.hold_bench_lock("first")
+    try:
+        second = open(bench.LOCK_PATH, "w")
+        with pytest.raises(OSError):
+            fcntl.flock(second, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        second.close()
+    finally:
+        holder.close()
+    # released on close: a fresh holder acquires without blocking
+    bench.hold_bench_lock("second").close()
+
+
+def test_bench_smoke_never_journals_candidate():
+    """--smoke geometry (64MB, single round) must not overwrite the
+    full-geometry BENCH_CANDIDATE.json measurement of record: the
+    journal write is gated on the smoke flag."""
+    import ast
+    import os as _os
+
+    src = open(_os.path.join(REPO, "bench.py")).read()
+    tree = ast.parse(src)
+    main = next(n for n in tree.body if isinstance(n, ast.FunctionDef)
+                and n.name == "main")
+    # every _save_candidate call inside main() sits under a non-smoke
+    # branch (if smoke: ... else: _save_candidate(out))
+    guarded = []
+    for node in ast.walk(main):
+        if isinstance(node, ast.If):
+            test = ast.dump(node.test)
+            if "smoke" in test:
+                guarded += [n for n in ast.walk(node)
+                            if isinstance(n, ast.Call)
+                            and getattr(n.func, "id", "")
+                            == "_save_candidate"]
+    all_calls = [n for n in ast.walk(main) if isinstance(n, ast.Call)
+                 and getattr(n.func, "id", "") == "_save_candidate"]
+    assert all_calls and len(all_calls) == len(guarded)
 
 
 def test_bench_fallback_carries_journal_metrics(tmp_path, monkeypatch):
