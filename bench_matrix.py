@@ -143,10 +143,19 @@ fn = lambda pages: scan_filter_step_pallas(pages, th)
 # different (unplaced) specialization, and the first real batch pays a
 # second ~0.8s compile inside the timed region
 warm = np.zeros((min(2048, n_pages), PAGE_SIZE), np.uint8)
-jax.block_until_ready(fn(jax.device_put(warm, jax.devices()[0])))
+warm_dev = jax.device_put(warm, jax.devices()[0])
+jax.block_until_ready(fn(warm_dev))
+# warm the K-wide coalesced dispatch too (one traced call folds K
+# batches — the streamed scan's steady-state shape); compiling it
+# inside the timed region would understate the row
+from nvme_strom_tpu.config import config as _cfg
+from nvme_strom_tpu.scan.executor import CoalescedFold
+fold = CoalescedFold(fn, int(_cfg.get("scan_dispatch_batch")))
+if fold.k > 1:
+    jax.block_until_ready(fold(*([warm_dev] * fold.k)))
 with TableScanner(path, schema, numa_bind=False) as sc:
     t0 = time.monotonic()
-    out = sc.scan_filter(fn)
+    out = sc.scan_filter(fn, dispatch_coalesce=fold)
     dt = time.monotonic() - t0
 nbytes = n_pages * PAGE_SIZE
 print("result:", {{k: int(v) for k, v in out.items()}})

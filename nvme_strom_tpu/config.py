@@ -165,6 +165,14 @@ class Config:
                      "overrides for experiments."))
         reg(Var("staging_buffers", 3, "int", minval=2, maxval=16,
                 help="pinned host staging buffers for the SSD->HBM pipeline (triple-buffered default)"))
+        reg(Var("scan_dispatch_batch", 4, "int", minval=1, maxval=64,
+                help="jitted-call coalescing width for streamed scan "
+                     "compute: fold this many device-resident page "
+                     "batches per kernel DISPATCH (one traced call over "
+                     "K batches) instead of dispatching per batch.  On "
+                     "a high-latency backend (this host's tunneled "
+                     "device) per-dispatch latency otherwise dominates "
+                     "streamed scans; 1 disables"))
         reg(Var("h2d_depth_max", 4, "int", minval=1, maxval=64,
                 help="ceiling for the ADAPTIVE H2D pipeline depth: the "
                      "scan executor and checkpoint restore start 2-deep "

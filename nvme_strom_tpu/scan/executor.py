@@ -17,6 +17,8 @@ and the *filter runs as an XLA kernel overlapped with the next batch's DMA*
 from __future__ import annotations
 
 import errno as _errno
+import functools
+import operator
 import os
 import threading
 from dataclasses import dataclass
@@ -34,6 +36,36 @@ from .planner import capability_cache
 from .pool import DmaBufferPool, DmaChunk, ResourceOwner
 
 __all__ = ["LocalCursor", "Batch", "TableScanner", "fold_results"]
+
+
+class CoalescedFold:
+    """Reusable K-wide jitted fold of a jit-safe batch kernel: one
+    traced call runs ``filter_fn`` over K device batches and folds the
+    results on device (tree-sum, or *combine*).  Create once and pass as
+    ``TableScanner.scan_filter(..., dispatch_coalesce=fold)`` so
+    repeated scans — and an untimed warm call — share one compiled
+    specialization instead of recompiling per scan."""
+
+    def __init__(self, filter_fn: Callable, k: int,
+                 combine: Optional[Callable] = None):
+        import jax
+        self.k = int(k)
+        if combine is None:
+            def _many(*bs):
+                outs = [filter_fn(b) for b in bs]
+                return jax.tree.map(
+                    lambda *xs: functools.reduce(operator.add, xs),
+                    *outs)
+        else:
+            def _many(*bs):
+                out = filter_fn(bs[0])
+                for b in bs[1:]:
+                    out = combine(out, filter_fn(b))
+                return out
+        self._jfn = jax.jit(_many)
+
+    def __call__(self, *batches):
+        return self._jfn(*batches)
 
 
 def fold_results(acc, out, combine: Optional[Callable] = None):
@@ -269,11 +301,24 @@ class TableScanner:
 
     # -- device-filter pipeline --------------------------------------------
     def scan_filter(self, filter_fn: Callable, *, device=None,
-                    combine: Optional[Callable] = None) -> dict:
+                    combine: Optional[Callable] = None,
+                    dispatch_coalesce: Union[int, CoalescedFold,
+                                             None] = None) -> dict:
         """Stream every batch to the device and fold ``filter_fn`` over it.
 
         ``filter_fn(pages_u8_device) -> dict of scalars``; results are
         summed (or combined with *combine*).
+
+        ``dispatch_coalesce=K`` folds K fenced device batches inside ONE
+        jitted call (filter_fn traced K times, results tree-summed or
+        *combine*-folded on device) instead of dispatching per batch —
+        on a high-latency backend each dispatch is a full tunnel round
+        trip, and per-16MB dispatches cap a streamed scan far below the
+        transport ceiling.  OPT-IN because it traces ``filter_fn`` and
+        *combine*: both must be jit-safe (the query kernels are; host-
+        side collect closures are not).  None/1 = per-batch dispatch.
+        Pass a prebuilt (warmable) :class:`CoalescedFold` to share one
+        compiled specialization across scans.
 
         ADAPTIVE H2D pipelining (VERDICT r2 #3 + r3 #6): several batches
         keep their device transfers in flight at once — the fence on
@@ -304,9 +349,29 @@ class TableScanner:
         # otherwise only moved on deepening and could never read 2)
         stats.gauge_max("h2d_depth_reached", ad.depth)
         inflight: List[tuple] = []   # (dev_pages, batch), oldest first
+        if isinstance(dispatch_coalesce, CoalescedFold):
+            fold_many: Optional[CoalescedFold] = dispatch_coalesce
+        elif dispatch_coalesce and int(dispatch_coalesce) > 1:
+            fold_many = CoalescedFold(filter_fn, int(dispatch_coalesce),
+                                      combine)
+        else:
+            fold_many = None
+        kmax = fold_many.k if fold_many is not None else 1
+        ready: List = []             # fenced batches awaiting dispatch
+
+        def dispatch_many() -> None:
+            # one traced call folds a full K-wide window on device; the
+            # n<kmax tail goes per-batch through the already-compiled
+            # filter_fn rather than paying a tail-width compile
+            nonlocal acc
+            if len(ready) == kmax and fold_many is not None:
+                acc = fold_results(acc, fold_many(*ready), combine)
+            else:
+                for dp in ready:
+                    acc = fold_results(acc, filter_fn(dp), combine)
+            ready.clear()
 
         def retire_oldest() -> None:
-            nonlocal acc
             dev_pages, b = inflight.pop(0)
             t0 = _time.monotonic_ns()
             # safe_device_put copied on CPU; on accelerators the H2D read
@@ -316,7 +381,9 @@ class TableScanner:
             bounded_fence(dev_pages, "scan-h2d")
             blocked_ns = _time.monotonic_ns() - t0
             self.recycle(b)
-            acc = fold_results(acc, filter_fn(dev_pages), combine)
+            ready.append(dev_pages)
+            if len(ready) >= kmax:
+                dispatch_many()
             # last_h2d_depth = the PEAK this scan reached (ANALYZE's
             # "h2d_depth_reached"); decay lowers ad.depth, not the peak
             if ad.observe(blocked_ns) > self.last_h2d_depth:
@@ -339,6 +406,7 @@ class TableScanner:
                         retire_oldest()
                 while inflight:
                     retire_oldest()
+                dispatch_many()   # tail below the coalescing width
             finally:
                 # consumer-held batches: fence + recycle before the ring
                 # drain, so abort recovery never frees a chunk an H2D

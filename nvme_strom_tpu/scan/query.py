@@ -1148,13 +1148,18 @@ class Query:
                 if own:
                     src.close()
         if plan.access_path == "direct":
+            from ..config import config as _cfg
             from .executor import TableScanner
             src, own = self._open_owned()
             try:
                 with TableScanner(src, self.schema,
                                   session=session) as sc:
-                    out = sc.scan_filter(fn, device=device,
-                                         combine=combine)
+                    # kernel paths are jit-safe end to end (jitted page
+                    # kernels, jnp combines) — coalesce their dispatches
+                    out = sc.scan_filter(
+                        fn, device=device, combine=combine,
+                        dispatch_coalesce=int(
+                            _cfg.get("scan_dispatch_batch")))
                     self._last_scan_h2d_depth = getattr(
                         sc, "last_h2d_depth", 0)
                     return self._finalize(out)
@@ -1937,12 +1942,16 @@ class Query:
                 how=how, owner_part=(n_parts, p) if own_needed else None)
             fn = lambda pages, run=run: run(pages)
             if plan.access_path == "direct":
+                from ..config import config as _cfg
                 from .executor import TableScanner
                 src, own = self._open_owned()
                 try:
                     with TableScanner(src, self.schema,
                                       session=session) as sc:
-                        out = sc.scan_filter(fn, device=device)
+                        out = sc.scan_filter(
+                            fn, device=device,
+                            dispatch_coalesce=int(
+                                _cfg.get("scan_dispatch_batch")))
                         self._last_scan_h2d_depth = getattr(
                             sc, "last_h2d_depth", 0)
                 finally:
