@@ -520,15 +520,38 @@ def _restore_streamed(sess, source, base: int, dtype: np.dtype,
     device: each staged sub-span lands with a donated
     ``dynamic_update_slice`` into the preallocated device leaf — no
     owned-host assembly copy (the old path materialized the whole leaf on
-    the host a second time before one giant device_put)."""
+    the host a second time before one giant device_put).
+
+    Same-shaped spans COALESCE: up to config ``scan_dispatch_batch``
+    staged chunks land in one ``_write_slices`` dispatch instead of a
+    per-span jitted call — per-dispatch latency on a tunneled backend
+    otherwise adds a round trip per 64MB span (the scan executor's
+    CoalescedFold discipline applied to restore)."""
     import jax
     import jax.numpy as jnp
 
-    from ..hbm.staging import _write_slice
+    from ..config import config
+    from ..hbm.staging import _write_slice, _write_slices
     nbytes = int(dtype.itemsize * np.prod(shape, dtype=np.int64)) \
         if shape else dtype.itemsize
     with jax.default_device(dev):
         dest = jnp.zeros(nbytes // dtype.itemsize, dtype)
+    kmax = max(1, int(config.get("scan_dispatch_batch")))
+    pending: List[tuple] = []   # (chunk_dev, elem_offset), same shapes
+
+    def flush(dest):
+        if not pending:
+            return dest
+        if len(pending) == 1:
+            dest = _write_slice(dest, pending[0][0],
+                                np.int32(pending[0][1]))
+        else:
+            starts = np.asarray([p[1] for p in pending], np.int32)
+            dest = _write_slices(dest, starts,
+                                 *[p[0] for p in pending])
+        pending.clear()
+        return dest
+
     done = 0
     while done < nbytes:
         take = min(ring.cap, nbytes - done)
@@ -538,9 +561,15 @@ def _restore_streamed(sess, source, base: int, dtype: np.dtype,
         take -= take % dtype.itemsize
         view = _read_span(sess, source, base + done, take, ring)
         chunk = ring.put(view.view(dtype), dev)
-        dest = _write_slice(dest, chunk,
-                            np.int32(done // dtype.itemsize))
+        if pending and pending[0][0].shape != chunk.shape:
+            # a shape change (final short span) would force a fresh
+            # _write_slices specialization: land it separately instead
+            dest = flush(dest)
+        pending.append((chunk, done // dtype.itemsize))
+        if len(pending) >= kmax:
+            dest = flush(dest)
         done += take
+    dest = flush(dest)
     return dest.reshape(shape)
 
 

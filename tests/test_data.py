@@ -620,3 +620,28 @@ def test_backend_loss_fails_loader_not_hangs(tmp_path):
                 assert ei.value.errno == errno.ENODEV
     finally:
         config.set("backend_fence_timeout", old)
+
+
+def test_streamed_restore_write_coalescing_widths(tmp_path):
+    """The coalesced landing (K dynamic_update_slices per dispatch,
+    scan_dispatch_batch) restores bit-identical leaves at every width,
+    including K=1 (per-span dispatch) and K past the span count, with
+    the short final span landing separately."""
+    from nvme_strom_tpu import config
+    rng = np.random.default_rng(23)
+    # 70_001 u8 elements over 16KB staging = 4 full spans + short tail
+    tree = {"u8": rng.integers(0, 255, 70_001, dtype=np.uint8),
+            "f32": rng.standard_normal(9_337).astype(np.float32)}
+    path = str(tmp_path / "ckco.strom")
+    save_checkpoint(path, tree)
+    old = config.get("scan_dispatch_batch")
+    try:
+        for k in (1, 3, 64):
+            config.set("scan_dispatch_batch", k)
+            out = restore_checkpoint(path, staging_bytes=16 << 10)
+            for key, v in tree.items():
+                np.testing.assert_array_equal(
+                    np.asarray(out[f"['{key}']"]), v,
+                    err_msg=f"k={k} {key}")
+    finally:
+        config.set("scan_dispatch_batch", old)
