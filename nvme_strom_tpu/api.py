@@ -161,6 +161,11 @@ STAT_FIELDS: Tuple[str, ...] = (
     # deepest ADAPTIVE H2D pipeline reached by a scan (gauge; grows only
     # when the consumer observed itself blocking on transfer readiness)
     "h2d_depth_reached",
+    # jitted kernel-call dispatches issued by streamed scan compute and
+    # checkpoint-restore landings: with dispatch coalescing (config
+    # scan_dispatch_batch = K) this moves once per K batches/spans, so
+    # nr_kernel_dispatch / batches ~ 1/K on coalesced paths
+    "nr_kernel_dispatch",
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

@@ -540,6 +540,7 @@ def _restore_streamed(sess, source, base: int, dtype: np.dtype,
     pending: List[tuple] = []   # (chunk_dev, elem_offset), same shapes
 
     def flush(dest):
+        from ..stats import stats
         if not pending:
             return dest
         if len(pending) == 1:
@@ -549,6 +550,7 @@ def _restore_streamed(sess, source, base: int, dtype: np.dtype,
             starts = np.asarray([p[1] for p in pending], np.int32)
             dest = _write_slices(dest, starts,
                                  *[p[0] for p in pending])
+        stats.add("nr_kernel_dispatch")
         pending.clear()
         return dest
 

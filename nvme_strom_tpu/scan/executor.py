@@ -366,9 +366,11 @@ class TableScanner:
             nonlocal acc
             if len(ready) == kmax and fold_many is not None:
                 acc = fold_results(acc, fold_many(*ready), combine)
+                stats.add("nr_kernel_dispatch")
             else:
                 for dp in ready:
                     acc = fold_results(acc, filter_fn(dp), combine)
+                    stats.add("nr_kernel_dispatch")
             ready.clear()
 
         def retire_oldest() -> None:

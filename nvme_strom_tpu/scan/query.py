@@ -988,7 +988,7 @@ class Query:
             d = {k: after.get(k, 0) - before.get(k, 0)
                  for k in ("total_dma_length", "nr_submit_dma",
                            "nr_ioctl_memcpy_wait", "nr_wrong_wakeup",
-                           "nr_enter_dma")}
+                           "nr_enter_dma", "nr_kernel_dispatch")}
             nsub = max(d["nr_submit_dma"], 1)
             out["_analyze"] = {
                 "elapsed_s": round(dt, 6),
@@ -997,6 +997,9 @@ class Query:
                 "avg_dma_bytes": int(d["total_dma_length"] // nsub),
                 "waits": int(d["nr_ioctl_memcpy_wait"]),
                 "submit_syscalls": int(d["nr_enter_dma"]),
+                # jitted kernel calls this run issued: coalescing makes
+                # this ~batches/K on streamed kernel paths
+                "kernel_dispatches": int(d["nr_kernel_dispatch"]),
                 # per-RUN value from this run's scanner (the registry
                 # gauge is process-lifetime and would misattribute a
                 # previous scan's pipelining to an index-served query)

@@ -567,3 +567,29 @@ def test_query_aggregate_uses_coalescing_and_matches(heap_file):
     finally:
         config.set("scan_dispatch_batch", old)
         config.set("debug_no_threshold", False)
+
+
+def test_analyze_reports_kernel_dispatches(heap_file):
+    """EXPLAIN ANALYZE exposes the per-run jitted dispatch count, and
+    coalescing reduces it by ~K on the direct kernel path."""
+    from nvme_strom_tpu.config import config
+    from nvme_strom_tpu.scan.query import Query
+    path, schema, c0, c1 = heap_file
+    config.set("debug_no_threshold", True)
+    old_k = config.get("scan_dispatch_batch")
+    old_ck = config.get("chunk_size")
+    try:
+        config.set("chunk_size", 64 << 10)   # many batches
+        counts = {}
+        for k in (1, 4):
+            config.set("scan_dispatch_batch", k)
+            out = Query(path, schema) \
+                .where(lambda cols: cols[0] > 100).run(analyze=True)
+            counts[k] = out["_analyze"]["kernel_dispatches"]
+        assert counts[1] > counts[4] >= 1
+        # K=4 issues about a quarter of the dispatches (plus a tail)
+        assert counts[4] <= -(-counts[1] // 4) + 4
+    finally:
+        config.set("scan_dispatch_batch", old_k)
+        config.set("chunk_size", old_ck)
+        config.set("debug_no_threshold", False)
