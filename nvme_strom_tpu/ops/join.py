@@ -104,17 +104,22 @@ def make_join_fn(schema: HeapSchema, probe_col: int,
     ``probe_col`` equals some build key (and *predicate* passes); *how*
     picks which rows the join emits (:data:`JOIN_HOWS`).
 
-    Returns per batch: ``matched`` (count of EMITTED rows), ``sums``
-    (over emitted rows, for the int32 fact columns in ``run.sum_cols``).
-    inner/left add ``payload_sum`` (sum of matched build values — for
-    left that is SQL's ``SUM(payload)`` over the outer result, NULLs
-    ignored); left adds ``null_count`` (emitted rows without a partner).
+    Returns per batch: ``matched`` (count of EMITTED rows), ``sums`` —
+    a LIST of per-column scalars over emitted rows covering EVERY fact
+    column (``run.sum_cols``), each accumulated in its
+    :func:`..ops.groupby.acc_dtypes` dtype: the same int32/uint32/
+    float32 convention GROUP BY uses, so ``SUM(float_col)`` works in a
+    join exactly as in an aggregate.  inner/left add ``payload_sum``
+    (sum of matched build values — for left that is SQL's
+    ``SUM(payload)`` over the outer result, NULLs ignored); left adds
+    ``null_count`` (emitted rows without a partner).
     ``owner_part`` — see :func:`_owner_mask` (Grace passes only).
     """
+    from .groupby import acc_dtypes
     check_join_how(how)
     keys, vals = _sorted_build(build_keys, build_values, schema, probe_col)
-    sum_cols = [c for c in range(schema.n_cols)
-                if schema.col_dtype(c) == np.dtype(np.int32)]
+    sum_cols = list(range(schema.n_cols))
+    accs = [acc_dtypes(schema.col_dtype(c))[0] for c in sum_cols]
 
     @jax.jit
     def run(pages_u8, *params):
@@ -127,8 +132,10 @@ def make_join_fn(schema: HeapSchema, probe_col: int,
         hit, pay = _probe(keys, vals, probe, sel)
         emit = _emit_mask(how, sel, hit)
         out = {"matched": jnp.sum(emit.astype(jnp.int32)),
-               "sums": jnp.stack([jnp.sum(jnp.where(emit, cols[c], 0))
-                                  for c in sum_cols])}
+               "sums": [jnp.sum(jnp.where(emit, cols[c],
+                                          schema.col_dtype(c).type(0)),
+                                dtype=acc)
+                        for c, acc in zip(sum_cols, accs)]}
         if how in ("inner", "left"):
             out["payload_sum"] = jnp.sum(jnp.where(hit, pay, 0))
         if how == "left":

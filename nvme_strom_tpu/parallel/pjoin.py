@@ -195,21 +195,30 @@ def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
     :func:`partition_build_sharded_from_table` (the bounded-host-RAM
     build); otherwise ``build_keys``/``build_values`` host arrays are
     partitioned in memory."""
+    from ..ops.groupby import acc_dtypes
     check_join_how(how)
     dp = mesh.shape["dp"]
     keys_dev, vals_dev, nreal_dev = build_parts or \
         partition_build_sharded(build_keys, build_values, mesh, schema,
                                 probe_col)
-    sum_cols = [c for c in range(schema.n_cols)
-                if schema.col_dtype(c) == np.dtype(np.int32)]
+    sum_cols = list(range(schema.n_cols))
+    col_dts = [schema.col_dtype(c) for c in sum_cols]
+    accs = [acc_dtypes(dt)[0] for dt in col_dts]
 
     def _local(pages, keys_row, vals_row, nreal_row):
         cols, valid = decode_pages(pages, schema)
         sel = valid if predicate is None else valid & predicate(cols)
         probe = cols[probe_col].reshape(-1)
         sel_flat = sel.reshape(-1)
-        rows = jnp.stack(
-            [probe] + [cols[c].reshape(-1) for c in sum_cols], axis=-1)
+
+        def enc(c):
+            # the exchange slab is int32-wide: float32/uint32 fact
+            # columns travel BITCAST (value-preserving), not converted
+            a = cols[c].reshape(-1)
+            return a if a.dtype == jnp.int32 else \
+                jax.lax.bitcast_convert_type(a, jnp.int32)
+
+        rows = jnp.stack([probe] + [enc(c) for c in sum_cols], axis=-1)
         bucket = (key_hash32(probe) % jnp.uint32(dp)).astype(jnp.int32)
         n = probe.shape[0]
         # capacity = the full local batch: the exchange can never drop a
@@ -226,11 +235,19 @@ def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
         # only selected rows were dispatched, so among routed slots
         # rvalid IS the selection mask the broadcast kernel calls sel
         emit = _emit_mask(how, rvalid, hit)
+
+        def dec(i):
+            w = recv[:, 1 + i]
+            dt = col_dts[i]
+            return w if dt == np.dtype(np.int32) else \
+                jax.lax.bitcast_convert_type(w, dt)
+
         out = {"matched": jax.lax.psum(
                    jnp.sum(emit.astype(jnp.int32)), "dp"),
                "sums": jax.lax.psum(
-                   jnp.stack([jnp.sum(jnp.where(emit, recv[:, 1 + i], 0))
-                              for i in range(len(sum_cols))]), "dp")}
+                   [jnp.sum(jnp.where(emit, dec(i), col_dts[i].type(0)),
+                            dtype=accs[i])
+                    for i in range(len(sum_cols))], "dp")}
         if how in ("inner", "left"):
             out["payload_sum"] = jax.lax.psum(
                 jnp.sum(jnp.where(hit, v[idx], 0)), "dp")
@@ -239,7 +256,7 @@ def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
                 jnp.sum((emit & ~hit).astype(jnp.int32)), "dp")
         return out
 
-    out_specs = {"matched": P(), "sums": P()}
+    out_specs = {"matched": P(), "sums": [P()] * len(sum_cols)}
     if how in ("inner", "left"):
         out_specs["payload_sum"] = P()
     if how == "left":

@@ -155,4 +155,5 @@ def ring_scan_source(source, thresholds_np: np.ndarray, *,
         source.read_buffered(covered * PAGE_SIZE, memoryview(view))
         tail[:n_pages - covered] = view.reshape(-1, PAGE_SIZE)
         fold(jax.device_put(tail, NamedSharding(mesh, P("dp", None))))
-    return {} if acc is None else {k: np.asarray(v) for k, v in acc.items()}
+    # per-leaf: heterogeneous list leaves keep their acc dtypes
+    return {} if acc is None else jax.tree.map(np.asarray, acc)

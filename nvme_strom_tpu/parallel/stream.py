@@ -211,4 +211,5 @@ def distributed_scan_filter(source: Source, mesh: Mesh, step, *,
                             session=session) as stream:
         for _first, arr in stream:
             acc = fold_results(acc, step(arr), combine)
-    return {} if acc is None else {k: np.asarray(v) for k, v in acc.items()}
+    # per-leaf: heterogeneous list leaves keep their acc dtypes
+    return {} if acc is None else jax.tree.map(np.asarray, acc)

@@ -430,8 +430,14 @@ class TableScanner:
                 gen.close()
         if acc is None:
             return {}
-        return {k: np.asarray(v) for k, v in
-                (acc.items() if isinstance(acc, dict) else acc)}
+        import jax
+        if not isinstance(acc, dict):
+            acc = dict(acc)
+        # per-leaf conversion: a heterogeneous sums LIST (join/aggregate
+        # faces mix int32/uint32/float32 accumulators) must keep each
+        # leaf's acc dtype — np.asarray over the list would upcast all
+        # of them to float64
+        return jax.tree.map(np.asarray, acc)
 
     def close(self) -> None:
         if self._prev_affinity is not None:

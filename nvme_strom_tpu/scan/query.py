@@ -1146,7 +1146,7 @@ class Query:
                 if acc is None:
                     return {}
                 return self._finalize(
-                    {k: np.asarray(v) for k, v in acc.items()})
+                    jax.tree.map(np.asarray, acc))
             finally:
                 if own:
                     src.close()
@@ -1581,24 +1581,25 @@ class Query:
                 how, pos_c[sl].astype(self._pos_dtype()),
                 key_c[sl].astype(np.int32), pay_c[sl].astype(np.int32),
                 hit_c[sl])
-        # aggregate face: emitted count + sums over the int32 fact
-        # columns (the kernel's run.sum_cols set, ascending) + the
+        # aggregate face: emitted count + per-column sums over EVERY
+        # fact column (the kernel's run.sum_cols set, each in its
+        # acc_dtypes accumulator — the GROUP BY convention) + the
         # per-how extras (payload_sum inner/left, null_count left)
-        cols = [c for c in range(self.schema.n_cols)
-                if self.schema.col_dtype(c) == np.dtype(np.int32)]
+        cols = list(range(self.schema.n_cols))
         out = self.fetch(pos_all, cols=cols, session=session,
                          device=device)
         keep = np.asarray(out["valid"]).astype(bool)
         probe = np.asarray(out[f"col{probe_col}"])[keep]
         hit, pay = probe_host(probe)
         emit = emit_of(hit)
-        acc = acc_dtypes(np.dtype(np.int32))[0]
-        sums = [np.sum(np.asarray(out[f"col{c}"])[keep][emit], dtype=acc)
+        sums = [np.sum(np.asarray(out[f"col{c}"])[keep][emit],
+                       dtype=acc_dtypes(self.schema.col_dtype(c))[0])
                 for c in cols]
         res = {"matched": np.int32(int(emit.sum())),
-               "sums": np.array(sums, acc)}
+               "sums": sums}
         if how in ("inner", "left"):
-            res["payload_sum"] = np.sum(pay[hit], dtype=acc)
+            res["payload_sum"] = np.sum(
+                pay[hit], dtype=acc_dtypes(np.dtype(np.int32))[0])
         if how == "left":
             res["null_count"] = np.int32(int((emit & ~hit).sum()))
         return res
@@ -1884,8 +1885,9 @@ class Query:
                 for pages in self._mesh_page_batches(src, mesh,
                                                      batch_pages, session):
                     acc = fold_results(acc, step(pages), None)
+                import jax as _jax
                 return {} if acc is None else \
-                    {k: np.asarray(v) for k, v in acc.items()}
+                    _jax.tree.map(np.asarray, acc)
             finally:
                 if own:
                     src.close()
@@ -1963,8 +1965,9 @@ class Query:
             else:
                 out = self._vfs_scan(fn, None, device)
             acc = fold_results(acc, out, None)
-        return {} if acc is None else \
-            {k: np.asarray(v) for k, v in acc.items()}
+        import jax as _jax
+        # per-leaf: the heterogeneous sums list keeps its acc dtypes
+        return {} if acc is None else _jax.tree.map(np.asarray, acc)
 
     def _mesh_page_batches(self, src, mesh, batch_pages, session):
         """Yield dp-divisible page batches covering EVERY page of *src*:
@@ -2289,4 +2292,5 @@ class Query:
                 src.close()
         if acc is None:
             return {}
-        return {k: np.asarray(v) for k, v in acc.items()}
+        # per-leaf: the heterogeneous sums list keeps its acc dtypes
+        return jax.tree.map(np.asarray, acc)
