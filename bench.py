@@ -517,7 +517,12 @@ def _probe_loop() -> int:
                 env = _env()
                 env.update({"BENCH_ROWS": _TUNNEL_ROWS,
                             "BENCH_SIZE_MB": matrix_size})
-                env.setdefault("BENCH_COOLDOWN_S", "180")
+                # 480s: a 256MB row drains the transport's token bucket
+                # and 180s does NOT refill it — rows late in the
+                # sequence then measure the throttle, not the framework
+                # (round 4: scan_filter 0.026 in-sequence vs 0.3+ alone
+                # after a full refill)
+                env.setdefault("BENCH_COOLDOWN_S", "480")
                 try:
                     m = subprocess.run(
                         [sys.executable, os.path.join(REPO, "bench_matrix.py")],

@@ -13,6 +13,13 @@ Each config runs in a fresh subprocess (PJRT/tunnel state isolation) with a
 cooldown between runs (the tunnel's H2D limiter is a token bucket — see
 BENCH notes).  Prints one human line per config and writes the JSON matrix.
 
+ROW-ORDER CAVEAT: a 256MB device row drains the token bucket and a short
+cooldown does not refill it, so device rows LATE in a sequence measure
+the throttle, not the framework (round 4: scan_filter 0.026 as row 5 of
+a sequence vs 0.3+ measured alone after a full ~8min refill).  For
+comparable device rows use BENCH_COOLDOWN_S >= 480, or re-run a suspect
+row alone via BENCH_ROWS after an idle.
+
 Env: BENCH_SIZE_MB (default 512), BENCH_COOLDOWN_S (default 30),
 BENCH_SMOKE=1 (64MB, no cooldown).
 """
