@@ -120,6 +120,7 @@ class Query:
         self._join: Optional[tuple] = None
         self._join_src: Optional[tuple] = None  # on-disk build side
         self._join_how: str = "inner"           # inner | left | semi | anti
+        self._group_cols: Optional[tuple] = None  # value-keyed GROUP BY
         self._select: Optional[tuple] = None
         self._quantiles: Optional[List[float]] = None
         self._eq: Optional[tuple] = None     # structured equality (col, v)
@@ -348,6 +349,159 @@ class Query:
         self._terminal_set = True
         self._group = (key_fn, int(n_groups), agg_cols, having)
         return self
+
+    def group_by_cols(self, key_cols, *,
+                      agg_cols: Optional[Sequence[int]] = None,
+                      having: Optional[Callable] = None,
+                      max_groups: int = 1 << 16) -> "Query":
+        """Terminal: SQL ``GROUP BY col[, col2]`` over actual column
+        VALUES — no key function, no group-count guess.  Two passes:
+        the distinct key set is discovered first (from a fresh sidecar
+        at zero table I/O when one exists, else a streamed projection
+        scan), then aggregation rides the normal GROUP BY kernels with
+        a ``searchsorted`` key function over the discovered keys.
+
+        Result = :meth:`group_by`'s (count/sums/mins/maxs/avgs/...)
+        plus ``key_cols``: one array per key column, aligned with the
+        surviving groups — the SELECT-list face SQL gives GROUP BY.
+        Groups that select no rows are dropped (SQL semantics); *having*
+        then filters like :meth:`group_by`'s.  One or two integer
+        columns; discovery beyond *max_groups* distinct keys fails with
+        ENOMEM instead of silently truncating."""
+        self._require_no_terminal()
+        cols_ = [int(c) for c in (key_cols if isinstance(
+            key_cols, (tuple, list)) else [key_cols])]
+        if not 1 <= len(cols_) <= 2:
+            raise StromError(22, "group_by_cols takes 1 or 2 key columns")
+        for c in cols_:
+            if not 0 <= c < self.schema.n_cols:
+                raise StromError(22, f"group_by_cols column {c} out of "
+                                     f"range")
+            if self.schema.col_dtype(c).kind not in "iu":
+                raise StromError(22, "group_by_cols keys must be integer "
+                                     "columns")
+        if max_groups < 1:
+            raise StromError(22, "max_groups must be >= 1")
+        self._op = "group_by"
+        self._terminal_set = True
+        # key_fn None = unresolved; run() discovers the keys first
+        self._group = (None, 0, agg_cols, None)
+        self._group_cols = (cols_, agg_cols, having, int(max_groups))
+        return self
+
+    def _resolve_group_keys(self, session, device) -> None:
+        """Pass 1 of :meth:`group_by_cols`: discover the sorted distinct
+        key set, then install the derived ``searchsorted`` key function,
+        the group count, and the composed HAVING (empty groups dropped —
+        discovery may be a SUPERSET of the selected rows' keys when it
+        comes from a sidecar) into ``self._group``."""
+        import jax.numpy as jnp
+
+        from .index import pack_pair, unpack_second
+        cols_, agg, user_having, max_groups = self._group_cols
+        dts = [self.schema.col_dtype(c) for c in cols_]
+        discovered = None
+        if len(cols_) == 1 and isinstance(self.source, str):
+            # fresh single-column sidecar: the distinct keys are the
+            # sorted sidecar's uniques — zero table I/O
+            from .index import index_path_for, open_index, probe_index
+            ip = index_path_for(self.source, cols_[0])
+            try:
+                if probe_index(ip, self.source, expect_col=cols_[0],
+                               allow_prefix=False):
+                    idx = open_index(ip, table_path=self.source)
+                    discovered = np.unique(idx.keys)
+            except Exception:   # raced away: fall to the scan
+                discovered = None
+        if discovered is not None and len(discovered) > max_groups:
+            raise StromError(12, f"group_by_cols: {len(discovered)} "
+                                 f"distinct keys exceed max_groups="
+                                 f"{max_groups}")
+        if discovered is None:
+            gather, _f, _d = self._make_gather_fn(cols_,
+                                                  want_positions=False)
+            merged = np.zeros(0, np.uint64 if len(cols_) == 2
+                              else dts[0])
+
+            def collect(pages_dev):
+                nonlocal merged
+                out = gather(pages_dev)
+                m = np.asarray(out["mask"]).astype(bool)
+                vs = [np.asarray(out[f"f{i}"])[m]
+                      for i in range(len(cols_))]
+                u = np.unique(vs[0]) if len(cols_) == 1 else \
+                    np.unique(pack_pair(vs[0], vs[1], dts[0], dts[1]))
+                merged = np.union1d(merged, u)
+                if len(merged) > max_groups:
+                    raise StromError(
+                        12, f"group_by_cols: more than {max_groups} "
+                            f"distinct keys (raise max_groups, or use "
+                            f"group_by with a key function)")
+                return {}
+
+            self._stream_collect(self._explain_inner(), collect, device,
+                                 session)
+            discovered = merged
+        if len(cols_) == 1:
+            keys = discovered.astype(dts[0])
+            g = len(keys)
+            kj = jnp.asarray(keys) if g else None
+
+            def key_fn(cols, kj=kj, g=g):
+                v = cols[cols_[0]]
+                if kj is None:       # empty table: one dropped bucket
+                    return jnp.zeros(v.shape, jnp.int32)
+                return jnp.clip(jnp.searchsorted(kj, v), 0,
+                                g - 1).astype(jnp.int32)
+
+            n_groups = max(g, 1)
+            self._gk_decode = lambda gids, keys=keys: [keys[gids]]
+        else:
+            packed = discovered                      # sorted uint64
+            g = len(packed)
+            hi = (packed >> np.uint64(32))
+            if dts[0] == np.dtype(np.int32):
+                k0 = (hi.astype(np.int64) - (1 << 31)).astype(np.int32)
+            else:
+                k0 = hi.astype(np.uint32)
+            k1 = unpack_second(packed, dts[1])
+            u0, u1 = np.unique(k0), np.unique(k1)
+            if len(u0) * max(len(u1), 1) > (1 << 22):
+                raise StromError(
+                    12, "group_by_cols: dense pair table over 4M "
+                        "entries; use group_by with a key function")
+            # dense (rank0, rank1) -> group-id table; absent pairs (and
+            # masked rows) land in the sentinel bucket g, dropped by the
+            # count>0 HAVING
+            table = np.full((max(len(u0), 1), max(len(u1), 1)), g,
+                            np.int32)
+            if g:
+                table[np.searchsorted(u0, k0),
+                      np.searchsorted(u1, k1)] = \
+                    np.arange(g, dtype=np.int32)
+            u0j, u1j = jnp.asarray(u0), jnp.asarray(u1)
+            tj = jnp.asarray(table)
+
+            def key_fn(cols, u0j=u0j, u1j=u1j, tj=tj):
+                if u0j.shape[0] == 0:
+                    return jnp.zeros(cols[cols_[0]].shape, jnp.int32)
+                i0 = jnp.clip(jnp.searchsorted(u0j, cols[cols_[0]]), 0,
+                              u0j.shape[0] - 1)
+                i1 = jnp.clip(jnp.searchsorted(u1j, cols[cols_[1]]), 0,
+                              u1j.shape[0] - 1)
+                return tj[i0, i1].astype(jnp.int32)
+
+            n_groups = g + 1
+            self._gk_decode = lambda gids, k0=k0, k1=k1: [k0[gids],
+                                                          k1[gids]]
+
+        def hv(res, user=user_having):
+            m = np.asarray(res["count"]) > 0
+            if user is not None:
+                m = m & np.asarray(user(res)).astype(bool)
+            return m
+
+        self._group = (key_fn, n_groups, agg, hv)
 
     def top_k(self, col: int, k: int, *, largest: bool = True) -> "Query":
         """Terminal: k best values of *col* + their global row positions."""
@@ -1009,6 +1163,11 @@ class Query:
                 if dt > 0 else None,
             }
             return out
+        if self._group_cols is not None and self._group[0] is None:
+            # value-keyed GROUP BY: discover the distinct key set first
+            # (sidecar when fresh, streamed scan otherwise), then run as
+            # a normal group_by with a searchsorted key function
+            self._resolve_group_keys(session, device)
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
@@ -1207,6 +1366,11 @@ class Query:
         res = {k: (v[mask] if v.ndim == 1 else v[..., mask])
                for k, v in res.items()}
         res["groups"] = np.flatnonzero(mask).astype(np.int32)
+        if self._group_cols is not None and \
+                getattr(self, "_gk_decode", None) is not None:
+            # the SELECT-list face of GROUP BY: actual key values per
+            # surviving group (group_by_cols contract)
+            res["key_cols"] = self._gk_decode(res["groups"])
         return res
 
     def _check_sortable_col(self, col: int, opname: str) -> np.dtype:

@@ -163,6 +163,15 @@ def main(argv=None) -> int:
                     help='int32 group key, e.g. "c1 %% 8"')
     ap.add_argument("--groups", type=int, default=None,
                     help="number of groups (required with --group-by)")
+    ap.add_argument("--group-by-cols", default=None, metavar="C[,C2]",
+                    help="SQL GROUP BY over column VALUES: distinct "
+                         "keys discovered automatically (sidecar or "
+                         "streamed scan), result carries key_cols — no "
+                         "key expression, no group count")
+    ap.add_argument("--max-groups", type=int, default=1 << 16,
+                    metavar="N",
+                    help="with --group-by-cols: refuse more than N "
+                         "distinct keys (ENOMEM, never truncation)")
     ap.add_argument("--agg-cols", default=None,
                     help="comma-separated column indices to aggregate")
     ap.add_argument("--having", default=None, metavar="EXPR",
@@ -250,6 +259,7 @@ def main(argv=None) -> int:
     src = args.file[0] if len(args.file) == 1 else list(args.file)
     terminals = [f for f, v in (("--select", args.select),
                                 ("--group-by", args.group_by),
+                                ("--group-by-cols", args.group_by_cols),
                                 ("--top-k", args.top_k),
                                 ("--order-by", args.order_by),
                                 ("--join", args.join),
@@ -391,8 +401,8 @@ def main(argv=None) -> int:
         except ValueError:
             ap.error("--where-eq takes COL:VALUE or C0,C1:V0,V1 "
                      "(numbers)")
-    if args.having and not args.group_by:
-        ap.error("--having requires --group-by")
+    if args.having and not (args.group_by or args.group_by_cols):
+        ap.error("--having requires --group-by or --group-by-cols")
     if args.select:
         sel_cols = None if args.select == "all" else \
             [int(c) for c in args.select.split(",")]
@@ -404,6 +414,15 @@ def main(argv=None) -> int:
                        agg_cols=agg_cols,
                        having=_having_fn(args.having)
                        if args.having else None)
+    elif args.group_by_cols:
+        try:
+            kcols = [int(c) for c in args.group_by_cols.split(",")]
+            q = q.group_by_cols(kcols, agg_cols=agg_cols,
+                                having=_having_fn(args.having)
+                                if args.having else None,
+                                max_groups=args.max_groups)
+        except (ValueError, StromError) as e:
+            ap.error(f"--group-by-cols: {e}")
     elif args.top_k:
         parts = args.top_k.split(":")
         largest = not (len(parts) > 2 and parts[2] == "smallest")

@@ -1351,3 +1351,117 @@ def test_join_table_disk_build_all_faces(tmp_path):
         assert int(got["payload_sum"]) == int(ref["payload_sum"])
     finally:
         config.set("join_broadcast_max", old)
+
+
+# ---------------------------------------------------------------------------
+# group_by_cols (value-keyed GROUP BY)
+# ---------------------------------------------------------------------------
+
+def test_group_by_cols_single_matches_oracle(heap):
+    """GROUP BY col over VALUES: keys discovered, aggregates per key,
+    key_cols carries the actual key values (ascending discovery order)."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).group_by_cols(1, agg_cols=[0]).run()
+    sel = vis != 0
+    want_keys = np.unique(c1[sel])
+    np.testing.assert_array_equal(out["key_cols"][0], want_keys)
+    for i, k in enumerate(want_keys):
+        m = sel & (c1 == k)
+        assert int(out["count"][i]) == int(m.sum())
+        assert int(out["sums"][0][i]) == int(c0[m].sum())
+
+
+def test_group_by_cols_predicate_and_having(heap):
+    """WHERE narrows the groups (keys absent under the predicate do not
+    appear) and HAVING composes on top of the empty-group drop."""
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).where(lambda cols: cols[0] > 800) \
+        .group_by_cols(1, agg_cols=[0],
+                       having=lambda g: g["count"] >= 3).run()
+    sel = (vis != 0) & (c0 > 800)
+    want = [k for k in np.unique(c1[sel])
+            if int((sel & (c1 == k)).sum()) >= 3]
+    np.testing.assert_array_equal(out["key_cols"][0], np.array(want))
+    for i, k in enumerate(want):
+        m = sel & (c1 == k)
+        assert int(out["count"][i]) == int(m.sum())
+
+
+def test_group_by_cols_pair(tmp_path):
+    """Two-column GROUP BY: the dense rank table maps value pairs to
+    groups; key_cols returns both columns' values per group."""
+    rng = np.random.default_rng(5)
+    schema = HeapSchema(n_cols=3, visibility=False)
+    n = schema.tuples_per_page * 6
+    c0 = rng.integers(0, 5, n).astype(np.int32)
+    c1 = rng.integers(-3, 3, n).astype(np.int32)
+    c2 = rng.integers(0, 100, n).astype(np.int32)
+    path = str(tmp_path / "p.heap")
+    build_heap_file(path, [c0, c1, c2], schema)
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).group_by_cols([0, 1], agg_cols=[2]).run()
+    pairs = sorted({(int(a), int(b)) for a, b in zip(c0, c1)})
+    got = list(zip(out["key_cols"][0].tolist(),
+                   out["key_cols"][1].tolist()))
+    assert got == pairs
+    for i, (a, b) in enumerate(pairs):
+        m = (c0 == a) & (c1 == b)
+        assert int(out["count"][i]) == int(m.sum())
+        assert int(out["sums"][0][i]) == int(c2[m].sum())
+
+
+def test_group_by_cols_sidecar_discovery(tmp_path):
+    """A fresh sidecar supplies the distinct keys at zero table I/O;
+    results equal the scan-discovered ones (superset keys from the
+    sidecar are dropped by the empty-group HAVING when a predicate
+    excludes them)."""
+    from nvme_strom_tpu.scan.index import build_index
+    rng = np.random.default_rng(9)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 12, n).astype(np.int32)
+    c1 = rng.integers(0, 50, n).astype(np.int32)
+    path = str(tmp_path / "s.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", True)
+    base = Query(path, schema).where(lambda cols: cols[1] > 25) \
+        .group_by_cols(0, agg_cols=[1]).run()
+    build_index(path, schema, 0)
+    idx = Query(path, schema).where(lambda cols: cols[1] > 25) \
+        .group_by_cols(0, agg_cols=[1]).run()
+    np.testing.assert_array_equal(idx["key_cols"][0], base["key_cols"][0])
+    np.testing.assert_array_equal(idx["count"], base["count"])
+    np.testing.assert_array_equal(idx["sums"], base["sums"])
+
+
+def test_group_by_cols_mesh_matches_local(heap):
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, vis = heap
+    config.set("debug_no_threshold", True)
+    local = Query(path, schema).group_by_cols(1, agg_cols=[0]).run()
+    mesh = make_scan_mesh(jax.devices())
+    dist = Query(path, schema).group_by_cols(1, agg_cols=[0]) \
+        .run(mesh=mesh, batch_pages=8)
+    np.testing.assert_array_equal(dist["key_cols"][0],
+                                  local["key_cols"][0])
+    np.testing.assert_array_equal(dist["count"], local["count"])
+    np.testing.assert_array_equal(dist["sums"], local["sums"])
+
+
+def test_group_by_cols_validation(heap):
+    path, schema, c0, c1, vis = heap
+    with pytest.raises(StromError):
+        Query(path, schema).group_by_cols([0, 1, 0])   # 3 cols
+    with pytest.raises(StromError):
+        Query(path, schema).group_by_cols(7)           # out of range
+    with pytest.raises(StromError):
+        Query(path, schema).group_by_cols(1, max_groups=0)
+    # discovery past max_groups fails with ENOMEM, not truncation
+    config.set("debug_no_threshold", True)
+    with pytest.raises(StromError) as ei:
+        Query(path, schema).group_by_cols(0, max_groups=4).run()
+    assert ei.value.errno == 12

@@ -655,3 +655,33 @@ def test_bench_fallback_carries_journal_metrics(tmp_path, monkeypatch):
     assert out["provenance"] == "p"
     assert out["cpu_live"]["samples"][0]["raw_odirect"] == 2.1
     assert out["cpu_live"]["vs_raw_odirect"] == 0.97
+
+
+def test_strom_query_cli_group_by_cols(tmp_path):
+    """--group-by-cols groups by VALUES: key_cols in the JSON output,
+    --having composes, conflicting terminals rejected."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(13)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 6, n).astype(np.int32)
+    c1 = rng.integers(0, 50, n).astype(np.int32)
+    path = str(tmp_path / "g.heap")
+    build_heap_file(path, [c0, c1], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--group-by-cols", "0", "--agg-cols", "1", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    keys = np.unique(c0)
+    assert res["key_cols"][0] == keys.tolist()
+    for i, k in enumerate(keys):
+        m = c0 == k
+        assert res["count"][i] == int(m.sum())
+        assert res["sums"][0][i] == int(c1[m].sum())
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--group-by-cols", "0", "--select", "all")
+    assert out.returncode != 0 and "exclusive" in out.stderr
