@@ -685,3 +685,58 @@ def test_strom_query_cli_group_by_cols(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
                "--group-by-cols", "0", "--select", "all")
     assert out.returncode != 0 and "exclusive" in out.stderr
+
+
+def test_bench_candidate_best_of_session(tmp_path, monkeypatch):
+    """A same-day lower capture must not overwrite a stronger journaled
+    one (quota-regime round ends), and the weaker attempt is recorded;
+    a better capture does overwrite."""
+    import json as _json
+
+    import bench
+    monkeypatch.setattr(bench, "CANDIDATE_PATH",
+                        str(tmp_path / "cand.json"))
+    today = bench._today()
+    _json.dump({"metric": "ssd2tpu_seq_GBps", "value": 1.0,
+                "captured_at": f"{today}T04:00:00Z"},
+               open(bench.CANDIDATE_PATH, "w"))
+    bench._save_candidate({"metric": "ssd2tpu_seq_GBps", "value": 0.04})
+    kept = _json.load(open(bench.CANDIDATE_PATH))
+    assert kept["value"] == 1.0
+    assert kept["later_lower_capture"]["value"] == 0.04
+    bench._save_candidate({"metric": "ssd2tpu_seq_GBps", "value": 1.3})
+    assert _json.load(open(bench.CANDIDATE_PATH))["value"] == 1.3
+    # a PREVIOUS-day candidate is always replaced by fresh evidence
+    _json.dump({"metric": "ssd2tpu_seq_GBps", "value": 9.9,
+                "captured_at": "2020-01-01T00:00:00Z"},
+               open(bench.CANDIDATE_PATH, "w"))
+    bench._save_candidate({"metric": "ssd2tpu_seq_GBps", "value": 0.5})
+    assert _json.load(open(bench.CANDIDATE_PATH))["value"] == 0.5
+
+
+def test_bench_fallback_labels_inround_replay(tmp_path, monkeypatch):
+    """A journal replay of THIS round's own capture is labeled
+    journal_replay, not stale_device_rows (which means a previous
+    round's number)."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+
+    import bench
+    monkeypatch.setattr(bench, "CANDIDATE_PATH",
+                        str(tmp_path / "cand.json"))
+    monkeypatch.setattr(bench, "_cpu_row", lambda path: {"direct": 2.0})
+    today = bench._today()
+    for stamp, fresh in ((f"{today}T04:00:00Z", True),
+                         ("2020-01-01T00:00:00Z", False)):
+        _json.dump({"metric": "ssd2tpu_seq_GBps", "value": 1.0,
+                    "captured_at": stamp},
+                   open(bench.CANDIDATE_PATH, "w"))
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = bench._emit_cpu_fallback("/nonexistent", "wedged")
+        assert rc == 0
+        out = _json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert out["value"] == 1.0
+        assert out.get("journal_replay", False) is fresh
+        assert out.get("stale_device_rows", False) is (not fresh)
