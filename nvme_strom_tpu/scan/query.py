@@ -928,6 +928,12 @@ class Query:
 
     def explain(self, *, mesh=None) -> QueryPlan:
         plan = self._explain_inner(mesh=mesh)
+        if self._group_cols is not None:
+            plan = dataclasses.replace(
+                plan, reason=plan.reason +
+                "; value-keyed GROUP BY: distinct keys discovered first "
+                "(fresh sidecar at zero table I/O, else one projection "
+                "scan), empty groups dropped")
         js = self._join_strategy()
         if js is not None:
             strat, n_parts = js

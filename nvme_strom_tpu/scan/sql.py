@@ -1,0 +1,514 @@
+"""SQL front-end: a parsed SELECT subset over heap tables.
+
+The reference ships as a PostgreSQL extension — SQL *is* its user
+interface (`pgsql/nvme_strom.c:941-979` hands tuples to the SQL
+executor).  This module gives the TPU framework the same face for the
+scan tier it implements: a hand-rolled tokenizer + recursive-descent
+parser (no dependencies) maps a SELECT statement onto the
+:class:`.query.Query` builder, so every access path the planner knows
+(direct / vfs / index sidecars), both kernels, and the mesh mode are
+reachable from a SQL string.
+
+Supported subset (one table, one terminal — the Query contract):
+
+    SELECT select_list FROM <name>
+      [WHERE cond [AND cond]...]
+      [GROUP BY cN[, cM]]
+      [HAVING agg cmp literal [AND ...]]
+      [ORDER BY cN [ASC|DESC]]
+      [LIMIT n [OFFSET m]]
+
+    select_list := '*' | item (',' item)*
+    item  := cN | COUNT(*) | COUNT(DISTINCT cN)
+           | SUM(cN) | AVG(cN) | MIN(cN) | MAX(cN)
+    cond  := cN cmp literal | literal cmp cN
+           | cN BETWEEN lit AND lit | cN IN (lit[, lit]...)
+    cmp   := = | == | != | <> | < | <= | > | >=
+
+Columns are named ``c0..cN-1`` (the CLI convention).  The mapping is
+exact, never approximate: a statement outside the subset raises EINVAL
+with a message naming the unsupported construct — silent semantic
+drift from real SQL is the one unforgivable failure mode of a facade.
+
+Mapping (each SQL shape -> the Query terminal that serves it):
+
+* plain columns                  -> ``select(cols)`` (LIMIT/OFFSET ride
+  the early DMA cut-off)
+* COUNT(*) / SUM / AVG, no GROUP -> ``aggregate(cols=...)``
+* sole MIN(c) / MAX(c), no GROUP -> ``top_k(c, 1)`` (index-served when
+  a sidecar is fresh)
+* sole COUNT(DISTINCT c)         -> ``count_distinct(c)``
+* GROUP BY c[, c2]               -> ``group_by_cols`` (value-keyed,
+  keys discovered; HAVING composes)
+* ORDER BY c [DESC] [LIMIT]      -> ``order_by`` (sidecar-served when
+  fresh)
+* WHERE: the first index-capable condition becomes a STRUCTURED filter
+  (``where_eq`` / ``where_range`` / ``where_in`` — the planner can ride
+  a sidecar); the rest fold into a residual predicate lambda.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import StromError
+from .query import Query
+
+__all__ = ["parse_sql", "sql_query"]
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<num>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\d+)
+    | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
+    | (?P<op><=|>=|!=|<>|==|=|<|>|\(|\)|,|\*)
+    )""", re.VERBOSE)
+
+_AGGS = ("count", "sum", "avg", "min", "max")
+_CMPS = ("=", "==", "!=", "<>", "<", "<=", ">", ">=")
+
+
+def _tokenize(sql: str) -> List[Tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(sql):
+        m = _TOKEN.match(sql, pos)
+        if m is None:
+            if sql[pos:].strip() == "":
+                break
+            raise StromError(22, f"SQL: cannot tokenize at "
+                                 f"{sql[pos:pos + 20]!r}")
+        pos = m.end()
+        if m.group("num") is not None:
+            out.append(("num", m.group("num")))
+        elif m.group("name") is not None:
+            out.append(("name", m.group("name")))
+        else:
+            out.append(("op", m.group("op")))
+    return out
+
+
+class _P:
+    """Token cursor with the small helpers a recursive descent needs."""
+
+    def __init__(self, toks: List[Tuple[str, str]]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> Tuple[str, str]:
+        t = self.peek()
+        if t is None:
+            raise StromError(22, "SQL: unexpected end of statement")
+        self.i += 1
+        return t
+
+    def kw(self, word: str) -> bool:
+        """Consume *word* (case-insensitive keyword) if next."""
+        t = self.peek()
+        if t and t[0] == "name" and t[1].lower() == word:
+            self.i += 1
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        t = self.next()
+        if t != ("op", op):
+            raise StromError(22, f"SQL: expected {op!r}, got {t[1]!r}")
+
+    def expect_kw(self, word: str) -> None:
+        if not self.kw(word):
+            t = self.peek()
+            raise StromError(22, f"SQL: expected {word.upper()}, got "
+                                 f"{t[1] if t else 'end'!r}")
+
+
+def _col(tok: Tuple[str, str], n_cols: int) -> int:
+    kind, v = tok
+    m = re.fullmatch(r"[cC](\d+)", v) if kind == "name" else None
+    if not m:
+        raise StromError(22, f"SQL: expected a column (c0..c{n_cols - 1})"
+                             f", got {v!r}")
+    c = int(m.group(1))
+    if not 0 <= c < n_cols:
+        raise StromError(22, f"SQL: column c{c} out of range "
+                             f"(table has {n_cols})")
+    return c
+
+
+def _lit(tok: Tuple[str, str]):
+    kind, v = tok
+    if kind != "num":
+        raise StromError(22, f"SQL: expected a numeric literal, got {v!r}")
+    return float(v) if ("." in v or "e" in v or "E" in v) else int(v)
+
+
+class _Item:
+    """One select-list item: ("col", c) or ("agg", fn, c|None, distinct)."""
+
+    def __init__(self, kind, fn=None, col=None, distinct=False,
+                 label=""):
+        self.kind, self.fn, self.col = kind, fn, col
+        self.distinct, self.label = distinct, label
+
+
+def _parse_select_list(p: _P, n_cols: int) -> Optional[List[_Item]]:
+    """None = ``*``."""
+    if p.peek() == ("op", "*"):
+        p.next()
+        return None
+    items = []
+    while True:
+        t = p.next()
+        if t[0] == "name" and t[1].lower() in _AGGS \
+                and p.peek() == ("op", "("):
+            fn = t[1].lower()
+            p.next()
+            distinct = False
+            if p.peek() == ("op", "*"):
+                p.next()
+                if fn != "count":
+                    raise StromError(22, f"SQL: {fn.upper()}(*) is not "
+                                         f"a thing; name a column")
+                col = None
+                label = "count(*)"
+            else:
+                if p.kw("distinct"):
+                    distinct = True
+                    if fn != "count":
+                        raise StromError(22, "SQL: DISTINCT only under "
+                                             "COUNT in this subset")
+                col = _col(p.next(), n_cols)
+                label = (f"{fn}(distinct c{col})" if distinct
+                         else f"{fn}(c{col})")
+            p.expect_op(")")
+            items.append(_Item("agg", fn, col, distinct, label))
+        else:
+            c = _col(t, n_cols)
+            items.append(_Item("col", col=c, label=f"c{c}"))
+        if p.peek() == ("op", ","):
+            p.next()
+            continue
+        return items
+
+
+def _parse_where(p: _P, n_cols: int) -> List[tuple]:
+    """List of conds: ("cmp", col, op, lit) | ("between", col, lo, hi) |
+    ("in", col, [lits])."""
+    conds = []
+    while True:
+        t = p.next()
+        if t[0] == "num":   # literal cmp col -> flip
+            lit = _lit(t)
+            op = p.next()
+            if op[0] != "op" or op[1] not in _CMPS:
+                raise StromError(22, f"SQL: expected comparison, got "
+                                     f"{op[1]!r}")
+            c = _col(p.next(), n_cols)
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+            conds.append(("cmp", c, flip.get(op[1], op[1]), lit))
+        else:
+            c = _col(t, n_cols)
+            if p.kw("between"):
+                lo = _lit(p.next())
+                p.expect_kw("and")
+                hi = _lit(p.next())
+                conds.append(("between", c, lo, hi))
+            elif p.kw("in"):
+                p.expect_op("(")
+                lits = [_lit(p.next())]
+                while p.peek() == ("op", ","):
+                    p.next()
+                    lits.append(_lit(p.next()))
+                p.expect_op(")")
+                conds.append(("in", c, lits))
+            else:
+                op = p.next()
+                if op[0] != "op" or op[1] not in _CMPS:
+                    raise StromError(22, f"SQL: expected comparison, "
+                                         f"got {op[1]!r}")
+                conds.append(("cmp", c, op[1], _lit(p.next())))
+        if p.kw("and"):
+            continue
+        if p.peek() and p.peek()[0] == "name" \
+                and p.peek()[1].lower() == "or":
+            raise StromError(22, "SQL: OR is outside this subset "
+                                 "(AND-conjunctions only)")
+        return conds
+
+
+def _parse_having(p: _P, n_cols: int) -> List[tuple]:
+    """[(fn, col|None, op, lit)] — aggregate comparisons only."""
+    out = []
+    while True:
+        t = p.next()
+        if t[0] != "name" or t[1].lower() not in _AGGS:
+            raise StromError(22, "SQL: HAVING takes aggregate "
+                                 "comparisons (COUNT/SUM/AVG/MIN/MAX)")
+        fn = t[1].lower()
+        p.expect_op("(")
+        if p.peek() == ("op", "*"):
+            p.next()
+            col = None
+            if fn != "count":
+                raise StromError(22, f"SQL: {fn.upper()}(*) in HAVING")
+        else:
+            col = _col(p.next(), n_cols)
+        p.expect_op(")")
+        op = p.next()
+        if op[0] != "op" or op[1] not in _CMPS:
+            raise StromError(22, "SQL: HAVING needs a comparison")
+        out.append((fn, col, op[1], _lit(p.next())))
+        if p.kw("and"):
+            continue
+        return out
+
+
+def _cmp_np(op: str):
+    return {"=": np.equal, "==": np.equal, "!=": np.not_equal,
+            "<>": np.not_equal, "<": np.less, "<=": np.less_equal,
+            ">": np.greater, ">=": np.greater_equal}[op]
+
+
+def _apply_where(q: Query, conds: List[tuple]) -> Query:
+    """A SOLE index-capable condition becomes a structured filter (the
+    planner can ride a sidecar); any conjunction composes into one
+    predicate lambda — Query's filter slot holds exactly one filter
+    (``where`` supersedes structured), so a mix must not split."""
+    if len(conds) == 1:
+        cond = conds[0]
+        if cond[0] == "cmp" and cond[2] in ("=", "=="):
+            return q.where_eq(cond[1], cond[3])
+        if cond[0] == "between":
+            return q.where_range(cond[1], cond[2], cond[3])
+        if cond[0] == "in":
+            return q.where_in(cond[1], cond[2])
+    residual = conds
+    if residual:
+        def pred(cols, residual=residual):
+            import jax.numpy as jnp
+            m = None
+            for cond in residual:
+                if cond[0] == "cmp":
+                    _, c, op, lit = cond
+                    fns = {"=": jnp.equal, "==": jnp.equal,
+                           "!=": jnp.not_equal, "<>": jnp.not_equal,
+                           "<": jnp.less, "<=": jnp.less_equal,
+                           ">": jnp.greater, ">=": jnp.greater_equal}
+                    one = fns[op](cols[c], lit)
+                elif cond[0] == "between":
+                    _, c, lo, hi = cond
+                    one = (cols[c] >= lo) & (cols[c] <= hi)
+                else:
+                    _, c, lits = cond
+                    one = jnp.zeros(cols[c].shape, bool)
+                    for v in lits:
+                        one = one | (cols[c] == v)
+                m = one if m is None else m & one
+            return m
+        q = q.where(pred)
+    return q
+
+
+def _having_fn(havings: List[tuple], agg_cols: List[int]):
+    if not havings:
+        return None
+
+    def hv(res, havings=havings, agg_cols=agg_cols):
+        m = np.ones(len(np.asarray(res["count"])), bool)
+        for fn, col, op, lit in havings:
+            if fn == "count":
+                vals = np.asarray(res["count"])
+            else:
+                if col not in agg_cols:
+                    raise StromError(22, f"SQL: HAVING {fn}(c{col}) "
+                                         f"needs c{col} aggregated in "
+                                         f"the SELECT list")
+                i = agg_cols.index(col)
+                vals = np.asarray(res[{"sum": "sums", "avg": "avgs",
+                                       "min": "mins",
+                                       "max": "maxs"}[fn]][i])
+            m = m & _cmp_np(op)(vals, lit)
+        return m
+    return hv
+
+
+def parse_sql(sql: str, source, schema) -> Tuple[Query, "callable"]:
+    """Parse *sql* against *source*/*schema*; returns ``(query,
+    assemble)`` where ``assemble(run_result) -> dict`` relabels the
+    terminal's output into the statement's select-list names."""
+    n_cols = schema.n_cols
+    p = _P(_tokenize(sql))
+    p.expect_kw("select")
+    items = _parse_select_list(p, n_cols)
+    p.expect_kw("from")
+    t = p.next()
+    if t[0] != "name":
+        raise StromError(22, f"SQL: FROM needs a table name, got {t[1]!r}")
+    conds = _parse_where(p, n_cols) if p.kw("where") else []
+    group_cols: Optional[List[int]] = None
+    if p.kw("group"):
+        p.expect_kw("by")
+        group_cols = [_col(p.next(), n_cols)]
+        while p.peek() == ("op", ","):
+            p.next()
+            group_cols.append(_col(p.next(), n_cols))
+    havings = _parse_having(p, n_cols) if p.kw("having") else []
+    order: Optional[Tuple[int, bool]] = None
+    if p.kw("order"):
+        p.expect_kw("by")
+        oc = _col(p.next(), n_cols)
+        desc = False
+        if p.kw("desc"):
+            desc = True
+        else:
+            p.kw("asc")
+        order = (oc, desc)
+    limit = offset = None
+    if p.kw("limit"):
+        limit = int(_lit(p.next()))
+    if p.kw("offset"):
+        offset = int(_lit(p.next()))
+    left = p.peek()
+    if left is not None:
+        raise StromError(22, f"SQL: trailing input at {left[1]!r}")
+    if havings and group_cols is None:
+        raise StromError(22, "SQL: HAVING requires GROUP BY")
+
+    q = _apply_where(Query(source, schema), conds)
+    off = offset or 0
+
+    # --- GROUP BY ---------------------------------------------------------
+    if group_cols is not None:
+        if order is not None or limit is not None:
+            raise StromError(22, "SQL: ORDER BY/LIMIT on grouped "
+                                 "results are outside this subset")
+        if items is None:
+            raise StromError(22, "SQL: GROUP BY needs an explicit "
+                                 "select list (group cols + aggregates)")
+        agg_cols: List[int] = []
+        for it in items:
+            if it.kind == "col":
+                if it.col not in group_cols:
+                    raise StromError(22, f"SQL: c{it.col} is neither "
+                                         f"grouped nor aggregated")
+            elif it.fn == "count" and it.col is None and not it.distinct:
+                pass
+            elif it.fn in ("sum", "avg", "min", "max"):
+                if it.col not in agg_cols:
+                    agg_cols.append(it.col)
+            else:
+                raise StromError(22, f"SQL: {it.label} under GROUP BY "
+                                     f"is outside this subset")
+        for fn, col, _op, _lit_ in havings:
+            if col is not None and col not in agg_cols:
+                agg_cols.append(col)
+        # the groupby kernels need at least one aggregation column even
+        # for a COUNT(*)-only statement: the group key column itself is
+        # the free choice (its sums are simply unused)
+        q = q.group_by_cols(group_cols,
+                            agg_cols=agg_cols or [group_cols[0]],
+                            having=_having_fn(havings,
+                                              agg_cols
+                                              or [group_cols[0]]))
+
+        def assemble(res, items=items, group_cols=group_cols,
+                     agg_cols=agg_cols):
+            out = {}
+            for it in items:
+                if it.kind == "col":
+                    out[it.label] = \
+                        res["key_cols"][group_cols.index(it.col)]
+                elif it.fn == "count":
+                    out[it.label] = np.asarray(res["count"])
+                else:
+                    i = agg_cols.index(it.col)
+                    key = {"sum": "sums", "avg": "avgs", "min": "mins",
+                           "max": "maxs"}[it.fn]
+                    out[it.label] = np.asarray(res[key][i])
+            return out
+        return q, assemble
+
+    # --- ORDER BY ---------------------------------------------------------
+    if order is not None:
+        oc, desc = order
+        if items is not None and not (
+                len(items) == 1 and items[0].kind == "col"
+                and items[0].col == oc):
+            raise StromError(22, "SQL: ORDER BY serves the ordered "
+                                 "column itself in this subset "
+                                 "(SELECT cN ... ORDER BY cN)")
+        q = q.order_by([oc], descending=desc, limit=limit, offset=off)
+
+        def assemble(res, oc=oc):
+            return {f"c{oc}": np.asarray(res["values"]),
+                    "positions": np.asarray(res["positions"])}
+        return q, assemble
+
+    # --- plain projection -------------------------------------------------
+    if items is None or all(it.kind == "col" for it in items):
+        cols = None if items is None else [it.col for it in items]
+        q = q.select(cols, limit=limit, offset=off)
+
+        def assemble(res, cols=cols):
+            sel = cols if cols is not None else \
+                [int(k[3:]) for k in res if k.startswith("col")]
+            out = {f"c{c}": np.asarray(res[f"col{c}"]) for c in sel}
+            out["positions"] = np.asarray(res["positions"])
+            return out
+        return q, assemble
+
+    # --- scalar aggregates ------------------------------------------------
+    if limit is not None:
+        raise StromError(22, "SQL: LIMIT on a scalar aggregate")
+    aggs = [it for it in items if it.kind == "agg"]
+    if len(aggs) != len(items):
+        raise StromError(22, "SQL: mixing bare columns with aggregates "
+                             "needs GROUP BY")
+    if len(aggs) == 1 and aggs[0].distinct:
+        q = q.count_distinct(aggs[0].col)
+        lbl = aggs[0].label
+        return q, (lambda res, lbl=lbl: {lbl: int(res["distinct"])})
+    if len(aggs) == 1 and aggs[0].fn in ("min", "max"):
+        it = aggs[0]
+        q = q.top_k(it.col, 1, largest=(it.fn == "max"))
+
+        def assemble(res, it=it):
+            vals = np.asarray(res["values"])
+            poss = np.asarray(res["positions"])
+            empty = len(vals) == 0 or int(poss[0]) < 0
+            return {it.label: None if empty else vals[0].item()}
+        return q, assemble
+    sum_cols: List[int] = []
+    for it in aggs:
+        if it.fn in ("sum", "avg"):
+            if it.col not in sum_cols:
+                sum_cols.append(it.col)
+        elif it.fn == "count" and it.col is None:
+            pass
+        else:
+            raise StromError(22, f"SQL: {it.label} cannot combine with "
+                                 f"other aggregates without GROUP BY")
+    q = q.aggregate(cols=sum_cols or None)
+
+    def assemble(res, aggs=aggs, sum_cols=sum_cols):
+        out = {}
+        n = int(res["count"])
+        for it in aggs:
+            if it.fn == "count":
+                out[it.label] = n
+            else:
+                s = np.asarray(res["sums"][sum_cols.index(it.col)])
+                out[it.label] = s.item() if it.fn == "sum" else \
+                    (s.item() / n if n else None)
+        return out
+    return q, assemble
+
+
+def sql_query(sql: str, source, schema, **run_kw) -> dict:
+    """Parse + run in one call; returns the select-list-labeled result."""
+    q, assemble = parse_sql(sql, source, schema)
+    return assemble(q.run(**run_kw))

@@ -122,6 +122,8 @@ def _to_jsonable(v):
     for empty groups, and bare NaN in --json output would break strict
     RFC-8259 consumers (jq et al.)."""
     import math
+    if v is None:   # empty-input aggregates (e.g. SQL MAX over no rows)
+        return None
     a = np.asarray(v)
     if a.dtype.kind != "f":
         return a.tolist()
@@ -240,6 +242,11 @@ def main(argv=None) -> int:
                     default="auto")
     ap.add_argument("--mesh", action="store_true",
                     help="stream sharded over all devices (dp axis)")
+    ap.add_argument("--sql", default=None, metavar="STATEMENT",
+                    help="run a SQL SELECT (subset; columns named "
+                         "c0..cN-1; FROM name is nominal — the "
+                         "positional file is the table); exclusive "
+                         "with the per-flag query builders")
     ap.add_argument("--explain", action="store_true",
                     help="print the plan and exit without scanning")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -280,6 +287,39 @@ def main(argv=None) -> int:
                  "--join with --join-rows")
     if args.join_rows and not args.join:
         ap.error("--join-rows requires --join")
+    if args.sql:
+        if terminals or args.where or args.where_eq or args.where_range \
+                or args.where_in or args.having or args.fetch \
+                or args.build_index is not None or args.index_lookup:
+            ap.error("--sql is the whole query; drop the per-flag "
+                     "builders")
+        from ..scan.sql import parse_sql
+        try:
+            q, assemble = parse_sql(args.sql, src, schema)
+        except StromError as e:
+            ap.error(f"--sql: {e}")
+        mesh = None
+        if args.mesh:
+            import jax
+
+            from ..parallel.mesh import make_scan_mesh
+            mesh = make_scan_mesh(jax.devices())
+        if args.explain:
+            plan = q.explain(mesh=mesh)
+            if args.as_json:
+                import dataclasses
+                print(json.dumps(dataclasses.asdict(plan)))
+            else:
+                print(plan)
+            return 0
+        out = assemble(q.run(mesh=mesh, kernel=args.kernel))
+        if args.as_json:
+            print(json.dumps({k: _to_jsonable(v) for k, v in out.items()},
+                             allow_nan=False))
+        else:
+            for k, v in out.items():
+                print(f"{k}: {v}")
+        return 0
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.build_index is not None or args.index_lookup:
         from ..scan.index import build_index, open_index

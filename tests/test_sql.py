@@ -1,0 +1,173 @@
+"""SQL front-end: the parsed SELECT subset maps exactly onto Query
+terminals — every answer is checked against a numpy oracle, and
+out-of-subset statements fail loudly (EINVAL naming the construct),
+never silently approximate."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.api import StromError
+from nvme_strom_tpu.config import config
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+from nvme_strom_tpu.scan.sql import parse_sql, sql_query
+
+
+@pytest.fixture()
+def table(tmp_path):
+    rng = np.random.default_rng(42)
+    schema = HeapSchema(n_cols=3, visibility=False,
+                        dtypes=("int32", "int32", "float32"))
+    n = schema.tuples_per_page * 8
+    c0 = rng.integers(0, 50, n).astype(np.int32)
+    c1 = rng.integers(-100, 100, n).astype(np.int32)
+    c2 = rng.standard_normal(n).astype(np.float32)
+    path = str(tmp_path / "t.heap")
+    build_heap_file(path, [c0, c1, c2], schema)
+    config.set("debug_no_threshold", True)
+    return path, schema, c0, c1, c2
+
+
+def test_sql_scalar_aggregates(table):
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT COUNT(*), SUM(c1), AVG(c1) FROM t "
+                    "WHERE c0 < 10", path, schema)
+    sel = c0 < 10
+    assert out["count(*)"] == int(sel.sum())
+    assert out["sum(c1)"] == int(c1[sel].sum())
+    assert out["avg(c1)"] == pytest.approx(c1[sel].mean())
+
+
+def test_sql_where_forms(table):
+    """=, BETWEEN, IN promote to structured filters; residual conds
+    compose; literal-first comparisons flip."""
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 = 7", path, schema)
+    assert out["count(*)"] == int((c0 == 7).sum())
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 BETWEEN 10 AND 19 "
+                    "AND c1 > 0", path, schema)
+    assert out["count(*)"] == int(((c0 >= 10) & (c0 <= 19)
+                                   & (c1 > 0)).sum())
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 IN (1, 2, 3)",
+                    path, schema)
+    assert out["count(*)"] == int(np.isin(c0, [1, 2, 3]).sum())
+    out = sql_query("SELECT COUNT(*) FROM t WHERE 0 < c1", path, schema)
+    assert out["count(*)"] == int((c1 > 0).sum())
+
+
+def test_sql_group_by_with_having(table):
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT c0, COUNT(*), SUM(c1), MIN(c1) FROM t "
+                    "WHERE c1 > 0 GROUP BY c0 "
+                    "HAVING COUNT(*) >= 20", path, schema)
+    sel = c1 > 0
+    keys = [k for k in np.unique(c0[sel])
+            if int((sel & (c0 == k)).sum()) >= 20]
+    np.testing.assert_array_equal(out["c0"], np.array(keys))
+    for i, k in enumerate(keys):
+        m = sel & (c0 == k)
+        assert out["count(*)"][i] == int(m.sum())
+        assert out["sum(c1)"][i] == int(c1[m].sum())
+        assert out["min(c1)"][i] == int(c1[m].min())
+
+
+def test_sql_select_order_limit(table):
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT c0, c1 FROM t WHERE c0 = 3 LIMIT 5",
+                    path, schema)
+    assert len(out["c0"]) == min(5, int((c0 == 3).sum()))
+    assert (out["c0"] == 3).all()
+    np.testing.assert_array_equal(out["c1"], c1[out["positions"]])
+    out = sql_query("SELECT c1 FROM t ORDER BY c1 DESC LIMIT 10",
+                    path, schema)
+    np.testing.assert_array_equal(out["c1"], np.sort(c1)[::-1][:10])
+
+
+def test_sql_min_max_count_distinct(table):
+    path, schema, c0, c1, c2 = table
+    assert sql_query("SELECT MAX(c1) FROM t", path, schema)["max(c1)"] \
+        == int(c1.max())
+    assert sql_query("SELECT MIN(c1) FROM t WHERE c0 = 3", path,
+                     schema)["min(c1)"] == int(c1[c0 == 3].min())
+    assert sql_query("SELECT COUNT(DISTINCT c0) FROM t", path,
+                     schema)["count(distinct c0)"] == \
+        len(np.unique(c0))
+
+
+def test_sql_star_projection(table):
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT * FROM t WHERE c1 > 95", path, schema)
+    sel = c1 > 95
+    np.testing.assert_array_equal(np.sort(out["positions"]),
+                                  np.flatnonzero(sel))
+
+
+def test_sql_rides_the_index(table):
+    """WHERE c0 = v through SQL plans the index access path once a
+    sidecar is fresh — the facade reaches the planner, not around it."""
+    from nvme_strom_tpu.scan.index import build_index
+    path, schema, c0, c1, c2 = table
+    build_index(path, schema, 0)
+    q, _ = parse_sql("SELECT COUNT(*), SUM(c1) FROM t WHERE c0 = 7",
+                     path, schema)
+    assert q.explain().access_path == "index"
+    out = sql_query("SELECT COUNT(*), SUM(c1) FROM t WHERE c0 = 7",
+                    path, schema)
+    assert out["count(*)"] == int((c0 == 7).sum())
+    assert out["sum(c1)"] == int(c1[c0 == 7].sum())
+
+
+def test_sql_mesh_mode(table):
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    path, schema, c0, c1, c2 = table
+    mesh = make_scan_mesh(jax.devices())
+    out = sql_query("SELECT COUNT(*), SUM(c1) FROM t WHERE c1 > 0",
+                    path, schema, mesh=mesh, batch_pages=8)
+    assert out["count(*)"] == int((c1 > 0).sum())
+    assert out["sum(c1)"] == int(c1[c1 > 0].sum())
+
+
+def test_sql_rejects_out_of_subset(table):
+    path, schema, *_ = table
+    bad = [
+        ("SELECT c0 FROM t WHERE c0 = 1 OR c1 = 2", "OR"),
+        ("SELECT c9 FROM t", "out of range"),
+        ("SELECT c0, SUM(c1) FROM t", "GROUP BY"),
+        # mixed-dtype aggregation set (int32 SUM + float32 HAVING SUM)
+        # hits the kernels' one-dtype contract with its own clear error
+        ("SELECT SUM(c1) FROM t GROUP BY c0 HAVING SUM(c2) > 0",
+         "dtype"),
+        ("SELECT MAX(c1), SUM(c0) FROM t", "cannot combine"),
+        ("SELECT c0 FROM t ORDER BY c1", "ordered column"),
+        ("SELECT AVG(*) FROM t", "name a column"),
+        ("SELECT c0 FROM t; DROP TABLE t", "tokenize"),
+        ("SELECT c0 FROM t LIMIT 5 EXTRA", "trailing"),
+        ("SELECT SUM(c0) FROM t HAVING COUNT(*) > 1", "GROUP BY"),
+    ]
+    for sql, needle in bad:
+        with pytest.raises(StromError) as ei:
+            sql_query(sql, path, schema)
+        assert needle.lower() in str(ei.value).lower(), sql
+
+
+def test_sql_having_over_unselected_aggregate(table):
+    """HAVING may reference an aggregate absent from the SELECT list
+    (legal SQL) — the parser aggregates it internally."""
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT c0, COUNT(*) FROM t GROUP BY c0 "
+                    "HAVING SUM(c1) > 100", path, schema)
+    keys = [k for k in np.unique(c0)
+            if int(c1[c0 == k].sum()) > 100]
+    np.testing.assert_array_equal(out["c0"], np.array(keys))
+
+
+def test_sql_empty_results(table):
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT MAX(c1) FROM t WHERE c0 = 999", path, schema)
+    assert out["max(c1)"] is None
+    out = sql_query("SELECT c0 FROM t WHERE c0 = 999", path, schema)
+    assert len(out["c0"]) == 0
+    out = sql_query("SELECT c0, COUNT(*) FROM t WHERE c0 = 999 "
+                    "GROUP BY c0", path, schema)
+    assert len(out["c0"]) == 0

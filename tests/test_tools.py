@@ -740,3 +740,36 @@ def test_bench_fallback_labels_inround_replay(tmp_path, monkeypatch):
         assert out["value"] == 1.0
         assert out.get("journal_replay", False) is fresh
         assert out.get("stale_device_rows", False) is (not fresh)
+
+
+def test_strom_query_cli_sql(tmp_path):
+    """--sql runs the parsed SELECT subset end to end; --explain shows
+    the plan; builder flags conflict."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(4)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 10, n).astype(np.int32)
+    c1 = rng.integers(-50, 50, n).astype(np.int32)
+    path = str(tmp_path / "s.heap")
+    build_heap_file(path, [c0, c1], schema)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--sql", "SELECT c0, COUNT(*), SUM(c1) FROM t "
+                        "GROUP BY c0 HAVING COUNT(*) > 10", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    keys = [k for k in np.unique(c0) if int((c0 == k).sum()) > 10]
+    assert res["c0"] == [int(k) for k in keys]
+    for i, k in enumerate(keys):
+        assert res["sum(c1)"][i] == int(c1[c0 == k].sum())
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--sql", "SELECT COUNT(*) FROM t", "--explain")
+    assert out.returncode == 0, out.stderr
+    assert "aggregate scan" in out.stdout
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--sql", "SELECT COUNT(*) FROM t", "--select", "all")
+    assert out.returncode != 0 and "whole query" in out.stderr
