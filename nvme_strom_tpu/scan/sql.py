@@ -9,14 +9,22 @@ parser (no dependencies) maps a SELECT statement onto the
 (direct / vfs / index sidecars), both kernels, and the mesh mode are
 reachable from a SQL string.
 
-Supported subset (one table, one terminal — the Query contract):
+Supported subset (one fact table, one terminal — the Query contract):
 
     SELECT select_list FROM <name>
+      [[INNER|LEFT|SEMI|ANTI] JOIN <dim> ON cN = <dim>.cM]
       [WHERE cond [AND cond]...]
       [GROUP BY cN[, cM]]
       [HAVING agg cmp literal [AND ...]]
       [ORDER BY cN [ASC|DESC]]
       [LIMIT n [OFFSET m]]
+
+JOIN binds a dimension table supplied via ``tables={"dim": (path,
+schema)}`` (on-disk heap; the engine streams it in bounded passes when
+it exceeds ``join_broadcast_max``) and serves both faces: aggregates —
+``COUNT(*)``, ``SUM(cN)`` over fact columns, ``SUM(dim.cK)`` over the
+matched build payload — or, with plain columns in the SELECT list, the
+materialized rows (the probe column and ``dim.cK``).
 
     select_list := '*' | item (',' item)*
     item  := cN | COUNT(*) | COUNT(DISTINCT cN)
@@ -63,7 +71,7 @@ _TOKEN = re.compile(r"""
     \s*(?:
       (?P<num>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\d+)
     | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
-    | (?P<op><=|>=|!=|<>|==|=|<|>|\(|\)|,|\*)
+    | (?P<op><=|>=|!=|<>|==|=|<|>|\(|\)|,|\*|\.)
     )""", re.VERBOSE)
 
 _AGGS = ("count", "sum", "avg", "min", "max")
@@ -147,12 +155,30 @@ def _lit(tok: Tuple[str, str]):
 
 
 class _Item:
-    """One select-list item: ("col", c) or ("agg", fn, c|None, distinct)."""
+    """One select-list item: ("col", c) or ("agg", fn, c|None, distinct);
+    ``table`` is None for fact columns, a dimension name for qualified
+    ``dim.cK`` references."""
 
     def __init__(self, kind, fn=None, col=None, distinct=False,
-                 label=""):
+                 label="", table=None):
         self.kind, self.fn, self.col = kind, fn, col
-        self.distinct, self.label = distinct, label
+        self.distinct, self.label, self.table = distinct, label, table
+
+
+def _colref(p: _P, n_cols: int) -> Tuple[Optional[str], int]:
+    """(table|None, col): a bare fact column (validated now) or a
+    qualified ``name.cK`` reference (validated at binding)."""
+    t = p.next()
+    if t[0] == "name" and p.peek() == ("op", "."):
+        p.next()
+        nxt = p.next()
+        m = re.fullmatch(r"[cC](\d+)", nxt[1]) if nxt[0] == "name" \
+            else None
+        if not m:
+            raise StromError(22, f"SQL: expected {t[1]}.cK, got "
+                                 f"{nxt[1]!r}")
+        return t[1], int(m.group(1))
+    return None, _col(t, n_cols)
 
 
 def _parse_select_list(p: _P, n_cols: int) -> Optional[List[_Item]]:
@@ -162,18 +188,19 @@ def _parse_select_list(p: _P, n_cols: int) -> Optional[List[_Item]]:
         return None
     items = []
     while True:
-        t = p.next()
-        if t[0] == "name" and t[1].lower() in _AGGS \
-                and p.peek() == ("op", "("):
-            fn = t[1].lower()
+        t = p.peek()
+        if t and t[0] == "name" and t[1].lower() in _AGGS \
+                and self_is_call(p):
             p.next()
+            fn = t[1].lower()
+            p.next()   # the '('
             distinct = False
             if p.peek() == ("op", "*"):
                 p.next()
                 if fn != "count":
                     raise StromError(22, f"SQL: {fn.upper()}(*) is not "
                                          f"a thing; name a column")
-                col = None
+                tbl, col = None, None
                 label = "count(*)"
             else:
                 if p.kw("distinct"):
@@ -181,18 +208,25 @@ def _parse_select_list(p: _P, n_cols: int) -> Optional[List[_Item]]:
                     if fn != "count":
                         raise StromError(22, "SQL: DISTINCT only under "
                                              "COUNT in this subset")
-                col = _col(p.next(), n_cols)
-                label = (f"{fn}(distinct c{col})" if distinct
-                         else f"{fn}(c{col})")
+                tbl, col = _colref(p, n_cols)
+                base = f"{tbl}.c{col}" if tbl else f"c{col}"
+                label = (f"{fn}(distinct {base})" if distinct
+                         else f"{fn}({base})")
             p.expect_op(")")
-            items.append(_Item("agg", fn, col, distinct, label))
+            items.append(_Item("agg", fn, col, distinct, label, tbl))
         else:
-            c = _col(t, n_cols)
-            items.append(_Item("col", col=c, label=f"c{c}"))
+            tbl, c = _colref(p, n_cols)
+            label = f"{tbl}.c{c}" if tbl else f"c{c}"
+            items.append(_Item("col", col=c, label=label, table=tbl))
         if p.peek() == ("op", ","):
             p.next()
             continue
         return items
+
+
+def self_is_call(p: _P) -> bool:
+    """Lookahead: the NAME at the cursor is followed by '('."""
+    return p.i + 1 < len(p.toks) and p.toks[p.i + 1] == ("op", "(")
 
 
 def _parse_where(p: _P, n_cols: int) -> List[tuple]:
@@ -336,10 +370,15 @@ def _having_fn(havings: List[tuple], agg_cols: List[int]):
     return hv
 
 
-def parse_sql(sql: str, source, schema) -> Tuple[Query, "callable"]:
+_JOIN_TYPES = ("inner", "left", "semi", "anti")
+
+
+def parse_sql(sql: str, source, schema,
+              tables: Optional[dict] = None) -> Tuple[Query, "callable"]:
     """Parse *sql* against *source*/*schema*; returns ``(query,
     assemble)`` where ``assemble(run_result) -> dict`` relabels the
-    terminal's output into the statement's select-list names."""
+    terminal's output into the statement's select-list names.
+    *tables* binds JOIN dimension names to ``(path, schema)``."""
     n_cols = schema.n_cols
     p = _P(_tokenize(sql))
     p.expect_kw("select")
@@ -348,6 +387,31 @@ def parse_sql(sql: str, source, schema) -> Tuple[Query, "callable"]:
     t = p.next()
     if t[0] != "name":
         raise StromError(22, f"SQL: FROM needs a table name, got {t[1]!r}")
+    join = None          # (how, dim_name, probe_col, dim_key_col)
+    nxt = p.peek()
+    how = "inner"
+    joining = False
+    if nxt and nxt[0] == "name" and nxt[1].lower() in _JOIN_TYPES:
+        how = p.next()[1].lower()
+        p.expect_kw("join")      # "FROM t LEFT ..." can be nothing else
+        joining = True
+    else:
+        joining = p.kw("join")
+    if joining:
+        dn = p.next()
+        if dn[0] != "name":
+            raise StromError(22, "SQL: JOIN needs a table name")
+        p.expect_kw("on")
+        lt, lc = _colref(p, n_cols)
+        p.expect_op("=")
+        rt, rc = _colref(p, n_cols)
+        sides = {lt: lc, rt: rc}
+        if None not in sides or dn[1] not in sides:
+            raise StromError(22, f"SQL: ON must equate a fact column "
+                                 f"with a {dn[1]}.cK column")
+        join = (how, dn[1], sides[None], sides[dn[1]])
+    elif how != "inner":
+        raise StromError(22, "SQL: join type without JOIN")
     conds = _parse_where(p, n_cols) if p.kw("where") else []
     group_cols: Optional[List[int]] = None
     if p.kw("group"):
@@ -380,6 +444,86 @@ def parse_sql(sql: str, source, schema) -> Tuple[Query, "callable"]:
 
     q = _apply_where(Query(source, schema), conds)
     off = offset or 0
+
+    # --- JOIN -------------------------------------------------------------
+    if join is not None:
+        how_, dname, probe_col, key_col = join
+        if not tables or dname not in tables:
+            raise StromError(22, f"SQL: JOIN table {dname!r} not bound "
+                                 f"(pass tables={{{dname!r}: (path, "
+                                 f"schema)}})")
+        dpath, dschema = tables[dname]
+        if group_cols is not None or havings or order is not None:
+            raise StromError(22, "SQL: GROUP BY/HAVING/ORDER BY with "
+                                 "JOIN are outside this subset")
+        if items is None:
+            raise StromError(22, "SQL: JOIN needs an explicit select "
+                                 "list")
+        if not 0 <= key_col < dschema.n_cols:
+            raise StromError(22, f"SQL: {dname}.c{key_col} out of range")
+        for it in items:
+            if it.table is not None and it.table != dname:
+                raise StromError(22, f"SQL: unknown table {it.table!r}")
+        dim_cols = sorted({it.col for it in items if it.table == dname})
+        if len(dim_cols) > 1:
+            raise StromError(22, f"SQL: one {dname}.cK column per join "
+                                 f"in this subset")
+        if dim_cols and how_ in ("semi", "anti"):
+            raise StromError(22, f"SQL: {how_.upper()} JOIN does not "
+                                 f"expose {dname} columns (EXISTS "
+                                 f"semantics)")
+        value_col = dim_cols[0] if dim_cols else key_col
+        if not 0 <= value_col < dschema.n_cols:
+            raise StromError(22, f"SQL: {dname}.c{value_col} out of "
+                                 f"range")
+        agg_items = [it for it in items if it.kind == "agg"]
+        if agg_items and len(agg_items) != len(items):
+            raise StromError(22, "SQL: JOIN mixes aggregates and bare "
+                                 "columns")
+        if agg_items:
+            if limit is not None:
+                raise StromError(22, "SQL: LIMIT on a join aggregate")
+            for it in agg_items:
+                ok = (it.fn == "count" and it.col is None) or \
+                     (it.fn == "sum" and not it.distinct)
+                if not ok:
+                    raise StromError(22, f"SQL: {it.label} with JOIN "
+                                         f"is outside this subset")
+            q = q.join_table(probe_col, dpath, dschema, key_col,
+                             value_col, how=how_)
+
+            def assemble(res, agg_items=agg_items):
+                out = {}
+                for it in agg_items:
+                    if it.fn == "count":
+                        out[it.label] = int(res["matched"])
+                    elif it.table is None:
+                        out[it.label] = \
+                            np.asarray(res["sums"][it.col]).item()
+                    else:
+                        out[it.label] = int(res["payload_sum"])
+                return out
+            return q, assemble
+        for it in items:
+            if it.table is None and it.col != probe_col:
+                raise StromError(
+                    22, f"SQL: the row face serves the probe column "
+                        f"c{probe_col} and {dname}.cK; fetch() other "
+                        f"fact columns by position")
+        q = q.join_table(probe_col, dpath, dschema, key_col, value_col,
+                         materialize=True, limit=limit, offset=off,
+                         how=how_)
+
+        def assemble(res, items=items):
+            out = {}
+            for it in items:
+                out[it.label] = np.asarray(
+                    res["keys"] if it.table is None else res["payload"])
+            out["positions"] = np.asarray(res["positions"])
+            if "matched" in res:   # the left face's NULL indicator
+                out["matched"] = np.asarray(res["matched"])
+            return out
+        return q, assemble
 
     # --- GROUP BY ---------------------------------------------------------
     if group_cols is not None:
@@ -508,7 +652,8 @@ def parse_sql(sql: str, source, schema) -> Tuple[Query, "callable"]:
     return q, assemble
 
 
-def sql_query(sql: str, source, schema, **run_kw) -> dict:
+def sql_query(sql: str, source, schema, tables: Optional[dict] = None,
+              **run_kw) -> dict:
     """Parse + run in one call; returns the select-list-labeled result."""
-    q, assemble = parse_sql(sql, source, schema)
+    q, assemble = parse_sql(sql, source, schema, tables=tables)
     return assemble(q.run(**run_kw))

@@ -247,6 +247,11 @@ def main(argv=None) -> int:
                          "c0..cN-1; FROM name is nominal — the "
                          "positional file is the table); exclusive "
                          "with the per-flag query builders")
+    ap.add_argument("--sql-table", action="append", default=[],
+                    metavar="NAME=PATH:NCOLS",
+                    help="bind a JOIN dimension table for --sql "
+                         "(repeatable): NAME as written after JOIN, "
+                         "PATH a heap file, NCOLS its column count")
     ap.add_argument("--explain", action="store_true",
                     help="print the plan and exit without scanning")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -294,8 +299,18 @@ def main(argv=None) -> int:
             ap.error("--sql is the whole query; drop the per-flag "
                      "builders")
         from ..scan.sql import parse_sql
+        tables = {}
+        for spec in args.sql_table:
+            name, eq, rest = spec.partition("=")
+            tpath, colon, ncols = rest.rpartition(":")
+            if not eq or not colon or not ncols.isdigit():
+                ap.error("--sql-table takes NAME=PATH:NCOLS")
+            tables[name] = (tpath,
+                            HeapSchema(n_cols=int(ncols),
+                                       visibility=False))
         try:
-            q, assemble = parse_sql(args.sql, src, schema)
+            q, assemble = parse_sql(args.sql, src, schema,
+                                    tables=tables)
         except StromError as e:
             ap.error(f"--sql: {e}")
         mesh = None

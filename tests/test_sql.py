@@ -171,3 +171,87 @@ def test_sql_empty_results(table):
     out = sql_query("SELECT c0, COUNT(*) FROM t WHERE c0 = 999 "
                     "GROUP BY c0", path, schema)
     assert len(out["c0"]) == 0
+
+
+@pytest.fixture()
+def joined(tmp_path):
+    rng = np.random.default_rng(77)
+    fschema = HeapSchema(n_cols=2, visibility=False)
+    n = fschema.tuples_per_page * 6
+    c0 = rng.integers(-50, 50, n).astype(np.int32)
+    c1 = rng.integers(0, 16, n).astype(np.int32)
+    fpath = str(tmp_path / "fact.heap")
+    build_heap_file(fpath, [c0, c1], fschema)
+    keys = np.arange(0, 8, dtype=np.int32)
+    vals = (keys * 100).astype(np.int32)
+    dschema = HeapSchema(n_cols=2, visibility=False)
+    dpath = str(tmp_path / "dim.heap")
+    build_heap_file(dpath, [keys, vals], dschema)
+    config.set("debug_no_threshold", True)
+    return fpath, fschema, c0, c1, dpath, dschema
+
+
+def test_sql_join_aggregate_faces(joined):
+    fpath, fschema, c0, c1, dpath, dschema = joined
+    tables = {"d": (dpath, dschema)}
+    partner = c1 < 8
+    out = sql_query("SELECT COUNT(*), SUM(c0), SUM(d.c1) FROM t "
+                    "JOIN d ON c1 = d.c0", fpath, fschema,
+                    tables=tables)
+    assert out["count(*)"] == int(partner.sum())
+    assert out["sum(c0)"] == int(c0[partner].sum())
+    assert out["sum(d.c1)"] == int((c1[partner] * 100).sum())
+    out = sql_query("SELECT COUNT(*) FROM t ANTI JOIN d ON c1 = d.c0",
+                    fpath, fschema, tables=tables)
+    assert out["count(*)"] == int((~partner).sum())
+    out = sql_query("SELECT COUNT(*), SUM(d.c1) FROM t "
+                    "LEFT JOIN d ON c1 = d.c0 WHERE c0 > 0",
+                    fpath, fschema, tables=tables)
+    sel = c0 > 0
+    assert out["count(*)"] == int(sel.sum())
+    assert out["sum(d.c1)"] == int((c1[sel & partner] * 100).sum())
+    out = sql_query("SELECT COUNT(*) FROM t SEMI JOIN d ON c1 = d.c0",
+                    fpath, fschema, tables=tables)
+    assert out["count(*)"] == int(partner.sum())
+
+
+def test_sql_join_row_face(joined):
+    fpath, fschema, c0, c1, dpath, dschema = joined
+    tables = {"d": (dpath, dschema)}
+    partner = c1 < 8
+    out = sql_query("SELECT c1, d.c1 FROM t JOIN d ON c1 = d.c0",
+                    fpath, fschema, tables=tables)
+    order = np.argsort(out["positions"])
+    np.testing.assert_array_equal(out["positions"][order],
+                                  np.flatnonzero(partner))
+    np.testing.assert_array_equal(out["c1"][order], c1[partner])
+    np.testing.assert_array_equal(out["d.c1"][order],
+                                  c1[partner] * 100)
+    # LEFT rows carry the NULL indicator
+    out = sql_query("SELECT c1, d.c1 FROM t LEFT JOIN d ON c1 = d.c0 "
+                    "LIMIT 20", fpath, fschema, tables=tables)
+    assert len(out["c1"]) == 20
+    m = out["matched"]
+    assert (out["d.c1"][~m] == 0).all()
+
+
+def test_sql_join_rejections(joined):
+    fpath, fschema, c0, c1, dpath, dschema = joined
+    tables = {"d": (dpath, dschema)}
+    bad = [
+        ("SELECT COUNT(*) FROM t JOIN x ON c1 = x.c0", "not bound"),
+        ("SELECT COUNT(*) FROM t JOIN d ON c1 = c0", "equate"),
+        ("SELECT d.c1 FROM t SEMI JOIN d ON c1 = d.c0", "EXISTS"),
+        ("SELECT c0, d.c1 FROM t JOIN d ON c1 = d.c0", "probe column"),
+        ("SELECT c1, COUNT(*) FROM t JOIN d ON c1 = d.c0",
+         "mixes aggregates"),
+        ("SELECT COUNT(*) FROM t JOIN d ON c1 = d.c0 GROUP BY c0",
+         "outside this subset"),
+        ("SELECT AVG(c0) FROM t JOIN d ON c1 = d.c0",
+         "outside this subset"),
+        ("SELECT COUNT(*) FROM t LEFT d ON c1 = d.c0", "JOIN"),
+    ]
+    for sql, needle in bad:
+        with pytest.raises(StromError) as ei:
+            sql_query(sql, fpath, fschema, tables=tables)
+        assert needle.lower() in str(ei.value).lower(), sql

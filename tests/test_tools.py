@@ -773,3 +773,35 @@ def test_strom_query_cli_sql(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
                "--sql", "SELECT COUNT(*) FROM t", "--select", "all")
     assert out.returncode != 0 and "whole query" in out.stderr
+
+
+def test_strom_query_cli_sql_join(tmp_path):
+    """--sql with JOIN binds the dimension via --sql-table."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    fschema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(8)
+    n = fschema.tuples_per_page * 4
+    c0 = rng.integers(0, 30, n).astype(np.int32)
+    c1 = rng.integers(0, 16, n).astype(np.int32)
+    fpath = str(tmp_path / "f.heap")
+    build_heap_file(fpath, [c0, c1], fschema)
+    keys = np.arange(0, 8, dtype=np.int32)
+    dpath = str(tmp_path / "d.heap")
+    build_heap_file(dpath, [keys, keys * 7],
+                    HeapSchema(n_cols=2, visibility=False))
+    out = _run("nvme_strom_tpu.tools.strom_query", fpath, "--cols", "2",
+               "--sql", "SELECT COUNT(*), SUM(d.c1) FROM t "
+                        "JOIN d ON c1 = d.c0",
+               "--sql-table", f"d={dpath}:2", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    partner = c1 < 8
+    assert res["count(*)"] == int(partner.sum())
+    assert res["sum(d.c1)"] == int((c1[partner] * 7).sum())
+    out = _run("nvme_strom_tpu.tools.strom_query", fpath, "--cols", "2",
+               "--sql", "SELECT COUNT(*) FROM t JOIN d ON c1 = d.c0")
+    assert out.returncode != 0 and "not bound" in out.stderr
