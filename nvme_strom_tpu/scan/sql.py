@@ -424,13 +424,29 @@ def parse_sql(sql: str, source, schema,
     order: Optional[Tuple[int, bool]] = None
     if p.kw("order"):
         p.expect_kw("by")
-        oc = _col(p.next(), n_cols)
+        t2 = p.peek()
+        if t2 and t2[0] == "name" and t2[1].lower() in _AGGS \
+                and self_is_call(p):
+            # ORDER BY COUNT(*)/SUM(c)/... — grouped-result ordering
+            fn = p.next()[1].lower()
+            p.next()   # '('
+            if p.peek() == ("op", "*"):
+                p.next()
+                ocol = None
+                if fn != "count":
+                    raise StromError(22, f"SQL: {fn.upper()}(*)")
+            else:
+                ocol = _col(p.next(), n_cols)
+            p.expect_op(")")
+            okey = ("agg", fn, ocol)
+        else:
+            okey = ("col", _col(p.next(), n_cols))
         desc = False
         if p.kw("desc"):
             desc = True
         else:
             p.kw("asc")
-        order = (oc, desc)
+        order = (okey, desc)
     limit = offset = None
     if p.kw("limit"):
         limit = int(_lit(p.next()))
@@ -527,9 +543,6 @@ def parse_sql(sql: str, source, schema,
 
     # --- GROUP BY ---------------------------------------------------------
     if group_cols is not None:
-        if order is not None or limit is not None:
-            raise StromError(22, "SQL: ORDER BY/LIMIT on grouped "
-                                 "results are outside this subset")
         if items is None:
             raise StromError(22, "SQL: GROUP BY needs an explicit "
                                  "select list (group cols + aggregates)")
@@ -550,46 +563,91 @@ def parse_sql(sql: str, source, schema,
         for fn, col, _op, _lit_ in havings:
             if col is not None and col not in agg_cols:
                 agg_cols.append(col)
+        # ORDER BY on grouped results sorts groups post-aggregation (the
+        # SQL top-N-groups shape): the key is a group column or an
+        # aggregate, which may need aggregating even if unselected
+        if order is not None and order[0][0] == "agg" \
+                and order[0][2] is not None \
+                and order[0][2] not in agg_cols:
+            agg_cols.append(order[0][2])
+        if order is not None and order[0][0] == "col" \
+                and order[0][1] not in group_cols:
+            raise StromError(22, f"SQL: ORDER BY c{order[0][1]} is "
+                                 f"neither grouped nor an aggregate")
         # the groupby kernels need at least one aggregation column even
         # for a COUNT(*)-only statement: the group key column itself is
         # the free choice (its sums are simply unused)
-        q = q.group_by_cols(group_cols,
-                            agg_cols=agg_cols or [group_cols[0]],
-                            having=_having_fn(havings,
-                                              agg_cols
-                                              or [group_cols[0]]))
+        eff_aggs = agg_cols or [group_cols[0]]
+        q = q.group_by_cols(group_cols, agg_cols=eff_aggs,
+                            having=_having_fn(havings, eff_aggs))
 
         def assemble(res, items=items, group_cols=group_cols,
-                     agg_cols=agg_cols):
+                     agg_cols=eff_aggs, order=order, limit=limit,
+                     off=off):
+            def field(kind, fn=None, col=None):
+                if kind == "col":
+                    return np.asarray(
+                        res["key_cols"][group_cols.index(col)])
+                if fn == "count":
+                    return np.asarray(res["count"])
+                return np.asarray(res[{"sum": "sums", "avg": "avgs",
+                                       "min": "mins",
+                                       "max": "maxs"}[fn]]
+                                  [agg_cols.index(col)])
+
+            n = len(np.asarray(res["count"]))
+            perm = np.arange(n)
+            if order is not None:
+                okey, desc = order
+                vals = field(*okey) if okey[0] == "agg" else \
+                    field("col", col=okey[1])
+                perm = np.argsort(vals, kind="stable")
+                if desc:
+                    perm = perm[::-1]
+            if order is not None or limit is not None:
+                end = None if limit is None else off + limit
+                perm = perm[off:end]
             out = {}
             for it in items:
-                if it.kind == "col":
-                    out[it.label] = \
-                        res["key_cols"][group_cols.index(it.col)]
-                elif it.fn == "count":
-                    out[it.label] = np.asarray(res["count"])
-                else:
-                    i = agg_cols.index(it.col)
-                    key = {"sum": "sums", "avg": "avgs", "min": "mins",
-                           "max": "maxs"}[it.fn]
-                    out[it.label] = np.asarray(res[key][i])
+                arr = field(it.kind, it.fn, it.col)
+                out[it.label] = arr[perm]
             return out
         return q, assemble
 
     # --- ORDER BY ---------------------------------------------------------
     if order is not None:
-        oc, desc = order
-        if items is not None and not (
-                len(items) == 1 and items[0].kind == "col"
-                and items[0].col == oc):
-            raise StromError(22, "SQL: ORDER BY serves the ordered "
-                                 "column itself in this subset "
-                                 "(SELECT cN ... ORDER BY cN)")
+        okey, desc = order
+        if okey[0] != "col":
+            raise StromError(22, "SQL: ORDER BY an aggregate requires "
+                                 "GROUP BY")
+        oc = okey[1]
+        extra: List[int] = []
+        if items is not None:
+            for it in items:
+                if it.kind != "col":
+                    raise StromError(22, "SQL: ORDER BY with "
+                                         "aggregates requires GROUP BY")
+                if it.col != oc and it.col not in extra:
+                    extra.append(it.col)
+        else:
+            extra = [c for c in range(n_cols) if c != oc]
         q = q.order_by([oc], descending=desc, limit=limit, offset=off)
+        labels = [it.label for it in items] if items is not None else \
+            [f"c{c}" for c in range(n_cols)]
 
-        def assemble(res, oc=oc):
-            return {f"c{oc}": np.asarray(res["values"]),
-                    "positions": np.asarray(res["positions"])}
+        def assemble(res, oc=oc, extra=extra, labels=labels,
+                     source=source, schema=schema):
+            pos = np.asarray(res["positions"])
+            out = {f"c{oc}": np.asarray(res["values"])}
+            if extra:
+                # projected columns beyond the sort key: point-lookups
+                # by position, returned in caller (sorted) order
+                fetched = Query(source, schema).fetch(pos, cols=extra)
+                for c in extra:
+                    out[f"c{c}"] = np.asarray(fetched[f"col{c}"])
+            out["positions"] = pos
+            return {**{lbl: out[lbl] for lbl in labels},
+                    "positions": pos}
         return q, assemble
 
     # --- plain projection -------------------------------------------------

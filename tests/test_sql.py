@@ -139,7 +139,8 @@ def test_sql_rejects_out_of_subset(table):
         ("SELECT SUM(c1) FROM t GROUP BY c0 HAVING SUM(c2) > 0",
          "dtype"),
         ("SELECT MAX(c1), SUM(c0) FROM t", "cannot combine"),
-        ("SELECT c0 FROM t ORDER BY c1", "ordered column"),
+        ("SELECT SUM(c0) FROM t ORDER BY c1", "requires GROUP BY"),
+        ("SELECT c0 FROM t ORDER BY COUNT(*)", "requires GROUP BY"),
         ("SELECT AVG(*) FROM t", "name a column"),
         ("SELECT c0 FROM t; DROP TABLE t", "tokenize"),
         ("SELECT c0 FROM t LIMIT 5 EXTRA", "trailing"),
@@ -149,6 +150,36 @@ def test_sql_rejects_out_of_subset(table):
         with pytest.raises(StromError) as ei:
             sql_query(sql, path, schema)
         assert needle.lower() in str(ei.value).lower(), sql
+
+
+def test_sql_order_by_projection(table):
+    """ORDER BY serves OTHER projected columns via point-lookups by
+    position, in sorted order."""
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT c0, c1 FROM t ORDER BY c1 DESC LIMIT 12",
+                    path, schema)
+    order = np.argsort(-c1, kind="stable")[:12]
+    np.testing.assert_array_equal(out["c1"], c1[order])
+    # c0 values correspond row-for-row with the sorted c1 rows
+    np.testing.assert_array_equal(out["c0"], c0[out["positions"]])
+
+
+def test_sql_top_n_groups(table):
+    """ORDER BY an aggregate + LIMIT on grouped results — SQL's
+    top-N-groups — sorts post-aggregation."""
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT c0, COUNT(*) FROM t GROUP BY c0 "
+                    "ORDER BY COUNT(*) DESC LIMIT 5", path, schema)
+    keys, counts = np.unique(c0, return_counts=True)
+    want = counts[np.argsort(counts, kind="stable")[::-1][:5]]
+    np.testing.assert_array_equal(out["count(*)"], want)
+    assert len(out["c0"]) == 5
+    # ORDER BY an aggregate that is not selected also works
+    out = sql_query("SELECT c0 FROM t GROUP BY c0 "
+                    "ORDER BY SUM(c1) DESC LIMIT 3", path, schema)
+    sums = np.array([c1[c0 == k].sum() for k in keys])
+    np.testing.assert_array_equal(
+        out["c0"], keys[np.argsort(sums, kind="stable")[::-1][:3]])
 
 
 def test_sql_having_over_unselected_aggregate(table):
