@@ -421,7 +421,8 @@ def parse_sql(sql: str, source, schema,
             p.next()
             group_cols.append(_col(p.next(), n_cols))
     havings = _parse_having(p, n_cols) if p.kw("having") else []
-    order: Optional[Tuple[int, bool]] = None
+    # (("col", c) | ("agg", fn, col), descending)
+    order: Optional[Tuple[tuple, bool]] = None
     if p.kw("order"):
         p.expect_kw("by")
         t2 = p.peek()
@@ -437,6 +438,10 @@ def parse_sql(sql: str, source, schema,
                     raise StromError(22, f"SQL: {fn.upper()}(*)")
             else:
                 ocol = _col(p.next(), n_cols)
+                if fn == "count":
+                    raise StromError(22, "SQL: COUNT takes (*) in this "
+                                         "subset (COUNT(cN) would "
+                                         "silently mean COUNT(*))")
             p.expect_op(")")
             okey = ("agg", fn, ocol)
         else:
@@ -458,6 +463,11 @@ def parse_sql(sql: str, source, schema,
     if havings and group_cols is None:
         raise StromError(22, "SQL: HAVING requires GROUP BY")
 
+    if join is None and items is not None:
+        for it in items:
+            if it.table is not None:
+                raise StromError(22, f"SQL: {it.label} references a "
+                                     f"table with no JOIN")
     q = _apply_where(Query(source, schema), conds)
     off = offset or 0
 
@@ -604,7 +614,7 @@ def parse_sql(sql: str, source, schema,
                 perm = np.argsort(vals, kind="stable")
                 if desc:
                     perm = perm[::-1]
-            if order is not None or limit is not None:
+            if order is not None or limit is not None or off:
                 end = None if limit is None else off + limit
                 perm = perm[off:end]
             out = {}
@@ -636,16 +646,18 @@ def parse_sql(sql: str, source, schema,
             [f"c{c}" for c in range(n_cols)]
 
         def assemble(res, oc=oc, extra=extra, labels=labels,
-                     source=source, schema=schema):
+                     source=source, schema=schema, session=None,
+                     device=None):
             pos = np.asarray(res["positions"])
             out = {f"c{oc}": np.asarray(res["values"])}
             if extra:
                 # projected columns beyond the sort key: point-lookups
-                # by position, returned in caller (sorted) order
-                fetched = Query(source, schema).fetch(pos, cols=extra)
+                # by position, returned in caller (sorted) order — on
+                # the CALLER's session/device (sql_query threads them)
+                fetched = Query(source, schema).fetch(
+                    pos, cols=extra, session=session, device=device)
                 for c in extra:
                     out[f"c{c}"] = np.asarray(fetched[f"col{c}"])
-            out["positions"] = pos
             return {**{lbl: out[lbl] for lbl in labels},
                     "positions": pos}
         return q, assemble
@@ -712,6 +724,13 @@ def parse_sql(sql: str, source, schema,
 
 def sql_query(sql: str, source, schema, tables: Optional[dict] = None,
               **run_kw) -> dict:
-    """Parse + run in one call; returns the select-list-labeled result."""
+    """Parse + run in one call; returns the select-list-labeled result.
+    ``session``/``device`` run kwargs also reach any post-pass the
+    assembler performs (the projected ORDER BY point-lookups)."""
+    import inspect
     q, assemble = parse_sql(sql, source, schema, tables=tables)
-    return assemble(q.run(**run_kw))
+    res = q.run(**run_kw)
+    params = inspect.signature(assemble).parameters
+    extra = {k: run_kw[k] for k in ("session", "device")
+             if k in run_kw and k in params}
+    return assemble(res, **extra)

@@ -286,3 +286,23 @@ def test_sql_join_rejections(joined):
         with pytest.raises(StromError) as ei:
             sql_query(sql, fpath, fschema, tables=tables)
         assert needle.lower() in str(ei.value).lower(), sql
+
+
+def test_sql_review_fixes(table):
+    """Round-4 review findings pinned: grouped OFFSET alone slices,
+    ORDER BY COUNT(cN) is rejected, and unbound qualified references
+    raise EINVAL (not KeyError / silent fact-column reads)."""
+    path, schema, c0, c1, c2 = table
+    full = sql_query("SELECT c0 FROM t GROUP BY c0", path, schema)
+    off2 = sql_query("SELECT c0 FROM t GROUP BY c0 OFFSET 2",
+                     path, schema)
+    np.testing.assert_array_equal(off2["c0"], full["c0"][2:])
+    for sql, needle in [
+        ("SELECT c0 FROM t GROUP BY c0 ORDER BY COUNT(c1)",
+         "COUNT takes (*)"),
+        ("SELECT d.c0 FROM t ORDER BY c0", "no JOIN"),
+        ("SELECT SUM(d.c1) FROM t", "no JOIN"),
+    ]:
+        with pytest.raises(StromError) as ei:
+            sql_query(sql, path, schema)
+        assert needle.lower() in str(ei.value).lower(), sql
