@@ -12,7 +12,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.mark.parametrize("script", ["01_direct_load.py", "02_query.py",
                                     "03_distributed.py",
-                                    "04_indexes_and_joins.py"])
+                                    "04_indexes_and_joins.py",
+                                    "05_sql.py"])
 def test_example_runs_clean(script, tmp_path):
     from nvme_strom_tpu._pluginpath import strip_tpu_plugin
     env = dict(os.environ)
