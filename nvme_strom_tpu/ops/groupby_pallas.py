@@ -9,12 +9,17 @@ shape as :mod:`.filter_pallas` but with ``(G,)``/``(V, G)`` accumulators
 instead of scalars.  Replaces the reference's per-tuple CPU aggregation
 walk (`pgsql/nvme_strom.c:941-979`).
 
-Group reduction inside the kernel is a **statically unrolled per-group
-masked reduction** over the 2-D ``(pages, tuples)`` block: Mosaic does not
-lower the flatten an ``(N, G)`` one-hot needs, and its int32 matmul
-support is narrower than XLA's — so the MXU contraction stays the XLA
-path's specialty, while this kernel's worth is the fused single pass at
-small group counts.
+Group reduction inside the kernel: **float32 aggregation rides the MXU**
+via a batched ``(bp, G, T)`` one-hot contraction (finite-masked values
+plus NaN/±inf indicator rows in one stacked matmul, IEEE semantics
+reconstructed per group — something even the XLA twin avoids, scatter-
+summing floats instead), while integer aggregation keeps the
+**statically unrolled per-group masked reduction**: Mosaic's int32
+matmul support is narrower than XLA's and float accumulation would
+break the int-exactness contract.  Mosaic layout constraints shape the
+float path: the one-hot is built ``(bp, G, T)`` with T minor (a G-minor
+layout needs a reshape Mosaic won't lower on decode-derived operands)
+and minor-dim insertion happens only on 32-bit operands, never bool.
 
 **Large-``G`` strategy (why the planner caps pallas at G <= 64,
 ``scan/query._PALLAS_MAX_GROUPS``):** the unroll emits ``O(G·V)`` scalar
@@ -82,6 +87,8 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
     zero = acc_np.type(0)
     sq_zero = sq_np.type(0)
 
+    float_mxu = agg_dt.kind == "f" and not jax.config.jax_enable_x64
+
     def make_kernel(n_params: int):
       def kernel(params_ref, w_ref, count_ref, sums_ref, sumsqs_ref,
                  mins_ref, maxs_ref):
@@ -89,11 +96,18 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
 
         @pl.when(i == 0)
         def _init():
-            for g in range(G):      # SMEM takes scalar stores only
-                count_ref[0, g] = 0
+            if float_mxu:   # VMEM accumulators take vector stores
+                count_ref[...] = jnp.zeros_like(count_ref)
+                sums_ref[...] = jnp.zeros_like(sums_ref)
+                sumsqs_ref[...] = jnp.zeros_like(sumsqs_ref)
+            else:
+                for g in range(G):  # SMEM takes scalar stores only
+                    count_ref[0, g] = 0
+                    for vi in range(V):
+                        sums_ref[vi, g] = zero
+                        sumsqs_ref[vi, g] = sq_zero
+            for g in range(G):
                 for vi in range(V):
-                    sums_ref[vi, g] = zero
-                    sumsqs_ref[vi, g] = sq_zero
                     mins_ref[vi, g] = hi
                     maxs_ref[vi, g] = lo
 
@@ -103,19 +117,85 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
         sel = valid & (keys >= 0) & (keys < G)
         if predicate is not None:
             sel = sel & predicate(cols, *params)
-        # static unroll over groups: 2-D masked VPU reductions, no
-        # flatten/one-hot (Mosaic cannot lower the (N, G) reshape)
+        if float_mxu:
+            # FLOAT path rides the MXU inside the kernel: a masked
+            # (bp, T, G) one-hot contracts with the value rows via a
+            # batched dot_general — one matmul per aggregation column
+            # replaces the G-wide unrolled masked-sum sweep (the reason
+            # the float kernel trailed the XLA path, which itself
+            # avoids the matmul for floats and scatter-sums instead).
+            # NaN/±inf rows would poison EVERY group through the
+            # contraction (0*NaN=NaN), so non-finite values contract as
+            # INDICATOR rows alongside the finite-masked values and the
+            # IEEE result is reconstructed per group — exact, not
+            # approximate.  min/max stay unrolled below: there is no
+            # MXU min-matmul.
+            bp, t = keys.shape
+            # (bp, G, T) orientation — T stays the MINOR dim: Mosaic
+            # refuses the reshape a G-minor (bp, T, G) layout needs on
+            # decode-derived operands, and minor-dim insertion is
+            # 32-bit-only (expand int32 keys / a float mask, never bool)
+            onehot = (keys[:, None, :] == jax.lax.broadcasted_iota(
+                jnp.int32, (bp, G, t), 1)).astype(jnp.float32) \
+                * sel.astype(jnp.float32)[:, None, :]   # (bp, G, T)
+            # per-block counts (<= bp*T) are exact in f32; the CAST per
+            # block keeps the cross-block accumulator int32-exact
+            count_ref[...] += jnp.sum(onehot,
+                                      axis=(0, 2)).astype(jnp.int32)
+            for vi, ci in enumerate(cols_idx):
+                vf = cols[ci].astype(jnp.float32)
+                isn = jnp.isnan(vf)
+                pin = vf == jnp.inf
+                nin = vf == -jnp.inf
+                fin = jnp.where(isn | pin | nin, 0.0, vf)
+                stk = jnp.stack(
+                    [fin, fin * fin, isn.astype(jnp.float32),
+                     pin.astype(jnp.float32), nin.astype(jnp.float32)],
+                    axis=1)                             # (bp, 5, T)
+                mm = jax.lax.dot_general(
+                    stk, onehot,
+                    dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)  # (bp, 5, G)
+                tot = jnp.sum(mm, axis=0)                # (5, G)
+                s, s2 = tot[0], tot[1]
+                n_nan, n_pinf, n_ninf = tot[2], tot[3], tot[4]
+                nan = jnp.float32(jnp.nan)
+                inf = jnp.float32(jnp.inf)
+                # IEEE sum semantics per group: NaN dominates; mixed
+                # infinities are NaN; one-signed infinity wins; else
+                # the finite contraction.  Cross-block accumulation
+                # preserves these cases (inf+-inf=NaN, NaN+x=NaN)
+                sum_g = jnp.where(
+                    (n_nan > 0) | ((n_pinf > 0) & (n_ninf > 0)), nan,
+                    jnp.where(n_pinf > 0, inf,
+                              jnp.where(n_ninf > 0, -inf, s)))
+                sq_g = jnp.where(
+                    n_nan > 0, nan,
+                    jnp.where((n_pinf > 0) | (n_ninf > 0), inf, s2))
+                sums_ref[vi, :] += sum_g
+                sumsqs_ref[vi, :] += sq_g
+        else:
+            # integer paths keep the static unroll: Mosaic's int32
+            # matmul support is narrower than XLA's, and float
+            # accumulation of int32 sums would break the exactness
+            # contract (acc_dtypes)
+            for g in range(G):
+                m = sel & (keys == g)                   # (bp, T)
+                count_ref[0, g] += jnp.sum(m.astype(jnp.int32))
+                for vi, ci in enumerate(cols_idx):
+                    v = cols[ci]
+                    vf = v.astype(sq_t)
+                    sums_ref[vi, g] += jnp.sum(
+                        jnp.where(m, v, agg_dt.type(0)).astype(acc_t))
+                    # floating accumulator (shared sumsqs contract:
+                    # int32 squares would wrap far earlier than sums)
+                    sumsqs_ref[vi, g] += jnp.sum(
+                        jnp.where(m, vf * vf, sq_zero))
+        # min/max: per-group masked reductions for every dtype
         for g in range(G):
-            m = sel & (keys == g)                       # (bp, T)
-            count_ref[0, g] += jnp.sum(m.astype(jnp.int32))
+            m = sel & (keys == g)
             for vi, ci in enumerate(cols_idx):
                 v = cols[ci]
-                vf = v.astype(sq_t)
-                sums_ref[vi, g] += jnp.sum(
-                    jnp.where(m, v, agg_dt.type(0)).astype(acc_t))
-                # floating accumulator (shared sumsqs contract: int32
-                # squares would wrap far earlier than the sums do)
-                sumsqs_ref[vi, g] += jnp.sum(jnp.where(m, vf * vf, sq_zero))
                 mins_ref[vi, g] = jnp.minimum(
                     mins_ref[vi, g], jnp.min(jnp.where(m, v, hi)))
                 maxs_ref[vi, g] = jnp.maximum(
@@ -130,22 +210,28 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             padded.reshape(b, _WORDS, 4), jnp.int32).reshape(b, _WORDS)
         pvec = jnp.stack([jnp.asarray(p, jnp.int32) for p in params]) \
             if params else jnp.zeros((1,), jnp.int32)
+        vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+        smem = pl.BlockSpec(memory_space=pltpu.SMEM)
         count, sums, sumsqs, mins, maxs = pl.pallas_call(
             make_kernel(len(params)),
             grid=(b // _BLOCK_PAGES,),
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
+                smem,
                 pl.BlockSpec((_BLOCK_PAGES, _WORDS), lambda i: (i, 0)),
             ],
+            # float path: MXU-contracted count/sums/sumsqs accumulate as
+            # VECTORS in VMEM; min/max (and every integer path) stay in
+            # SMEM scalar accumulators
             out_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
+                vmem if float_mxu else smem,
+                vmem if float_mxu else smem,
+                vmem if float_mxu else smem,
+                smem,
+                smem,
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((1, G), jnp.int32),
+                jax.ShapeDtypeStruct((G,) if float_mxu else (1, G),
+                                     jnp.int32),
                 jax.ShapeDtypeStruct((V, G), acc_t),
                 jax.ShapeDtypeStruct((V, G), sq_t),
                 jax.ShapeDtypeStruct((V, G), col_t),
@@ -153,7 +239,8 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             ],
             interpret=_should_interpret() if interpret is None else interpret,
         )(pvec, words)
-        return {"count": count[0], "sums": sums, "sumsqs": sumsqs,
+        return {"count": count if float_mxu else count[0],
+                "sums": sums, "sumsqs": sumsqs,
                 "mins": mins, "maxs": maxs}
 
     return run
