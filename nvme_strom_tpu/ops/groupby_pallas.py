@@ -100,16 +100,16 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                 count_ref[...] = jnp.zeros_like(count_ref)
                 sums_ref[...] = jnp.zeros_like(sums_ref)
                 sumsqs_ref[...] = jnp.zeros_like(sumsqs_ref)
+                mins_ref[...] = jnp.full_like(mins_ref, hi)
+                maxs_ref[...] = jnp.full_like(maxs_ref, lo)
             else:
                 for g in range(G):  # SMEM takes scalar stores only
                     count_ref[0, g] = 0
                     for vi in range(V):
                         sums_ref[vi, g] = zero
                         sumsqs_ref[vi, g] = sq_zero
-            for g in range(G):
-                for vi in range(V):
-                    mins_ref[vi, g] = hi
-                    maxs_ref[vi, g] = lo
+                        mins_ref[vi, g] = hi
+                        maxs_ref[vi, g] = lo
 
         params = [params_ref[k] for k in range(n_params)]
         cols, valid = _decode_block(w_ref[...], schema)
@@ -128,8 +128,9 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             # contraction (0*NaN=NaN), so non-finite values contract as
             # INDICATOR rows alongside the finite-masked values and the
             # IEEE result is reconstructed per group — exact, not
-            # approximate.  min/max stay unrolled below: there is no
-            # MXU min-matmul.
+            # approximate.  min/max have no MXU form but vectorize
+            # across groups off the same one-hot (one 3-D reduction
+            # each, not a G-unrolled sweep).
             bp, t = keys.shape
             # (bp, G, T) orientation — T stays the MINOR dim: Mosaic
             # refuses the reshape a G-minor (bp, T, G) layout needs on
@@ -174,6 +175,16 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                     jnp.where((n_pinf > 0) | (n_ninf > 0), inf, s2))
                 sums_ref[vi, :] += sum_g
                 sumsqs_ref[vi, :] += sq_g
+                # min/max vectorize across groups off the same one-hot:
+                # ONE 3-D masked reduction each instead of a G-unrolled
+                # sweep (VMEM vector accumulators on this path)
+                vb = cols[ci][:, None, :]               # (bp, 1, T)
+                mins_ref[vi, :] = jnp.minimum(
+                    mins_ref[vi, :],
+                    jnp.min(jnp.where(onehot > 0, vb, hi), axis=(0, 2)))
+                maxs_ref[vi, :] = jnp.maximum(
+                    maxs_ref[vi, :],
+                    jnp.max(jnp.where(onehot > 0, vb, lo), axis=(0, 2)))
         else:
             # integer paths keep the static unroll: Mosaic's int32
             # matmul support is narrower than XLA's, and float
@@ -191,15 +202,17 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                     # int32 squares would wrap far earlier than sums)
                     sumsqs_ref[vi, g] += jnp.sum(
                         jnp.where(m, vf * vf, sq_zero))
-        # min/max: per-group masked reductions for every dtype
-        for g in range(G):
-            m = sel & (keys == g)
-            for vi, ci in enumerate(cols_idx):
-                v = cols[ci]
-                mins_ref[vi, g] = jnp.minimum(
-                    mins_ref[vi, g], jnp.min(jnp.where(m, v, hi)))
-                maxs_ref[vi, g] = jnp.maximum(
-                    maxs_ref[vi, g], jnp.max(jnp.where(m, v, lo)))
+        if not float_mxu:
+            # integer min/max: per-group masked reductions (the float
+            # path vectorized them off the one-hot above)
+            for g in range(G):
+                m = sel & (keys == g)
+                for vi, ci in enumerate(cols_idx):
+                    v = cols[ci]
+                    mins_ref[vi, g] = jnp.minimum(
+                        mins_ref[vi, g], jnp.min(jnp.where(m, v, hi)))
+                    maxs_ref[vi, g] = jnp.maximum(
+                        maxs_ref[vi, g], jnp.max(jnp.where(m, v, lo)))
       return kernel
 
     @jax.jit
@@ -226,8 +239,8 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                 vmem if float_mxu else smem,
                 vmem if float_mxu else smem,
                 vmem if float_mxu else smem,
-                smem,
-                smem,
+                vmem if float_mxu else smem,
+                vmem if float_mxu else smem,
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((G,) if float_mxu else (1, G),
