@@ -96,7 +96,7 @@ class HbmRegistry:
             n = int(size_or_array)
             if n <= 0:
                 raise StromError(_errno.EINVAL, "buffer size must be positive")
-            dev = device or jax.devices()[0]
+            dev = device or jax.local_devices()[0]
             arr = jax.device_put(jnp.zeros((n,), dtype=dtype), dev)
         with self._lock:
             handle = self._next

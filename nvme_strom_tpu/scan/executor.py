@@ -336,7 +336,11 @@ class TableScanner:
 
         from ..hbm.staging import (AdaptiveH2DDepth, bounded_fence,
                                    safe_device_put)
-        dev = device or jax.devices()[0]
+        # local_devices, not devices: under jax.distributed the
+        # global list leads with process 0's device, and a
+        # device_put onto a non-addressable device poisons the
+        # whole scan (observed in the 2-process group_by_cols leg)
+        dev = device or jax.local_devices()[0]
         acc: Optional[dict] = None
         # pool must hold: DMA ring (async_depth) + the batch being drawn
         # + every consumer-held in-flight batch

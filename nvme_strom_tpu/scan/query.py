@@ -1519,7 +1519,7 @@ class Query:
                 out["valid"] = np.zeros(0, bool)
                 return out
             uniq = np.unique(pos // t)          # pages to touch, sorted
-            dev = device or jax.devices()[0]
+            dev = device or jax.local_devices()[0]
             gather = _fetch_gather_fn(self.schema, tuple(cols))
 
             from ..engine import Session as _S

@@ -411,6 +411,27 @@ def _worker_main(process_id: int, num_processes: int, devices_per_proc: int,
     np.testing.assert_array_equal(mypos, exp_pos)
     result["checks"]["pjoin_rows"] = int(len(mypos))
 
+    # 7c. value-keyed GROUP BY across process boundaries (round 4):
+    #     pass 1 discovers the distinct keys per process from the shared
+    #     table, pass 2 psum-folds over the real 2-process mesh — the
+    #     replicated result must equal the global oracle on EVERY process
+    from ..config import config as _gcfg
+    from ..scan.query import Query
+    gsnap = _gcfg.snapshot()
+    try:
+        _gcfg.set("debug_no_threshold", True)
+        gout = Query(os.path.join(workdir, HEAP_NAME), schema) \
+            .group_by_cols(1, agg_cols=[0]).run(mesh=mesh)
+    finally:
+        _gcfg.restore(gsnap)
+    c1v = np.asarray(cols[1]).reshape(-1)
+    vv2 = np.asarray(valid).reshape(-1).astype(bool)
+    want_keys = np.unique(c1v[vv2])
+    np.testing.assert_array_equal(np.asarray(gout["key_cols"][0]),
+                                  want_keys)
+    assert int(np.asarray(gout["count"]).sum()) == int(vv2.sum())
+    result["checks"]["group_by_cols"] = int(len(want_keys))
+
     result["ok"] = True
     with open(os.path.join(workdir, f"result_{process_id}.json"), "w") as f:
         json.dump(result, f)

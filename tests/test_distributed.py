@@ -27,7 +27,8 @@ def test_multi_process_distributed(tmp_path, nproc, dpp):
         assert set(r["checks"]) == {"sharded_load", "scan_step",
                                     "stream_fold", "dist_sort",
                                     "ckpt_restore", "ckpt_save_sharded",
-                                    "pjoin", "pjoin_rows"}
+                                    "pjoin", "pjoin_rows",
+                                    "group_by_cols"}
     # the row-face outputs partition across processes: every process
     # owns a disjoint subset and together they cover every matched row
     assert sum(r["checks"]["pjoin_rows"] for r in results) \
