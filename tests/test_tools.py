@@ -805,3 +805,38 @@ def test_strom_query_cli_sql_join(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", fpath, "--cols", "2",
                "--sql", "SELECT COUNT(*) FROM t JOIN d ON c1 = d.c0")
     assert out.returncode != 0 and "not bound" in out.stderr
+
+
+def test_bench_sustained_regime_fails_fast(tmp_path, monkeypatch):
+    """A responsive device whose burst probe crawls must journal-replay
+    immediately instead of burning ~an hour measuring the throttle."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+
+    import bench
+    monkeypatch.setattr(bench, "CANDIDATE_PATH",
+                        str(tmp_path / "cand.json"))
+    monkeypatch.setattr(bench, "LOCK_PATH", str(tmp_path / "b.lock"))
+    monkeypatch.setattr(bench, "_ensure_file", lambda p, s: None)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: True)
+    monkeypatch.setattr(bench, "_cpu_row", lambda path: {"direct": 2.0})
+    ran = []
+    monkeypatch.setattr(bench, "_run_mode",
+                        lambda *a, **k: ran.append(a) or (0.0, {}))
+    bench._LAST_BURST_GBPS.clear()
+    bench._LAST_BURST_GBPS.append(0.04)
+    today = bench._today()
+    _json.dump({"metric": "ssd2tpu_seq_GBps", "value": 1.01,
+                "captured_at": f"{today}T03:56:59Z"},
+               open(bench.CANDIDATE_PATH, "w"))
+    import sys as _sys
+    monkeypatch.setattr(_sys, "argv", ["bench.py"])
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.main()
+    assert rc == 0
+    out = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["value"] == 1.01 and out.get("journal_replay")
+    assert "sustained/quota regime" in out["error_device"]
+    assert not ran   # no full direct run was attempted
