@@ -401,13 +401,17 @@ class Query:
         cols_, agg, user_having, max_groups = self._group_cols
         dts = [self.schema.col_dtype(c) for c in cols_]
         discovered = None
-        if len(cols_) == 1 and isinstance(self.source, str):
-            # fresh single-column sidecar: the distinct keys are the
-            # sorted sidecar's uniques — zero table I/O
+        if isinstance(self.source, str):
+            # fresh sidecar shortcut: the distinct keys are the sorted
+            # sidecar's uniques — zero table I/O.  Composite (c0, c1)
+            # sidecars serve PAIR grouping the same way (their packed
+            # uint64 keys use the same pack_pair ordering discovery
+            # derives by scanning)
             from .index import index_path_for, open_index, probe_index
-            ip = index_path_for(self.source, cols_[0])
+            want = cols_[0] if len(cols_) == 1 else tuple(cols_)
+            ip = index_path_for(self.source, want)
             try:
-                if probe_index(ip, self.source, expect_col=cols_[0],
+                if probe_index(ip, self.source, expect_col=want,
                                allow_prefix=False):
                     idx = open_index(ip, table_path=self.source)
                     discovered = np.unique(idx.keys)
