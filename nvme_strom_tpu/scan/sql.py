@@ -733,4 +733,7 @@ def sql_query(sql: str, source, schema, tables: Optional[dict] = None,
     params = inspect.signature(assemble).parameters
     extra = {k: run_kw[k] for k in ("session", "device")
              if k in run_kw and k in params}
-    return assemble(res, **extra)
+    out = assemble(res, **extra)
+    if isinstance(res, dict) and "_analyze" in res:
+        out["_analyze"] = res["_analyze"]   # EXPLAIN ANALYZE face
+    return out

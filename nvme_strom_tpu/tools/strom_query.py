@@ -254,6 +254,11 @@ def main(argv=None) -> int:
                          "PATH a heap file, NCOLS its column count")
     ap.add_argument("--explain", action="store_true",
                     help="print the plan and exit without scanning")
+    ap.add_argument("--analyze", action="store_true",
+                    help="EXPLAIN ANALYZE: run, then report elapsed "
+                         "time and the engine's per-run I/O counters "
+                         "(bytes, requests, submit syscalls, kernel "
+                         "dispatches, H2D depth)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     args = ap.parse_args(argv)
@@ -327,13 +332,20 @@ def main(argv=None) -> int:
             else:
                 print(plan)
             return 0
-        out = assemble(q.run(mesh=mesh, kernel=args.kernel))
+        res = q.run(mesh=mesh, kernel=args.kernel,
+                    analyze=args.analyze)
+        out = assemble(res)
+        ana = res.get("_analyze") if isinstance(res, dict) else None
         if args.as_json:
-            print(json.dumps({k: _to_jsonable(v) for k, v in out.items()},
-                             allow_nan=False))
+            body = {k: _to_jsonable(v) for k, v in out.items()}
+            if ana:
+                body["_analyze"] = ana
+            print(json.dumps(body, allow_nan=False))
         else:
             for k, v in out.items():
                 print(f"{k}: {v}")
+            if ana:
+                print(f"_analyze: {ana}")
         return 0
     q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
     if args.build_index is not None or args.index_lookup:
@@ -558,7 +570,7 @@ def main(argv=None) -> int:
             print(plan)
         return 0
 
-    out = q.run(mesh=mesh, kernel=args.kernel)
+    out = q.run(mesh=mesh, kernel=args.kernel, analyze=args.analyze)
     if args.kernel != "auto" and args.kernel != plan.kernel \
             and not args.order_by and not args.select and not args.join \
             and not args.quantiles and args.count_distinct is None:

@@ -840,3 +840,28 @@ def test_bench_sustained_regime_fails_fast(tmp_path, monkeypatch):
     assert out["value"] == 1.01 and out.get("journal_replay")
     assert "sustained/quota regime" in out["error_device"]
     assert not ran   # no full direct run was attempted
+
+
+def test_strom_query_cli_analyze(tmp_path):
+    """--analyze attaches the EXPLAIN ANALYZE block (builder and SQL
+    paths), including the kernel-dispatch count."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(2)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 10, n).astype(np.int32)
+    path = str(tmp_path / "a.heap")
+    build_heap_file(path, [c0, c0], schema)
+    for extra in (["--where", "c0 > 3"],
+                  ["--sql", "SELECT COUNT(*) FROM t WHERE c0 > 3"]):
+        out = _run("nvme_strom_tpu.tools.strom_query", path,
+                   "--cols", "2", *extra, "--analyze", "--json")
+        assert out.returncode == 0, out.stderr
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        ana = res["_analyze"]
+        assert ana["elapsed_s"] > 0
+        assert "kernel_dispatches" in ana and "submit_syscalls" in ana
