@@ -111,6 +111,7 @@ class Query:
         self.schema = schema
         self._stripe_chunk = stripe_chunk_size
         self._pred: Optional[Callable] = None
+        self._residual: Optional[Callable] = None  # index-path recheck
         self._op = "aggregate"
         self._terminal_set = False
         self._agg_cols: Optional[Sequence[int]] = None
@@ -129,16 +130,36 @@ class Query:
 
     # -- builders -----------------------------------------------------------
     def where(self, predicate: Callable) -> "Query":
-        """Row filter: ``predicate(cols) -> (B, T) bool`` (jnp ops only)."""
+        """Row filter: ``predicate(cols) -> (B, T) bool`` (jnp ops only).
+
+        Chained filters COMPOSE as a conjunction (the SQL-builder
+        convention): ``where(a).where(b)`` selects rows passing both.
+        Composed onto a STRUCTURED filter (:meth:`where_eq` /
+        :meth:`where_range` / :meth:`where_in`), the predicate becomes a
+        RESIDUAL — the seqscan applies the conjunction and the index
+        path RECHECKS index-resolved rows against it (PG's Index Cond +
+        Filter shape), so adding a predicate never demotes an
+        index-capable query to a seqscan.  The structured setters
+        replace the WHOLE filter (they define a new index condition)."""
+        if self._pred is not None:
+            old = self._pred
+            self._pred = lambda cols: old(cols) & predicate(cols)
+            if self._index_col() is not None:
+                prev = self._residual
+                self._residual = predicate if prev is None else \
+                    (lambda cols, p=prev: p(cols) & predicate(cols))
+            return self
         self._pred = predicate
-        self._set_structured()   # an opaque predicate supersedes any
         return self
 
     def _set_structured(self, *, eq=None, rng=None, members=None) -> None:
-        """Install exactly one structured filter (the others clear)."""
+        """Install exactly one structured filter (the others clear; a
+        stale residual from a previous filter generation must never
+        survive into the new one's index recheck)."""
         self._eq = eq
         self._range = rng
         self._in = members
+        self._residual = None
 
     def where_eq(self, col: int, value) -> "Query":
         """Structured equality filter: ``col == value``.  Unlike the
@@ -1033,13 +1054,16 @@ class Query:
             else:
                 c, lo, hi = self._range
                 cond = f"range {lo!r} <= col{c} <= {hi!r}"
+            recheck = ("" if self._residual is None else
+                       " + residual filter RECHECKED on index-resolved "
+                       "rows (Index Cond + Filter)")
             return QueryPlan(
                 operator=self._op, access_path="index", kernel=kernel,
                 mode=mode, n_pages=n_pages, cost_direct=cd.total,
                 cost_vfs=cv.total,
                 reason=f"fresh index on col{c}: {cond} resolves "
                        f"positions from the sidecar and reads only "
-                       f"matching pages; " + why)
+                       f"matching pages{recheck}; " + why)
         if direct:
             reason = ("table above the direct-scan threshold and backing "
                       "eligible; " + why)
@@ -1599,7 +1623,26 @@ class Query:
         return res
 
     def _index_positions(self, idx) -> np.ndarray:
-        """Positions matching the structured filter via the sidecar."""
+        """Positions matching the structured filter via the sidecar —
+        then RECHECKED against any residual :meth:`where` predicate
+        (the PG Index Cond + Filter shape): the candidate rows' columns
+        are fetched once and the residual mask applied, so every index
+        runner downstream sees only fully-qualified rows."""
+        pos = self._index_positions_cond(idx)
+        if self._residual is None or len(pos) == 0:
+            return pos
+        pos = np.asarray(pos, np.int64)
+        cols_all = list(range(self.schema.n_cols))
+        out = self.fetch(pos, cols=cols_all)
+        colsd = {c: np.asarray(out[f"col{c}"]) for c in cols_all}
+        mask = np.asarray(self._residual(colsd)).astype(bool).reshape(-1)
+        # an invisible row's decoded values are garbage: never let the
+        # residual resurrect one (downstream keeps would drop it anyway;
+        # COUNT-style runners trust the position list)
+        return pos[mask & np.asarray(out["valid"]).astype(bool)]
+
+    def _index_positions_cond(self, idx) -> np.ndarray:
+        """The structured (index-cond) half of :meth:`_index_positions`."""
         prefix = idx.composite and not isinstance(self._index_col(),
                                                   (tuple, list))
         if self._eq is not None:

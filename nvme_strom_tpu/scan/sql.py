@@ -308,19 +308,31 @@ def _cmp_np(op: str):
 
 
 def _apply_where(q: Query, conds: List[tuple]) -> Query:
-    """A SOLE index-capable condition becomes a structured filter (the
-    planner can ride a sidecar); any conjunction composes into one
-    predicate lambda — Query's filter slot holds exactly one filter
-    (``where`` supersedes structured), so a mix must not split."""
-    if len(conds) == 1:
-        cond = conds[0]
-        if cond[0] == "cmp" and cond[2] in ("=", "=="):
-            return q.where_eq(cond[1], cond[3])
-        if cond[0] == "between":
-            return q.where_range(cond[1], cond[2], cond[3])
-        if cond[0] == "in":
-            return q.where_in(cond[1], cond[2])
-    residual = conds
+    """The FIRST index-capable condition becomes a structured filter
+    (the planner can ride a sidecar); the remaining conjunction composes
+    as a residual ``where`` predicate, which the index path RECHECKS on
+    index-resolved rows (Query's Index Cond + Filter shape) — so a
+    mixed WHERE keeps index access instead of demoting to a seqscan."""
+    structured = None
+    residual = []
+    for cond in conds:
+        if structured is None and cond[0] == "cmp" \
+                and cond[2] in ("=", "=="):
+            structured = ("eq", cond)
+        elif structured is None and cond[0] == "between":
+            structured = ("range", cond)
+        elif structured is None and cond[0] == "in":
+            structured = ("in", cond)
+        else:
+            residual.append(cond)
+    if structured is not None:
+        kind, cond = structured
+        if kind == "eq":
+            q = q.where_eq(cond[1], cond[3])
+        elif kind == "range":
+            q = q.where_range(cond[1], cond[2], cond[3])
+        else:
+            q = q.where_in(cond[1], cond[2])
     if residual:
         def pred(cols, residual=residual):
             import jax.numpy as jnp

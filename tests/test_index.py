@@ -159,18 +159,26 @@ def test_where_eq_planner_picks_index_scan(table):
                                   np.flatnonzero(c0 == 42))
 
 
-def test_where_after_where_eq_clears_index_plan(table):
-    """where() after where_eq() must clear the structured equality — the
-    planner would otherwise index-scan the OLD filter (review finding)."""
+def test_where_after_where_eq_composes_with_recheck(table):
+    """where() after where_eq() composes as a residual (round-4
+    semantics: chained filters are a conjunction, the SQL-builder
+    convention) — the planner KEEPS the index path and the recheck
+    makes the answer the conjunction, never the stale index cond alone
+    (the original review concern)."""
     path, schema, c0, c1 = table
     config.set("debug_no_threshold", True)
     build_index(path, schema, 0)
     q = Query(path, schema).where_eq(0, 42) \
         .where(lambda c: c[0] > 100).select()
-    assert q.explain().access_path != "index"
-    out = q.run()
-    np.testing.assert_array_equal(np.sort(out["positions"]),
-                                  np.flatnonzero(c0 > 100))
+    assert q.explain().access_path == "index"
+    out = q.run()   # 42 is not > 100: the conjunction selects nothing
+    assert len(out["positions"]) == 0
+    q2 = Query(path, schema).where_eq(0, 42) \
+        .where(lambda c: c[1] > 0).select()
+    out2 = q2.run()
+    np.testing.assert_array_equal(
+        np.sort(out2["positions"]),
+        np.flatnonzero((c0 == 42) & (c1 > 0)))
 
 
 def test_corrupt_sidecar_falls_back_silently(table):
@@ -1020,3 +1028,57 @@ def test_composite_build_over_mesh_bit_identical(tmp_path):
     with open(meshp, "rb") as f:
         mesh_bytes = f.read()
     assert host_bytes == mesh_bytes
+
+
+def test_index_cond_plus_residual_filter(table):
+    """A structured filter composed with a residual where() keeps the
+    index access path and RECHECKS the residual on index-resolved rows
+    — parity with the seqscan across terminals (PG's Index Cond +
+    Filter shape)."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+
+    def q():
+        return Query(path, schema).where_range(0, 40, 60) \
+            .where(lambda cols: cols[1] > 0)
+
+    seq_agg = q().aggregate(cols=[1]).run()
+    seq_sel = q().select([1]).run()
+    build_index(path, schema, 0)
+    qa = q().aggregate(cols=[1])
+    plan = qa.explain()
+    assert plan.access_path == "index"
+    assert "RECHECKED" in plan.reason
+    ia = qa.run()
+    assert int(ia["count"]) == int(seq_agg["count"])
+    assert int(ia["sums"][0]) == int(seq_agg["sums"][0])
+    im = q().select([1]).run()
+    np.testing.assert_array_equal(np.sort(im["positions"]),
+                                  np.sort(seq_sel["positions"]))
+    # oracle
+    m = (c0 >= 40) & (c0 <= 60) & (c1 > 0)
+    assert int(ia["count"]) == int(m.sum())
+    # join face over the recheck
+    keys = np.arange(-500, 500, dtype=np.int32)
+    ij = q().join(1, keys, (keys * 3).astype(np.int32)).run()
+    assert int(ij["matched"]) == int((m & (c1 >= -500) & (c1 < 500)).sum())
+
+
+def test_residual_semantics_and_staleness(table):
+    """where() BEFORE any structured filter still replaces; a structured
+    setter after where() supersedes (and never leaves a stale residual
+    behind for the index recheck)."""
+    path, schema, c0, c1 = table
+    config.set("debug_no_threshold", True)
+    build_index(path, schema, 0)
+    # structured AFTER opaque: supersedes entirely
+    q = Query(path, schema).where(lambda cols: cols[1] > 0).where_eq(0, 57)
+    assert q._residual is None
+    out = q.aggregate(cols=[1]).run()
+    assert int(out["count"]) == int((c0 == 57).sum())
+    # structured, then residual, then a NEW structured: residual cleared
+    q2 = Query(path, schema).where_range(0, 40, 60) \
+        .where(lambda cols: cols[1] > 0).where_eq(0, 57)
+    assert q2._residual is None
+    out2 = q2.aggregate(cols=[1]).run()
+    assert int(out2["count"]) == int((c0 == 57).sum())

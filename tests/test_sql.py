@@ -306,3 +306,21 @@ def test_sql_review_fixes(table):
         with pytest.raises(StromError) as ei:
             sql_query(sql, path, schema)
         assert needle.lower() in str(ei.value).lower(), sql
+
+
+def test_sql_mixed_where_rides_the_index(table):
+    """A mixed WHERE (eq + residual) keeps the index path through SQL:
+    the first index-capable condition is the Index Cond, the rest
+    recheck."""
+    from nvme_strom_tpu.scan.index import build_index
+    path, schema, c0, c1, c2 = table
+    build_index(path, schema, 0)
+    q, _ = parse_sql("SELECT COUNT(*), SUM(c1) FROM t "
+                     "WHERE c0 = 7 AND c1 > 0", path, schema)
+    plan = q.explain()
+    assert plan.access_path == "index" and "RECHECKED" in plan.reason
+    out = sql_query("SELECT COUNT(*), SUM(c1) FROM t "
+                    "WHERE c0 = 7 AND c1 > 0", path, schema)
+    m = (c0 == 7) & (c1 > 0)
+    assert out["count(*)"] == int(m.sum())
+    assert out["sum(c1)"] == int(c1[m].sum())
