@@ -1588,7 +1588,7 @@ class Query:
         cols, limit, offset = self._select
         if cols is None:
             cols = list(range(self.schema.n_cols))
-        pos = self._index_positions(idx)
+        pos = self._index_positions(idx, session, device)
         # index rows were valid at build time and the table is stamped
         # unchanged; keep the defensive mask anyway — applied BEFORE the
         # offset/limit window, matching the seqscan's filter-then-slice
@@ -1622,18 +1622,21 @@ class Query:
         res["count"] = np.int64(len(res["positions"]))
         return res
 
-    def _index_positions(self, idx) -> np.ndarray:
+    def _index_positions(self, idx, session=None,
+                         device=None) -> np.ndarray:
         """Positions matching the structured filter via the sidecar —
         then RECHECKED against any residual :meth:`where` predicate
         (the PG Index Cond + Filter shape): the candidate rows' columns
-        are fetched once and the residual mask applied, so every index
-        runner downstream sees only fully-qualified rows."""
+        are fetched once (on the caller's session/device) and the
+        residual mask applied, so every index runner downstream sees
+        only fully-qualified rows."""
         pos = self._index_positions_cond(idx)
         if self._residual is None or len(pos) == 0:
             return pos
         pos = np.asarray(pos, np.int64)
         cols_all = list(range(self.schema.n_cols))
-        out = self.fetch(pos, cols=cols_all)
+        out = self.fetch(pos, cols=cols_all, session=session,
+                         device=device)
         colsd = {c: np.asarray(out[f"col{c}"]) for c in cols_all}
         mask = np.asarray(self._residual(colsd)).astype(bool).reshape(-1)
         # an invisible row's decoded values are garbage: never let the
@@ -1678,7 +1681,7 @@ class Query:
         local path's exactly."""
         col = self._order[0][0]
         self._check_sortable_col(col, self._op)
-        pos = self._index_positions(idx)
+        pos = self._index_positions(idx, session, device)
         out = self.fetch(pos, cols=[col], session=session, device=device)
         vals = out[f"col{col}"][np.asarray(out["valid"]).astype(bool)]
         if self._op == "count_distinct":
@@ -1704,7 +1707,7 @@ class Query:
         from ..ops.groupby import _check_agg_cols, acc_dtypes
         key_fn, g, agg, _having = self._group
         cols_idx, agg_dt = _check_agg_cols(self.schema, agg)
-        pos = self._index_positions(idx)
+        pos = self._index_positions(idx, session, device)
         # key_fn is an opaque lambda over ALL columns: fetch every column
         out = self.fetch(pos, session=session, device=device)
         keep = np.asarray(out["valid"]).astype(bool)
@@ -1747,7 +1750,7 @@ class Query:
         # the kernel path's exact build-side validation + sort (host
         # arrays; the probe column is int32 by that validation)
         keys, vals = _sorted_build(bk, bv, self.schema, probe_col)
-        pos_all = np.sort(self._index_positions(idx))
+        pos_all = np.sort(self._index_positions(idx, session, device))
 
         def probe_host(probe):
             if len(keys) == 0:
@@ -1830,7 +1833,7 @@ class Query:
         from ..ops.groupby import acc_dtypes
         agg_cols = list(self._agg_cols) if self._agg_cols is not None \
             else list(range(self.schema.n_cols))
-        pos = self._index_positions(idx)
+        pos = self._index_positions(idx, session, device)
         out = self.fetch(pos, cols=agg_cols, session=session,
                          device=device)
         keep = out["valid"]
@@ -1854,7 +1857,7 @@ class Query:
         from ..ops.topk import rank_topk
         col, k, largest = self._topk
         dt = self.schema.col_dtype(col)
-        pos = np.sort(self._index_positions(idx))
+        pos = np.sort(self._index_positions(idx, session, device))
         out = self.fetch(pos, cols=[col], session=session, device=device)
         keep = np.asarray(out["valid"]).astype(bool)
         vals = out[f"col{col}"][keep]
