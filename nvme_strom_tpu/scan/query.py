@@ -825,8 +825,12 @@ class Query:
         (single-column structured equality + single-column order_by over
         a DIFFERENT integer column), or None."""
         if (self._op != "order_by" or self._eq is None
+                or self._residual is not None
                 or isinstance(self._eq[0], (tuple, list))
                 or not isinstance(self.source, str)):
+            # a residual where() disqualifies the span shortcut: the
+            # prefix span is read straight off the sidecar with no row
+            # recheck, so it would silently ignore the predicate
             return None
         oc = self._order[0]
         if len(oc) != 1:

@@ -1082,3 +1082,28 @@ def test_residual_semantics_and_staleness(table):
     assert q2._residual is None
     out2 = q2.aggregate(cols=[1]).run()
     assert int(out2["count"]) == int((c0 == 57).sum())
+
+
+def test_residual_disqualifies_prefix_span_shortcut(tmp_path):
+    """WHERE c0 = v AND <residual> ORDER BY c1 must NOT ride the
+    composite prefix span (which never rechecks rows): the residual
+    falls it back to the sort path and the answer honors the
+    conjunction."""
+    rng = np.random.default_rng(3)
+    schema = HeapSchema(n_cols=3, visibility=False)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 8, n).astype(np.int32)
+    c1 = rng.integers(-100, 100, n).astype(np.int32)
+    c2 = rng.integers(0, 2, n).astype(np.int32)
+    path = str(tmp_path / "rs.heap")
+    build_heap_file(path, [c0, c1, c2], schema)
+    config.set("debug_no_threshold", True)
+    build_index(path, schema, (0, 1))
+    q = Query(path, schema).where_eq(0, 3) \
+        .where(lambda cols: cols[2] > 0) \
+        .order_by(1)
+    out = q.run()
+    m = (c0 == 3) & (c2 > 0)
+    np.testing.assert_array_equal(out["values"], np.sort(c1[m]))
+    np.testing.assert_array_equal(
+        np.sort(out["positions"]), np.flatnonzero(m))
