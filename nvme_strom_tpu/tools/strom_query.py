@@ -427,13 +427,14 @@ def main(argv=None) -> int:
             for k, v in out.items():
                 print(f"{k}: {np.array2string(np.asarray(v), threshold=32)}")
         return 0
-    if sum(bool(x) for x in (args.where, args.where_eq,
-                             args.where_range, args.where_in)) > 1:
-        ap.error("--where, --where-eq, --where-range and --where-in "
-                 "are exclusive")
-    if args.where:
-        q = q.where(_expr_fn(args.where, args.cols))
-    elif args.where_in:
+    if sum(bool(x) for x in (args.where_eq, args.where_range,
+                             args.where_in)) > 1:
+        ap.error("--where-eq, --where-range and --where-in are "
+                 "exclusive (one structured filter); --where composes "
+                 "with any of them as a residual")
+    # structured filter FIRST: a --where alongside it composes as a
+    # residual predicate the index path rechecks (Index Cond + Filter)
+    if args.where_in:
         colspec, _, vspec = args.where_in.partition(":")
         if not colspec.isdigit() or not vspec:
             ap.error("--where-in takes COL:V[,V...]")
@@ -468,6 +469,8 @@ def main(argv=None) -> int:
         except ValueError:
             ap.error("--where-eq takes COL:VALUE or C0,C1:V0,V1 "
                      "(numbers)")
+    if args.where:
+        q = q.where(_expr_fn(args.where, args.cols))
     if args.having and not (args.group_by or args.group_by_cols):
         ap.error("--having requires --group-by or --group-by-cols")
     if args.select:

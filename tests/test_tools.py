@@ -430,10 +430,14 @@ def test_strom_query_cli_where_eq_index_plan(tmp_path):
     res = json.loads(out.stdout.strip().splitlines()[-1])
     want = np.flatnonzero(c0 == 9)
     assert sorted(res["positions"]) == want.tolist()
-    # --where and --where-eq are exclusive
+    # --where now COMPOSES with --where-eq (Index Cond + Filter):
+    # the conjunction answer
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
-               "--where", "c0 > 1", "--where-eq", "0:9")
-    assert out.returncode != 0 and "exclusive" in out.stderr
+               "--where", "c1 > 0", "--where-eq", "0:9",
+               "--select", "all", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert sorted(res["positions"]) ==         np.flatnonzero((c0 == 9) & (c1 > 0)).tolist()
 
 
 def test_strom_query_cli_where_range(tmp_path):
@@ -457,10 +461,13 @@ def test_strom_query_cli_where_range(tmp_path):
                "--where-range", f"0:{n - 3}:", "--select", "all", "--json")
     res = json.loads(out.stdout.strip().splitlines()[-1])
     assert sorted(res["positions"]) == list(range(n - 3, n))
-    # exclusive with --where
+    # --where composes with --where-range (residual conjunction)
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "1",
-               "--where", "c0 > 1", "--where-range", "0:1:2")
-    assert out.returncode != 0 and "exclusive" in out.stderr
+               "--where", "c0 > 1", "--where-range", "0:1:2",
+               "--select", "all", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert sorted(res["positions"]) ==         np.flatnonzero((c0 >= 1) & (c0 <= 2) & (c0 > 1)).tolist()
 
 
 def test_tpu_stat_json_snapshot(data_file, tmp_path):
@@ -865,3 +872,36 @@ def test_strom_query_cli_analyze(tmp_path):
         ana = res["_analyze"]
         assert ana["elapsed_s"] > 0
         assert "kernel_dispatches" in ana and "submit_syscalls" in ana
+
+
+def test_strom_query_cli_where_composes_with_structured(tmp_path):
+    """--where alongside --where-eq composes as the index-path residual
+    (Index Cond + Filter from the CLI)."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.index import build_index
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(6)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 10, n).astype(np.int32)
+    c1 = rng.integers(-50, 50, n).astype(np.int32)
+    path = str(tmp_path / "w.heap")
+    build_heap_file(path, [c0, c1], schema)
+    build_index(path, schema, 0)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--where-eq", "0:3", "--where", "c1 > 0", "--explain")
+    assert out.returncode == 0, out.stderr
+    assert "index" in out.stdout and "RECHECKED" in out.stdout
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--where-eq", "0:3", "--where", "c1 > 0", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    m = (c0 == 3) & (c1 > 0)
+    assert res["count"] == int(m.sum())
+    # two structured flags stay exclusive
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--where-eq", "0:3", "--where-in", "0:1,2")
+    assert out.returncode != 0 and "exclusive" in out.stderr
