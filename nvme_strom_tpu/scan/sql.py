@@ -69,7 +69,8 @@ __all__ = ["parse_sql", "sql_query"]
 
 _TOKEN = re.compile(r"""
     \s*(?:
-      (?P<num>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\d+)
+      (?P<str>'[^']*')
+    | (?P<num>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\d+)
     | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
     | (?P<op><=|>=|!=|<>|==|=|<|>|\(|\)|,|\*|\.)
     )""", re.VERBOSE)
@@ -88,7 +89,9 @@ def _tokenize(sql: str) -> List[Tuple[str, str]]:
             raise StromError(22, f"SQL: cannot tokenize at "
                                  f"{sql[pos:pos + 20]!r}")
         pos = m.end()
-        if m.group("num") is not None:
+        if m.group("str") is not None:
+            out.append(("str", m.group("str")[1:-1]))
+        elif m.group("num") is not None:
             out.append(("num", m.group("num")))
         elif m.group("name") is not None:
             out.append(("name", m.group("name")))
@@ -147,10 +150,17 @@ def _col(tok: Tuple[str, str], n_cols: int) -> int:
     return c
 
 
+class _Str(str):
+    """Marker for a parsed SQL string literal ('...') — translated to
+    dictionary codes before the numeric machinery sees it."""
+
+
 def _lit(tok: Tuple[str, str]):
     kind, v = tok
+    if kind == "str":
+        return _Str(v)
     if kind != "num":
-        raise StromError(22, f"SQL: expected a numeric literal, got {v!r}")
+        raise StromError(22, f"SQL: expected a literal, got {v!r}")
     return float(v) if ("." in v or "e" in v or "E" in v) else int(v)
 
 
@@ -301,6 +311,121 @@ def _parse_having(p: _P, n_cols: int) -> List[tuple]:
         return out
 
 
+def _dict_cache(source):
+    """Per-statement dictionary loader: ``get(col) -> StringDict|None``
+    (missing sidecar = a plain numeric column; a STALE sidecar raises
+    EIO loudly — stale codes decode to WRONG strings)."""
+    cache: dict = {}
+
+    def get(c: int):
+        if c in cache:
+            return cache[c]
+        d = None
+        if isinstance(source, str):
+            from .strings import load_dict
+            try:
+                d = load_dict(source, c)
+            except FileNotFoundError:
+                d = None
+        cache[c] = d
+        return d
+    return get
+
+
+def _translate_string_conds(conds, dicts, schema) -> List[tuple]:
+    """Map string-literal conditions onto dictionary-code space BEFORE
+    the numeric filter machinery sees them: the dictionary is SORTED, so
+    codes preserve lexicographic order and =, !=, <, <=, >, >=, BETWEEN
+    and IN all translate exactly.  Absent strings become match-nothing
+    (empty IN) or drop out (!=), mirroring the unrepresentable-literal
+    rule for numerics."""
+    out = []
+    for cond in conds:
+        has_str = any(isinstance(x, _Str) for x in
+                      (cond[2:] if cond[0] != "in" else cond[2]))
+        c = cond[1]
+        if not has_str:
+            if dicts(c) is not None:
+                raise StromError(22, f"SQL: comparing c{c} (string "
+                                     f"column) with a number — use a "
+                                     f"'string' literal")
+            out.append(cond)
+            continue
+        d = dicts(c)
+        if d is None:
+            raise StromError(22, f"SQL: string literal against c{c}, "
+                                 f"which has no string dictionary "
+                                 f"(scan.strings.save_dict builds one)")
+        vals = np.asarray(d.values)
+        if cond[0] == "cmp":
+            _k, _c, op, lit = cond
+            if not isinstance(lit, _Str):
+                raise StromError(22, f"SQL: comparing c{c} (string "
+                                     f"column) with a number")
+            if op in ("=", "=="):
+                code = d.code_of(lit)
+                out.append(("cmp", c, "=", code) if code is not None
+                           else ("in", c, []))
+            elif op in ("!=", "<>"):
+                code = d.code_of(lit)
+                if code is not None:
+                    out.append(("cmp", c, "!=", code))
+                # absent: != 'x' matches every row; the cond drops out
+            elif op == "<":
+                hi = int(np.searchsorted(vals, str(lit), "left")) - 1
+                out.append(("between", c, 0, hi) if hi >= 0
+                           else ("in", c, []))
+            elif op == "<=":
+                hi = int(np.searchsorted(vals, str(lit), "right")) - 1
+                out.append(("between", c, 0, hi) if hi >= 0
+                           else ("in", c, []))
+            elif op == ">":
+                lo = int(np.searchsorted(vals, str(lit), "right"))
+                out.append(("between", c, lo, len(vals) - 1)
+                           if lo < len(vals) else ("in", c, []))
+            else:   # >=
+                lo = int(np.searchsorted(vals, str(lit), "left"))
+                out.append(("between", c, lo, len(vals) - 1)
+                           if lo < len(vals) else ("in", c, []))
+        elif cond[0] == "between":
+            _k, _c, lo, hi = cond
+            if not (isinstance(lo, _Str) and isinstance(hi, _Str)):
+                raise StromError(22, f"SQL: BETWEEN on c{c} mixes "
+                                     f"string and numeric bounds")
+            clo, chi = d.range_codes(lo, hi)
+            out.append(("between", c, clo, chi)
+                       if clo is not None and chi is not None
+                       and clo <= chi else ("in", c, []))
+        else:   # in
+            _k, _c, lits = cond
+            if not all(isinstance(x, _Str) for x in lits):
+                raise StromError(22, f"SQL: IN list on c{c} mixes "
+                                     f"strings and numbers")
+            codes = [d.code_of(x) for x in lits]
+            out.append(("in", c, [x for x in codes if x is not None]))
+    return out
+
+
+def _decode_strings(out: dict, dicts) -> dict:
+    """Result-edge decode: labels naming a dictionary column (``cN``,
+    ``min(cN)``, ``max(cN)``) turn codes back into strings."""
+    for label, v in list(out.items()):
+        m = re.fullmatch(r"(?:(min|max)\()?c(\d+)\)?", label)
+        if not m:
+            continue
+        d = dicts(int(m.group(2)))
+        if d is None:
+            continue
+        if v is None:
+            continue
+        arr = np.asarray(v)
+        if arr.ndim == 0:
+            out[label] = d.decode([int(arr)])[0]
+        else:
+            out[label] = d.decode(arr)
+    return out
+
+
 def _cmp_np(op: str):
     return {"=": np.equal, "==": np.equal, "!=": np.not_equal,
             "<>": np.not_equal, "<": np.less, "<=": np.less_equal,
@@ -389,8 +514,23 @@ def parse_sql(sql: str, source, schema,
               tables: Optional[dict] = None) -> Tuple[Query, "callable"]:
     """Parse *sql* against *source*/*schema*; returns ``(query,
     assemble)`` where ``assemble(run_result) -> dict`` relabels the
-    terminal's output into the statement's select-list names.
-    *tables* binds JOIN dimension names to ``(path, schema)``."""
+    terminal's output into the statement's select-list names — with
+    dictionary-encoded string columns decoded back to strings at the
+    edge.  *tables* binds JOIN dimension names to ``(path, schema)``."""
+    import inspect
+    q, assemble = _parse_sql_raw(sql, source, schema, tables=tables)
+    dicts = _dict_cache(source)
+
+    def assemble_decoded(res, **kw):
+        return _decode_strings(assemble(res, **kw), dicts)
+
+    assemble_decoded.__signature__ = inspect.signature(assemble)
+    return q, assemble_decoded
+
+
+def _parse_sql_raw(sql: str, source, schema,
+                   tables: Optional[dict] = None) -> Tuple[Query,
+                                                           "callable"]:
     n_cols = schema.n_cols
     p = _P(_tokenize(sql))
     p.expect_kw("select")
@@ -425,6 +565,8 @@ def parse_sql(sql: str, source, schema,
     elif how != "inner":
         raise StromError(22, "SQL: join type without JOIN")
     conds = _parse_where(p, n_cols) if p.kw("where") else []
+    dicts = _dict_cache(source)
+    conds = _translate_string_conds(conds, dicts, schema)
     group_cols: Optional[List[int]] = None
     if p.kw("group"):
         p.expect_kw("by")
@@ -577,6 +719,12 @@ def parse_sql(sql: str, source, schema,
             elif it.fn == "count" and it.col is None and not it.distinct:
                 pass
             elif it.fn in ("sum", "avg", "min", "max"):
+                if it.fn in ("sum", "avg") and dicts(it.col) is not None:
+                    raise StromError(22, f"SQL: {it.label} over a "
+                                         f"string column (codes would "
+                                         f"sum meaninglessly; MIN/MAX/"
+                                         f"COUNT are the string "
+                                         f"aggregates)")
                 if it.col not in agg_cols:
                     agg_cols.append(it.col)
             else:
@@ -711,6 +859,10 @@ def parse_sql(sql: str, source, schema,
     sum_cols: List[int] = []
     for it in aggs:
         if it.fn in ("sum", "avg"):
+            if dicts(it.col) is not None:
+                raise StromError(22, f"SQL: {it.label} over a string "
+                                     f"column (MIN/MAX/COUNT are the "
+                                     f"string aggregates)")
             if it.col not in sum_cols:
                 sum_cols.append(it.col)
         elif it.fn == "count" and it.col is None:

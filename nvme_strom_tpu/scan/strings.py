@@ -1,0 +1,152 @@
+"""Dictionary-encoded string columns over the numeric heap.
+
+The heap format is 4-byte numeric by design (the columnar layout that
+lets the XLA/Pallas kernels decode pages in registers).  Strings ride
+it as **sorted-dictionary codes**: a string column stores uint32 ranks
+into a per-column dictionary sidecar (``<table>.dict<col>``), and
+because the dictionary is SORTED, code order IS lexicographic string
+order — so every numeric machine the scan tier already has works on
+strings unchanged:
+
+* equality:  ``WHERE city = 'Berlin'``  -> ``code == rank('Berlin')``
+  (absent string -> match-nothing, the where_eq unrepresentable rule)
+* ranges:    ``WHERE city BETWEEN 'A' AND 'C'`` -> a code range via
+  ``np.searchsorted`` bounds (absent endpoints bind to their rank
+  position, preserving lexicographic semantics)
+* ORDER BY a string column = ordering by its codes
+* GROUP BY / index sidecars / joins on string keys: the codes are the
+  keys; results decode back to strings at the edge
+
+The dictionary is STATIC per table build (the reference's scan reads
+immutable-during-scan tables the same way); rewriting the table with
+new strings rewrites the sidecar.  Stamped against the table
+(size + mtime) like index sidecars, so a stale dictionary fails loudly
+instead of decoding garbage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import StromError
+
+__all__ = ["StringDict", "encode_strings", "dict_path_for",
+           "save_dict", "load_dict"]
+
+_MAGIC = "strom-strdict-v1"
+
+
+def dict_path_for(table_path: str, col: int) -> str:
+    return f"{table_path}.dict{int(col)}"
+
+
+class StringDict:
+    """A sorted string dictionary: ``code = rank`` (lexicographic)."""
+
+    def __init__(self, values: Sequence[str]):
+        vals = sorted(set(str(v) for v in values))
+        if len(vals) >= (1 << 32):
+            raise StromError(12, "string dictionary exceeds uint32 codes")
+        self.values: List[str] = vals
+        self._rank = {v: i for i, v in enumerate(vals)}
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def encode(self, strings) -> np.ndarray:
+        """uint32 codes; an unknown string raises (build-time API)."""
+        try:
+            return np.fromiter((self._rank[str(s)] for s in strings),
+                               np.uint32, count=len(strings))
+        except KeyError as e:
+            raise StromError(22, f"string {e.args[0]!r} not in the "
+                                 f"dictionary") from None
+
+    def code_of(self, s: str) -> Optional[int]:
+        """Rank of *s*, or None when absent (query-time equality: the
+        match-nothing rule, like an unrepresentable numeric literal)."""
+        return self._rank.get(str(s))
+
+    def range_codes(self, lo: Optional[str],
+                    hi: Optional[str]) -> Tuple[Optional[int],
+                                                Optional[int]]:
+        """Inclusive code bounds equivalent to the STRING range
+        ``lo <= s <= hi`` — absent endpoints bind via searchsorted so
+        lexicographic semantics hold exactly (e.g. hi='C' excludes
+        'Ca' but includes 'C' itself when present)."""
+        clo = None
+        if lo is not None:
+            clo = int(np.searchsorted(np.asarray(self.values), str(lo),
+                                      side="left"))
+        chi = None
+        if hi is not None:
+            chi = int(np.searchsorted(np.asarray(self.values), str(hi),
+                                      side="right")) - 1
+        return clo, chi
+
+    def decode(self, codes) -> np.ndarray:
+        codes = np.asarray(codes, np.int64).reshape(-1)
+        if len(codes) and (codes.min() < 0
+                           or codes.max() >= len(self.values)):
+            raise StromError(22, "code outside the dictionary (stale "
+                                 "sidecar?)")
+        return np.array([self.values[c] for c in codes], dtype=object)
+
+
+def encode_strings(strings) -> Tuple[np.ndarray, StringDict]:
+    """Build-time helper: ``(uint32 codes, dict)`` for a string column."""
+    d = StringDict(strings)
+    return d.encode(strings), d
+
+
+def _table_stamp(table_path: str) -> Tuple[int, int]:
+    st = os.stat(table_path)
+    return int(st.st_size), int(st.st_mtime_ns)
+
+
+def save_dict(table_path: str, col: int, d: StringDict) -> str:
+    """Write the sidecar, stamped against the CURRENT table file
+    (crash-safe tmp+rename, the index-sidecar discipline)."""
+    size, mtime = _table_stamp(table_path)
+    path = dict_path_for(table_path, col)
+    body = json.dumps({"magic": _MAGIC, "col": int(col),
+                       "table_size": size, "table_mtime_ns": mtime,
+                       "values": d.values})
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(body)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_dict(table_path: str, col: int, *,
+              check_stale: bool = True) -> StringDict:
+    """Load a column's dictionary; a table rewritten since the sidecar
+    was saved fails with EIO (stale codes decode to WRONG strings —
+    silent corruption, the one unforgivable failure)."""
+    path = dict_path_for(table_path, col)
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise
+    except (OSError, json.JSONDecodeError) as e:
+        raise StromError(5, f"string dictionary {path}: {e}") from e
+    if meta.get("magic") != _MAGIC or meta.get("col") != int(col):
+        raise StromError(5, f"string dictionary {path}: wrong header")
+    if check_stale:
+        size, mtime = _table_stamp(table_path)
+        if (meta.get("table_size"), meta.get("table_mtime_ns")) \
+                != (size, mtime):
+            raise StromError(5, f"string dictionary {path} is STALE "
+                                f"(table rewritten); rebuild it")
+    d = StringDict.__new__(StringDict)
+    d.values = [str(v) for v in meta["values"]]
+    d._rank = {v: i for i, v in enumerate(d.values)}
+    return d

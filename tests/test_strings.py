@@ -1,0 +1,143 @@
+"""Dictionary-encoded string columns: sorted-dict codes ride the numeric
+scan machinery (equality/range/ORDER BY/GROUP BY on strings), decode at
+the SQL edge, and stale sidecars fail loudly."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.api import StromError
+from nvme_strom_tpu.config import config
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+from nvme_strom_tpu.scan.sql import sql_query
+from nvme_strom_tpu.scan.strings import (StringDict, dict_path_for,
+                                         encode_strings, load_dict,
+                                         save_dict)
+
+CITIES = ["Berlin", "Amsterdam", "Chicago", "Berlin", "Austin",
+          "Boston", "Chicago", "Berlin"]
+
+
+@pytest.fixture()
+def table(tmp_path):
+    rng = np.random.default_rng(8)
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("uint32", "int32"))
+    n = schema.tuples_per_page * 2
+    names = [CITIES[i % len(CITIES)] for i in range(n)]
+    codes, d = encode_strings(names)
+    c1 = rng.integers(0, 100, n).astype(np.int32)
+    path = str(tmp_path / "s.heap")
+    build_heap_file(path, [codes, c1], schema)
+    save_dict(path, 0, d)
+    config.set("debug_no_threshold", True)
+    return path, schema, np.array(names, object), c1
+
+
+def test_dict_roundtrip_and_order():
+    codes, d = encode_strings(CITIES)
+    assert list(d.decode(codes)) == CITIES
+    # sorted dictionary: code order IS lexicographic order
+    assert d.values == sorted(set(CITIES))
+    assert d.code_of("nope") is None
+    lo, hi = d.range_codes("B", "Bz")
+    assert [d.values[c] for c in range(lo, hi + 1)] == \
+        ["Berlin", "Boston"]
+
+
+def test_sql_string_equality_and_group(table):
+    path, schema, names, c1 = table
+    out = sql_query("SELECT COUNT(*), SUM(c1) FROM t "
+                    "WHERE c0 = 'Berlin'", path, schema)
+    m = names == "Berlin"
+    assert out["count(*)"] == int(m.sum())
+    assert out["sum(c1)"] == int(c1[m].sum())
+    # absent string: match-nothing, not an error
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 = 'Nowhere'",
+                    path, schema)
+    assert out["count(*)"] == 0
+    # GROUP BY decodes the keys back to strings
+    out = sql_query("SELECT c0, COUNT(*) FROM t GROUP BY c0 "
+                    "ORDER BY COUNT(*) DESC LIMIT 3", path, schema)
+    uniq, counts = np.unique(names.astype(str), return_counts=True)
+    want = counts[np.argsort(counts, kind="stable")[::-1][:3]]
+    np.testing.assert_array_equal(out["count(*)"], want)
+    assert all(isinstance(x, str) for x in out["c0"])
+
+
+def test_sql_string_ranges_and_order(table):
+    path, schema, names, c1 = table
+    sn = names.astype(str)
+    out = sql_query("SELECT COUNT(*) FROM t "
+                    "WHERE c0 BETWEEN 'A' AND 'Bz'", path, schema)
+    m = (sn >= "A") & (sn <= "Bz")
+    assert out["count(*)"] == int(m.sum())
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 < 'Boston'",
+                    path, schema)
+    assert out["count(*)"] == int((sn < "Boston").sum())
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 >= 'Boston' "
+                    "AND c1 > 50", path, schema)
+    assert out["count(*)"] == int(((sn >= "Boston") & (c1 > 50)).sum())
+    out = sql_query("SELECT COUNT(*) FROM t "
+                    "WHERE c0 IN ('Austin', 'Boston', 'Nowhere')",
+                    path, schema)
+    assert out["count(*)"] == int(np.isin(sn, ["Austin", "Boston"]).sum())
+    # ORDER BY a string column = lexicographic, decoded
+    out = sql_query("SELECT c0 FROM t ORDER BY c0 LIMIT 5", path, schema)
+    np.testing.assert_array_equal(out["c0"], np.sort(sn)[:5])
+    # != present and absent strings
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 != 'Berlin'",
+                    path, schema)
+    assert out["count(*)"] == int((sn != "Berlin").sum())
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 <> 'Nowhere'",
+                    path, schema)
+    assert out["count(*)"] == len(sn)
+
+
+def test_sql_string_minmax_and_rejections(table):
+    path, schema, names, c1 = table
+    sn = names.astype(str)
+    assert sql_query("SELECT MAX(c0) FROM t", path,
+                     schema)["max(c0)"] == max(sn)
+    assert sql_query("SELECT MIN(c0) FROM t WHERE c1 > 50", path,
+                     schema)["min(c0)"] == min(sn[c1 > 50])
+    assert sql_query("SELECT COUNT(DISTINCT c0) FROM t", path,
+                     schema)["count(distinct c0)"] == len(set(sn))
+    for sql, needle in [
+        ("SELECT SUM(c0) FROM t", "string column"),
+        ("SELECT c0, AVG(c0) FROM t GROUP BY c0", "string column"),
+        ("SELECT COUNT(*) FROM t WHERE c0 = 5", "comparing"),
+        ("SELECT COUNT(*) FROM t WHERE c1 = 'x'", "no string dict"),
+        ("SELECT COUNT(*) FROM t WHERE c0 BETWEEN 'A' AND 5", "mixes"),
+        ("SELECT COUNT(*) FROM t WHERE c0 IN ('A', 5)", "mixes"),
+    ]:
+        with pytest.raises(StromError) as ei:
+            sql_query(sql, path, schema)
+        assert needle.lower() in str(ei.value).lower(), sql
+
+
+def test_string_index_scan(table):
+    """String equality rides a sidecar on the CODE column."""
+    from nvme_strom_tpu.scan.index import build_index
+    from nvme_strom_tpu.scan.sql import parse_sql
+    path, schema, names, c1 = table
+    build_index(path, schema, 0)
+    q, _ = parse_sql("SELECT COUNT(*) FROM t WHERE c0 = 'Chicago'",
+                     path, schema)
+    assert q.explain().access_path == "index"
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 = 'Chicago'",
+                    path, schema)
+    assert out["count(*)"] == int((names.astype(str) == "Chicago").sum())
+
+
+def test_stale_dict_fails_loudly(table):
+    path, schema, names, c1 = table
+    codes2, d2 = encode_strings(["x"] * len(names))
+    build_heap_file(path, [codes2,
+                           np.zeros(len(names), np.int32)], schema)
+    with pytest.raises(StromError) as ei:
+        sql_query("SELECT COUNT(*) FROM t WHERE c0 = 'Berlin'",
+                  path, schema)
+    assert "STALE" in str(ei.value)
+    with pytest.raises(StromError):
+        load_dict(path, 0)
+    assert load_dict(path, 0, check_stale=False).values
