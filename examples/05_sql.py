@@ -64,6 +64,22 @@ def main() -> int:
                       f.name, schema)
         except Exception as e:
             print(f"   {e}")
+
+        print("-- string columns: sorted-dictionary codes")
+        from nvme_strom_tpu.scan.strings import encode_strings, save_dict
+        cities = ["Berlin", "Austin", "Chicago", "Berlin", "Boston"]
+        codes, cdict = encode_strings(
+            [cities[i % len(cities)] for i in range(n)])
+        sschema = HeapSchema(n_cols=2, visibility=False,
+                             dtypes=("uint32", "int32"))
+        with tempfile.NamedTemporaryFile(suffix=".heap") as sf:
+            build_heap_file(sf.name, [codes, c1], sschema)
+            save_dict(sf.name, 0, cdict)
+            out = sql_query("SELECT c0, COUNT(*) FROM t "
+                            "WHERE c0 BETWEEN 'B' AND 'Bz' "
+                            "GROUP BY c0", sf.name, sschema)
+            for i in range(len(out["c0"])):
+                print(f"   {out['c0'][i]:<8} n={out['count(*)'][i]}")
     return 0
 
 
