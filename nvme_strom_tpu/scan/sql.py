@@ -57,6 +57,7 @@ Mapping (each SQL shape -> the Query terminal that serves it):
 
 from __future__ import annotations
 
+import os
 import re
 from typing import List, Optional, Sequence, Tuple
 
@@ -641,6 +642,18 @@ def _parse_sql_raw(sql: str, source, schema,
                                  "list")
         if not 0 <= key_col < dschema.n_cols:
             raise StromError(22, f"SQL: {dname}.c{key_col} out of range")
+        # two string columns carry codes from SEPARATE dictionaries —
+        # joining them would compare incomparable ranks and silently
+        # return wrong rows; refuse until the tables share an encoding
+        if dicts(probe_col) is not None or (
+                isinstance(dpath, str) and os.path.exists(
+                    __import__("nvme_strom_tpu.scan.strings",
+                               fromlist=["dict_path_for"])
+                    .dict_path_for(dpath, key_col))):
+            raise StromError(22, "SQL: JOIN on string-dictionary "
+                                 "columns is outside this subset "
+                                 "(separate dictionaries make codes "
+                                 "incomparable)")
         for it in items:
             if it.table is not None and it.table != dname:
                 raise StromError(22, f"SQL: unknown table {it.table!r}")

@@ -141,3 +141,20 @@ def test_stale_dict_fails_loudly(table):
     with pytest.raises(StromError):
         load_dict(path, 0)
     assert load_dict(path, 0, check_stale=False).values
+
+
+def test_string_join_rejected(table, tmp_path):
+    """Joining two string-dictionary columns is refused: separate
+    dictionaries make codes incomparable (silent wrong rows otherwise)."""
+    path, schema, names, c1 = table
+    dschema = HeapSchema(n_cols=2, visibility=False,
+                         dtypes=("uint32", "int32"))
+    dcodes, dd = encode_strings(["Berlin", "Boston"])
+    dpath = str(tmp_path / "dim.heap")
+    build_heap_file(dpath, [dcodes, np.arange(2, dtype=np.int32)],
+                    dschema)
+    save_dict(dpath, 0, dd)
+    with pytest.raises(StromError) as ei:
+        sql_query("SELECT COUNT(*) FROM t JOIN d ON c0 = d.c0",
+                  path, schema, tables={"d": (dpath, dschema)})
+    assert "incomparable" in str(ei.value)
