@@ -645,11 +645,10 @@ def _parse_sql_raw(sql: str, source, schema,
         # two string columns carry codes from SEPARATE dictionaries —
         # joining them would compare incomparable ranks and silently
         # return wrong rows; refuse until the tables share an encoding
+        from .strings import dict_path_for
         if dicts(probe_col) is not None or (
-                isinstance(dpath, str) and os.path.exists(
-                    __import__("nvme_strom_tpu.scan.strings",
-                               fromlist=["dict_path_for"])
-                    .dict_path_for(dpath, key_col))):
+                isinstance(dpath, str)
+                and os.path.exists(dict_path_for(dpath, key_col))):
             raise StromError(22, "SQL: JOIN on string-dictionary "
                                  "columns is outside this subset "
                                  "(separate dictionaries make codes "
