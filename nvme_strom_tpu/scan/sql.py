@@ -29,9 +29,12 @@ materialized rows (the probe column and ``dim.cK``).
     select_list := '*' | item (',' item)*
     item  := cN | COUNT(*) | COUNT(DISTINCT cN)
            | SUM(cN) | AVG(cN) | MIN(cN) | MAX(cN)
+    where := term (OR term)* ; term := factor (AND factor)*
+    factor := '(' where ')' | cond       -- SQL precedence, parens group
     cond  := cN cmp literal | literal cmp cN
            | cN BETWEEN lit AND lit | cN IN (lit[, lit]...)
     cmp   := = | == | != | <> | < | <= | > | >=
+    literal := number | 'string'   (strings need a dictionary sidecar)
 
 Columns are named ``c0..cN-1`` (the CLI convention).  The mapping is
 exact, never approximate: a statement outside the subset raises EINVAL
@@ -50,9 +53,11 @@ Mapping (each SQL shape -> the Query terminal that serves it):
   keys discovered; HAVING composes)
 * ORDER BY c [DESC] [LIMIT]      -> ``order_by`` (sidecar-served when
   fresh)
-* WHERE: the first index-capable condition becomes a STRUCTURED filter
-  (``where_eq`` / ``where_range`` / ``where_in`` — the planner can ride
-  a sidecar); the rest fold into a residual predicate lambda.
+* WHERE: the first index-capable LEAF of a top-level AND becomes a
+  STRUCTURED filter (``where_eq`` / ``where_range`` / ``where_in`` —
+  the planner can ride a sidecar); the rest of the tree — remaining
+  conjuncts, OR subtrees — composes as the residual predicate the
+  index path RECHECKS (Index Cond + Filter).
 """
 
 from __future__ import annotations
@@ -240,49 +245,64 @@ def self_is_call(p: _P) -> bool:
     return p.i + 1 < len(p.toks) and p.toks[p.i + 1] == ("op", "(")
 
 
-def _parse_where(p: _P, n_cols: int) -> List[tuple]:
-    """List of conds: ("cmp", col, op, lit) | ("between", col, lo, hi) |
-    ("in", col, [lits])."""
-    conds = []
-    while True:
-        t = p.next()
-        if t[0] == "num":   # literal cmp col -> flip
-            lit = _lit(t)
-            op = p.next()
-            if op[0] != "op" or op[1] not in _CMPS:
-                raise StromError(22, f"SQL: expected comparison, got "
-                                     f"{op[1]!r}")
-            c = _col(p.next(), n_cols)
-            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-            conds.append(("cmp", c, flip.get(op[1], op[1]), lit))
-        else:
-            c = _col(t, n_cols)
-            if p.kw("between"):
-                lo = _lit(p.next())
-                p.expect_kw("and")
-                hi = _lit(p.next())
-                conds.append(("between", c, lo, hi))
-            elif p.kw("in"):
-                p.expect_op("(")
-                lits = [_lit(p.next())]
-                while p.peek() == ("op", ","):
-                    p.next()
-                    lits.append(_lit(p.next()))
-                p.expect_op(")")
-                conds.append(("in", c, lits))
-            else:
-                op = p.next()
-                if op[0] != "op" or op[1] not in _CMPS:
-                    raise StromError(22, f"SQL: expected comparison, "
-                                         f"got {op[1]!r}")
-                conds.append(("cmp", c, op[1], _lit(p.next())))
-        if p.kw("and"):
-            continue
-        if p.peek() and p.peek()[0] == "name" \
-                and p.peek()[1].lower() == "or":
-            raise StromError(22, "SQL: OR is outside this subset "
-                                 "(AND-conjunctions only)")
-        return conds
+def _parse_cond_leaf(p: _P, n_cols: int) -> tuple:
+    """One comparison: ("cmp", col, op, lit) | ("between", col, lo, hi)
+    | ("in", col, [lits])."""
+    t = p.next()
+    if t[0] in ("num", "str"):   # literal cmp col -> flip
+        lit = _lit(t)
+        op = p.next()
+        if op[0] != "op" or op[1] not in _CMPS:
+            raise StromError(22, f"SQL: expected comparison, got "
+                                 f"{op[1]!r}")
+        c = _col(p.next(), n_cols)
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        return ("cmp", c, flip.get(op[1], op[1]), lit)
+    c = _col(t, n_cols)
+    if p.kw("between"):
+        lo = _lit(p.next())
+        p.expect_kw("and")
+        hi = _lit(p.next())
+        return ("between", c, lo, hi)
+    if p.kw("in"):
+        p.expect_op("(")
+        lits = [_lit(p.next())]
+        while p.peek() == ("op", ","):
+            p.next()
+            lits.append(_lit(p.next()))
+        p.expect_op(")")
+        return ("in", c, lits)
+    op = p.next()
+    if op[0] != "op" or op[1] not in _CMPS:
+        raise StromError(22, f"SQL: expected comparison, got {op[1]!r}")
+    return ("cmp", c, op[1], _lit(p.next()))
+
+
+def _parse_where(p: _P, n_cols: int):
+    """Condition TREE with SQL precedence (AND binds tighter than OR;
+    parentheses group): ("leaf", cond) | ("and", [t..]) | ("or", [t..]).
+    """
+    def factor():
+        if p.peek() == ("op", "("):
+            p.next()
+            t = expr()
+            p.expect_op(")")
+            return t
+        return ("leaf", _parse_cond_leaf(p, n_cols))
+
+    def term():
+        fs = [factor()]
+        while p.kw("and"):
+            fs.append(factor())
+        return fs[0] if len(fs) == 1 else ("and", fs)
+
+    def expr():
+        ts = [term()]
+        while p.kw("or"):
+            ts.append(term())
+        return ts[0] if len(ts) == 1 else ("or", ts)
+
+    return expr()
 
 
 def _parse_having(p: _P, n_cols: int) -> List[tuple]:
@@ -333,78 +353,77 @@ def _dict_cache(source):
     return get
 
 
-def _translate_string_conds(conds, dicts, schema) -> List[tuple]:
-    """Map string-literal conditions onto dictionary-code space BEFORE
-    the numeric filter machinery sees them: the dictionary is SORTED, so
-    codes preserve lexicographic order and =, !=, <, <=, >, >=, BETWEEN
-    and IN all translate exactly.  Absent strings become match-nothing
-    (empty IN) or drop out (!=), mirroring the unrepresentable-literal
-    rule for numerics."""
-    out = []
-    for cond in conds:
-        has_str = any(isinstance(x, _Str) for x in
-                      (cond[2:] if cond[0] != "in" else cond[2]))
-        c = cond[1]
-        if not has_str:
-            if dicts(c) is not None:
-                raise StromError(22, f"SQL: comparing c{c} (string "
-                                     f"column) with a number — use a "
-                                     f"'string' literal")
-            out.append(cond)
-            continue
-        d = dicts(c)
-        if d is None:
-            raise StromError(22, f"SQL: string literal against c{c}, "
-                                 f"which has no string dictionary "
-                                 f"(scan.strings.save_dict builds one)")
-        vals = np.asarray(d.values)
-        if cond[0] == "cmp":
-            _k, _c, op, lit = cond
-            if not isinstance(lit, _Str):
-                raise StromError(22, f"SQL: comparing c{c} (string "
-                                     f"column) with a number")
-            if op in ("=", "=="):
-                code = d.code_of(lit)
-                out.append(("cmp", c, "=", code) if code is not None
-                           else ("in", c, []))
-            elif op in ("!=", "<>"):
-                code = d.code_of(lit)
-                if code is not None:
-                    out.append(("cmp", c, "!=", code))
-                # absent: != 'x' matches every row; the cond drops out
-            elif op == "<":
-                hi = int(np.searchsorted(vals, str(lit), "left")) - 1
-                out.append(("between", c, 0, hi) if hi >= 0
-                           else ("in", c, []))
-            elif op == "<=":
-                hi = int(np.searchsorted(vals, str(lit), "right")) - 1
-                out.append(("between", c, 0, hi) if hi >= 0
-                           else ("in", c, []))
-            elif op == ">":
-                lo = int(np.searchsorted(vals, str(lit), "right"))
-                out.append(("between", c, lo, len(vals) - 1)
-                           if lo < len(vals) else ("in", c, []))
-            else:   # >=
-                lo = int(np.searchsorted(vals, str(lit), "left"))
-                out.append(("between", c, lo, len(vals) - 1)
-                           if lo < len(vals) else ("in", c, []))
-        elif cond[0] == "between":
-            _k, _c, lo, hi = cond
-            if not (isinstance(lo, _Str) and isinstance(hi, _Str)):
-                raise StromError(22, f"SQL: BETWEEN on c{c} mixes "
-                                     f"string and numeric bounds")
-            clo, chi = d.range_codes(lo, hi)
-            out.append(("between", c, clo, chi)
-                       if clo is not None and chi is not None
-                       and clo <= chi else ("in", c, []))
-        else:   # in
-            _k, _c, lits = cond
-            if not all(isinstance(x, _Str) for x in lits):
-                raise StromError(22, f"SQL: IN list on c{c} mixes "
-                                     f"strings and numbers")
-            codes = [d.code_of(x) for x in lits]
-            out.append(("in", c, [x for x in codes if x is not None]))
-    return out
+def _translate_cond(cond, dicts) -> Optional[tuple]:
+    """One leaf onto dictionary-code space (see the module docstring);
+    None = the leaf is vacuously TRUE (``!= 'absent string'``)."""
+    has_str = any(isinstance(x, _Str) for x in
+                  (cond[2:] if cond[0] != "in" else cond[2]))
+    c = cond[1]
+    if not has_str:
+        if dicts(c) is not None:
+            raise StromError(22, f"SQL: comparing c{c} (string "
+                                 f"column) with a number — use a "
+                                 f"'string' literal")
+        return cond
+    d = dicts(c)
+    if d is None:
+        raise StromError(22, f"SQL: string literal against c{c}, "
+                             f"which has no string dictionary "
+                             f"(scan.strings.save_dict builds one)")
+    vals = np.asarray(d.values)
+    if cond[0] == "cmp":
+        _k, _c, op, lit = cond
+        if not isinstance(lit, _Str):
+            raise StromError(22, f"SQL: comparing c{c} (string "
+                                 f"column) with a number")
+        if op in ("=", "=="):
+            code = d.code_of(lit)
+            return ("cmp", c, "=", code) if code is not None                 else ("in", c, [])
+        if op in ("!=", "<>"):
+            code = d.code_of(lit)
+            return ("cmp", c, "!=", code) if code is not None else None
+        if op == "<":
+            hi = int(np.searchsorted(vals, str(lit), "left")) - 1
+            return ("between", c, 0, hi) if hi >= 0 else ("in", c, [])
+        if op == "<=":
+            hi = int(np.searchsorted(vals, str(lit), "right")) - 1
+            return ("between", c, 0, hi) if hi >= 0 else ("in", c, [])
+        if op == ">":
+            lo = int(np.searchsorted(vals, str(lit), "right"))
+            return ("between", c, lo, len(vals) - 1)                 if lo < len(vals) else ("in", c, [])
+        lo = int(np.searchsorted(vals, str(lit), "left"))
+        return ("between", c, lo, len(vals) - 1)             if lo < len(vals) else ("in", c, [])
+    if cond[0] == "between":
+        _k, _c, lo, hi = cond
+        if not (isinstance(lo, _Str) and isinstance(hi, _Str)):
+            raise StromError(22, f"SQL: BETWEEN on c{c} mixes "
+                                 f"string and numeric bounds")
+        clo, chi = d.range_codes(lo, hi)
+        return ("between", c, clo, chi)             if clo is not None and chi is not None and clo <= chi             else ("in", c, [])
+    _k, _c, lits = cond
+    if not all(isinstance(x, _Str) for x in lits):
+        raise StromError(22, f"SQL: IN list on c{c} mixes "
+                             f"strings and numbers")
+    codes = [d.code_of(x) for x in lits]
+    return ("in", c, [x for x in codes if x is not None])
+
+
+def _translate_tree(tree, dicts):
+    """Translate every leaf; vacuously-true leaves simplify out (a true
+    child erases an OR, drops from an AND).  None = no filter at all."""
+    if tree is None:
+        return None
+    kind = tree[0]
+    if kind == "leaf":
+        cond = _translate_cond(tree[1], dicts)
+        return None if cond is None else ("leaf", cond)
+    kids = [_translate_tree(t, dicts) for t in tree[1]]
+    if kind == "or" and any(k is None for k in kids):
+        return None
+    kids = [k for k in kids if k is not None]
+    if not kids:
+        return None
+    return kids[0] if len(kids) == 1 else (kind, kids)
 
 
 def _decode_strings(out: dict, dicts) -> dict:
@@ -433,55 +452,74 @@ def _cmp_np(op: str):
             ">": np.greater, ">=": np.greater_equal}[op]
 
 
-def _apply_where(q: Query, conds: List[tuple]) -> Query:
-    """The FIRST index-capable condition becomes a structured filter
-    (the planner can ride a sidecar); the remaining conjunction composes
-    as a residual ``where`` predicate, which the index path RECHECKS on
-    index-resolved rows (Query's Index Cond + Filter shape) — so a
-    mixed WHERE keeps index access instead of demoting to a seqscan."""
-    structured = None
-    residual = []
-    for cond in conds:
-        if structured is None and cond[0] == "cmp" \
-                and cond[2] in ("=", "=="):
-            structured = ("eq", cond)
-        elif structured is None and cond[0] == "between":
-            structured = ("range", cond)
-        elif structured is None and cond[0] == "in":
-            structured = ("in", cond)
+def _leaf_mask(cond, cols):
+    """jnp mask for one leaf condition."""
+    import jax.numpy as jnp
+    if cond[0] == "cmp":
+        _, c, op, lit = cond
+        fns = {"=": jnp.equal, "==": jnp.equal,
+               "!=": jnp.not_equal, "<>": jnp.not_equal,
+               "<": jnp.less, "<=": jnp.less_equal,
+               ">": jnp.greater, ">=": jnp.greater_equal}
+        return fns[op](cols[c], lit)
+    if cond[0] == "between":
+        _, c, lo, hi = cond
+        return (cols[c] >= lo) & (cols[c] <= hi)
+    _, c, lits = cond
+    import jax.numpy as jnp
+    one = jnp.zeros(cols[c].shape, bool)
+    for v in lits:
+        one = one | (cols[c] == v)
+    return one
+
+
+def _tree_mask(tree, cols):
+    if tree[0] == "leaf":
+        return _leaf_mask(tree[1], cols)
+    masks = [_tree_mask(t, cols) for t in tree[1]]
+    out = masks[0]
+    for m in masks[1:]:
+        out = (out & m) if tree[0] == "and" else (out | m)
+    return out
+
+
+def _promotable(cond) -> bool:
+    return (cond[0] == "cmp" and cond[2] in ("=", "=="))         or cond[0] in ("between", "in")
+
+
+def _promote(q: Query, cond) -> Query:
+    if cond[0] == "cmp":
+        return q.where_eq(cond[1], cond[3])
+    if cond[0] == "between":
+        return q.where_range(cond[1], cond[2], cond[3])
+    return q.where_in(cond[1], cond[2])
+
+
+def _apply_where(q: Query, tree) -> Query:
+    """The first index-capable LEAF of a top-level AND (or a sole leaf)
+    becomes a STRUCTURED filter the planner can serve from a sidecar;
+    everything else — the rest of the conjunction, or any OR tree —
+    composes as a residual ``where`` predicate the index path RECHECKS
+    on index-resolved rows (Query's Index Cond + Filter shape)."""
+    if tree is None:
+        return q
+    rest = None
+    if tree[0] == "leaf" and _promotable(tree[1]):
+        q = _promote(q, tree[1])
+    elif tree[0] == "and":
+        kids = list(tree[1])
+        pick = next((i for i, k in enumerate(kids)
+                     if k[0] == "leaf" and _promotable(k[1])), None)
+        if pick is None:
+            rest = tree
         else:
-            residual.append(cond)
-    if structured is not None:
-        kind, cond = structured
-        if kind == "eq":
-            q = q.where_eq(cond[1], cond[3])
-        elif kind == "range":
-            q = q.where_range(cond[1], cond[2], cond[3])
-        else:
-            q = q.where_in(cond[1], cond[2])
-    if residual:
-        def pred(cols, residual=residual):
-            import jax.numpy as jnp
-            m = None
-            for cond in residual:
-                if cond[0] == "cmp":
-                    _, c, op, lit = cond
-                    fns = {"=": jnp.equal, "==": jnp.equal,
-                           "!=": jnp.not_equal, "<>": jnp.not_equal,
-                           "<": jnp.less, "<=": jnp.less_equal,
-                           ">": jnp.greater, ">=": jnp.greater_equal}
-                    one = fns[op](cols[c], lit)
-                elif cond[0] == "between":
-                    _, c, lo, hi = cond
-                    one = (cols[c] >= lo) & (cols[c] <= hi)
-                else:
-                    _, c, lits = cond
-                    one = jnp.zeros(cols[c].shape, bool)
-                    for v in lits:
-                        one = one | (cols[c] == v)
-                m = one if m is None else m & one
-            return m
-        q = q.where(pred)
+            q = _promote(q, kids[pick][1])
+            kids = kids[:pick] + kids[pick + 1:]
+            rest = kids[0] if len(kids) == 1 else ("and", kids)
+    else:
+        rest = tree
+    if rest is not None:
+        q = q.where(lambda cols, rest=rest: _tree_mask(rest, cols))
     return q
 
 
@@ -565,9 +603,9 @@ def _parse_sql_raw(sql: str, source, schema,
         join = (how, dn[1], sides[None], sides[dn[1]])
     elif how != "inner":
         raise StromError(22, "SQL: join type without JOIN")
-    conds = _parse_where(p, n_cols) if p.kw("where") else []
+    where_tree = _parse_where(p, n_cols) if p.kw("where") else None
     dicts = _dict_cache(source)
-    conds = _translate_string_conds(conds, dicts, schema)
+    where_tree = _translate_tree(where_tree, dicts)
     group_cols: Optional[List[int]] = None
     if p.kw("group"):
         p.expect_kw("by")
@@ -623,7 +661,7 @@ def _parse_sql_raw(sql: str, source, schema,
             if it.table is not None:
                 raise StromError(22, f"SQL: {it.label} references a "
                                      f"table with no JOIN")
-    q = _apply_where(Query(source, schema), conds)
+    q = _apply_where(Query(source, schema), where_tree)
     off = offset or 0
 
     # --- JOIN -------------------------------------------------------------

@@ -131,7 +131,9 @@ def test_sql_mesh_mode(table):
 def test_sql_rejects_out_of_subset(table):
     path, schema, *_ = table
     bad = [
-        ("SELECT c0 FROM t WHERE c0 = 1 OR c1 = 2", "OR"),
+        ("SELECT c0 FROM t WHERE c0 = 1 OR", "end of statement"),
+        ("SELECT c0 FROM t WHERE (c0 = 1 OR c1 = 2",
+         "end of statement"),
         ("SELECT c9 FROM t", "out of range"),
         ("SELECT c0, SUM(c1) FROM t", "GROUP BY"),
         # mixed-dtype aggregation set (int32 SUM + float32 HAVING SUM)
@@ -286,6 +288,39 @@ def test_sql_join_rejections(joined):
         with pytest.raises(StromError) as ei:
             sql_query(sql, fpath, fschema, tables=tables)
         assert needle.lower() in str(ei.value).lower(), sql
+
+
+def test_sql_or_and_parentheses(table):
+    """OR with SQL precedence (AND binds tighter) and parentheses; a
+    top-level AND still promotes its first index-capable leaf with the
+    OR tree as the recheck residual."""
+    from nvme_strom_tpu.scan.index import build_index
+    from nvme_strom_tpu.scan.sql import parse_sql
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT COUNT(*) FROM t "
+                    "WHERE c0 = 7 OR c0 = 9", path, schema)
+    assert out["count(*)"] == int(((c0 == 7) | (c0 == 9)).sum())
+    # precedence: a OR b AND c == a OR (b AND c)
+    out = sql_query("SELECT COUNT(*) FROM t "
+                    "WHERE c0 = 7 OR c0 = 9 AND c1 > 0", path, schema)
+    assert out["count(*)"] == int(
+        ((c0 == 7) | ((c0 == 9) & (c1 > 0))).sum())
+    # parentheses override
+    out = sql_query("SELECT COUNT(*) FROM t "
+                    "WHERE (c0 = 7 OR c0 = 9) AND c1 > 0", path, schema)
+    m = ((c0 == 7) | (c0 == 9)) & (c1 > 0)
+    assert out["count(*)"] == int(m.sum())
+    # index-capable leaf of a top-level AND promotes; OR tree rechecks
+    build_index(path, schema, 1)
+    q, _ = parse_sql("SELECT COUNT(*) FROM t "
+                     "WHERE c1 BETWEEN 0 AND 50 AND "
+                     "(c0 = 7 OR c0 = 9)", path, schema)
+    plan = q.explain()
+    assert plan.access_path == "index" and "RECHECKED" in plan.reason
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c1 BETWEEN 0 AND 50 "
+                    "AND (c0 = 7 OR c0 = 9)", path, schema)
+    assert out["count(*)"] == int(
+        ((c1 >= 0) & (c1 <= 50) & ((c0 == 7) | (c0 == 9))).sum())
 
 
 def test_sql_review_fixes(table):
