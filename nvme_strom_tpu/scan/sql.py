@@ -30,7 +30,7 @@ materialized rows (the probe column and ``dim.cK``).
     item  := cN | COUNT(*) | COUNT(DISTINCT cN)
            | SUM(cN) | AVG(cN) | MIN(cN) | MAX(cN)
     where := term (OR term)* ; term := factor (AND factor)*
-    factor := '(' where ')' | cond       -- SQL precedence, parens group
+    factor := NOT factor | '(' where ')' | cond   -- SQL precedence
     cond  := cN cmp literal | literal cmp cN
            | cN BETWEEN lit AND lit | cN IN (lit[, lit]...)
     cmp   := = | == | != | <> | < | <= | > | >=
@@ -283,6 +283,8 @@ def _parse_where(p: _P, n_cols: int):
     parentheses group): ("leaf", cond) | ("and", [t..]) | ("or", [t..]).
     """
     def factor():
+        if p.kw("not"):
+            return ("not", [factor()])
         if p.peek() == ("op", "("):
             p.next()
             t = expr()
@@ -418,6 +420,11 @@ def _translate_tree(tree, dicts):
         cond = _translate_cond(tree[1], dicts)
         return None if cond is None else ("leaf", cond)
     kids = [_translate_tree(t, dicts) for t in tree[1]]
+    if kind == "not":
+        # NOT over a vacuously-true child is vacuously FALSE: keep a
+        # match-nothing leaf so the truth value survives simplification
+        return ("not", kids) if kids[0] is not None \
+            else ("leaf", ("in", 0, []))
     if kind == "or" and any(k is None for k in kids):
         return None
     kids = [k for k in kids if k is not None]
@@ -476,6 +483,8 @@ def _leaf_mask(cond, cols):
 def _tree_mask(tree, cols):
     if tree[0] == "leaf":
         return _leaf_mask(tree[1], cols)
+    if tree[0] == "not":
+        return ~_tree_mask(tree[1][0], cols)
     masks = [_tree_mask(t, cols) for t in tree[1]]
     out = masks[0]
     for m in masks[1:]:

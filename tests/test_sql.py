@@ -359,3 +359,18 @@ def test_sql_mixed_where_rides_the_index(table):
     m = (c0 == 7) & (c1 > 0)
     assert out["count(*)"] == int(m.sum())
     assert out["sum(c1)"] == int(c1[m].sum())
+
+
+def test_sql_not(table):
+    path, schema, c0, c1, c2 = table
+    out = sql_query("SELECT COUNT(*) FROM t WHERE NOT c0 = 7",
+                    path, schema)
+    assert out["count(*)"] == int((c0 != 7).sum())
+    out = sql_query("SELECT COUNT(*) FROM t "
+                    "WHERE NOT (c0 = 7 OR c0 = 9) AND c1 > 0",
+                    path, schema)
+    assert out["count(*)"] == int(
+        (~((c0 == 7) | (c0 == 9)) & (c1 > 0)).sum())
+    out = sql_query("SELECT COUNT(*) FROM t WHERE NOT NOT c0 = 7",
+                    path, schema)
+    assert out["count(*)"] == int((c0 == 7).sum())
