@@ -88,6 +88,25 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
     sq_zero = sq_np.type(0)
 
     float_mxu = agg_dt.kind == "f" and not jax.config.jax_enable_x64
+    # Mosaic cannot reduce UNSIGNED integers ("Reductions over unsigned
+    # integers not implemented") — the uint32 path therefore computes in
+    # order/wrap-preserving int32 BIT-SPACE on device: sums accumulate
+    # int32 bits (two's-complement wraparound == uint32 wraparound, so
+    # the acc_dtypes mod-2^32 contract holds exactly) and min/max work
+    # on sign-bit-XORed values (u32 order == i32 order after the flip);
+    # run() bitcasts the outputs back.  x64 widens to 64-bit
+    # accumulators where the same trick would need int64 SMEM — the
+    # interpret path serves that (no-x64 is the TPU configuration).
+    uint_bits = agg_dt.kind == "u" and not jax.config.jax_enable_x64
+    if uint_bits:
+        # stored representations on device: int32 bits for sums (wrap-
+        # exact), sign-flipped int32 for min/max (order-preserving);
+        # sentinels are the flipped images of hi=uint32max / lo=0
+        store_acc, store_col = jnp.int32, jnp.int32
+        ref_hi, ref_lo = np.int32((1 << 31) - 1), np.int32(-(1 << 31))
+    else:
+        store_acc, store_col = acc_t, col_t
+        ref_hi, ref_lo = hi, lo
 
     def make_kernel(n_params: int):
       def kernel(params_ref, w_ref, count_ref, sums_ref, sumsqs_ref,
@@ -108,8 +127,8 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                     for vi in range(V):
                         sums_ref[vi, g] = zero
                         sumsqs_ref[vi, g] = sq_zero
-                        mins_ref[vi, g] = hi
-                        maxs_ref[vi, g] = lo
+                        mins_ref[vi, g] = ref_hi
+                        maxs_ref[vi, g] = ref_lo
 
         params = [params_ref[k] for k in range(n_params)]
         cols, valid = _decode_block(w_ref[...], schema)
@@ -195,24 +214,47 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
                 count_ref[0, g] += jnp.sum(m.astype(jnp.int32))
                 for vi, ci in enumerate(cols_idx):
                     v = cols[ci]
-                    vf = v.astype(sq_t)
-                    sums_ref[vi, g] += jnp.sum(
-                        jnp.where(m, v, agg_dt.type(0)).astype(acc_t))
+                    if uint_bits:
+                        # Mosaic lacks the uint32->float cast too:
+                        # decompose through int32 halves (hi bit + low
+                        # 31 bits), both of which cast fine
+                        lo31 = jax.lax.bitcast_convert_type(
+                            v & jnp.uint32(0x7FFFFFFF),
+                            jnp.int32).astype(sq_t)
+                        hib = jax.lax.bitcast_convert_type(
+                            v >> 31, jnp.int32).astype(sq_t)
+                        vf = hib * sq_t.type(2.0 ** 31) + lo31
+                    else:
+                        vf = v.astype(sq_t)
+                    if uint_bits:   # int32 bit-space sum (wrap-exact)
+                        v32 = jax.lax.bitcast_convert_type(v, jnp.int32)
+                        sums_ref[vi, g] += jnp.sum(
+                            jnp.where(m, v32, jnp.int32(0)))
+                    else:
+                        sums_ref[vi, g] += jnp.sum(
+                            jnp.where(m, v,
+                                      agg_dt.type(0)).astype(acc_t))
                     # floating accumulator (shared sumsqs contract:
                     # int32 squares would wrap far earlier than sums)
                     sumsqs_ref[vi, g] += jnp.sum(
                         jnp.where(m, vf * vf, sq_zero))
         if not float_mxu:
             # integer min/max: per-group masked reductions (the float
-            # path vectorized them off the one-hot above)
+            # path vectorized them off the one-hot above); unsigned
+            # values compare in sign-flipped int32 space
             for g in range(G):
                 m = sel & (keys == g)
                 for vi, ci in enumerate(cols_idx):
                     v = cols[ci]
+                    if uint_bits:   # sign-flip: u32 order in i32 space
+                        v = jax.lax.bitcast_convert_type(
+                            v ^ jnp.uint32(1 << 31), jnp.int32)
                     mins_ref[vi, g] = jnp.minimum(
-                        mins_ref[vi, g], jnp.min(jnp.where(m, v, hi)))
+                        mins_ref[vi, g],
+                        jnp.min(jnp.where(m, v, ref_hi)))
                     maxs_ref[vi, g] = jnp.maximum(
-                        maxs_ref[vi, g], jnp.max(jnp.where(m, v, lo)))
+                        maxs_ref[vi, g],
+                        jnp.max(jnp.where(m, v, ref_lo)))
       return kernel
 
     @jax.jit
@@ -245,13 +287,19 @@ def make_groupby_fn_pallas(schema: HeapSchema, key_fn: Callable,
             out_shape=[
                 jax.ShapeDtypeStruct((G,) if float_mxu else (1, G),
                                      jnp.int32),
-                jax.ShapeDtypeStruct((V, G), acc_t),
+                jax.ShapeDtypeStruct((V, G), store_acc),
                 jax.ShapeDtypeStruct((V, G), sq_t),
-                jax.ShapeDtypeStruct((V, G), col_t),
-                jax.ShapeDtypeStruct((V, G), col_t),
+                jax.ShapeDtypeStruct((V, G), store_col),
+                jax.ShapeDtypeStruct((V, G), store_col),
             ],
             interpret=_should_interpret() if interpret is None else interpret,
         )(pvec, words)
+        if uint_bits:
+            sums = jax.lax.bitcast_convert_type(sums, jnp.uint32)
+            mins = jax.lax.bitcast_convert_type(
+                mins, jnp.uint32) ^ jnp.uint32(1 << 31)
+            maxs = jax.lax.bitcast_convert_type(
+                maxs, jnp.uint32) ^ jnp.uint32(1 << 31)
         return {"count": count if float_mxu else count[0],
                 "sums": sums, "sumsqs": sumsqs,
                 "mins": mins, "maxs": maxs}
