@@ -26,9 +26,11 @@ it exceeds ``join_broadcast_max``) and serves both faces: aggregates —
 matched build payload — or, with plain columns in the SELECT list, the
 materialized rows (the probe column and ``dim.cK``).
 
-    select_list := '*' | item (',' item)*
+    select_list := [DISTINCT] '*' | item [AS name] (',' item [AS name])*
     item  := cN | COUNT(*) | COUNT(DISTINCT cN)
            | SUM(cN) | AVG(cN) | MIN(cN) | MAX(cN)
+    -- SELECT DISTINCT cols == GROUP BY the select list (keys only);
+    -- ORDER BY takes cN[, cM] (later keys break ties) outside GROUP BY
     where := term (OR term)* ; term := factor (AND factor)*
     factor := NOT factor | '(' where ')' | cond   -- SQL precedence
     cond  := cN cmp literal | literal cmp cN
@@ -179,6 +181,7 @@ class _Item:
                  label="", table=None):
         self.kind, self.fn, self.col = kind, fn, col
         self.distinct, self.label, self.table = distinct, label, table
+        self.alias = None   # AS name: relabels the output
 
 
 def _colref(p: _P, n_cols: int) -> Tuple[Optional[str], int]:
@@ -234,6 +237,11 @@ def _parse_select_list(p: _P, n_cols: int) -> Optional[List[_Item]]:
             tbl, c = _colref(p, n_cols)
             label = f"{tbl}.c{c}" if tbl else f"c{c}"
             items.append(_Item("col", col=c, label=label, table=tbl))
+        if p.kw("as"):
+            alias = p.next()
+            if alias[0] != "name":
+                raise StromError(22, "SQL: AS needs a name")
+            items[-1].alias = alias[1]
         if p.peek() == ("op", ","):
             p.next()
             continue
@@ -566,23 +574,31 @@ def parse_sql(sql: str, source, schema,
     dictionary-encoded string columns decoded back to strings at the
     edge.  *tables* binds JOIN dimension names to ``(path, schema)``."""
     import inspect
-    q, assemble = _parse_sql_raw(sql, source, schema, tables=tables)
+    aliases: dict = {}
+    q, assemble = _parse_sql_raw(sql, source, schema, tables=tables,
+                                 _aliases_out=aliases)
     dicts = _dict_cache(source)
 
     def assemble_decoded(res, **kw):
-        return _decode_strings(assemble(res, **kw), dicts)
+        out = _decode_strings(assemble(res, **kw), dicts)
+        return {aliases.get(k, k): v for k, v in out.items()}
 
     assemble_decoded.__signature__ = inspect.signature(assemble)
     return q, assemble_decoded
 
 
 def _parse_sql_raw(sql: str, source, schema,
-                   tables: Optional[dict] = None) -> Tuple[Query,
-                                                           "callable"]:
+                   tables: Optional[dict] = None,
+                   _aliases_out: Optional[dict] = None
+                   ) -> Tuple[Query, "callable"]:
     n_cols = schema.n_cols
     p = _P(_tokenize(sql))
     p.expect_kw("select")
+    select_distinct = p.kw("distinct")
     items = _parse_select_list(p, n_cols)
+    if _aliases_out is not None and items:
+        _aliases_out.update({it.label: it.alias for it in items
+                             if it.alias})
     p.expect_kw("from")
     t = p.next()
     if t[0] != "name":
@@ -647,7 +663,12 @@ def _parse_sql_raw(sql: str, source, schema,
             p.expect_op(")")
             okey = ("agg", fn, ocol)
         else:
-            okey = ("col", _col(p.next(), n_cols))
+            ocols = [_col(p.next(), n_cols)]
+            while p.peek() == ("op", ","):
+                p.next()
+                ocols.append(_col(p.next(), n_cols))
+            okey = ("col", ocols[0]) if len(ocols) == 1 \
+                else ("cols", ocols)
         desc = False
         if p.kw("desc"):
             desc = True
@@ -670,6 +691,19 @@ def _parse_sql_raw(sql: str, source, schema,
             if it.table is not None:
                 raise StromError(22, f"SQL: {it.label} references a "
                                      f"table with no JOIN")
+    if select_distinct:
+        if items is None or any(it.kind != "col" or it.table is not None
+                                for it in items):
+            raise StromError(22, "SQL: SELECT DISTINCT takes 1-2 plain "
+                                 "fact columns")
+        if group_cols is not None or join is not None:
+            raise StromError(22, "SQL: SELECT DISTINCT with GROUP BY/"
+                                 "JOIN is outside this subset")
+        seen: List[int] = []
+        for it in items:
+            if it.col not in seen:
+                seen.append(it.col)
+        group_cols = seen      # DISTINCT == GROUP BY the select list
     q = _apply_where(Query(source, schema), where_tree)
     off = offset or 0
 
@@ -799,6 +833,9 @@ def _parse_sql_raw(sql: str, source, schema,
                 and order[0][2] is not None \
                 and order[0][2] not in agg_cols:
             agg_cols.append(order[0][2])
+        if order is not None and order[0][0] == "cols":
+            raise StromError(22, "SQL: multi-key ORDER BY on grouped "
+                                 "results is outside this subset")
         if order is not None and order[0][0] == "col" \
                 and order[0][1] not in group_cols:
             raise StromError(22, f"SQL: ORDER BY c{order[0][1]} is "
@@ -846,10 +883,11 @@ def _parse_sql_raw(sql: str, source, schema,
     # --- ORDER BY ---------------------------------------------------------
     if order is not None:
         okey, desc = order
-        if okey[0] != "col":
+        if okey[0] == "agg":
             raise StromError(22, "SQL: ORDER BY an aggregate requires "
                                  "GROUP BY")
-        oc = okey[1]
+        ocols = [okey[1]] if okey[0] == "col" else list(okey[1])
+        oc = ocols[0]
         extra: List[int] = []
         if items is not None:
             for it in items:
@@ -860,7 +898,7 @@ def _parse_sql_raw(sql: str, source, schema,
                     extra.append(it.col)
         else:
             extra = [c for c in range(n_cols) if c != oc]
-        q = q.order_by([oc], descending=desc, limit=limit, offset=off)
+        q = q.order_by(ocols, descending=desc, limit=limit, offset=off)
         labels = [it.label for it in items] if items is not None else \
             [f"c{c}" for c in range(n_cols)]
 

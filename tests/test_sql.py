@@ -374,3 +374,31 @@ def test_sql_not(table):
     out = sql_query("SELECT COUNT(*) FROM t WHERE NOT NOT c0 = 7",
                     path, schema)
     assert out["count(*)"] == int((c0 == 7).sum())
+
+
+def test_sql_distinct_alias_multikey_order(table):
+    path, schema, c0, c1, c2 = table
+    # SELECT DISTINCT == GROUP BY the select list, keys only
+    out = sql_query("SELECT DISTINCT c0 FROM t WHERE c1 > 0",
+                    path, schema)
+    np.testing.assert_array_equal(out["c0"], np.unique(c0[c1 > 0]))
+    out = sql_query("SELECT DISTINCT c0 FROM t ORDER BY c0 DESC LIMIT 4",
+                    path, schema)
+    np.testing.assert_array_equal(out["c0"], np.unique(c0)[::-1][:4])
+    # AS aliases relabel outputs
+    out = sql_query("SELECT COUNT(*) AS n, SUM(c1) AS total FROM t",
+                    path, schema)
+    assert out["n"] == len(c0) and out["total"] == int(c1.sum())
+    out = sql_query("SELECT c0 AS grp, COUNT(*) AS n FROM t "
+                    "GROUP BY c0 ORDER BY COUNT(*) DESC LIMIT 2",
+                    path, schema)
+    assert len(out["grp"]) == 2 and len(out["n"]) == 2
+    # multi-key ORDER BY: later columns break ties
+    out = sql_query("SELECT c0, c1 FROM t ORDER BY c0, c1 LIMIT 20",
+                    path, schema)
+    order = np.lexsort((c1, c0))[:20]
+    np.testing.assert_array_equal(out["c0"], c0[order])
+    np.testing.assert_array_equal(out["c1"], c1[order])
+    with pytest.raises(StromError):
+        sql_query("SELECT c0 FROM t GROUP BY c0 ORDER BY c0, c1",
+                  path, schema)
