@@ -392,8 +392,8 @@ class Query:
         self._require_no_terminal()
         cols_ = [int(c) for c in (key_cols if isinstance(
             key_cols, (tuple, list)) else [key_cols])]
-        if not 1 <= len(cols_) <= 2:
-            raise StromError(22, "group_by_cols takes 1 or 2 key columns")
+        if not 1 <= len(cols_) <= 4:
+            raise StromError(22, "group_by_cols takes 1-4 key columns")
         for c in cols_:
             if not 0 <= c < self.schema.n_cols:
                 raise StromError(22, f"group_by_cols column {c} out of "
@@ -422,7 +422,7 @@ class Query:
         cols_, agg, user_having, max_groups = self._group_cols
         dts = [self.schema.col_dtype(c) for c in cols_]
         discovered = None
-        if isinstance(self.source, str):
+        if isinstance(self.source, str) and len(cols_) <= 2:
             # fresh sidecar shortcut: the distinct keys are the sorted
             # sidecar's uniques — zero table I/O.  Composite (c0, c1)
             # sidecars serve PAIR grouping the same way (their packed
@@ -445,18 +445,28 @@ class Query:
         if discovered is None:
             gather, _f, _d = self._make_gather_fn(cols_,
                                                   want_positions=False)
-            merged = np.zeros(0, np.uint64 if len(cols_) == 2
-                              else dts[0])
+            nk = len(cols_)
+            if nk <= 2:
+                merged = np.zeros(0, np.uint64 if nk == 2 else dts[0])
+            else:   # N-column keys: (k, N) row array, lexicographic
+                merged = np.zeros((0, nk), np.int64)
 
             def collect(pages_dev):
                 nonlocal merged
                 out = gather(pages_dev)
                 m = np.asarray(out["mask"]).astype(bool)
                 vs = [np.asarray(out[f"f{i}"])[m]
-                      for i in range(len(cols_))]
-                u = np.unique(vs[0]) if len(cols_) == 1 else \
-                    np.unique(pack_pair(vs[0], vs[1], dts[0], dts[1]))
-                merged = np.union1d(merged, u)
+                      for i in range(nk)]
+                if nk == 1:
+                    merged = np.union1d(merged, np.unique(vs[0]))
+                elif nk == 2:
+                    merged = np.union1d(merged, np.unique(
+                        pack_pair(vs[0], vs[1], dts[0], dts[1])))
+                else:
+                    u = np.unique(np.stack(
+                        [v.astype(np.int64) for v in vs], 1), axis=0)
+                    merged = np.unique(
+                        np.concatenate([merged, u]), axis=0)
                 if len(merged) > max_groups:
                     raise StromError(
                         12, f"group_by_cols: more than {max_groups} "
@@ -481,7 +491,7 @@ class Query:
 
             n_groups = max(g, 1)
             self._gk_decode = lambda gids, keys=keys: [keys[gids]]
-        else:
+        elif len(cols_) == 2:
             packed = discovered                      # sorted uint64
             g = len(packed)
             hi = (packed >> np.uint64(32))
@@ -519,6 +529,48 @@ class Query:
             n_groups = g + 1
             self._gk_decode = lambda gids, k0=k0, k1=k1: [k0[gids],
                                                           k1[gids]]
+
+        if len(cols_) >= 3:
+            krows = discovered.astype(np.int64)      # (g, N) lex-sorted
+            g = len(krows)
+            uniqs = [np.unique(krows[:, j]) for j in range(len(cols_))]
+            dims = [max(len(u), 1) for u in uniqs]
+            total = 1
+            for dnn in dims:
+                total *= dnn
+            if total > (1 << 22):
+                raise StromError(
+                    12, "group_by_cols: dense rank table over 4M "
+                        "entries; use group_by with a key function")
+            # mixed-radix flat table: rank tuple -> group id (sentinel
+            # g for combinations that never occur / masked rows)
+            table = np.full(total, g, np.int32)
+            if g:
+                flat = np.zeros(g, np.int64)
+                for j in range(len(cols_)):
+                    flat = flat * dims[j] + np.searchsorted(
+                        uniqs[j], krows[:, j])
+                table[flat] = np.arange(g, dtype=np.int32)
+            ujs = [jnp.asarray(u.astype(np.int64).astype(np.int32)
+                               if dts[j].kind == "i"
+                               else u.astype(np.uint32))
+                   for j, u in enumerate(uniqs)]
+            tjN = jnp.asarray(table)
+
+            def key_fn(cols, ujs=ujs, tjN=tjN, dims=tuple(dims)):
+                if ujs[0].shape[0] == 0:
+                    return jnp.zeros(cols[cols_[0]].shape, jnp.int32)
+                flat = None
+                for j, cj in enumerate(cols_):
+                    r = jnp.clip(jnp.searchsorted(ujs[j], cols[cj]), 0,
+                                 max(ujs[j].shape[0] - 1, 0))
+                    flat = r if flat is None else flat * dims[j] + r
+                return tjN[flat].astype(jnp.int32)
+
+            n_groups = g + 1
+            self._gk_decode = lambda gids, krows=krows, dts=dts: [
+                krows[:, j][gids].astype(dts[j])
+                for j in range(len(cols_))]
 
         def hv(res, user=user_having):
             m = np.asarray(res["count"]) > 0

@@ -1455,7 +1455,7 @@ def test_group_by_cols_mesh_matches_local(heap):
 def test_group_by_cols_validation(heap):
     path, schema, c0, c1, vis = heap
     with pytest.raises(StromError):
-        Query(path, schema).group_by_cols([0, 1, 0])   # 3 cols
+        Query(path, schema).group_by_cols([0, 1, 0, 1, 0])   # 5 cols
     with pytest.raises(StromError):
         Query(path, schema).group_by_cols(7)           # out of range
     with pytest.raises(StromError):
@@ -1489,3 +1489,42 @@ def test_group_by_cols_pair_sidecar_discovery(tmp_path):
     for i in (0, 1):
         np.testing.assert_array_equal(idx["key_cols"][i],
                                       base["key_cols"][i])
+
+
+def test_group_by_cols_three_columns(tmp_path):
+    """3-column value-keyed GROUP BY (mixed-radix rank table): keys and
+    aggregates match the numpy oracle, local and mesh."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    rng = np.random.default_rng(41)
+    schema = HeapSchema(n_cols=4, visibility=False,
+                        dtypes=("int32", "uint32", "int32", "int32"))
+    n = schema.tuples_per_page * 6
+    c0 = rng.integers(-3, 3, n).astype(np.int32)
+    c1 = rng.integers(0, 4, n).astype(np.uint32)
+    c2 = rng.integers(0, 3, n).astype(np.int32)
+    c3 = rng.integers(0, 100, n).astype(np.int32)
+    path = str(tmp_path / "t3.heap")
+    build_heap_file(path, [c0, c1, c2, c3], schema)
+    config.set("debug_no_threshold", True)
+    out = Query(path, schema).group_by_cols([0, 1, 2],
+                                            agg_cols=[3]).run()
+    rows = sorted({(int(a), int(b), int(d))
+                   for a, b, d in zip(c0, c1, c2)})
+    got = list(zip(out["key_cols"][0].tolist(),
+                   out["key_cols"][1].tolist(),
+                   out["key_cols"][2].tolist()))
+    assert got == rows
+    for i, (a, b, d) in enumerate(rows):
+        m = (c0 == a) & (c1 == b) & (c2 == d)
+        assert int(out["count"][i]) == int(m.sum())
+        assert int(out["sums"][0][i]) == int(c3[m].sum())
+    assert out["key_cols"][1].dtype == np.uint32
+    mesh = make_scan_mesh(jax.devices())
+    dist = Query(path, schema).group_by_cols([0, 1, 2], agg_cols=[3]) \
+        .run(mesh=mesh, batch_pages=12)
+    np.testing.assert_array_equal(dist["count"], out["count"])
+    np.testing.assert_array_equal(dist["sums"], out["sums"])
+    with pytest.raises(StromError):
+        Query(path, schema).group_by_cols([0, 1, 2, 3, 0])  # 5 keys
