@@ -402,3 +402,27 @@ def test_sql_distinct_alias_multikey_order(table):
     with pytest.raises(StromError):
         sql_query("SELECT c0 FROM t GROUP BY c0 ORDER BY c0, c1",
                   path, schema)
+
+
+def test_sql_group_by_three_columns(tmp_path):
+    rng = np.random.default_rng(51)
+    schema = HeapSchema(n_cols=4, visibility=False)
+    n = schema.tuples_per_page * 4
+    cols = [rng.integers(0, k, n).astype(np.int32) for k in (3, 4, 2)]
+    c3 = rng.integers(0, 50, n).astype(np.int32)
+    path = str(tmp_path / "g3.heap")
+    build_heap_file(path, cols + [c3], schema)
+    config.set("debug_no_threshold", True)
+    out = sql_query("SELECT c0, c1, c2, COUNT(*), SUM(c3) FROM t "
+                    "GROUP BY c0, c1, c2 HAVING COUNT(*) > 5",
+                    path, schema)
+    rows = {}
+    for a, b, d, v in zip(*cols, c3):
+        rows.setdefault((int(a), int(b), int(d)), []).append(int(v))
+    want = sorted(k for k, vs in rows.items() if len(vs) > 5)
+    got = list(zip(out["c0"].tolist(), out["c1"].tolist(),
+                   out["c2"].tolist()))
+    assert got == want
+    for i, k in enumerate(want):
+        assert out["count(*)"][i] == len(rows[k])
+        assert out["sum(c3)"][i] == sum(rows[k])
