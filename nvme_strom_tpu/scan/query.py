@@ -1691,14 +1691,23 @@ class Query:
             return pos
         pos = np.asarray(pos, np.int64)
         cols_all = list(range(self.schema.n_cols))
-        out = self.fetch(pos, cols=cols_all, session=session,
-                         device=device)
-        colsd = {c: np.asarray(out[f"col{c}"]) for c in cols_all}
-        mask = np.asarray(self._residual(colsd)).astype(bool).reshape(-1)
-        # an invisible row's decoded values are garbage: never let the
-        # residual resurrect one (downstream keeps would drop it anyway;
-        # COUNT-style runners trust the position list)
-        return pos[mask & np.asarray(out["valid"]).astype(bool)]
+        # batched recheck: host memory stays bounded to one batch of
+        # candidate rows however large the index cond's result is
+        keep_parts = []
+        batch = 1 << 16
+        for b0 in range(0, len(pos), batch):
+            pb = pos[b0:b0 + batch]
+            out = self.fetch(pb, cols=cols_all, session=session,
+                             device=device)
+            colsd = {c: np.asarray(out[f"col{c}"]) for c in cols_all}
+            mask = np.asarray(self._residual(colsd)) \
+                .astype(bool).reshape(-1)
+            # an invisible row's decoded values are garbage: never let
+            # the residual resurrect one (downstream keeps would drop it
+            # anyway; COUNT-style runners trust the position list)
+            keep_parts.append(
+                pb[mask & np.asarray(out["valid"]).astype(bool)])
+        return np.concatenate(keep_parts)
 
     def _index_positions_cond(self, idx) -> np.ndarray:
         """The structured (index-cond) half of :meth:`_index_positions`."""
