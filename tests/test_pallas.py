@@ -340,3 +340,38 @@ def test_groupby_agg_col_out_of_range_clean_error():
     schema = HeapSchema(n_cols=2, visibility=False)
     with pytest.raises(ValueError, match="out of range"):
         make_groupby_fn(schema, lambda cols: cols[0], 4, agg_cols=[9])
+
+
+def test_uint32_groupby_bitspace_large_values():
+    """The device path computes uint32 aggregates in int32 bit-space
+    (Mosaic cannot reduce unsigned): values crossing 2^31 must keep
+    exact wrap-mod-2^32 sums and correct unsigned min/max ordering."""
+    from nvme_strom_tpu.ops.groupby import acc_dtypes, make_groupby_fn
+    from nvme_strom_tpu.ops.groupby_pallas import make_groupby_fn_pallas
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_pages
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("uint32", "int32"))
+    rng = np.random.default_rng(13)
+    n = schema.tuples_per_page * 8
+    # values straddling the sign bit, plus extremes
+    vals = rng.integers(0, 2**32, n, dtype=np.uint64).astype(np.uint32)
+    vals[0], vals[1] = np.uint32(0), np.uint32(2**32 - 1)
+    cat = (np.arange(n) % 4).astype(np.int32)
+    pages = build_pages([vals, cat], schema)
+    outs = []
+    for make in (make_groupby_fn, make_groupby_fn_pallas):
+        run = make(schema, lambda cols: cols[1], 4, agg_cols=[0])
+        outs.append({k: np.asarray(v) for k, v in run(pages).items()})
+    xla, pal = outs
+    np.testing.assert_array_equal(pal["count"], xla["count"])
+    np.testing.assert_array_equal(pal["sums"], xla["sums"])
+    np.testing.assert_array_equal(pal["mins"], xla["mins"])
+    np.testing.assert_array_equal(pal["maxs"], xla["maxs"])
+    assert pal["sums"].dtype.kind == "u"
+    # oracle: exact mod-2^32 per group, unsigned ordering
+    for g in range(4):
+        m = cat == g
+        assert int(pal["sums"][0][g]) == \
+            int(vals[m].sum(dtype=np.uint64) & np.uint64(0xFFFFFFFF))
+        assert int(pal["mins"][0][g]) == int(vals[m].min())
+        assert int(pal["maxs"][0][g]) == int(vals[m].max())
