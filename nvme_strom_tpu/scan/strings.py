@@ -94,7 +94,8 @@ class StringDict:
                            or codes.max() >= len(self.values)):
             raise StromError(22, "code outside the dictionary (stale "
                                  "sidecar?)")
-        return np.array([self.values[c] for c in codes], dtype=object)
+        # vectorized take: a SELECT face can decode millions of rows
+        return np.array(self.values, dtype=object)[codes]
 
 
 def encode_strings(strings) -> Tuple[np.ndarray, StringDict]:
