@@ -73,7 +73,7 @@ import numpy as np
 from ..api import StromError
 from .query import Query
 
-__all__ = ["parse_sql", "sql_query"]
+__all__ = ["parse_sql", "sql_query", "create_table_as"]
 
 _TOKEN = re.compile(r"""
     \s*(?:
@@ -998,3 +998,66 @@ def sql_query(sql: str, source, schema, tables: Optional[dict] = None,
     if isinstance(res, dict) and "_analyze" in res:
         out["_analyze"] = res["_analyze"]   # EXPLAIN ANALYZE face
     return out
+
+
+def create_table_as(dest_path: str, sql: str, source, schema,
+                    tables: Optional[dict] = None, **run_kw):
+    """CREATE TABLE AS: run *sql* and materialize its result as a NEW
+    heap table at *dest_path* (the ETL face — derived tables requery
+    with the full scan machinery, indexes and SQL included).
+
+    Every equal-length result column becomes a table column in
+    select-list order: int results land as int32 (range-checked, never
+    silently wrapped), uint as uint32, floats as float32, and STRING
+    columns re-encode with a fresh sorted dictionary saved as the new
+    table's sidecar.  Scalar aggregate results build a 1-row table.
+    ``positions`` (row provenance) is dropped.  Returns
+    ``(dest_schema, n_rows)``."""
+    from .heap import HeapSchema as _HS, build_heap_file
+    from .strings import StringDict, save_dict
+    out = sql_query(sql, source, schema, tables=tables, **run_kw)
+    out.pop("_analyze", None)
+    out.pop("positions", None)
+    out.pop("matched", None)       # the LEFT row face's NULL indicator
+    cols, dts, dict_cols = [], [], {}
+    n_rows = None
+    for label, v in out.items():
+        arr = np.asarray(v) if not np.isscalar(v) and v is not None \
+            else np.asarray([0 if v is None else v])
+        arr = arr.reshape(-1)
+        if n_rows is None:
+            n_rows = len(arr)
+        elif len(arr) != n_rows:
+            raise StromError(22, f"CREATE TABLE AS: column {label!r} "
+                                 f"has {len(arr)} rows, expected "
+                                 f"{n_rows} (mixed result faces)")
+        if arr.dtype.kind == "O":      # strings: fresh dictionary
+            d = StringDict(arr.tolist())
+            dict_cols[len(cols)] = d
+            cols.append(d.encode(arr.tolist()))
+            dts.append("uint32")
+        elif arr.dtype.kind == "f":
+            cols.append(arr.astype(np.float32))
+            dts.append("float32")
+        elif arr.dtype.kind == "u":
+            if len(arr) and int(arr.max()) > 0xFFFFFFFF:
+                raise StromError(34, f"CREATE TABLE AS: {label!r} "
+                                     f"exceeds uint32")
+            cols.append(arr.astype(np.uint32))
+            dts.append("uint32")
+        else:
+            if len(arr) and (int(arr.min()) < -(1 << 31)
+                             or int(arr.max()) >= (1 << 31)):
+                raise StromError(34, f"CREATE TABLE AS: {label!r} "
+                                     f"exceeds int32")
+            cols.append(arr.astype(np.int32))
+            dts.append("int32")
+    if not cols:
+        raise StromError(22, "CREATE TABLE AS: the statement returned "
+                             "no columns")
+    dest_schema = _HS(n_cols=len(cols), visibility=False,
+                      dtypes=tuple(dts))
+    build_heap_file(dest_path, cols, dest_schema)
+    for c, d in dict_cols.items():
+        save_dict(dest_path, c, d)
+    return dest_schema, n_rows

@@ -247,6 +247,11 @@ def main(argv=None) -> int:
                          "c0..cN-1; FROM name is nominal — the "
                          "positional file is the table); exclusive "
                          "with the per-flag query builders")
+    ap.add_argument("--sql-create", default=None, metavar="DEST",
+                    help="with --sql: CREATE TABLE AS — materialize the "
+                         "statement's result as a new heap table at "
+                         "DEST (string columns re-encoded with fresh "
+                         "dictionaries)")
     ap.add_argument("--sql-table", action="append", default=[],
                     metavar="NAME=PATH:NCOLS",
                     help="bind a JOIN dimension table for --sql "
@@ -313,6 +318,18 @@ def main(argv=None) -> int:
             tables[name] = (tpath,
                             HeapSchema(n_cols=int(ncols),
                                        visibility=False))
+        if args.sql_create:
+            from ..scan.sql import create_table_as
+            try:
+                dsch, n = create_table_as(args.sql_create, args.sql,
+                                          src, schema, tables=tables)
+            except StromError as e:
+                ap.error(f"--sql-create: {e}")
+            print(f"created {args.sql_create}: {n} rows, "
+                  f"{dsch.n_cols} columns "
+                  f"({','.join(str(dsch.col_dtype(i))
+                               for i in range(dsch.n_cols))})")
+            return 0
         try:
             q, assemble = parse_sql(args.sql, src, schema,
                                     tables=tables)

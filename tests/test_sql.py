@@ -426,3 +426,55 @@ def test_sql_group_by_three_columns(tmp_path):
     for i, k in enumerate(want):
         assert out["count(*)"][i] == len(rows[k])
         assert out["sum(c3)"][i] == sum(rows[k])
+
+
+def test_create_table_as(tmp_path, table):
+    """CREATE TABLE AS materializes SQL results as requeryable heap
+    tables — projection, grouped (string keys re-encoded with a fresh
+    dictionary), and scalar faces."""
+    from nvme_strom_tpu.scan.sql import create_table_as
+    path, schema, c0, c1, c2 = table
+    # projection face
+    dest = str(tmp_path / "derived.heap")
+    dschema, n = create_table_as(
+        dest, "SELECT c0, c1 FROM t WHERE c0 < 10", path, schema)
+    sel = c0 < 10
+    assert n == int(sel.sum()) and dschema.n_cols == 2
+    out = sql_query("SELECT COUNT(*), SUM(c1) FROM t", dest, dschema)
+    assert out["count(*)"] == n
+    assert out["sum(c1)"] == int(c1[sel].sum())
+    # grouped face with aliases
+    dest2 = str(tmp_path / "grouped.heap")
+    g2, ng = create_table_as(
+        dest2, "SELECT c0 AS k, COUNT(*) AS n, AVG(c1) AS m FROM t "
+               "GROUP BY c0", path, schema)
+    assert ng == len(np.unique(c0)) and g2.col_dtype(2).kind == "f"
+    out = sql_query("SELECT SUM(c1) FROM t", dest2, g2)
+    assert out["sum(c1)"] == len(c0)   # the counts sum to the row total
+    # scalar face -> 1-row table
+    g3, n3 = create_table_as(str(tmp_path / "s.heap"),
+                             "SELECT COUNT(*), SUM(c1) FROM t",
+                             path, schema)
+    assert n3 == 1
+
+
+def test_create_table_as_strings(tmp_path):
+    from nvme_strom_tpu.scan.heap import HeapSchema as HS
+    from nvme_strom_tpu.scan.sql import create_table_as
+    from nvme_strom_tpu.scan.strings import encode_strings, save_dict
+    schema = HS(n_cols=2, visibility=False, dtypes=("uint32", "int32"))
+    names = ["b", "a", "c", "a", "b", "a"] * 100
+    codes, d = encode_strings(names)
+    vals = np.arange(len(names), dtype=np.int32)
+    src = str(tmp_path / "src.heap")
+    from nvme_strom_tpu.scan.heap import build_heap_file
+    build_heap_file(src, [codes[:len(vals)], vals], schema)
+    save_dict(src, 0, d)
+    config.set("debug_no_threshold", True)
+    dest = str(tmp_path / "agg.heap")
+    g, n = create_table_as(
+        dest, "SELECT c0, COUNT(*) FROM t GROUP BY c0", src, schema)
+    assert n == 3
+    # the derived table's string column requeries through ITS dictionary
+    out = sql_query("SELECT c1 FROM t WHERE c0 = 'a'", dest, g)
+    assert out["c1"][0] == names[:len(vals)].count("a")

@@ -905,3 +905,23 @@ def test_strom_query_cli_where_composes_with_structured(tmp_path):
     out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
                "--where-eq", "0:3", "--where-in", "0:1,2")
     assert out.returncode != 0 and "exclusive" in out.stderr
+
+
+def test_strom_query_cli_sql_create(tmp_path):
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    rng = np.random.default_rng(3)
+    n = schema.tuples_per_page * 2
+    c0 = rng.integers(0, 5, n).astype(np.int32)
+    path = str(tmp_path / "s.heap")
+    build_heap_file(path, [c0, c0 * 2], schema)
+    dest = str(tmp_path / "d.heap")
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--sql", "SELECT c0, COUNT(*) FROM t GROUP BY c0",
+               "--sql-create", dest)
+    assert out.returncode == 0, out.stderr
+    assert "created" in out.stdout and "5 rows" in out.stdout
+    import os
+    assert os.path.exists(dest)
