@@ -336,7 +336,12 @@ def _parse_having(p: _P, n_cols: int) -> List[tuple]:
         op = p.next()
         if op[0] != "op" or op[1] not in _CMPS:
             raise StromError(22, "SQL: HAVING needs a comparison")
-        out.append((fn, col, op[1], _lit(p.next())))
+        lit = _lit(p.next())
+        if isinstance(lit, _Str):
+            raise StromError(22, "SQL: HAVING against a string literal "
+                                 "is outside this subset (aggregates "
+                                 "compare numerically)")
+        out.append((fn, col, op[1], lit))
         if p.kw("and"):
             continue
         return out

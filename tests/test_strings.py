@@ -109,6 +109,8 @@ def test_sql_string_minmax_and_rejections(table):
         ("SELECT COUNT(*) FROM t WHERE c1 = 'x'", "no string dict"),
         ("SELECT COUNT(*) FROM t WHERE c0 BETWEEN 'A' AND 5", "mixes"),
         ("SELECT COUNT(*) FROM t WHERE c0 IN ('A', 5)", "mixes"),
+        ("SELECT c0, MIN(c1) FROM t GROUP BY c0 HAVING MIN(c1) > 'x'",
+         "outside this subset"),
     ]:
         with pytest.raises(StromError) as ei:
             sql_query(sql, path, schema)
