@@ -1022,8 +1022,10 @@ def create_table_as(dest_path: str, sql: str, source, schema,
     from .strings import StringDict, save_dict
     out = sql_query(sql, source, schema, tables=tables, **run_kw)
     out.pop("_analyze", None)
-    out.pop("positions", None)
-    out.pop("matched", None)       # the LEFT row face's NULL indicator
+    out.pop("positions", None)     # row provenance, not data
+    # the LEFT row face's NULL indicator ("matched") stays: it becomes
+    # an int32 0/1 column — dropping it would silently erase which
+    # rows were unpartnered
     cols, dts, dict_cols = [], [], {}
     n_rows = None
     for label, v in out.items():

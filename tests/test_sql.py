@@ -478,3 +478,15 @@ def test_create_table_as_strings(tmp_path):
     # the derived table's string column requeries through ITS dictionary
     out = sql_query("SELECT c1 FROM t WHERE c0 = 'a'", dest, g)
     assert out["c1"][0] == names[:len(vals)].count("a")
+
+
+def test_create_table_as_left_join_keeps_indicator(joined, tmp_path):
+    from nvme_strom_tpu.scan.sql import create_table_as
+    fpath, fschema, c0, c1, dpath, dschema = joined
+    dest = str(tmp_path / "lj.heap")
+    g, n = create_table_as(
+        dest, "SELECT c1, d.c1 FROM t LEFT JOIN d ON c1 = d.c0",
+        fpath, fschema, tables={"d": (dpath, dschema)})
+    assert n == len(c1) and g.n_cols == 3   # c1, d.c1, matched
+    out = sql_query("SELECT SUM(c2) FROM t", dest, g)  # matched col
+    assert out["sum(c2)"] == int((c1 < 8).sum())
