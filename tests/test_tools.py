@@ -925,3 +925,30 @@ def test_strom_query_cli_sql_create(tmp_path):
     assert "created" in out.stdout and "5 rows" in out.stdout
     import os
     assert os.path.exists(dest)
+
+
+def test_strom_query_cli_sql_strings(tmp_path):
+    """String literals work through the CLI facade (quoting survives
+    the subprocess boundary; results decode)."""
+    import json
+
+    import numpy as np
+
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.strings import encode_strings, save_dict
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("uint32", "int32"))
+    names = ["x", "y", "z"] * 400
+    codes, d = encode_strings(names)
+    n = len(names)
+    path = str(tmp_path / "s.heap")
+    build_heap_file(path, [codes, np.arange(n, dtype=np.int32)], schema)
+    save_dict(path, 0, d)
+    out = _run("nvme_strom_tpu.tools.strom_query", path, "--cols", "2",
+               "--dtypes", "uint32,int32",
+               "--sql", "SELECT c0, COUNT(*) FROM t "
+                        "WHERE c0 != 'y' GROUP BY c0", "--json")
+    assert out.returncode == 0, out.stderr
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["c0"] == ["x", "z"]
+    assert res["count(*)"] == [400, 400]
