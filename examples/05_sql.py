@@ -80,6 +80,17 @@ def main() -> int:
                             "GROUP BY c0", sf.name, sschema)
             for i in range(len(out["c0"])):
                 print(f"   {out['c0'][i]:<8} n={out['count(*)'][i]}")
+
+            print("-- CREATE TABLE AS: materialize + requery")
+            from nvme_strom_tpu.scan.sql import create_table_as
+            with tempfile.NamedTemporaryFile(suffix=".heap") as df:
+                g, nrows = create_table_as(
+                    df.name, "SELECT c0 AS city, COUNT(*) AS n FROM t "
+                             "GROUP BY c0", sf.name, sschema)
+                top = sql_query("SELECT c0, c1 FROM t "
+                                "ORDER BY c1 DESC LIMIT 1", df.name, g)
+                print(f"   {nrows} groups materialized; busiest: "
+                      f"{top['c0'][0]} ({top['c1'][0]} rows)")
     return 0
 
 
