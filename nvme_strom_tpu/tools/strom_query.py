@@ -8,10 +8,14 @@ decision without running it.
 
 Usage:
   strom_query FILE --cols 3 [--dtypes int32,float32,int32] [--visibility]
-              [--where "c0 > 10"] [--group-by "c1 % 8" --groups 8]
+              [--where "c0 > 10"] [--where-eq/-range/-in ...]
+              [--group-by "c1 % 8" --groups 8 | --group-by-cols 0,1]
               [--top-k COL:K[:smallest]] [--agg-cols 0,1]
               [--select COLS|all --limit N --offset M]
-              [--explain] [--kernel auto|pallas|xla] [--mesh]
+              [--join COL:TABLE --join-how inner|left|semi|anti]
+              [--sql "SELECT ..." [--sql-table d=DIM.heap:2]
+                                  [--sql-create DEST]]
+              [--explain] [--analyze] [--kernel auto|pallas|xla] [--mesh]
 
 Predicates/keys are restricted jnp expressions over columns c0..cN (and
 abs/min/max), evaluated with eval() on a whitelisted namespace — this is
