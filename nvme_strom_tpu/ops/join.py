@@ -88,7 +88,7 @@ def hash_split_build(build_keys, build_values, n_parts: int):
     ``config join_broadcast_max`` (Grace-style multi-pass locally, one
     partition per device over a mesh)."""
     bk = np.asarray(build_keys, np.int32)
-    bv = np.asarray(build_values, np.int32)
+    bv = np.asarray(build_values, _value_dtype(build_values))
     part = (key_hash32(bk) % np.uint32(n_parts)).astype(np.int64)
     return [(bk[part == p], bv[part == p]) for p in range(n_parts)]
 
@@ -137,7 +137,11 @@ def make_join_fn(schema: HeapSchema, probe_col: int,
                                 dtype=acc)
                         for c, acc in zip(sum_cols, accs)]}
         if how in ("inner", "left"):
-            out["payload_sum"] = jnp.sum(jnp.where(hit, pay, 0))
+            # payload accumulates in ITS acc_dtypes dtype (float stays
+            # float32, ints follow the int convention)
+            out["payload_sum"] = jnp.sum(
+                jnp.where(hit, pay, vals.dtype.type(0)),
+                dtype=acc_dtypes(vals.dtype)[0])
         if how == "left":
             out["null_count"] = jnp.sum((emit & ~hit).astype(jnp.int32))
         return out
@@ -146,12 +150,25 @@ def make_join_fn(schema: HeapSchema, probe_col: int,
     return run
 
 
+_VALUE_DTS = (np.dtype(np.int32), np.dtype(np.uint32),
+              np.dtype(np.float32))
+
+
+def _value_dtype(build_values) -> np.dtype:
+    """Payload dtype normalization: int32/uint32/float32 pass through
+    (SUM over a float payload column is ordinary SQL), anything else —
+    python int lists, int64 — lands as int32 like before."""
+    dt = np.asarray(build_values).dtype
+    return dt if dt in _VALUE_DTS else np.dtype(np.int32)
+
+
 def _sorted_build(build_keys: np.ndarray, build_values: np.ndarray,
                   schema: HeapSchema, probe_col: int):
     """Shared build-side prep: unique-key check + sort.  Returns HOST
     arrays — the jitted kernels capture them as constants (jnp ops accept
     np operands), and the index path's host emulation avoids a pointless
-    H2D/D2H round trip."""
+    H2D/D2H round trip.  Keys are int32; VALUES keep their dtype
+    (int32/uint32/float32)."""
     if len(np.unique(build_keys)) != len(build_keys):
         raise ValueError("build_keys must be unique (inner join on a "
                          "dimension key)")
@@ -159,7 +176,7 @@ def _sorted_build(build_keys: np.ndarray, build_values: np.ndarray,
         raise ValueError("probe column must be int32")
     order = np.argsort(build_keys, kind="stable")
     return (np.asarray(build_keys, np.int32)[order],
-            np.asarray(build_values, np.int32)[order])
+            np.asarray(build_values, _value_dtype(build_values))[order])
 
 
 def _probe(keys, vals, probe, sel):

@@ -66,7 +66,7 @@ def partition_build_sharded(build_keys, build_values, mesh: Mesh,
     sizes = np.bincount(part, minlength=dp)
     cap = max(1, int(sizes.max()))
     keys_p = np.full((dp, cap), _I32_MAX, np.int32)
-    vals_p = np.zeros((dp, cap), np.int32)
+    vals_p = np.zeros((dp, cap), bv.dtype)   # payload keeps its dtype
     for p in range(dp):
         sel = part == p
         n = int(sizes[p])
@@ -117,6 +117,7 @@ def partition_build_sharded_from_table(table_path: str, build_schema,
     dt_k = build_schema.col_dtype(key_col)
     if dt_k != np.dtype(np.int32):
         raise ValueError("build key column must be int32")
+    dt_v = build_schema.col_dtype(value_col)
     if budget is None:
         budget = int(config.get("join_build_host_max"))
     table_bytes = os.path.getsize(table_path)
@@ -154,7 +155,7 @@ def partition_build_sharded_from_table(table_path: str, build_schema,
             .select([key_col, value_col]) \
             .run(session=session, device=device)
         pk = np.asarray(part[f"col{key_col}"], np.int32)
-        pv = np.asarray(part[f"col{value_col}"], np.int32)
+        pv = np.asarray(part[f"col{value_col}"], dt_v)
         if len(np.unique(pk)) != len(pk):
             raise ValueError("build_keys must be unique (inner join on "
                              "a dimension key)")
@@ -164,7 +165,7 @@ def partition_build_sharded_from_table(table_path: str, build_schema,
             raise StromError(5, f"build table changed between passes "
                                 f"(partition {p}: {n} != {sizes[p]})")
         kp = np.full(cap, _I32_MAX, np.int32)
-        vp = np.zeros(cap, np.int32)
+        vp = np.zeros(cap, dt_v)
         kp[:n] = pk[order]
         vp[:n] = pv[order]
         kshards.append(jax.device_put(kp[None], dev))
@@ -249,8 +250,10 @@ def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
                             dtype=accs[i])
                     for i in range(len(sum_cols))], "dp")}
         if how in ("inner", "left"):
+            from ..ops.groupby import acc_dtypes as _adt
             out["payload_sum"] = jax.lax.psum(
-                jnp.sum(jnp.where(hit, v[idx], 0)), "dp")
+                jnp.sum(jnp.where(hit, v[idx], v.dtype.type(0)),
+                        dtype=_adt(np.dtype(v.dtype))[0]), "dp")
         if how == "left":
             out["null_count"] = jax.lax.psum(
                 jnp.sum((emit & ~hit).astype(jnp.int32)), "dp")

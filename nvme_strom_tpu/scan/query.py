@@ -710,10 +710,11 @@ class Query:
         for c in (key_col, value_col):
             if not 0 <= int(c) < build_schema.n_cols:
                 raise StromError(22, f"join_table column {c} out of range")
-        for c in (key_col, value_col):
-            if build_schema.col_dtype(int(c)) != np.dtype(np.int32):
-                raise StromError(22, "join_table key and value columns "
-                                     "must be int32")
+        if build_schema.col_dtype(int(key_col)) != np.dtype(np.int32):
+            raise StromError(22, "join_table key column must be int32")
+        if build_schema.col_dtype(int(value_col)).kind not in "iuf":
+            raise StromError(22, "join_table value column must be "
+                                 "int32/uint32/float32")
         # header check up front: a missing file, a non-heap file, or a
         # schema whose column count disagrees with what the pages carry
         # must fail HERE with a clear error, not surface later as a raw
@@ -838,8 +839,8 @@ class Query:
                                                  device=device)
         pc, _bk, _bv, mat, lim, off = self._join
         self._join = (pc, np.asarray(out[f"col{kc}"], np.int32),
-                      np.asarray(out[f"col{vc}"], np.int32), mat, lim,
-                      off)
+                      np.asarray(out[f"col{vc}"],
+                                 bs.col_dtype(vc)), mat, lim, off)
         self._join_src = None
 
     def _join_strategy(self) -> Optional[tuple]:
@@ -1859,12 +1860,14 @@ class Query:
                 hit_c = np.concatenate([p[3] for p in parts])
             else:
                 pos_c = np.zeros(0, np.int64)
-                key_c = pay_c = np.zeros(0, np.int32)
+                key_c = np.zeros(0, np.int32)
+                pay_c = np.zeros(0, self._join_value_dtype())
                 hit_c = np.zeros(0, bool)
             sl = slice(offset, end)
             return self._join_rows_result(
                 how, pos_c[sl].astype(self._pos_dtype()),
-                key_c[sl].astype(np.int32), pay_c[sl].astype(np.int32),
+                key_c[sl].astype(np.int32),
+                pay_c[sl].astype(self._join_value_dtype()),
                 hit_c[sl])
         # aggregate face: emitted count + per-column sums over EVERY
         # fact column (the kernel's run.sum_cols set, each in its
@@ -1884,7 +1887,7 @@ class Query:
                "sums": sums}
         if how in ("inner", "left"):
             res["payload_sum"] = np.sum(
-                pay[hit], dtype=acc_dtypes(np.dtype(np.int32))[0])
+                pay[hit], dtype=acc_dtypes(self._join_value_dtype())[0])
         if how == "left":
             res["null_count"] = np.int32(int((emit & ~hit).sum()))
         return res
@@ -1969,6 +1972,15 @@ class Query:
             device, session, limit=limit, offset=offset)
         return self._join_rows_result(how, *arrs)
 
+    def _join_value_dtype(self) -> np.dtype:
+        """The build payload's dtype (int32/uint32/float32)."""
+        from ..ops.join import _value_dtype
+        if self._join_src is not None:
+            _bt, bs, _kc, vc = self._join_src
+            return bs.col_dtype(vc)
+        bv = self._join[2]
+        return _value_dtype(bv) if bv is not None else np.dtype(np.int32)
+
     def _join_row_fields(self, how: str):
         """Kernel output fields the row face collects under *how* —
         faces that drop a column (semi/anti: payload+partner; inner:
@@ -1977,7 +1989,7 @@ class Query:
         dtypes = [self._pos_dtype(), np.int32]
         if how in ("inner", "left"):
             fields.append("payload")
-            dtypes.append(np.int32)
+            dtypes.append(self._join_value_dtype())
         if how == "left":
             fields.append("partner")
             dtypes.append(np.bool_)
@@ -2317,7 +2329,7 @@ class Query:
                                                      device=device)
             yield from hash_split_build(
                 np.asarray(out[f"col{kc}"], np.int32),
-                np.asarray(out[f"col{vc}"], np.int32), n_parts)
+                np.asarray(out[f"col{vc}"], bs.col_dtype(vc)), n_parts)
             return
 
         def owner(cols):
@@ -2337,7 +2349,7 @@ class Query:
                 raise StromError(5, f"build table {bt} changed between "
                                     f"partition passes")
             yield (np.asarray(part[f"col{kc}"], np.int32),
-                   np.asarray(part[f"col{vc}"], np.int32))
+                   np.asarray(part[f"col{vc}"], bs.col_dtype(vc)))
 
     def _run_join_partitioned_mesh_rows(self, mesh, session, device,
                                         batch_pages,

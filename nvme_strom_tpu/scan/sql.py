@@ -779,7 +779,8 @@ def _parse_sql_raw(sql: str, source, schema,
                         out[it.label] = \
                             np.asarray(res["sums"][it.col]).item()
                     else:
-                        out[it.label] = int(res["payload_sum"])
+                        out[it.label] = \
+                            np.asarray(res["payload_sum"]).item()
                 return out
             return q, assemble
         for it in items:

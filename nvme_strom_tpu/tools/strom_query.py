@@ -549,15 +549,18 @@ def main(argv=None) -> int:
                     if "keys" not in z or "values" not in z:
                         ap.error("--join .npz table needs 'keys' and "
                                  "'values' arrays")
+                    from ..ops.join import _value_dtype
                     jk = np.asarray(z["keys"], np.int32)
-                    jv = np.asarray(z["values"], np.int32)
+                    jv = np.asarray(z["values"],
+                                    _value_dtype(z["values"]))
                 else:
                     a = np.load(table)
                     if a.ndim != 2 or a.shape[1] != 2:
                         ap.error("--join .npy table must be (N, 2) "
                                  "[key, value]")
+                    from ..ops.join import _value_dtype
                     jk = np.asarray(a[:, 0], np.int32)
-                    jv = np.asarray(a[:, 1], np.int32)
+                    jv = np.asarray(a[:, 1], _value_dtype(a[:, 1]))
             except (OSError, ValueError) as e:
                 ap.error(f"--join table {table!r} unreadable: {e}")
             q = q.join(int(colspec), jk, jv, materialize=args.join_rows,

@@ -313,3 +313,80 @@ def test_join_sums_cover_float_and_uint_columns(tmp_path):
     qa = Query(path, schema).where_range(0, 0, 511).join(0, KEYS, VALS)
     assert qa.explain().access_path == "index"
     check(qa.run(), partner)
+
+
+def test_join_float_payload_all_strategies(tmp_path):
+    """SUM over a FLOAT build payload (SQL's SUM(d.price)) keeps float32
+    accumulation on every strategy and both faces."""
+    import jax
+
+    from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+    rng = np.random.default_rng(67)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 6
+    c0 = rng.integers(-50, 50, n).astype(np.int32)
+    c1 = rng.integers(0, 1024, n).astype(np.int32)
+    path = str(tmp_path / "fp.heap")
+    build_heap_file(path, [c0, c1], schema)
+    config.set("debug_no_threshold", True)
+    fvals = (KEYS.astype(np.float32) * 0.25 + 0.125)
+    partner = c1 < 512
+    want = float(fvals[c1[partner]].sum())
+
+    def check(out):
+        assert np.asarray(out["payload_sum"]).dtype == np.float32
+        np.testing.assert_allclose(float(out["payload_sum"]), want,
+                                   rtol=1e-4)
+
+    check(Query(path, schema).join(1, KEYS, fvals).run())
+    rows = Query(path, schema).join(1, KEYS, fvals,
+                                    materialize=True).run()
+    assert rows["payload"].dtype == np.float32
+    np.testing.assert_allclose(float(rows["payload"].sum()), want,
+                               rtol=1e-4)
+    old = config.get("join_broadcast_max")
+    config.set("join_broadcast_max", 1024)
+    try:
+        check(Query(path, schema).join(1, KEYS, fvals).run())  # Grace
+        mesh = make_scan_mesh(jax.devices())
+        check(Query(path, schema).join(1, KEYS, fvals)
+              .run(mesh=mesh, batch_pages=12))                 # mesh
+    finally:
+        config.set("join_broadcast_max", old)
+    # index-served
+    from nvme_strom_tpu.scan.index import build_index
+    build_index(path, schema, 0)
+    q = Query(path, schema).where_range(0, -50, 50).join(1, KEYS, fvals)
+    assert q.explain().access_path == "index"
+    check(q.run())
+
+
+def test_join_table_float_value_col(tmp_path):
+    """join_table accepts a float32 value column (the dim price case),
+    both broadcast-sized and streamed-partitioned builds."""
+    rng = np.random.default_rng(68)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 4
+    c0 = rng.integers(0, 100, n).astype(np.int32)
+    c1 = rng.integers(0, 512, n).astype(np.int32)
+    fpath = str(tmp_path / "fact.heap")
+    build_heap_file(fpath, [c0, c1], schema)
+    dschema = HeapSchema(n_cols=2, visibility=False,
+                         dtypes=("int32", "float32"))
+    dkeys = np.arange(0, 512, dtype=np.int32)
+    dvals = (dkeys * 0.5).astype(np.float32)
+    dpath = str(tmp_path / "dim.heap")
+    build_heap_file(dpath, [dkeys, dvals], dschema)
+    config.set("debug_no_threshold", True)
+    want = float(dvals[c1].sum())
+    old = config.get("join_broadcast_max")
+    try:
+        for cap in (old, 1024):
+            config.set("join_broadcast_max", cap)
+            out = Query(fpath, schema).join_table(
+                1, dpath, dschema, 0, 1).run()
+            assert np.asarray(out["payload_sum"]).dtype == np.float32
+            np.testing.assert_allclose(float(out["payload_sum"]), want,
+                                       rtol=1e-4)
+    finally:
+        config.set("join_broadcast_max", old)

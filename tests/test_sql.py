@@ -490,3 +490,22 @@ def test_create_table_as_left_join_keeps_indicator(joined, tmp_path):
     assert n == len(c1) and g.n_cols == 3   # c1, d.c1, matched
     out = sql_query("SELECT SUM(c2) FROM t", dest, g)  # matched col
     assert out["sum(c2)"] == int((c1 < 8).sum())
+
+
+def test_sql_join_float_payload(joined, tmp_path):
+    """SUM(d.cK) over a float dimension column stays float through the
+    SQL facade."""
+    fpath, fschema, c0, c1, dpath, dschema = joined
+    d2schema = HeapSchema(n_cols=2, visibility=False,
+                          dtypes=("int32", "float32"))
+    keys = np.arange(0, 8, dtype=np.int32)
+    fv = (keys * 0.5).astype(np.float32)
+    d2 = str(tmp_path / "fdim.heap")
+    build_heap_file(d2, [keys, fv], d2schema)
+    out = sql_query("SELECT COUNT(*), SUM(d.c1) FROM t "
+                    "JOIN d ON c1 = d.c0", fpath, fschema,
+                    tables={"d": (d2, d2schema)})
+    partner = c1 < 8
+    assert isinstance(out["sum(d.c1)"], float)
+    np.testing.assert_allclose(out["sum(d.c1)"],
+                               float(fv[c1[partner]].sum()), rtol=1e-4)
