@@ -160,3 +160,21 @@ def test_string_join_rejected(table, tmp_path):
         sql_query("SELECT COUNT(*) FROM t JOIN d ON c0 = d.c0",
                   path, schema, tables={"d": (dpath, dschema)})
     assert "incomparable" in str(ei.value)
+
+
+def test_string_index_cond_plus_residual(table):
+    """WHERE c0 = 'Chicago' AND c1 > 50: the string equality promotes
+    to the structured code filter (index-served) and the numeric
+    residual rechecks."""
+    from nvme_strom_tpu.scan.index import build_index
+    from nvme_strom_tpu.scan.sql import parse_sql
+    path, schema, names, c1 = table
+    build_index(path, schema, 0)
+    q, _ = parse_sql("SELECT COUNT(*) FROM t WHERE c0 = 'Chicago' "
+                     "AND c1 > 50", path, schema)
+    plan = q.explain()
+    assert plan.access_path == "index" and "RECHECKED" in plan.reason
+    out = sql_query("SELECT COUNT(*) FROM t WHERE c0 = 'Chicago' "
+                    "AND c1 > 50", path, schema)
+    m = (names.astype(str) == "Chicago") & (c1 > 50)
+    assert out["count(*)"] == int(m.sum())
