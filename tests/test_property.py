@@ -495,3 +495,100 @@ def test_pos_word_roundtrip(vals):
     p32 = pos[pos <= np.iinfo(np.int32).max].astype(np.int32)
     np.testing.assert_array_equal(
         combine_pos_words(p32, np.zeros_like(p32)).astype(np.int32), p32)
+
+
+# ---------------------------------------------------------------------------
+# SQL WHERE-tree property: random AND/OR/NOT trees vs a numpy oracle
+# ---------------------------------------------------------------------------
+
+_sql_conds = st.deferred(lambda: st.one_of(
+    st.tuples(st.just("cmp"), st.integers(0, 1),
+              st.sampled_from(["=", "!=", "<", "<=", ">", ">="]),
+              st.integers(-20, 20)),
+    st.tuples(st.just("between"), st.integers(0, 1),
+              st.integers(-20, 0), st.integers(0, 20)),
+    st.tuples(st.just("in"), st.integers(0, 1),
+              st.lists(st.integers(-20, 20), min_size=1, max_size=4)),
+))
+
+_sql_tree = st.recursive(
+    st.tuples(st.just("leaf"), _sql_conds),
+    lambda kids: st.one_of(
+        st.tuples(st.just("and"), st.lists(kids, min_size=2, max_size=3)),
+        st.tuples(st.just("or"), st.lists(kids, min_size=2, max_size=3)),
+        st.tuples(st.just("not"), st.lists(kids, min_size=1, max_size=1)),
+    ),
+    max_leaves=6)
+
+
+def _tree_to_sql(t) -> str:
+    kind = t[0]
+    if kind == "leaf":
+        c = t[1]
+        if c[0] == "cmp":
+            return f"c{c[1]} {c[2]} {c[3]}"
+        if c[0] == "between":
+            return f"c{c[1]} BETWEEN {c[2]} AND {c[3]}"
+        return f"c{c[1]} IN ({', '.join(str(v) for v in c[2])})"
+    if kind == "not":
+        return f"NOT ({_tree_to_sql(t[1][0])})"
+    joiner = " AND " if kind == "and" else " OR "
+    return "(" + joiner.join(_tree_to_sql(k) for k in t[1]) + ")"
+
+
+def _tree_oracle(t, c0, c1):
+    cols = {0: c0, 1: c1}
+    kind = t[0]
+    if kind == "leaf":
+        c = t[1]
+        v = cols[c[1]]
+        if c[0] == "cmp":
+            import operator as op
+            fns = {"=": op.eq, "!=": op.ne, "<": op.lt, "<=": op.le,
+                   ">": op.gt, ">=": op.ge}
+            return fns[c[2]](v, c[3])
+        if c[0] == "between":
+            return (v >= c[2]) & (v <= c[3])
+        return np.isin(v, c[2])
+    if kind == "not":
+        return ~_tree_oracle(t[1][0], c0, c1)
+    masks = [_tree_oracle(k, c0, c1) for k in t[1]]
+    out = masks[0]
+    for m in masks[1:]:
+        out = (out & m) if kind == "and" else (out | m)
+    return out
+
+
+_SQL_PROP_TABLE: list = []
+
+
+def _sql_prop_fixture():
+    if not _SQL_PROP_TABLE:   # one shared table across examples
+        import tempfile
+
+        from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+        rng = np.random.default_rng(99)
+        schema = HeapSchema(n_cols=2, visibility=False)
+        n = schema.tuples_per_page * 2
+        c0 = rng.integers(-25, 25, n).astype(np.int32)
+        c1 = rng.integers(-25, 25, n).astype(np.int32)
+        d = tempfile.mkdtemp()
+        path = f"{d}/prop.heap"
+        build_heap_file(path, [c0, c1], schema)
+        _SQL_PROP_TABLE.append((path, schema, c0, c1))
+    return _SQL_PROP_TABLE[0]
+
+
+@settings(max_examples=25, deadline=None)
+@given(tree=_sql_tree)
+def test_sql_where_tree_matches_numpy_oracle(tree):
+    """Any random AND/OR/NOT condition tree rendered to SQL selects
+    exactly the rows the equivalent numpy expression selects."""
+    from nvme_strom_tpu.scan.sql import sql_query
+    path, schema, c0, c1 = _sql_prop_fixture()
+    from nvme_strom_tpu.config import config as _cfg
+    _cfg.set("debug_no_threshold", True)
+    sql = f"SELECT COUNT(*) FROM t WHERE {_tree_to_sql(tree)}"
+    out = sql_query(sql, path, schema)
+    want = int(_tree_oracle(tree, c0, c1).sum())
+    assert out["count(*)"] == want, sql
