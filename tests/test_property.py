@@ -592,3 +592,22 @@ def test_sql_where_tree_matches_numpy_oracle(tree):
     out = sql_query(sql, path, schema)
     want = int(_tree_oracle(tree, c0, c1).sum())
     assert out["count(*)"] == want, sql
+
+
+@settings(max_examples=60, deadline=None)
+@given(text=st.text(
+    alphabet=st.sampled_from(list(
+        "abcdefgSELECTFROMWHEREcGROUPBYANDORNT0123456789().,*='<>! ")),
+    min_size=0, max_size=60))
+def test_sql_parser_never_crashes(text):
+    """Arbitrary input to the SQL facade raises a clean StromError (or
+    parses, for accidental valid statements) — never an internal
+    exception: a facade that can crash on input is a facade that can be
+    crashed by input."""
+    from nvme_strom_tpu.api import StromError
+    from nvme_strom_tpu.scan.sql import parse_sql
+    path, schema, _c0, _c1 = _sql_prop_fixture()
+    try:
+        parse_sql(text, path, schema)
+    except StromError:
+        pass
