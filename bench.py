@@ -606,10 +606,13 @@ def main() -> int:
         sys.stderr.write("bench: remediation worked — device is back\n")
     # sustained-regime guard: a responsive device whose burst probe
     # crawls is in the transport's long-window quota regime — a full
-    # direct run would take ~an hour and time out anyway, so fail FAST
-    # to the journal replay instead of burning the round-end budget
+    # direct run would take the better part of an hour and measure only
+    # the throttle, so fail FAST to the journal replay instead of
+    # burning the round-end budget.  0.3 default: observed regime
+    # bursts hover 0.01-0.16, healthy windows open at ~1.0 — anything
+    # in between is the throttle, not the framework
     # (BENCH_MIN_BURST_GBPS=0 disables)
-    min_burst = float(os.environ.get("BENCH_MIN_BURST_GBPS", "0.15"))
+    min_burst = float(os.environ.get("BENCH_MIN_BURST_GBPS", "0.3"))
     if min_burst > 0 and _LAST_BURST_GBPS \
             and _LAST_BURST_GBPS[0] < min_burst:
         return _emit_cpu_fallback(
