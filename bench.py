@@ -256,13 +256,13 @@ with open(path, "rb") as _f:
 # direct and raw stay ADJACENT in every round (the r3 fix) while the
 # direct/vfs pair still flips order round to round, so neither ratio's
 # denominator systematically inherits the other mode's cache state
-# 5 rounds: with the shared disk swinging ~2x between adjacent pairs,
-# a 3-round median still inherits one outlier draw; a characterization
-# A/B on this host (5 alternated rounds, host-cache warmed) measured
-# per-round engine/raw ratios 1.15/1.03/1.04/0.86/0.97 — median 1.03,
-# i.e. parity, with single rounds as low as 0.7 and as high as 1.15
+# 9 rounds: with the shared disk swinging ~2x between adjacent pairs,
+# few-round medians still inherit draw luck — two same-session 5-round
+# medians measured 0.85 and 1.00 (characterization A/B: 1.15/1.03/
+# 1.04/0.86/0.97, median 1.03 = parity).  At ~2s per round the extra
+# rounds are free next to the probe stage
 directs, vfss, ratios, raw_ratios, samples = [], [], [], [], []
-for r in range(5):
+for r in range(9):
     if r % 2 == 0:
         d, rw, v = run_direct(), run_raw(), run_vfs()
     else:
