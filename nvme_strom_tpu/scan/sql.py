@@ -1007,7 +1007,8 @@ def sql_query(sql: str, source, schema, tables: Optional[dict] = None,
 
 
 def create_table_as(dest_path: str, sql: str, source, schema,
-                    tables: Optional[dict] = None, **run_kw):
+                    tables: Optional[dict] = None,
+                    overwrite: bool = False, **run_kw):
     """CREATE TABLE AS: run *sql* and materialize its result as a NEW
     heap table at *dest_path* (the ETL face — derived tables requery
     with the full scan machinery, indexes and SQL included).
@@ -1017,10 +1018,14 @@ def create_table_as(dest_path: str, sql: str, source, schema,
     silently wrapped), uint as uint32, floats as float32, and STRING
     columns re-encode with a fresh sorted dictionary saved as the new
     table's sidecar.  Scalar aggregate results build a 1-row table.
-    ``positions`` (row provenance) is dropped.  Returns
+    ``positions`` (row provenance) is dropped.  An existing
+    *dest_path* is refused (EEXIST) unless ``overwrite=True``.  Returns
     ``(dest_schema, n_rows)``."""
     from .heap import HeapSchema as _HS, build_heap_file
     from .strings import StringDict, save_dict
+    if os.path.exists(dest_path) and not overwrite:
+        raise StromError(17, f"CREATE TABLE AS: {dest_path} exists "
+                             f"(overwrite=True replaces it)")
     out = sql_query(sql, source, schema, tables=tables, **run_kw)
     out.pop("_analyze", None)
     out.pop("positions", None)     # row provenance, not data

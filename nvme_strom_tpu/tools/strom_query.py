@@ -251,6 +251,8 @@ def main(argv=None) -> int:
                          "c0..cN-1; FROM name is nominal — the "
                          "positional file is the table); exclusive "
                          "with the per-flag query builders")
+    ap.add_argument("--sql-create-force", action="store_true",
+                    help="with --sql-create: replace an existing DEST")
     ap.add_argument("--sql-create", default=None, metavar="DEST",
                     help="with --sql: CREATE TABLE AS — materialize the "
                          "statement's result as a new heap table at "
@@ -325,8 +327,9 @@ def main(argv=None) -> int:
         if args.sql_create:
             from ..scan.sql import create_table_as
             try:
-                dsch, n = create_table_as(args.sql_create, args.sql,
-                                          src, schema, tables=tables)
+                dsch, n = create_table_as(
+                    args.sql_create, args.sql, src, schema,
+                    tables=tables, overwrite=args.sql_create_force)
             except StromError as e:
                 ap.error(f"--sql-create: {e}")
             print(f"created {args.sql_create}: {n} rows, "

@@ -456,6 +456,17 @@ def test_create_table_as(tmp_path, table):
                              "SELECT COUNT(*), SUM(c1) FROM t",
                              path, schema)
     assert n3 == 1
+    # an existing destination is refused unless overwrite=True
+    with pytest.raises(StromError) as ei:
+        create_table_as(dest, "SELECT c0 FROM t", path, schema)
+    assert ei.value.errno == 17
+    create_table_as(dest, "SELECT c0 FROM t WHERE c0 < 5", path,
+                    schema, overwrite=True)
+    out2 = sql_query("SELECT COUNT(*) FROM t", dest,
+                     __import__("nvme_strom_tpu.scan.heap",
+                                fromlist=["HeapSchema"])
+                     .HeapSchema(n_cols=1, visibility=False))
+    assert out2["count(*)"] == int((c0 < 5).sum())
 
 
 def test_create_table_as_strings(tmp_path):
