@@ -1,0 +1,111 @@
+"""End-to-end star-schema workload: every scan-tier capability composing
+in one realistic analytic session — dictionary strings, secondary
+indexes, the four join faces, value-keyed grouping, top-N ordering,
+CTAS derivation — each statement checked against a numpy oracle."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.config import config
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+from nvme_strom_tpu.scan.index import build_index
+from nvme_strom_tpu.scan.sql import create_table_as, parse_sql, sql_query
+from nvme_strom_tpu.scan.strings import encode_strings, save_dict
+
+REGIONS = ["emea", "amer", "apac"]
+
+
+@pytest.fixture(scope="module")
+def star(tmp_path_factory):
+    d = tmp_path_factory.mktemp("star")
+    rng = np.random.default_rng(2026)
+    # fact: (region_code u32-dict, sku i32, qty i32, day i32)
+    fschema = HeapSchema(n_cols=4, visibility=False,
+                         dtypes=("uint32", "int32", "int32", "int32"))
+    n = fschema.tuples_per_page * 24
+    region = rng.choice(REGIONS, n)
+    rcodes, rdict = encode_strings(list(region))
+    sku = rng.integers(0, 200, n).astype(np.int32)
+    qty = rng.integers(1, 10, n).astype(np.int32)
+    day = rng.integers(0, 30, n).astype(np.int32)
+    fact = str(d / "fact.heap")
+    build_heap_file(fact, [rcodes, sku, qty, day], fschema)
+    save_dict(fact, 0, rdict)
+    build_index(fact, fschema, 1)          # sku index
+    # dim: sku -> float price (only skus < 150 priced)
+    dschema = HeapSchema(n_cols=2, visibility=False,
+                         dtypes=("int32", "float32"))
+    dk = np.arange(0, 150, dtype=np.int32)
+    price = (dk * 0.1 + 1.0).astype(np.float32)
+    dim = str(d / "dim.heap")
+    build_heap_file(dim, [dk, price], dschema)
+    config.set("debug_no_threshold", True)
+    return (fact, fschema, dim, dschema,
+            region, sku, qty, day, price)
+
+
+def test_q1_filtered_revenue(star):
+    """Revenue for one region over priced skus (string eq + float-
+    payload join), vs the oracle."""
+    fact, fs, dim, ds, region, sku, qty, day, price = star
+    out = sql_query("SELECT COUNT(*), SUM(d.c1) AS rev FROM t "
+                    "JOIN d ON c1 = d.c0 WHERE c0 = 'emea'",
+                    fact, fs, tables={"d": (dim, ds)})
+    m = (region == "emea") & (sku < 150)
+    assert out["count(*)"] == int(m.sum())
+    np.testing.assert_allclose(out["rev"],
+                               float(price[sku[m]].sum()), rtol=1e-4)
+
+
+def test_q2_unpriced_skus(star):
+    """ANTI join: order lines whose sku has no price."""
+    fact, fs, dim, ds, region, sku, qty, day, price = star
+    out = sql_query("SELECT COUNT(*) FROM t ANTI JOIN d ON c1 = d.c0",
+                    fact, fs, tables={"d": (dim, ds)})
+    assert out["count(*)"] == int((sku >= 150).sum())
+
+
+def test_q3_daily_top_regions(star):
+    """Value-keyed GROUP BY over (region, day) with HAVING + top-N."""
+    fact, fs, dim, ds, region, sku, qty, day, price = star
+    out = sql_query("SELECT c0, c3, SUM(c2) AS units FROM t "
+                    "GROUP BY c0, c3 HAVING SUM(c2) > 100 "
+                    "ORDER BY SUM(c2) DESC LIMIT 5", fact, fs)
+    totals = {}
+    for r, dd, q in zip(region, day, qty):
+        totals[(r, int(dd))] = totals.get((r, int(dd)), 0) + int(q)
+    keep = {k: v for k, v in totals.items() if v > 100}
+    want = sorted(keep.values(), reverse=True)[:5]
+    np.testing.assert_array_equal(out["units"], want)
+    assert all(isinstance(r, str) for r in out["c0"])
+
+
+def test_q4_sku_drilldown_rides_the_index(star):
+    """Index Cond + Filter through SQL: sku equality rides the sidecar,
+    the qty predicate rechecks."""
+    fact, fs, dim, ds, region, sku, qty, day, price = star
+    q, _ = parse_sql("SELECT COUNT(*), AVG(c2) FROM t "
+                     "WHERE c1 = 7 AND c2 >= 5", fact, fs)
+    plan = q.explain()
+    assert plan.access_path == "index" and "RECHECKED" in plan.reason
+    out = sql_query("SELECT COUNT(*), AVG(c2) FROM t "
+                    "WHERE c1 = 7 AND c2 >= 5", fact, fs)
+    m = (sku == 7) & (qty >= 5)
+    assert out["count(*)"] == int(m.sum())
+    if m.any():
+        assert out["avg(c2)"] == pytest.approx(qty[m].mean())
+
+
+def test_q5_ctas_rollup_requeries(star, tmp_path):
+    """CTAS rollup (region totals) then a second-stage query over the
+    derived table, string keys surviving the round trip."""
+    fact, fs, dim, ds, region, sku, qty, day, price = star
+    roll = str(tmp_path / "rollup.heap")
+    g, n = create_table_as(
+        roll, "SELECT c0 AS region, SUM(c2) AS units FROM t "
+              "GROUP BY c0", fact, fs)
+    assert n == 3
+    out = sql_query("SELECT c0 FROM t ORDER BY c1 DESC LIMIT 1",
+                    roll, g)
+    totals = {r: int(qty[region == r].sum()) for r in REGIONS}
+    assert out["c0"][0] == max(totals, key=totals.get)
