@@ -84,9 +84,11 @@ def main() -> int:
             print("-- CREATE TABLE AS: materialize + requery")
             from nvme_strom_tpu.scan.sql import create_table_as
             with tempfile.NamedTemporaryFile(suffix=".heap") as df:
+                # overwrite: NamedTemporaryFile pre-creates the path
                 g, nrows = create_table_as(
                     df.name, "SELECT c0 AS city, COUNT(*) AS n FROM t "
-                             "GROUP BY c0", sf.name, sschema)
+                             "GROUP BY c0", sf.name, sschema,
+                    overwrite=True)
                 top = sql_query("SELECT c0, c1 FROM t "
                                 "ORDER BY c1 DESC LIMIT 1", df.name, g)
                 print(f"   {nrows} groups materialized; busiest: "
