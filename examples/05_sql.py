@@ -58,10 +58,15 @@ def main() -> int:
                          f.name, schema)
         print(f"   {q.explain()}")
 
+        print("-- WHERE trees: OR/NOT/parens with SQL precedence")
+        out = sql_query("SELECT COUNT(*) FROM t "
+                        "WHERE (c0 = 1 OR c0 = 2) AND NOT c1 < 0",
+                        f.name, schema)
+        print(f"   {out}")
+
         print("-- out-of-subset SQL fails loudly, never approximates")
         try:
-            sql_query("SELECT c0 FROM t WHERE c0 = 1 OR c0 = 2",
-                      f.name, schema)
+            sql_query("SELECT c0 FROM t CROSS JOIN q", f.name, schema)
         except Exception as e:
             print(f"   {e}")
 
