@@ -53,8 +53,14 @@ Mapping (each SQL shape -> the Query terminal that serves it):
 * sole COUNT(DISTINCT c)         -> ``count_distinct(c)``
 * GROUP BY c[, c2]               -> ``group_by_cols`` (value-keyed,
   keys discovered; HAVING composes)
-* ORDER BY c [DESC] [LIMIT]      -> ``order_by`` (sidecar-served when
-  fresh)
+* ORDER BY c[, c2] [DESC]        -> ``order_by`` (sidecar-served when
+  fresh; other selected columns fetched by position); ORDER BY an
+  aggregate + LIMIT on grouped results = top-N groups
+* SELECT DISTINCT cols           -> ``group_by_cols`` keys only
+* AS name                        -> output relabeling (after string
+  decode)
+* :func:`create_table_as`        -> materialize any result as a new
+  requeryable heap table (CLI ``--sql-create``)
 * WHERE: the first index-capable LEAF of a top-level AND becomes a
   STRUCTURED filter (``where_eq`` / ``where_range`` / ``where_in`` —
   the planner can ride a sidecar); the rest of the tree — remaining
