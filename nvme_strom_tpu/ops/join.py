@@ -27,8 +27,9 @@ import numpy as np
 from ..scan.heap import HeapSchema
 from .filter_xla import DEFAULT_SCHEMA, decode_pages
 
-__all__ = ["make_join_fn", "make_join_rows_fn", "key_hash32",
-           "hash_split_build", "check_join_how", "JOIN_HOWS"]
+__all__ = ["make_join_fn", "make_join_rows_fn", "make_star_fn",
+           "make_star_rows_fn", "key_hash32", "hash_split_build",
+           "check_join_how", "JOIN_HOWS"]
 
 # Knuth multiplicative constant: scrambles int32 keys so hash % P spreads
 # adjacent/striped key spaces evenly across partitions
@@ -224,5 +225,121 @@ def make_join_rows_fn(schema: HeapSchema, probe_col: int,
                 "payload": jnp.where(hit, pay, 0).reshape(-1),
                 "positions": global_row_positions(
                     pages_u8, schema).reshape(-1)}
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Star joins (several broadcast dimensions probed in one pass)
+# ---------------------------------------------------------------------------
+#
+# The reference never joins itself — its scan hands tuples to the
+# PostgreSQL executor, which composes any number of joins ABOVE it
+# (`pgsql/nvme_strom.c:941-979`).  This tier gives the TPU framework the
+# star-schema core of that composition: each scanned batch probes N
+# sorted dimension tables in the SAME fused kernel (N vectorized binary
+# searches back-to-back — the probes pipeline on the VPU, and the batch
+# is decoded once instead of once per join).
+
+def _star_probe_all(joins, cols, valid, predicate, params):
+    """Shared star-probe core: returns (emit mask, [(hit_i, pay_i)]).
+
+    inner/semi dims restrict the emitted rows to partnered ones, anti
+    dims to unpartnered ones; left dims never restrict (their NULL
+    indicator is the per-dim hit mask)."""
+    sel = valid if predicate is None else valid & predicate(cols, *params)
+    probes = []
+    emit = sel
+    for (pc, keys, vals, how) in joins:
+        # payload-less dims (semi/anti faces) probe with the keys as a
+        # stand-in payload (never read)
+        hit, pay = _probe(keys, keys if vals is None else vals,
+                          cols[pc], sel)
+        if how in ("inner", "semi"):
+            emit = emit & hit
+        elif how == "anti":
+            emit = emit & ~hit
+        probes.append((hit, pay))
+    return emit, probes
+
+
+def make_star_fn(schema: HeapSchema, joins, *,
+                 predicate: Optional[Callable] = None,
+                 expr_fns=(), expr_zeros=(), expr_accs=()):
+    """Build a jitted star-join aggregate step over *joins* — a list of
+    ``(probe_col, build_keys, build_values|None, how)`` dimensions
+    (build arrays pre-sorted via :func:`_sorted_build`).
+
+    Returns per batch: ``count`` (emitted rows), ``sums`` — per-column
+    masked sums over every fact column (acc_dtypes convention),
+    ``pay_sums`` — one entry per dimension: the payload sum over
+    emitted rows that HIT that dimension (None-valued dims — semi/anti —
+    contribute 0), ``null_counts`` — per dimension, emitted rows without
+    a partner there (the LEFT NULL face), and ``esums`` — masked sums of
+    the optional expression values (``expr_fns[i](cols) -> (B, T)``,
+    accumulated as ``expr_accs[i]`` with ``expr_zeros[i]`` off-rows).
+    Everything is additive, so batches fold by plain tree-sum."""
+    from .groupby import acc_dtypes
+    sum_cols = list(range(schema.n_cols))
+    accs = [acc_dtypes(schema.col_dtype(c))[0] for c in sum_cols]
+
+    @jax.jit
+    def run(pages_u8, *params):
+        cols, valid = decode_pages(pages_u8, schema)
+        emit, probes = _star_probe_all(joins, cols, valid, predicate,
+                                       params)
+        out = {"count": jnp.sum(emit.astype(jnp.int32)),
+               "sums": [jnp.sum(jnp.where(emit, cols[c],
+                                          schema.col_dtype(c).type(0)),
+                                dtype=acc)
+                        for c, acc in zip(sum_cols, accs)]}
+        pay_sums, null_counts = [], []
+        for (pc, keys, vals, how), (hit, pay) in zip(joins, probes):
+            if vals is None:
+                pay_sums.append(jnp.int32(0))
+            else:
+                pay_sums.append(jnp.sum(
+                    jnp.where(emit & hit, pay, vals.dtype.type(0)),
+                    dtype=acc_dtypes(np.asarray(vals).dtype)[0]))
+            null_counts.append(jnp.sum((emit & ~hit).astype(jnp.int32)))
+        out["pay_sums"] = pay_sums
+        out["null_counts"] = null_counts
+        if expr_fns:
+            out["esums"] = [
+                jnp.sum(jnp.where(emit, f(cols), z), dtype=a)
+                for f, z, a in zip(expr_fns, expr_zeros, expr_accs)]
+        return out
+
+    run.sum_cols = sum_cols
+    return run
+
+
+def make_star_rows_fn(schema: HeapSchema, joins, *,
+                      predicate: Optional[Callable] = None,
+                      fact_cols=()):
+    """Row-materializing twin of :func:`make_star_fn`: per batch returns
+    ``hit`` (the emit mask), the requested fact columns (``c<i>``), each
+    dimension's matched payload (``pay<i>``, zeros where unpartnered)
+    and partner mask (``m<i>``), and global ``positions`` — flattened
+    for host-side compression (the SELECT face of a star query)."""
+    from .filter_xla import global_row_positions
+    fact_cols = list(fact_cols)
+
+    @jax.jit
+    def run(pages_u8, *params):
+        cols, valid = decode_pages(pages_u8, schema)
+        emit, probes = _star_probe_all(joins, cols, valid, predicate,
+                                       params)
+        out = {"hit": emit.reshape(-1),
+               "positions": global_row_positions(
+                   pages_u8, schema).reshape(-1)}
+        for c in fact_cols:
+            out[f"c{c}"] = cols[c].reshape(-1)
+        for i, ((pc, keys, vals, how), (hit, pay)) in \
+                enumerate(zip(joins, probes)):
+            if vals is not None:
+                out[f"pay{i}"] = jnp.where(hit, pay, 0).reshape(-1)
+            out[f"m{i}"] = hit.reshape(-1)
+        return out
 
     return run

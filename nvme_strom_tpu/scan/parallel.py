@@ -1,12 +1,22 @@
 """Multi-worker parallel scan: shared cursor across processes.
 
 Capability analog of the pgsql Gather integration (`pgsql/nvme_strom.c:
-1057-1112`): a DSM segment carries the scan descriptor (relation id, total
-blocks, a shared atomic cursor, shared DMA counters) and every worker claims
-disjoint block ranges from it.  Here the descriptor lives in
-``multiprocessing.shared_memory`` and workers are processes running their
-own :class:`~nvme_strom_tpu.scan.executor.TableScanner` against the shared
-cursor — the same data-parallel shape, minus the PostgreSQL executor.
+582-595,1057-1112`): a DSM segment carries the scan descriptor (relation
+id, total blocks, a shared atomic cursor, shared DMA counters) and every
+worker claims disjoint block ranges from it.  Here the descriptor lives
+in ``multiprocessing.shared_memory`` and workers are processes running
+their own :class:`~nvme_strom_tpu.scan.executor.TableScanner` against
+the shared cursor — the same data-parallel shape, minus the PostgreSQL
+executor.
+
+Planner-integrated since round 5: ``Query(..., workers=N).run()`` (or
+``run(workers=N)`` / ``sql_query(..., workers=N)`` / ``strom_query
+--workers N``) ships a picklable spec (structured filters, SQL predicate
+trees, terminal, resolved GROUP BY keys) to N spawned processes via
+:func:`run_query_workers`; each rebuilds the query
+(`Query._from_worker_spec`), scans chunks claimed from the shared
+cursor with its OWN Session, and the leader folds the partial results
+exactly like the batch fold (`Query._run_workers`).
 """
 
 from __future__ import annotations
@@ -14,14 +24,11 @@ from __future__ import annotations
 import multiprocessing as mp
 import struct
 from multiprocessing import shared_memory
-from typing import Callable, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
-import numpy as np
+from .heap import PAGE_SIZE
 
-from .executor import TableScanner
-from .heap import HeapSchema
-
-__all__ = ["SharedCursor", "ParallelScanDesc", "parallel_scan"]
+__all__ = ["SharedCursor", "run_query_workers", "parallel_scan"]
 
 _HDR = struct.Struct("<qq")  # next_chunk, n_chunks
 
@@ -73,47 +80,50 @@ class SharedCursor:
                 pass
 
 
-def _worker(path: str, cursor_name: str, lock, chunk_size: int,
-            threshold: int, out_q) -> None:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    from ..ops.filter_xla import scan_filter_step
-    import jax.numpy as jnp
-    cursor = SharedCursor(0, name=cursor_name, create=False, lock=lock)
+def _query_worker(spec: dict, cursor_name: str, lock, out_q) -> None:
+    """Worker entry (spawned process): rebuild the query from the spec,
+    scan shared-cursor chunks, report the picklable partial."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    cursor = None
     try:
-        with TableScanner(path, chunk_size=chunk_size, cursor=cursor,
-                          numa_bind=False) as scanner:
-            acc = {"count": 0, "sum": 0, "pages": 0, "nr_ssd": 0, "nr_wb": 0}
-            for batch in scanner.batches():
-                out = scan_filter_step(batch.pages,
-                                       jnp.asarray(threshold, jnp.int32))
-                acc["count"] += int(out["count"])
-                acc["sum"] += int(out["sum"])
-                acc["pages"] += batch.pages.shape[0]
-                acc["nr_ssd"] += batch.nr_ssd
-                acc["nr_wb"] += batch.nr_wb
-        out_q.put(("ok", acc))
+        cursor = SharedCursor(0, name=cursor_name, create=False,
+                              lock=lock)
+        from .query import Query
+        q = Query._from_worker_spec(spec)
+        out_q.put(("ok", q._run_worker_partial(spec, cursor)))
     except BaseException as e:  # noqa: BLE001 — worker must always report
         out_q.put(("err", repr(e)))
     finally:
-        cursor.close()
+        if cursor is not None:
+            cursor.close()
 
 
-def parallel_scan(path: str, *, n_workers: int = 2,
-                  chunk_size: int = 1 << 20,
-                  threshold: int = 0) -> dict:
-    """Scan *path* with ``n_workers`` processes sharing one cursor; returns
-    summed aggregates (count/sum over the demo schema's filter)."""
-    import os
-    size = os.path.getsize(path)
+def shared_chunk_count(size: int, chunk_size: int) -> int:
+    """Total cursor positions for a table of *size* bytes: whole chunks
+    plus one tail position when the remainder still holds whole pages —
+    MUST match ``TableScanner``'s own cursor sizing or workers would
+    skip (or double-claim) the tail."""
     n_chunks = size // chunk_size
+    tail = size - n_chunks * chunk_size
+    return n_chunks + (1 if (tail and tail % PAGE_SIZE == 0) else 0)
+
+
+def run_query_workers(spec: dict, n_workers: int, *,
+                      timeout_s: float = 600.0) -> List[dict]:
+    """Fan a worker spec out to *n_workers* spawned processes sharing one
+    cursor; returns each worker's partial result (the leader folds)."""
+    import os
+    if n_workers < 2:
+        raise ValueError("run_query_workers needs >= 2 workers")
+    size = os.path.getsize(spec["source"])
+    total = shared_chunk_count(size, spec["chunk_size"])
     ctx = mp.get_context("spawn")
     lock = ctx.Lock()
-    cursor = SharedCursor(n_chunks, lock=lock)
+    cursor = SharedCursor(total, lock=lock)
     q = ctx.Queue()
-    procs = [ctx.Process(target=_worker,
-                         args=(path, cursor.name, lock, chunk_size,
-                               threshold, q))
+    procs = [ctx.Process(target=_query_worker,
+                         args=(spec, cursor.name, lock, q))
              for _ in range(n_workers)]
     try:
         for p in procs:
@@ -121,17 +131,41 @@ def parallel_scan(path: str, *, n_workers: int = 2,
         results: List[dict] = []
         errors: List[str] = []
         for _ in procs:
-            kind, payload = q.get(timeout=300)
+            kind, payload = q.get(timeout=timeout_s)
             (results if kind == "ok" else errors).append(payload)
         for p in procs:
             p.join(timeout=60)
         if errors:
             raise RuntimeError(f"parallel scan worker failed: {errors[0]}")
-        total = {k: sum(r[k] for r in results) for k in results[0]}
-        total["workers"] = len(results)
-        return total
+        return results
     finally:
         for p in procs:
             if p.is_alive():
                 p.terminate()
         cursor.close(unlink=True)
+
+
+def parallel_scan(path: str, *, n_workers: int = 2,
+                  chunk_size: int = 1 << 20,
+                  threshold: int = 0) -> dict:
+    """Back-compat demo face (subsumed by ``Query(..., workers=N)``):
+    scan *path* with ``n_workers`` processes sharing one cursor over the
+    demo filter (count rows with col0 > threshold, sum col1 over them);
+    returns summed count/sum plus the worker count.  Unlike the old
+    standalone harness this rides the planner-integrated path, so the
+    sub-chunk tail IS covered."""
+    from ..config import config
+    from .heap import HeapSchema
+    from .query import Query
+    schema = HeapSchema(n_cols=2, visibility=True)
+    q = Query(path, schema).where_range(0, threshold + 1, None) \
+        .aggregate(cols=[1])
+    prev = config.get("chunk_size")
+    config.set("chunk_size", chunk_size)
+    try:
+        out = q.run(workers=n_workers)
+    finally:
+        config.set("chunk_size", prev)
+    return {"count": int(out["count"]) if out else 0,
+            "sum": int(out["sums"][0]) if out else 0,
+            "workers": n_workers}

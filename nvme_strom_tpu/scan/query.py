@@ -70,6 +70,109 @@ class _ScanLimitReached(Exception):
     the plan's limit is satisfied)."""
 
 
+class _GroupSpill(Exception):
+    """Private control flow: key discovery crossed ``max_groups`` on a
+    shape the sorted-aggregation path can serve (1-2 key columns) — the
+    runner reroutes instead of failing with ENOMEM."""
+
+    def __init__(self, seen: int):
+        self.seen = seen
+        super().__init__(f"group key discovery passed {seen} distinct")
+
+
+class _SortedGroupAcc:
+    """Running sorted-aggregation state for the GROUP BY spill path:
+    a sorted packed-key array plus per-key count/sums/sumsqs/mins/maxs,
+    merged batch by batch — footprint O(distinct keys).  Accumulator
+    dtypes follow :func:`..ops.groupby.acc_dtypes` exactly so the spill
+    path and the one-hot kernels cannot drift (int sums wrap at the
+    same width on both)."""
+
+    def __init__(self, n_vals: int, acc_np, sq_np, lo, hi, cap: int):
+        self.V, self.cap = n_vals, cap
+        self.acc_np, self.sq_np, self.lo, self.hi = acc_np, sq_np, lo, hi
+        self.keys: Optional[np.ndarray] = None
+        self.count = self.sums = self.sumsqs = None
+        self.mins = self.maxs = None
+
+    def _batch_partial(self, kv: np.ndarray, vals: np.ndarray):
+        """Sort one batch's (keys, (V, n) values) and segment-reduce."""
+        order = np.argsort(kv, kind="stable")
+        kv, vals = kv[order], vals[:, order]
+        uk, starts = np.unique(kv, return_index=True)
+        count = np.diff(np.append(starts, len(kv))).astype(np.int64)
+        av = vals.astype(self.acc_np)
+        sums = np.add.reduceat(av, starts, axis=1)
+        fv = vals.astype(np.float64)
+        sumsqs = np.add.reduceat(fv * fv, starts,
+                                 axis=1).astype(self.sq_np)
+        mins = np.minimum.reduceat(vals, starts, axis=1)
+        maxs = np.maximum.reduceat(vals, starts, axis=1)
+        return uk, count, sums, sumsqs, mins, maxs
+
+    def add_batch(self, kv: np.ndarray, vals: np.ndarray) -> None:
+        if not len(kv):
+            return
+        self.merge_state(dict(zip(
+            ("keys", "count", "sums", "sumsqs", "mins", "maxs"),
+            self._batch_partial(kv, vals))))
+
+    def merge_state(self, st: dict) -> None:
+        """Merge another sorted partial (a batch's, or a worker's whole
+        state) into this one."""
+        uk = st["keys"]
+        if uk is None or not len(uk):
+            return
+        if self.keys is None:
+            self.keys = uk
+            self.count, self.sums = st["count"], st["sums"]
+            self.sumsqs = st["sumsqs"]
+            self.mins, self.maxs = st["mins"], st["maxs"]
+        else:
+            merged = np.union1d(self.keys, uk)
+            if len(merged) > self.cap:
+                raise StromError(12, f"group_by_cols: {len(merged)} "
+                                     f"distinct keys exceed even the "
+                                     f"sorted-aggregation cap "
+                                     f"{self.cap} (unbounded key set)")
+            io = np.searchsorted(merged, self.keys)
+            iN = np.searchsorted(merged, uk)
+            g = len(merged)
+            count = np.zeros(g, np.int64)
+            count[io] = self.count
+            np.add.at(count, iN, st["count"])
+            sums = np.zeros((self.V, g), self.acc_np)
+            sumsqs = np.zeros((self.V, g), self.sq_np)
+            mins = np.full((self.V, g), self.hi)
+            maxs = np.full((self.V, g), self.lo)
+            sums[:, io] = self.sums
+            sumsqs[:, io] = self.sumsqs
+            mins[:, io] = self.mins
+            maxs[:, io] = self.maxs
+            for v in range(self.V):
+                np.add.at(sums[v], iN, st["sums"][v])
+                np.add.at(sumsqs[v], iN, st["sumsqs"][v])
+                np.minimum.at(mins[v], iN, st["mins"][v])
+                np.maximum.at(maxs[v], iN, st["maxs"][v])
+            self.keys, self.count = merged, count
+            self.sums, self.sumsqs = sums, sumsqs
+            self.mins, self.maxs = mins, maxs
+
+    def state(self) -> dict:
+        """Picklable state (the worker's return value / the leader's
+        fold input) — empty-scan state is a zero-group result."""
+        if self.keys is None:
+            z = np.zeros(0, np.int64)
+            return {"keys": z, "count": z,
+                    "sums": np.zeros((self.V, 0), self.acc_np),
+                    "sumsqs": np.zeros((self.V, 0), self.sq_np),
+                    "mins": np.zeros((self.V, 0)),
+                    "maxs": np.zeros((self.V, 0))}
+        return {"keys": self.keys, "count": self.count,
+                "sums": self.sums, "sumsqs": self.sumsqs,
+                "mins": self.mins, "maxs": self.maxs}
+
+
 @dataclass(frozen=True)
 class QueryPlan:
     """What ``run()`` will do, decided before any I/O (EXPLAIN analog)."""
@@ -82,10 +185,12 @@ class QueryPlan:
     cost_vfs: float
     reason: str
     join_strategy: Optional[str] = None  # broadcast | partitioned(N)
+    workers: int = 0       # parallel worker processes (0 = serial)
 
     def __str__(self) -> str:
+        par = f", workers={self.workers}" if self.workers else ""
         return (f"{self.operator} scan  [{self.access_path} path, "
-                f"{self.kernel} kernel, {self.mode}]\n"
+                f"{self.kernel} kernel, {self.mode}{par}]\n"
                 f"  pages: {self.n_pages}  cost: direct={self.cost_direct:.0f} "
                 f"vfs={self.cost_vfs:.0f}\n"
                 f"  {self.reason}")
@@ -102,7 +207,7 @@ class Query:
     """
 
     def __init__(self, source, schema: HeapSchema, *,
-                 stripe_chunk_size: int = 512 << 10):
+                 stripe_chunk_size: int = 512 << 10, workers: int = 0):
         if isinstance(source, os.PathLike):
             source = str(source)
         elif isinstance(source, (list, tuple)):
@@ -110,6 +215,9 @@ class Query:
         self.source = source
         self.schema = schema
         self._stripe_chunk = stripe_chunk_size
+        self._workers = int(workers)   # >= 2: parallel worker processes
+        self._pred_trees: List[tuple] = []   # picklable predicate trees
+        self._opaque_pred = False            # a where() lambda w/o tree
         self._pred: Optional[Callable] = None
         self._residual: Optional[Callable] = None  # index-path recheck
         self._op = "aggregate"
@@ -129,7 +237,7 @@ class Query:
         self._in: Optional[tuple] = None     # structured IN (col, members)
 
     # -- builders -----------------------------------------------------------
-    def where(self, predicate: Callable) -> "Query":
+    def where(self, predicate: Callable, *, _tree=None) -> "Query":
         """Row filter: ``predicate(cols) -> (B, T) bool`` (jnp ops only).
 
         Chained filters COMPOSE as a conjunction (the SQL-builder
@@ -140,7 +248,15 @@ class Query:
         path RECHECKS index-resolved rows against it (PG's Index Cond +
         Filter shape), so adding a predicate never demotes an
         index-capable query to a seqscan.  The structured setters
-        replace the WHOLE filter (they define a new index condition)."""
+        replace the WHOLE filter (they define a new index condition).
+
+        ``_tree`` (internal, set by the SQL facade) carries the
+        predicate's picklable condition tree so worker processes can
+        reconstruct it; a bare lambda marks the query non-parallel."""
+        if _tree is not None:
+            self._pred_trees.append(_tree)
+        else:
+            self._opaque_pred = True
         if self._pred is not None:
             old = self._pred
             self._pred = lambda cols: old(cols) & predicate(cols)
@@ -160,6 +276,10 @@ class Query:
         self._range = rng
         self._in = members
         self._residual = None
+        # the structured setters replace the WHOLE filter — any prior
+        # opaque where() is gone, so the query is shippable again
+        self._pred_trees = []
+        self._opaque_pred = False
 
     def where_eq(self, col: int, value) -> "Query":
         """Structured equality filter: ``col == value``.  Unlike the
@@ -416,9 +536,17 @@ class Query:
         the group count, and the composed HAVING (empty groups dropped —
         discovery may be a SUPERSET of the selected rows' keys when it
         comes from a sidecar) into ``self._group``."""
-        import jax.numpy as jnp
+        self._install_group_keys(self._discover_group_keys(session,
+                                                           device))
 
-        from .index import pack_pair, unpack_second
+    def _discover_group_keys(self, session, device) -> np.ndarray:
+        """Discovery half of :meth:`_resolve_group_keys`: the sorted
+        distinct key set (packed uint64 for pairs, (g, N) lex rows for
+        3-4 keys) from a fresh sidecar at zero table I/O, else a
+        streamed projection scan.  Raises :class:`_GroupSpill` past
+        ``max_groups`` when the sorted-aggregation fallback can serve
+        this shape (run() catches it); ENOMEM otherwise."""
+        from .index import pack_pair
         cols_, agg, user_having, max_groups = self._group_cols
         dts = [self.schema.col_dtype(c) for c in cols_]
         discovered = None
@@ -439,6 +567,8 @@ class Query:
             except Exception:   # raced away: fall to the scan
                 discovered = None
         if discovered is not None and len(discovered) > max_groups:
+            if len(cols_) <= 2:
+                raise _GroupSpill(len(discovered))
             raise StromError(12, f"group_by_cols: {len(discovered)} "
                                  f"distinct keys exceed max_groups="
                                  f"{max_groups}")
@@ -468,6 +598,8 @@ class Query:
                     merged = np.unique(
                         np.concatenate([merged, u]), axis=0)
                 if len(merged) > max_groups:
+                    if nk <= 2:
+                        raise _GroupSpill(len(merged))
                     raise StromError(
                         12, f"group_by_cols: more than {max_groups} "
                             f"distinct keys (raise max_groups, or use "
@@ -477,6 +609,19 @@ class Query:
             self._stream_collect(self._explain_inner(), collect, device,
                                  session)
             discovered = merged
+        return discovered
+
+    def _install_group_keys(self, discovered: np.ndarray) -> None:
+        """Installation half of :meth:`_resolve_group_keys`: derive the
+        ``searchsorted`` key function + group count from the (already
+        discovered, possibly worker-shipped) sorted key set and compose
+        the empty-group-dropping HAVING into ``self._group``."""
+        import jax.numpy as jnp
+
+        from .index import unpack_second
+        cols_, agg, user_having, _max_groups = self._group_cols
+        dts = [self.schema.col_dtype(c) for c in cols_]
+        self._group_discovered = discovered   # worker spec ships this
         if len(cols_) == 1:
             keys = discovered.astype(dts[0])
             g = len(keys)
@@ -1010,6 +1155,15 @@ class Query:
 
     def explain(self, *, mesh=None) -> QueryPlan:
         plan = self._explain_inner(mesh=mesh)
+        if self._workers >= 2 and mesh is None:
+            from .planner import _parallel_divisor
+            plan = dataclasses.replace(
+                plan, workers=self._workers,
+                reason=plan.reason +
+                f"; parallel: {self._workers} worker processes claim "
+                f"chunks from ONE shared cursor (per-worker Sessions, "
+                f"partials fold on the leader; cost divisor "
+                f"{_parallel_divisor(self._workers):.1f})")
         if self._group_cols is not None:
             plan = dataclasses.replace(
                 plan, reason=plan.reason +
@@ -1048,8 +1202,9 @@ class Query:
             path, table_size=size)
         mode = "mesh" if mesh is not None else "local"
         kernel, why = self._kernel_choice(mode)
-        cd = cost_direct_scan(n_pages, n_pages * t)
-        cv = cost_vfs_scan(n_pages, n_pages * t)
+        nw = self._workers if self._workers >= 2 else 0
+        cd = cost_direct_scan(n_pages, n_pages * t, workers=nw)
+        cv = cost_vfs_scan(n_pages, n_pages * t, workers=nw)
         if mode == "local" and kernel != "invalid":
             comb = self._eq_order_combo_path()
             if comb is not None and self._eq[1] is not None:
@@ -1196,15 +1351,19 @@ class Query:
     # -- execution ----------------------------------------------------------
     def run(self, *, mesh=None, device=None, kernel: str = "auto",
             batch_pages: Optional[int] = None, session=None,
-            analyze: bool = False) -> dict:
+            analyze: bool = False, workers: Optional[int] = None) -> dict:
         """Execute the planned scan and return numpy results.
 
         ``kernel`` overrides the planner's pallas/XLA choice ("auto" |
         "pallas" | "xla").  With *mesh*, batches stream sharded over the
         mesh's ``dp`` axis and XLA inserts the reduction collectives.
-        ``analyze=True`` attaches an ``"_analyze"`` key — elapsed wall
-        time plus the engine's stage counters for this run (the EXPLAIN
-        ANALYZE face of the STAT_INFO registry,
+        ``workers=N`` (or ``Query(..., workers=N)``) runs the scan as N
+        worker PROCESSES sharing one atomic chunk cursor — the Gather
+        analog (`pgsql/nvme_strom.c:582-595,1057-1112`); each worker
+        scans with its own Session and the partial results fold on the
+        leader.  ``analyze=True`` attaches an ``"_analyze"`` key —
+        elapsed wall time plus the engine's stage counters for this run
+        (the EXPLAIN ANALYZE face of the STAT_INFO registry,
         kmod/nvme_strom.c:2056-2103)."""
         if analyze:
             import time as _time
@@ -1226,7 +1385,8 @@ class Query:
             self._last_scan_h2d_depth = 0
             t0 = _time.monotonic()
             out = self.run(mesh=mesh, device=device, kernel=kernel,
-                           batch_pages=batch_pages, session=session)
+                           batch_pages=batch_pages, session=session,
+                           workers=workers)
             dt = _time.monotonic() - t0
             _fold(session)
             after = _stats.snapshot(reset_max=False).counters
@@ -1254,11 +1414,19 @@ class Query:
                 if dt > 0 else None,
             }
             return out
+        nw = self._workers if workers is None else int(workers)
+        if nw >= 2 and mesh is None:
+            return self._run_workers(nw, session=session, device=device)
         if self._group_cols is not None and self._group[0] is None:
             # value-keyed GROUP BY: discover the distinct key set first
             # (sidecar when fresh, streamed scan otherwise), then run as
-            # a normal group_by with a searchsorted key function
-            self._resolve_group_keys(session, device)
+            # a normal group_by with a searchsorted key function; past
+            # max_groups the sorted-aggregation path takes over (the
+            # one-hot kernels' footprint grows with the group count)
+            try:
+                self._resolve_group_keys(session, device)
+            except _GroupSpill:
+                return self._run_groupby_sorted(device, session)
         plan = self.explain(mesh=mesh)
         if plan.kernel == "invalid":
             raise StromError(22, f"query not executable: {plan.reason}")
@@ -1463,6 +1631,326 @@ class Query:
             # surviving group (group_by_cols contract)
             res["key_cols"] = self._gk_decode(res["groups"])
         return res
+
+    # -- sorted (spill) GROUP BY -------------------------------------------
+    _SPILL_HARD_MAX = 1 << 24   # truly-unbounded guard (ENOMEM past this)
+
+    def _sorted_group_ctx(self):
+        """Shared setup for the sorted-aggregation GROUP BY (serial and
+        worker halves): ``(key_cols, agg_idx, packer, accumulator)``."""
+        from ..ops.groupby import _check_agg_cols, acc_dtypes
+        from .index import pack_pair
+        cols_, agg, _user_having, _mg = self._group_cols
+        agg_idx, agg_dt = _check_agg_cols(self.schema, agg)
+        acc_np, sq_np, lo, hi = acc_dtypes(agg_dt)
+        dts = [self.schema.col_dtype(c) for c in cols_]
+        if len(cols_) == 1:
+            packer = lambda ks: ks[0]
+        else:
+            packer = lambda ks: pack_pair(ks[0], ks[1], dts[0], dts[1])
+        acc = _SortedGroupAcc(len(agg_idx), acc_np, sq_np, lo, hi,
+                              self._SPILL_HARD_MAX)
+        return cols_, agg_idx, packer, acc
+
+    def _sorted_group_scan(self, acc, cols_, agg_idx, packer, device,
+                           session, *, scanner=None) -> None:
+        """Stream the scan through the sorted accumulator: gather key +
+        aggregate columns, pack keys, sort-reduce per batch, merge."""
+        gather, _f, _d = self._make_gather_fn(list(cols_) + list(agg_idx),
+                                              want_positions=False)
+        nk = len(cols_)
+
+        def collect(pages_dev):
+            out = gather(pages_dev)
+            m = np.asarray(out["mask"]).astype(bool)
+            ks = [np.asarray(out[f"f{i}"])[m] for i in range(nk)]
+            vals = np.stack([np.asarray(out[f"f{nk + j}"])[m]
+                             for j in range(len(agg_idx))])
+            acc.add_batch(packer(ks), vals)
+            return {}
+
+        if scanner is not None:
+            scanner.scan_filter(collect, device=device)
+        else:
+            self._stream_collect(self._explain_inner(), collect, device,
+                                 session)
+
+    def _sorted_group_result(self, acc) -> dict:
+        """Fold the accumulator state into the group_by result contract
+        (same faces as the one-hot kernels + ``key_cols``), via
+        :meth:`_finalize` so HAVING/avgs/vars compose identically."""
+        from .index import unpack_second
+        cols_, agg, user_having, _mg = self._group_cols
+        dts = [self.schema.col_dtype(c) for c in cols_]
+        st = acc.state()
+        keys = st.pop("keys")
+
+        def hv(res, user=user_having):
+            m = np.asarray(res["count"]) > 0
+            if user is not None:
+                m = m & np.asarray(user(res)).astype(bool)
+            return m
+
+        self._group = (None, len(keys), agg, hv)
+        if len(cols_) == 1:
+            self._gk_decode = lambda gids, keys=keys: [
+                keys.astype(dts[0])[gids]]
+        else:
+            hi_w = (keys >> np.uint64(32))
+            if dts[0] == np.dtype(np.int32):
+                k0 = (hi_w.astype(np.int64) - (1 << 31)).astype(np.int32)
+            else:
+                k0 = hi_w.astype(np.uint32)
+            k1 = unpack_second(keys, dts[1])
+            self._gk_decode = lambda gids, k0=k0, k1=k1: [k0[gids],
+                                                          k1[gids]]
+        return self._finalize(st)
+
+    def _run_groupby_sorted(self, device, session) -> dict:
+        """GROUP BY past the one-hot budget (``max_groups``): sort-then-
+        segment-reduce — each batch's selected rows sort by packed key
+        and ``reduceat`` into per-key partials, merged into a running
+        sorted state whose footprint is O(distinct keys), not
+        O(rows x groups) like the one-hot contraction.  The SQL executor
+        the reference sits under switches to sort-aggregation for
+        high-cardinality keys the same way.  Local host path (the mesh
+        one-hot path keeps its own budget); result contract identical to
+        the kernel path."""
+        cols_, agg_idx, packer, acc = self._sorted_group_ctx()
+        self._sorted_group_scan(acc, cols_, agg_idx, packer, device,
+                                session)
+        return self._sorted_group_result(acc)
+
+    # -- parallel worker processes (the Gather analog) ----------------------
+    _WORKER_OPS = ("aggregate", "group_by", "top_k", "select")
+
+    def _worker_spec(self, discovered=None) -> dict:
+        """Picklable reconstruction recipe for worker processes: the
+        structured filter, SQL predicate trees, terminal, and (for
+        value-keyed GROUP BY) the leader-discovered key set."""
+        from ..config import config as _cfg
+        spec = {
+            "source": self.source,
+            "schema": (self.schema.n_cols, self.schema.visibility,
+                       self.schema.dtypes),
+            "chunk_size": int(_cfg.get("chunk_size")),
+            "eq": self._eq, "rng": self._range, "in": self._in,
+            "trees": list(self._pred_trees),
+            "op": self._op,
+            "agg_cols": (None if self._agg_cols is None
+                         else list(self._agg_cols)),
+            "select": self._select,
+            "topk": self._topk,
+        }
+        if self._op == "group_by":
+            cols_, agg, _hv, max_groups = self._group_cols
+            spec["group"] = (list(cols_), None if agg is None
+                             else list(agg), int(max_groups))
+            spec["discovered"] = discovered
+        return spec
+
+    @classmethod
+    def _from_worker_spec(cls, spec: dict) -> "Query":
+        """Rebuild the leader's query inside a worker process from the
+        picklable spec (inverse of :meth:`_worker_spec`)."""
+        n_cols, vis, dts = spec["schema"]
+        schema = HeapSchema(n_cols=n_cols, visibility=vis, dtypes=dts)
+        q = cls(spec["source"], schema)
+        if spec["eq"] is not None:
+            col, v = spec["eq"]
+            if v is None:    # no representable literal: matches nothing
+                c0 = int(col[0]) if isinstance(col, (tuple, list)) \
+                    else int(col)
+                q._pred = lambda cols: cols[c0] != cols[c0]
+                q._set_structured(eq=(col, None))
+            elif isinstance(col, (tuple, list)):
+                q.where_eq(tuple(col), tuple(v))
+            else:
+                q.where_eq(col, v)
+        elif spec["rng"] is not None:
+            c, lo, hi = spec["rng"]
+            q.where_range(c, lo, hi)
+        elif spec["in"] is not None:
+            c, members = spec["in"]
+            q.where_in(c, members)
+        from .sql import _tree_mask
+        for t in spec["trees"]:
+            q.where(lambda cols, t=t: _tree_mask(t, cols), _tree=t)
+        op = spec["op"]
+        if op == "aggregate":
+            q.aggregate(spec["agg_cols"])
+        elif op == "top_k":
+            tc, tk, tl = spec["topk"]
+            q.top_k(tc, tk, largest=tl)
+        elif op == "select":
+            cols, limit, offset = spec["select"]
+            # offset applies on the LEADER (rows split across workers);
+            # each worker gathers up to offset+limit and the leader
+            # slices the concatenation
+            stop = None if limit is None else limit + offset
+            q.select(cols, limit=stop, offset=0)
+        elif op in ("group_by", "group_sorted"):
+            cols_, agg, max_groups = spec["group"]
+            q.group_by_cols(cols_, agg_cols=agg, max_groups=max_groups)
+            if op == "group_by":
+                q._install_group_keys(spec["discovered"])
+            else:    # spill: workers sort-aggregate, no key table
+                q._op = "group_sorted"
+        else:
+            raise StromError(22, f"worker spec op {op!r}")
+        return q
+
+    def _run_worker_partial(self, spec: dict, cursor) -> dict:
+        """Worker-side execution: scan chunks claimed from the SHARED
+        cursor with this process's own Session and return the picklable
+        partial (raw accumulator — the leader folds and finalizes).
+        ``scan_s`` rides along: the worker's own scan wall time, net of
+        process spawn/jit, so the leader can report how the scan work
+        actually divided."""
+        import time as _time
+
+        from .executor import TableScanner
+        t0 = _time.monotonic()
+        out = self._worker_partial_inner(spec, cursor, TableScanner)
+        out["scan_s"] = _time.monotonic() - t0
+        return out
+
+    def _worker_partial_inner(self, spec: dict, cursor,
+                              TableScanner) -> dict:
+        with TableScanner(self.source, self.schema, cursor=cursor,
+                          chunk_size=spec["chunk_size"],
+                          numa_bind=False) as sc:
+            if self._op == "group_sorted":
+                cols_, agg_idx, packer, acc = self._sorted_group_ctx()
+                self._sorted_group_scan(acc, cols_, agg_idx, packer,
+                                        None, None, scanner=sc)
+                return {"sorted": acc.state()}
+            if self._op in ("aggregate", "group_by", "top_k"):
+                fn, combine = self._build_fn("xla")
+                return {"acc": sc.scan_filter(fn, combine=combine)}
+            # select
+            cols, stop, _off = self._select
+            if cols is None:
+                cols = list(range(self.schema.n_cols))
+            gather, fields, dtypes = self._make_gather_fn(cols)
+            chunks: List[list] = []
+            gathered = 0
+
+            def collect(pages_dev):
+                nonlocal gathered
+                out = gather(pages_dev)
+                m = np.asarray(out["mask"]).astype(bool)
+                chunks.append([np.asarray(out[f])[m] for f in fields])
+                gathered += int(m.sum())
+                if stop is not None and gathered >= stop:
+                    raise _ScanLimitReached
+                return {}
+
+            try:
+                sc.scan_filter(collect)
+            except _ScanLimitReached:
+                pass
+            if chunks:
+                arrs = [np.concatenate([c[i] for c in chunks])
+                        for i in range(len(fields))]
+            else:
+                arrs = [np.zeros(0, dt) for dt in dtypes]
+            if stop is not None:
+                arrs = [a[:stop] for a in arrs]
+            return {"rows": arrs}
+
+    def _run_workers(self, n_workers: int, *, session=None,
+                     device=None) -> dict:
+        """Leader side of the parallel scan: validate the query is
+        worker-shippable, resolve GROUP BY keys once (workers must share
+        one key space), fan out via :func:`.parallel.run_query_workers`,
+        and fold the partials exactly like the batch fold."""
+        from .executor import fold_results
+        from .parallel import run_query_workers
+        if not isinstance(self.source, str):
+            raise StromError(22, "workers: parallel scan takes a single "
+                                 "on-disk table path (striped sets scan "
+                                 "serially or via a mesh)")
+        if self._join is not None or self._join_src is not None:
+            raise StromError(22, "workers: JOIN is not worker-servable "
+                                 "yet (use the mesh partitioned join)")
+        if self._opaque_pred:
+            raise StromError(22, "workers: an opaque where() lambda "
+                                 "cannot ship to worker processes — use "
+                                 "where_eq/where_range/where_in or the "
+                                 "SQL facade (predicate trees travel)")
+        spill = False
+        discovered = None
+        if self._op == "group_by":
+            if self._group_cols is None:
+                raise StromError(22, "workers: group_by needs "
+                                     "group_by_cols (key-function "
+                                     "closures cannot ship)")
+            if self._group[0] is None:
+                try:
+                    discovered = self._discover_group_keys(session,
+                                                           device)
+                    self._install_group_keys(discovered)
+                except _GroupSpill:
+                    spill = True
+            else:
+                discovered = getattr(self, "_group_discovered", None)
+                if discovered is None:
+                    raise StromError(22, "workers: group keys resolved "
+                                         "without a shippable key set")
+        elif self._op not in self._WORKER_OPS:
+            raise StromError(22, f"workers: terminal {self._op!r} is "
+                                 f"not worker-servable "
+                                 f"({'/'.join(self._WORKER_OPS)})")
+        spec = self._worker_spec(discovered)
+        if spill:
+            spec["op"] = "group_sorted"
+        partials = run_query_workers(spec, n_workers)
+        winfo = {"n": n_workers,
+                 "scan_s": [round(p.pop("scan_s", 0.0), 6)
+                            for p in partials]}
+
+        def _tag(out: dict) -> dict:
+            # per-worker scan seconds (net of spawn/jit) — the Gather
+            # observability face; assemblers drop it like "_analyze"
+            if isinstance(out, dict) and out:
+                out["_workers"] = winfo
+            return out
+        if spill:
+            _c, _a, _p, acc = self._sorted_group_ctx()
+            for p in partials:
+                acc.merge_state(p["sorted"])
+            return _tag(self._sorted_group_result(acc))
+        if self._op == "select":
+            cols, limit, offset = self._select
+            if cols is None:
+                cols = list(range(self.schema.n_cols))
+            _g, fields, dtypes = self._make_gather_fn(cols)
+            rows = [p["rows"] for p in partials]
+            arrs = [np.concatenate([r[i] for r in rows])
+                    if rows else np.zeros(0, dtypes[i])
+                    for i in range(len(fields))]
+            stop = None if limit is None else offset + limit
+            arrs = [a[offset:stop] for a in arrs]
+            out = {f"col{c}": v for c, v in zip(cols, arrs[:-1])}
+            out["positions"] = arrs[-1]
+            out["count"] = np.int64(len(out["positions"]))
+            return _tag(out)
+        accs = [p["acc"] for p in partials if p["acc"]]
+        if not accs:
+            return {}
+        if self._op == "group_by":
+            from ..ops.groupby import combine_groupby
+            combine = combine_groupby
+        elif self._op == "top_k":
+            _fn, combine = self._build_fn("xla")
+        else:
+            combine = None
+        folded = None
+        for a in accs:
+            folded = fold_results(folded, a, combine)
+        import jax
+        return _tag(self._finalize(jax.tree.map(np.asarray, folded)))
 
     def _check_sortable_col(self, col: int, opname: str) -> np.dtype:
         if not 0 <= col < self.schema.n_cols:

@@ -547,7 +547,10 @@ def _apply_where(q: Query, tree) -> Query:
     else:
         rest = tree
     if rest is not None:
-        q = q.where(lambda cols, rest=rest: _tree_mask(rest, cols))
+        # _tree rides along so worker processes can rebuild the mask
+        # (a bare lambda would mark the query non-parallel)
+        q = q.where(lambda cols, rest=rest: _tree_mask(rest, cols),
+                    _tree=rest)
     return q
 
 
@@ -578,16 +581,20 @@ _JOIN_TYPES = ("inner", "left", "semi", "anti")
 
 
 def parse_sql(sql: str, source, schema,
-              tables: Optional[dict] = None) -> Tuple[Query, "callable"]:
+              tables: Optional[dict] = None,
+              workers: int = 0) -> Tuple[Query, "callable"]:
     """Parse *sql* against *source*/*schema*; returns ``(query,
     assemble)`` where ``assemble(run_result) -> dict`` relabels the
     terminal's output into the statement's select-list names — with
     dictionary-encoded string columns decoded back to strings at the
-    edge.  *tables* binds JOIN dimension names to ``(path, schema)``."""
+    edge.  *tables* binds JOIN dimension names to ``(path, schema)``.
+    ``workers=N`` plans the scan over N worker processes (the Gather
+    analog; predicate trees ship to workers, so any WHERE subset
+    statement parallelizes)."""
     import inspect
     aliases: dict = {}
     q, assemble = _parse_sql_raw(sql, source, schema, tables=tables,
-                                 _aliases_out=aliases)
+                                 _aliases_out=aliases, workers=workers)
     dicts = _dict_cache(source)
 
     def assemble_decoded(res, **kw):
@@ -600,8 +607,8 @@ def parse_sql(sql: str, source, schema,
 
 def _parse_sql_raw(sql: str, source, schema,
                    tables: Optional[dict] = None,
-                   _aliases_out: Optional[dict] = None
-                   ) -> Tuple[Query, "callable"]:
+                   _aliases_out: Optional[dict] = None,
+                   workers: int = 0) -> Tuple[Query, "callable"]:
     n_cols = schema.n_cols
     p = _P(_tokenize(sql))
     p.expect_kw("select")
@@ -715,7 +722,7 @@ def _parse_sql_raw(sql: str, source, schema,
             if it.col not in seen:
                 seen.append(it.col)
         group_cols = seen      # DISTINCT == GROUP BY the select list
-    q = _apply_where(Query(source, schema), where_tree)
+    q = _apply_where(Query(source, schema, workers=workers), where_tree)
     off = offset or 0
 
     # --- JOIN -------------------------------------------------------------
@@ -1001,7 +1008,8 @@ def sql_query(sql: str, source, schema, tables: Optional[dict] = None,
     ``session``/``device`` run kwargs also reach any post-pass the
     assembler performs (the projected ORDER BY point-lookups)."""
     import inspect
-    q, assemble = parse_sql(sql, source, schema, tables=tables)
+    q, assemble = parse_sql(sql, source, schema, tables=tables,
+                            workers=int(run_kw.get("workers") or 0))
     res = q.run(**run_kw)
     params = inspect.signature(assemble).parameters
     extra = {k: run_kw[k] for k in ("session", "device")
@@ -1009,6 +1017,8 @@ def sql_query(sql: str, source, schema, tables: Optional[dict] = None,
     out = assemble(res, **extra)
     if isinstance(res, dict) and "_analyze" in res:
         out["_analyze"] = res["_analyze"]   # EXPLAIN ANALYZE face
+    if isinstance(res, dict) and "_workers" in res:
+        out["_workers"] = res["_workers"]   # per-worker scan seconds
     return out
 
 
@@ -1041,9 +1051,18 @@ def create_table_as(dest_path: str, sql: str, source, schema,
     cols, dts, dict_cols = [], [], {}
     n_rows = None
     for label, v in out.items():
-        arr = np.asarray(v) if not np.isscalar(v) and v is not None \
-            else np.asarray([0 if v is None else v])
+        if v is None:
+            # a NULL scalar aggregate (MIN over zero rows): the heap
+            # format has no scalar NULL — refuse rather than silently
+            # materializing SQL NULL as a real value
+            raise StromError(22, f"CREATE TABLE AS: {label!r} is NULL "
+                                 f"(aggregate over zero rows) — no NULL "
+                                 f"scalar representation in the heap "
+                                 f"format")
+        arr = np.asarray([v]) if np.isscalar(v) else np.asarray(v)
         arr = arr.reshape(-1)
+        if arr.dtype.kind in "US":     # string results re-encode below
+            arr = arr.astype(object)
         if n_rows is None:
             n_rows = len(arr)
         elif len(arr) != n_rows:

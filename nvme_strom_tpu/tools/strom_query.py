@@ -244,6 +244,11 @@ def main(argv=None) -> int:
                          "(positions/keys/payload; --limit/--offset apply)")
     ap.add_argument("--kernel", choices=("auto", "pallas", "xla"),
                     default="auto")
+    ap.add_argument("--workers", type=int, default=0, metavar="N",
+                    help="run the scan as N worker processes sharing "
+                         "one cursor (the Gather analog; structured "
+                         "filters and --sql predicates parallelize; "
+                         "exclusive with --mesh)")
     ap.add_argument("--mesh", action="store_true",
                     help="stream sharded over all devices (dp axis)")
     ap.add_argument("--sql", default=None, metavar="STATEMENT",
@@ -314,16 +319,30 @@ def main(argv=None) -> int:
                 or args.build_index is not None or args.index_lookup:
             ap.error("--sql is the whole query; drop the per-flag "
                      "builders")
+        if args.workers and args.mesh:
+            ap.error("--workers and --mesh are exclusive scan modes")
         from ..scan.sql import parse_sql
         tables = {}
         for spec in args.sql_table:
             name, eq, rest = spec.partition("=")
-            tpath, colon, ncols = rest.rpartition(":")
-            if not eq or not colon or not ncols.isdigit():
-                ap.error("--sql-table takes NAME=PATH:NCOLS")
-            tables[name] = (tpath,
-                            HeapSchema(n_cols=int(ncols),
-                                       visibility=False))
+            tpath, colon, tail = rest.rpartition(":")
+            if not eq or not colon:
+                ap.error("--sql-table takes NAME=PATH:NCOLS or "
+                         "NAME=PATH:DT,DT,... (dtypes like the main "
+                         "table's --dtypes)")
+            if tail.isdigit():
+                # bare count = all-int32 columns; a typed payload needs
+                # the dtype form or SUM(dim.cK) reinterprets its bits
+                tsch = HeapSchema(n_cols=int(tail), visibility=False)
+            else:
+                try:
+                    tsch = HeapSchema(
+                        n_cols=len(tail.split(",")), visibility=False,
+                        dtypes=tuple(tail.split(",")))
+                except (TypeError, ValueError) as e:
+                    ap.error(f"--sql-table {name}: bad dtype list "
+                             f"{tail!r} ({e})")
+            tables[name] = (tpath, tsch)
         if args.sql_create:
             from ..scan.sql import create_table_as
             try:
@@ -332,14 +351,14 @@ def main(argv=None) -> int:
                     tables=tables, overwrite=args.sql_create_force)
             except StromError as e:
                 ap.error(f"--sql-create: {e}")
+            dts = ",".join(str(dsch.col_dtype(i))
+                           for i in range(dsch.n_cols))
             print(f"created {args.sql_create}: {n} rows, "
-                  f"{dsch.n_cols} columns "
-                  f"({','.join(str(dsch.col_dtype(i))
-                               for i in range(dsch.n_cols))})")
+                  f"{dsch.n_cols} columns ({dts})")
             return 0
         try:
             q, assemble = parse_sql(args.sql, src, schema,
-                                    tables=tables)
+                                    tables=tables, workers=args.workers)
         except StromError as e:
             ap.error(f"--sql: {e}")
         mesh = None
@@ -371,7 +390,10 @@ def main(argv=None) -> int:
             if ana:
                 print(f"_analyze: {ana}")
         return 0
-    q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk))
+    if args.workers and args.mesh:
+        ap.error("--workers and --mesh are exclusive scan modes")
+    q = Query(src, schema, stripe_chunk_size=parse_size(args.stripe_chunk),
+              workers=args.workers)
     if args.build_index is not None or args.index_lookup:
         from ..scan.index import build_index, open_index
         if terminals or args.where or args.where_eq or args.where_range or args.where_in \

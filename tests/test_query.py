@@ -1460,11 +1460,15 @@ def test_group_by_cols_validation(heap):
         Query(path, schema).group_by_cols(7)           # out of range
     with pytest.raises(StromError):
         Query(path, schema).group_by_cols(1, max_groups=0)
-    # discovery past max_groups fails with ENOMEM, not truncation
+    # discovery past max_groups now SPILLS to sorted aggregation (round
+    # 5) instead of failing with ENOMEM — same result, never truncation
     config.set("debug_no_threshold", True)
-    with pytest.raises(StromError) as ei:
-        Query(path, schema).group_by_cols(0, max_groups=4).run()
-    assert ei.value.errno == 12
+    spilled = Query(path, schema).group_by_cols(0, max_groups=4).run()
+    normal = Query(path, schema).group_by_cols(0).run()
+    np.testing.assert_array_equal(spilled["key_cols"][0],
+                                  normal["key_cols"][0])
+    np.testing.assert_array_equal(spilled["count"], normal["count"])
+    np.testing.assert_array_equal(spilled["sums"], normal["sums"])
 
 
 def test_group_by_cols_pair_sidecar_discovery(tmp_path):

@@ -223,17 +223,12 @@ def test_parallel_scan_two_workers(heap_file):
     from nvme_strom_tpu.scan.parallel import parallel_scan
     path, schema, c0, c1 = heap_file
     out = parallel_scan(path, n_workers=2, chunk_size=CHUNK, threshold=100)
+    # the planner-integrated parallel path covers the sub-chunk tail
+    # too (the old standalone harness dropped it)
     sel = c0 > 100
-    # workers split the chunk grid; the sub-chunk tail is not scanned in
-    # parallel mode, so compare against the chunk-aligned prefix
-    n_chunks = os.path.getsize(path) // CHUNK
-    rows_per_page = schema.tuples_per_page
-    pages_covered = n_chunks * (CHUNK // PAGE_SIZE)
-    rows_covered = min(pages_covered * rows_per_page, len(c0))
-    sel_cov = sel[:rows_covered]
     assert out["workers"] == 2
-    assert out["count"] == int(sel_cov.sum())
-    assert out["sum"] == int(c1[:rows_covered][sel_cov].sum())
+    assert out["count"] == int(sel.sum())
+    assert out["sum"] == int(c1[sel].sum())
 
 
 def test_scanner_steady_state_many_chunks(tmp_path):
