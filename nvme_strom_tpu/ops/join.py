@@ -255,10 +255,9 @@ def _star_probe_all(joins, cols, valid, predicate, params):
         # stand-in payload (never read)
         hit, pay = _probe(keys, keys if vals is None else vals,
                           cols[pc], sel)
-        if how in ("inner", "semi"):
-            emit = emit & hit
-        elif how == "anti":
-            emit = emit & ~hit
+        # per-dim restriction composes THE single emit derivation
+        # (_emit_mask) — left contributes sel, i.e. no restriction
+        emit = emit & _emit_mask(how, sel, hit)
         probes.append((hit, pay))
     return emit, probes
 

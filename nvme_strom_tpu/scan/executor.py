@@ -35,7 +35,20 @@ from .heap import PAGE_SIZE, HeapSchema
 from .planner import capability_cache
 from .pool import DmaBufferPool, DmaChunk, ResourceOwner
 
-__all__ = ["LocalCursor", "Batch", "TableScanner", "fold_results"]
+__all__ = ["LocalCursor", "Batch", "TableScanner", "fold_results",
+           "cursor_chunk_count"]
+
+
+def cursor_chunk_count(size: int, chunk_size: int) -> int:
+    """Total cursor positions for a source of *size* bytes: whole chunks
+    plus one tail position when the remainder still holds whole pages.
+    THE single formula — :class:`TableScanner` sizes its own cursor with
+    it and the cross-process :class:`..scan.parallel.SharedCursor` must
+    be created with the same count, or workers would skip (or
+    double-claim) the tail."""
+    n_chunks = size // chunk_size
+    tail = size - n_chunks * chunk_size
+    return n_chunks + (1 if (tail and tail % PAGE_SIZE == 0) else 0)
 
 
 class CoalescedFold:
@@ -163,7 +176,8 @@ class TableScanner:
             self._tail_pages = tail // PAGE_SIZE
         else:
             self._tail_pages = 0
-        self.cursor = cursor or LocalCursor(self.n_chunks + (1 if self._tail_pages else 0))
+        self.cursor = cursor or LocalCursor(
+            cursor_chunk_count(self.source.size, self.chunk_size))
         self._own_pool = pool is None
         # + h2d_depth_max: scan_filter keeps that many batches alive with
         # their H2D transfers in flight (deferred-fence pipelining), on

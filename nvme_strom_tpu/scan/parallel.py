@@ -26,8 +26,6 @@ import struct
 from multiprocessing import shared_memory
 from typing import List, Optional, Tuple
 
-from .heap import PAGE_SIZE
-
 __all__ = ["SharedCursor", "run_query_workers", "parallel_scan"]
 
 _HDR = struct.Struct("<qq")  # next_chunk, n_chunks
@@ -87,6 +85,15 @@ def _query_worker(spec: dict, cursor_name: str, lock, out_q) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     cursor = None
     try:
+        # mirror the leader's runtime state BEFORE building anything:
+        # the x64 flag changes accumulator widths (acc_dtypes) and the
+        # config snapshot carries the scan/join knobs — a worker running
+        # defaults would fold silently different partials
+        import jax
+        jax.config.update("jax_enable_x64", bool(spec.get("x64")))
+        if spec.get("config") is not None:
+            from ..config import config
+            config.restore(spec["config"])
         cursor = SharedCursor(0, name=cursor_name, create=False,
                               lock=lock)
         from .query import Query
@@ -99,25 +106,19 @@ def _query_worker(spec: dict, cursor_name: str, lock, out_q) -> None:
             cursor.close()
 
 
-def shared_chunk_count(size: int, chunk_size: int) -> int:
-    """Total cursor positions for a table of *size* bytes: whole chunks
-    plus one tail position when the remainder still holds whole pages —
-    MUST match ``TableScanner``'s own cursor sizing or workers would
-    skip (or double-claim) the tail."""
-    n_chunks = size // chunk_size
-    tail = size - n_chunks * chunk_size
-    return n_chunks + (1 if (tail and tail % PAGE_SIZE == 0) else 0)
-
-
 def run_query_workers(spec: dict, n_workers: int, *,
                       timeout_s: float = 600.0) -> List[dict]:
     """Fan a worker spec out to *n_workers* spawned processes sharing one
-    cursor; returns each worker's partial result (the leader folds)."""
+    cursor; returns each worker's partial result (the leader folds).
+    The cursor is sized by ``executor.cursor_chunk_count`` — the SAME
+    formula ``TableScanner`` sizes its own cursor with."""
     import os
+
+    from .executor import cursor_chunk_count
     if n_workers < 2:
         raise ValueError("run_query_workers needs >= 2 workers")
     size = os.path.getsize(spec["source"])
-    total = shared_chunk_count(size, spec["chunk_size"])
+    total = cursor_chunk_count(size, spec["chunk_size"])
     ctx = mp.get_context("spawn")
     lock = ctx.Lock()
     cursor = SharedCursor(total, lock=lock)

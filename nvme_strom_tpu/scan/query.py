@@ -223,6 +223,9 @@ class Query:
         self._op = "aggregate"
         self._terminal_set = False
         self._agg_cols: Optional[Sequence[int]] = None
+        self._agg_exprs: Optional[list] = None   # expression sums
+        self._star: Optional[dict] = None        # multi-dim star join
+        self._star_resolved: Optional[list] = None
         self._group: Optional[tuple] = None
         self._topk: Optional[tuple] = None
         self._order: Optional[tuple] = None
@@ -876,6 +879,212 @@ class Query:
                           int(value_col))
         return self
 
+    def aggregate_exprs(self, exprs) -> "Query":
+        """Terminal: selected-row count + masked sums of EXPRESSIONS
+        over fact columns — SQL's ``SUM(c1*c2)`` / ``AVG(c0+5)`` face.
+        *exprs* are picklable trees in the :mod:`.sql` expression
+        grammar (``("col", c) | ("lit", v) | ("neg", e) |
+        ("bin", op, l, r)``); each evaluates per row on device (int32
+        arithmetic wraps at the storage width, float math runs at
+        float32) and sums under the scan mask.  The reference's scan
+        gets this for free from the executor above it
+        (pgsql/nvme_strom.c:941-979); here the expressions fuse INTO the
+        scan kernel.  Result: ``{"count", "esums": [scalar per expr]}``.
+        """
+        from .sql import _expr_info
+        self._require_no_terminal()
+        exprs = list(exprs)
+        if not exprs:
+            raise StromError(22, "aggregate_exprs needs >= 1 expression")
+        for e in exprs:
+            _expr_info(e, self.schema)   # raises EINVAL outside subset
+        self._op = "aggregate"
+        self._terminal_set = True
+        self._agg_exprs = exprs
+        return self
+
+    def star_join(self, joins, *, materialize: bool = False,
+                  fact_cols: Optional[Sequence[int]] = None,
+                  exprs: Optional[Sequence] = None,
+                  limit: Optional[int] = None, offset: int = 0) -> "Query":
+        """Terminal: probe SEVERAL broadcast dimension tables in ONE
+        scan pass — the star-schema query shape the reference gets from
+        the executor above its scan (`pgsql/nvme_strom.c:941-979`
+        composes any joins over the handed-up tuples).
+
+        *joins* — a sequence of dicts, one per dimension::
+
+            {"probe_col": int,          # fact column carrying the key
+             "table": path, "schema": HeapSchema,   # on-disk dim table
+             "key_col": int,            # int32 unique-key column
+             "value_col": int | None,   # payload column (None: no
+                                        #  payload face — semi/anti)
+             "how": "inner"|"left"|"semi"|"anti"}
+
+        Every dimension must fit ``config join_broadcast_max`` (each is
+        loaded once and probed as a sorted broadcast table); a larger
+        build refuses with EINVAL — join it singly (the partitioned
+        path) and CTAS the result instead.
+
+        Default face: additive aggregates — ``count`` (rows passing all
+        dims + the filter), ``sums`` (every fact column), ``pay_sums``
+        (per-dim payload over partnered emitted rows), ``null_counts``
+        (per-dim unpartnered emitted rows — the LEFT NULL face), and
+        ``esums`` for optional expression trees (*exprs*, the
+        :meth:`aggregate_exprs` grammar).  ``materialize=True`` returns
+        the rows: requested *fact_cols*, per-dim payload + partner mask,
+        positions, with ``limit``/``offset`` slicing like
+        :meth:`select`."""
+        from ..config import config as _cfg
+        from ..ops.join import check_join_how
+        from .heap import validate_heap_header
+        self._require_no_terminal()
+        joins = [dict(j) for j in joins]
+        if len(joins) < 1:
+            raise StromError(22, "star_join needs >= 1 dimension")
+        cap = int(_cfg.get("join_broadcast_max"))
+        for j in joins:
+            try:
+                check_join_how(j.get("how", "inner"))
+            except ValueError as e:
+                raise StromError(22, str(e)) from None
+            j.setdefault("how", "inner")
+            pc = int(j["probe_col"])
+            if not 0 <= pc < self.schema.n_cols:
+                raise StromError(22, f"star_join probe column {pc} out "
+                                     f"of range")
+            if self.schema.col_dtype(pc) != np.dtype(np.int32):
+                raise StromError(22, "star_join probe columns must be "
+                                     "int32")
+            bs = j["schema"]
+            if isinstance(j["table"], os.PathLike):
+                j["table"] = str(j["table"])
+            kc, vc = int(j["key_col"]), j["value_col"]
+            if not 0 <= kc < bs.n_cols:
+                raise StromError(22, f"star_join key column {kc} out of "
+                                     f"range")
+            if bs.col_dtype(kc) != np.dtype(np.int32):
+                raise StromError(22, "star_join key columns must be "
+                                     "int32")
+            if vc is not None:
+                vc = int(vc)
+                if not 0 <= vc < bs.n_cols:
+                    raise StromError(22, f"star_join value column {vc} "
+                                         f"out of range")
+                if bs.col_dtype(vc).kind not in "iuf":
+                    raise StromError(22, "star_join value columns must "
+                                         "be int32/uint32/float32")
+                if j["how"] in ("semi", "anti"):
+                    raise StromError(22, f"star_join: {j['how']} "
+                                         f"dimensions expose no payload "
+                                         f"(EXISTS semantics)")
+                j["value_col"] = vc
+            try:
+                validate_heap_header(j["table"], bs)
+            except (OSError, ValueError) as e:
+                raise StromError(getattr(e, "errno", None) or 22,
+                                 f"star_join build table: {e}") from e
+            rows = (os.path.getsize(j["table"]) // PAGE_SIZE) \
+                * bs.tuples_per_page
+            if rows * 8 > cap:
+                raise StromError(22, f"star_join: dimension "
+                                     f"{j['table']} (~{rows} rows) is "
+                                     f"above join_broadcast_max — join "
+                                     f"it singly (the partitioned path) "
+                                     f"and CTAS the result")
+        if exprs:
+            from .sql import _expr_info
+            for e in exprs:
+                _expr_info(e, self.schema)
+        if materialize:
+            if limit is not None and limit < 0:
+                raise StromError(22, "star_join limit must be >= 0")
+            if offset < 0:
+                raise StromError(22, "star_join offset must be >= 0")
+            fact_cols = [int(c) for c in (fact_cols or [])]
+            for c in fact_cols:
+                if not 0 <= c < self.schema.n_cols:
+                    raise StromError(22, f"star_join fact column {c} "
+                                         f"out of range")
+        elif limit is not None or offset:
+            raise StromError(22, "star_join limit/offset require "
+                                 "materialize=True")
+        self._op = "star"
+        self._terminal_set = True
+        self._star = {"joins": joins, "materialize": bool(materialize),
+                      "fact_cols": list(fact_cols or []),
+                      "exprs": list(exprs or []), "limit": limit,
+                      "offset": int(offset)}
+        self._star_resolved = None
+        return self
+
+    def _resolve_star_builds(self, session, device) -> None:
+        """Load every dimension (one projection scan each) into the
+        sorted host-array form the star kernels capture; idempotent."""
+        from ..ops.join import _sorted_build
+        if getattr(self, "_star_resolved", None) is not None:
+            return
+        resolved = []
+        for j in self._star["joins"]:
+            bs, kc, vc = j["schema"], j["key_col"], j["value_col"]
+            cols = [kc] if vc is None or vc == kc else [kc, vc]
+            out = Query(j["table"], bs).select(cols).run(session=session,
+                                                         device=device)
+            bk = np.asarray(out[f"col{kc}"], np.int32)
+            bv = None if vc is None else np.asarray(
+                out[f"col{vc}"], bs.col_dtype(vc))
+            try:
+                keys, vals = _sorted_build(
+                    bk, bk if bv is None else bv, self.schema,
+                    j["probe_col"])
+            except ValueError as e:
+                raise StromError(22, f"star_join {j['table']}: {e}") \
+                    from None
+            resolved.append((j["probe_col"], keys,
+                             None if bv is None else vals, j["how"]))
+        self._star_resolved = resolved
+
+    def _star_expr_parts(self):
+        """(expr_fns, expr_zeros, expr_accs) for the star/expr kernels."""
+        from ..ops.groupby import acc_dtypes
+        from .sql import _eval_expr, _expr_info
+        fns, zeros, accs = [], [], []
+        for e in self._star["exprs"] if self._op == "star" \
+                else self._agg_exprs:
+            dt, _cols = _expr_info(e, self.schema)
+            fns.append(lambda cols, e=e: _eval_expr(e, cols))
+            zeros.append(dt.type(0))
+            accs.append(acc_dtypes(dt)[0])
+        return fns, zeros, accs
+
+    def _run_star_rows(self, plan: QueryPlan, device, session) -> dict:
+        """Star row face: stream the scan, probe every dimension per
+        batch, hand the emitted rows back (fact cols + per-dim payload/
+        partner + positions)."""
+        from ..ops.join import make_star_rows_fn
+        st = self._star
+        pred = self._pred
+        run = make_star_rows_fn(
+            self.schema, self._star_resolved,
+            predicate=(lambda cols: pred(cols)) if pred else None,
+            fact_cols=st["fact_cols"])
+        fields = [f"c{c}" for c in st["fact_cols"]]
+        dtypes = [self.schema.col_dtype(c) for c in st["fact_cols"]]
+        for i, (pc, _k, vals, how) in enumerate(self._star_resolved):
+            if vals is not None:
+                fields.append(f"pay{i}")
+                dtypes.append(vals.dtype)
+            fields.append(f"m{i}")
+            dtypes.append(np.dtype(bool))
+        fields.append("positions")
+        dtypes.append(self._pos_dtype())
+        arrs = self._collect_rows(plan, run, "hit", fields, dtypes,
+                                  device, session, limit=st["limit"],
+                                  offset=st["offset"])
+        out = dict(zip(fields, arrs))
+        out["count"] = np.int64(len(out["positions"]))
+        return out
+
     def _require_no_terminal(self) -> None:
         if self._terminal_set:
             raise StromError(22, "one terminal operator per query "
@@ -924,6 +1133,18 @@ class Query:
                 return "invalid", (f"aggregate column {bad[0]} out of "
                                    f"range (schema has "
                                    f"{self.schema.n_cols})")
+        if self._op == "star":
+            n = len(self._star["joins"])
+            face = "row materialization" if self._star["materialize"] \
+                else "additive aggregate"
+            return "xla", (f"star join: {n} broadcast dimension"
+                           f"{'s' if n != 1 else ''} probed per batch "
+                           f"(sorted searchsorted probes fused in one "
+                           f"kernel), {face} face")
+        if self._op == "aggregate" and self._agg_exprs is not None:
+            return "xla", (f"{len(self._agg_exprs)} expression "
+                           f"aggregate(s) fuse into the scan kernel "
+                           f"(XLA elementwise + masked sum)")
         if self._op == "top_k" \
                 and not 0 <= self._topk[0] < self.schema.n_cols:
             return "invalid", (f"top_k column {self._topk[0]} out of "
@@ -958,6 +1179,15 @@ class Query:
                           "be pure overhead"
         if self._op == "group_by":
             _, g, agg, _hv = self._group
+            if self._group_cols is not None:
+                # value-keyed GROUP BY: the derived key function closes
+                # over the DISCOVERED key table (a device array), and
+                # pallas_call rejects captured array constants — found
+                # live on TPU driving `--sql ... GROUP BY c0` (round 5)
+                return "xla", ("value-keyed GROUP BY: the discovered "
+                               "key table is a captured array (Mosaic "
+                               "kernels take arrays as inputs only); "
+                               "XLA serves the searchsorted key path")
             if jax.config.jax_enable_x64:
                 # acc_dtypes widens sums/sumsqs to i64/f64 under x64 —
                 # dtypes Mosaic cannot hold in SMEM on real hardware
@@ -1296,6 +1526,30 @@ class Query:
     def _build_fn(self, kernel: str):
         """Returns (fn(pages)->dict, combine or None)."""
         pred = self._pred
+        if self._op == "star":
+            from ..ops.join import make_star_fn
+            fns, zeros, accs = self._star_expr_parts()
+            run = make_star_fn(
+                self.schema, self._star_resolved,
+                predicate=(lambda cols: pred(cols)) if pred else None,
+                expr_fns=fns, expr_zeros=zeros, expr_accs=accs)
+            return (lambda pages: run(pages)), None
+        if self._op == "aggregate" and self._agg_exprs is not None:
+            import jax
+            import jax.numpy as jnp
+
+            from ..ops.filter_xla import decode_pages
+            fns, zeros, accs = self._star_expr_parts()
+
+            @jax.jit
+            def efn(pages):
+                cols, valid = decode_pages(pages, self.schema)
+                sel = valid if pred is None else valid & pred(cols)
+                return {"count": jnp.sum(sel.astype(jnp.int32)),
+                        "esums": [jnp.sum(jnp.where(sel, f(cols), z),
+                                          dtype=a)
+                                  for f, z, a in zip(fns, zeros, accs)]}
+            return efn, None
         if self._op == "aggregate":
             import jax.numpy as jnp
 
@@ -1323,6 +1577,14 @@ class Query:
             key_fn, g, agg, _having = self._group
             kw = dict(agg_cols=agg,
                       predicate=(lambda cols: pred(cols)) if pred else None)
+            if kernel == "pallas" and self._group_cols is not None:
+                # an explicit kernel="pallas" override must refuse
+                # cleanly, not die inside pallas_call tracing
+                raise StromError(22, "value-keyed GROUP BY cannot run "
+                                     "on the pallas kernel (the "
+                                     "discovered key table is a "
+                                     "captured array); use kernel="
+                                     "'xla' or 'auto'")
             if kernel == "pallas":
                 from ..ops.groupby_pallas import make_groupby_fn_pallas
                 run = make_groupby_fn_pallas(self.schema, lambda cols: key_fn(cols),
@@ -1436,6 +1698,10 @@ class Query:
             # it, then every downstream join path (incl. indexed) sees
             # plain host arrays
             self._resolve_join_build(session, device)
+        if self._op == "star":
+            self._resolve_star_builds(session, device)
+            if self._star["materialize"]:
+                return self._run_star_rows(plan, device, session)
         if plan.access_path == "index" and self._op == "order_by" \
                 and self._eq is not None:
             comb = self._eq_order_combo_path()
@@ -1722,26 +1988,38 @@ class Query:
         return self._sorted_group_result(acc)
 
     # -- parallel worker processes (the Gather analog) ----------------------
-    _WORKER_OPS = ("aggregate", "group_by", "top_k", "select")
+    _WORKER_OPS = ("aggregate", "group_by", "top_k", "select", "star")
 
     def _worker_spec(self, discovered=None) -> dict:
         """Picklable reconstruction recipe for worker processes: the
         structured filter, SQL predicate trees, terminal, and (for
         value-keyed GROUP BY) the leader-discovered key set."""
+        import jax
+
         from ..config import config as _cfg
         spec = {
             "source": self.source,
             "schema": (self.schema.n_cols, self.schema.visibility,
                        self.schema.dtypes),
             "chunk_size": int(_cfg.get("chunk_size")),
+            # leader-side runtime state workers must mirror: the config
+            # snapshot (join_broadcast_max, scan knobs, ...) and the
+            # x64 flag (acc_dtypes widens int sums under x64 — a worker
+            # accumulating at a different width would fold silently
+            # different partials)
+            "config": _cfg.snapshot(),
+            "x64": bool(jax.config.jax_enable_x64),
             "eq": self._eq, "rng": self._range, "in": self._in,
             "trees": list(self._pred_trees),
             "op": self._op,
             "agg_cols": (None if self._agg_cols is None
                          else list(self._agg_cols)),
+            "agg_exprs": self._agg_exprs,
             "select": self._select,
             "topk": self._topk,
         }
+        if self._op == "star":
+            spec["star"] = self._star
         if self._op == "group_by":
             cols_, agg, _hv, max_groups = self._group_cols
             spec["group"] = (list(cols_), None if agg is None
@@ -1778,7 +2056,13 @@ class Query:
             q.where(lambda cols, t=t: _tree_mask(t, cols), _tree=t)
         op = spec["op"]
         if op == "aggregate":
-            q.aggregate(spec["agg_cols"])
+            if spec.get("agg_exprs"):
+                q.aggregate_exprs(spec["agg_exprs"])
+            else:
+                q.aggregate(spec["agg_cols"])
+        elif op == "star":
+            st = spec["star"]
+            q.star_join(st["joins"], exprs=st["exprs"])
         elif op == "top_k":
             tc, tk, tl = spec["topk"]
             q.top_k(tc, tk, largest=tl)
@@ -1825,38 +2109,21 @@ class Query:
                 self._sorted_group_scan(acc, cols_, agg_idx, packer,
                                         None, None, scanner=sc)
                 return {"sorted": acc.state()}
-            if self._op in ("aggregate", "group_by", "top_k"):
+            if self._op in ("aggregate", "group_by", "top_k", "star"):
+                if self._op == "star":
+                    # each worker loads the (broadcast-sized) dims once
+                    self._resolve_star_builds(None, None)
                 fn, combine = self._build_fn("xla")
                 return {"acc": sc.scan_filter(fn, combine=combine)}
-            # select
+            # select: the shared row-collection machinery, fed from
+            # THIS scanner (the spec already folded offset into stop)
             cols, stop, _off = self._select
             if cols is None:
                 cols = list(range(self.schema.n_cols))
             gather, fields, dtypes = self._make_gather_fn(cols)
-            chunks: List[list] = []
-            gathered = 0
-
-            def collect(pages_dev):
-                nonlocal gathered
-                out = gather(pages_dev)
-                m = np.asarray(out["mask"]).astype(bool)
-                chunks.append([np.asarray(out[f])[m] for f in fields])
-                gathered += int(m.sum())
-                if stop is not None and gathered >= stop:
-                    raise _ScanLimitReached
-                return {}
-
-            try:
-                sc.scan_filter(collect)
-            except _ScanLimitReached:
-                pass
-            if chunks:
-                arrs = [np.concatenate([c[i] for c in chunks])
-                        for i in range(len(fields))]
-            else:
-                arrs = [np.zeros(0, dt) for dt in dtypes]
-            if stop is not None:
-                arrs = [a[:stop] for a in arrs]
+            arrs = self._collect_rows(None, gather, "mask", fields,
+                                      dtypes, None, None, limit=stop,
+                                      offset=0, scanner=sc)
             return {"rows": arrs}
 
     def _run_workers(self, n_workers: int, *, session=None,
@@ -1871,6 +2138,12 @@ class Query:
             raise StromError(22, "workers: parallel scan takes a single "
                                  "on-disk table path (striped sets scan "
                                  "serially or via a mesh)")
+        # plan validation BEFORE spawning: a query the serial path
+        # refuses with a clean StromError must refuse identically here,
+        # not crash inside N worker processes
+        plan = self.explain()
+        if plan.kernel == "invalid":
+            raise StromError(22, f"query not executable: {plan.reason}")
         if self._join is not None or self._join_src is not None:
             raise StromError(22, "workers: JOIN is not worker-servable "
                                  "yet (use the mesh partitioned join)")
@@ -1898,6 +2171,10 @@ class Query:
                 if discovered is None:
                     raise StromError(22, "workers: group keys resolved "
                                          "without a shippable key set")
+        elif self._op == "star" and self._star["materialize"]:
+            raise StromError(22, "workers: the star row face is not "
+                                 "worker-servable (aggregate face "
+                                 "only)")
         elif self._op not in self._WORKER_OPS:
             raise StromError(22, f"workers: terminal {self._op!r} is "
                                  f"not worker-servable "
@@ -2000,10 +2277,11 @@ class Query:
             dtypes.append(self._pos_dtype())
         return gather, fields, dtypes
 
-    def _collect_rows(self, plan: QueryPlan, batch_fn, mask_key: str,
+    def _collect_rows(self, plan: Optional[QueryPlan], batch_fn,
+                      mask_key: str,
                       fields: Sequence[str], empty_dtypes, device,
                       session, *, limit: Optional[int] = None,
-                      offset: int = 0) -> List[np.ndarray]:
+                      offset: int = 0, scanner=None) -> List[np.ndarray]:
         """Shared row-materialization engine (SELECT and the join's row
         face): stream batches, compress rows by ``batch_fn``'s *mask_key*
         output host-side (one concat at the end — a fold-style growing
@@ -2024,7 +2302,8 @@ class Query:
                 raise _ScanLimitReached
             return {}   # nothing to fold
 
-        self._stream_collect(plan, collect, device, session)
+        self._stream_collect(plan, collect, device, session,
+                             scanner=scanner)
         if chunks:
             arrs = [np.concatenate([c[i] for c in chunks])
                     for i in range(len(fields))]
@@ -2032,13 +2311,17 @@ class Query:
             arrs = [np.zeros(0, dt) for dt in empty_dtypes]
         return [a[offset:stop] for a in arrs]
 
-    def _stream_collect(self, plan: QueryPlan, collect, device,
-                        session) -> None:
+    def _stream_collect(self, plan: Optional[QueryPlan], collect, device,
+                        session, *, scanner=None) -> None:
         """Stream the planned access path through a host-side collector
         (shared by the SELECT gather and the materializing join); a
-        :class:`_ScanLimitReached` from *collect* stops the scan."""
+        :class:`_ScanLimitReached` from *collect* stops the scan.  A
+        caller-supplied *scanner* (the worker path's shared-cursor
+        TableScanner) replaces plan-driven source opening."""
         try:
-            if plan.access_path == "direct":
+            if scanner is not None:
+                scanner.scan_filter(collect, device=device)
+            elif plan.access_path == "direct":
                 from .executor import TableScanner
                 src, own = self._open_owned()
                 try:

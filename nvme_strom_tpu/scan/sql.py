@@ -12,7 +12,7 @@ reachable from a SQL string.
 Supported subset (one fact table, one terminal — the Query contract):
 
     SELECT select_list FROM <name>
-      [[INNER|LEFT|SEMI|ANTI] JOIN <dim> ON cN = <dim>.cM]
+      [[INNER|LEFT|SEMI|ANTI] JOIN <dim> ON cN = <dim>.cM]...
       [WHERE cond [AND cond]...]
       [GROUP BY cN[, cM]]
       [HAVING agg cmp literal [AND ...]]
@@ -24,19 +24,39 @@ schema)}`` (on-disk heap; the engine streams it in bounded passes when
 it exceeds ``join_broadcast_max``) and serves both faces: aggregates —
 ``COUNT(*)``, ``SUM(cN)`` over fact columns, ``SUM(dim.cK)`` over the
 matched build payload — or, with plain columns in the SELECT list, the
-materialized rows (the probe column and ``dim.cK``).
+materialized rows.  TWO OR MORE JOIN clauses form a STAR statement
+(round 5): every dimension probes in the same fused scan kernel
+(broadcast-sized dims only; aggregates gain ``AVG(dim.cK)`` and
+``SUM(expr)``, the row face serves any fact columns + one payload
+column per dimension, LEFT dims add a ``matched_<dim>`` indicator).
 
     select_list := [DISTINCT] '*' | item [AS name] (',' item [AS name])*
     item  := cN | COUNT(*) | COUNT(DISTINCT cN)
-           | SUM(cN) | AVG(cN) | MIN(cN) | MAX(cN)
+           | SUM(cN|expr) | AVG(cN|expr) | MIN(cN) | MAX(cN)
     -- SELECT DISTINCT cols == GROUP BY the select list (keys only);
     -- ORDER BY takes cN[, cM] (later keys break ties) outside GROUP BY
     where := term (OR term)* ; term := factor (AND factor)*
     factor := NOT factor | '(' where ')' | cond   -- SQL precedence
-    cond  := cN cmp literal | literal cmp cN
+    cond  := expr cmp expr
            | cN BETWEEN lit AND lit | cN IN (lit[, lit]...)
+    expr  := cN | number | '(' expr ')' | -expr
+           | expr (+|-|*|/) expr        -- usual precedence
     cmp   := = | == | != | <> | < | <= | > | >=
     literal := number | 'string'   (strings need a dictionary sidecar)
+
+Expression semantics are EXACT, never approximate: int arithmetic runs
+at int32 (the storage width — wraparound is the storage semantics),
+float math at float32, mixed operands promote to float32; int/int
+division is EINVAL (PostgreSQL truncates — returning the float answer
+would be silent drift), as are uint32 operands and string columns in
+arithmetic.  One DOCUMENTED divergence: float division follows IEEE 754
+(``x / 0.0`` is ±inf, ``0.0 / 0.0`` is NaN, and NaN comparisons are
+false) where PostgreSQL raises ``division_by_zero`` — a per-row raise
+cannot live inside the fused kernel, and a silent wrong answer is
+worse than the standard float answer.  Plain ``cN cmp literal`` leaves keep their structured
+form, so index promotion and string translation are unchanged;
+expression aggregates are scalar-only (no GROUP BY) and fuse into the
+scan kernel (``Query.aggregate_exprs``).
 
 Columns are named ``c0..cN-1`` (the CLI convention).  The mapping is
 exact, never approximate: a statement outside the subset raises EINVAL
@@ -84,9 +104,9 @@ __all__ = ["parse_sql", "sql_query", "create_table_as"]
 _TOKEN = re.compile(r"""
     \s*(?:
       (?P<str>'[^']*')
-    | (?P<num>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\d+)
+    | (?P<num>\d+\.\d+(?:[eE][+-]?\d+)?|\d+)
     | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
-    | (?P<op><=|>=|!=|<>|==|=|<|>|\(|\)|,|\*|\.)
+    | (?P<op><=|>=|!=|<>|==|=|<|>|\(|\)|,|\*|\.|\+|-|/|%)
     )""", re.VERBOSE)
 
 _AGGS = ("count", "sum", "avg", "min", "max")
@@ -178,15 +198,176 @@ def _lit(tok: Tuple[str, str]):
     return float(v) if ("." in v or "e" in v or "E" in v) else int(v)
 
 
+def _plit(p: "_P"):
+    """A possibly-negated literal (the tokenizer emits '-' as an
+    operator so expressions can subtract)."""
+    if p.peek() == ("op", "-"):
+        p.next()
+        v = _lit(p.next())
+        if isinstance(v, _Str):
+            raise StromError(22, "SQL: cannot negate a string literal")
+        return -v
+    return _lit(p.next())
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic expressions (round 5): cN, literals, + - * /, parentheses
+# ---------------------------------------------------------------------------
+#
+# Trees are picklable tuples — ("col", c) | ("lit", v) | ("neg", e) |
+# ("bin", op, l, r) — so worker processes can rebuild them, and the SAME
+# evaluator serves WHERE leaves and aggregate arguments.  Semantics are
+# exact, never approximate: int arithmetic runs at int32 (the storage
+# width — wraparound is the documented storage semantics, like the
+# kernels' sums), float math at float32, mixed operands promote to
+# float32, and integer/integer division is EINVAL (PostgreSQL truncates
+# int division; silently returning the float answer would be semantic
+# drift, so this subset only serves `/` when a float operand makes the
+# answer SQL's answer).
+
+_EXPR_DTS = (np.dtype(np.int32), np.dtype(np.float32))
+
+
+def _parse_expr(p: "_P", n_cols: int):
+    """expr := term (('+'|'-') term)* ; term := factor (('*'|'/'|'%')
+    factor)* ; factor := ['-'] atom ; atom := cN | number | '(' expr ')'
+    """
+    def atom():
+        t = p.peek()
+        if t == ("op", "("):
+            p.next()
+            e = add()
+            p.expect_op(")")
+            return e
+        if t is not None and t[0] in ("num", "str"):
+            return ("lit", _lit(p.next()))
+        return ("col", _col(p.next(), n_cols))
+
+    def factor():
+        if p.peek() == ("op", "-"):
+            p.next()
+            f = factor()
+            if f[0] == "lit" and not isinstance(f[1], _Str):
+                return ("lit", -f[1])
+            return ("neg", f)
+        return atom()
+
+    def term():
+        e = factor()
+        while p.peek() in (("op", "*"), ("op", "/"), ("op", "%")):
+            op = p.next()[1]
+            if op == "%":
+                raise StromError(22, "SQL: the modulo operator is "
+                                     "outside this subset")
+            e = ("bin", op, e, factor())
+        return e
+
+    def add():
+        e = term()
+        while p.peek() in (("op", "+"), ("op", "-")):
+            op = p.next()[1]
+            e = ("bin", op, e, term())
+        return e
+
+    return add()
+
+
+def _expr_info(e, schema) -> Tuple[np.dtype, set]:
+    """(result dtype, referenced columns) of an expression tree, raising
+    EINVAL for shapes outside the subset (strings in arithmetic, uint32
+    operands, int/int division, out-of-int32 literals)."""
+    k = e[0]
+    if k == "col":
+        dt = schema.col_dtype(e[1])
+        if dt not in _EXPR_DTS and dt != np.dtype(np.uint32) \
+                and dt.kind not in "iuf":
+            raise StromError(22, f"SQL: c{e[1]} ({dt}) in an expression")
+        return dt, {e[1]}
+    if k == "lit":
+        v = e[1]
+        if isinstance(v, _Str):
+            raise StromError(22, "SQL: string literals cannot appear in "
+                                 "arithmetic")
+        if isinstance(v, int):
+            if not -(1 << 31) <= v < (1 << 31):
+                raise StromError(22, f"SQL: integer literal {v} outside "
+                                     f"int32 in an expression")
+            return np.dtype(np.int32), set()
+        return np.dtype(np.float32), set()
+    if k == "neg":
+        dt, cs = _expr_info(e[1], schema)
+        if dt == np.dtype(np.uint32):
+            raise StromError(22, "SQL: negating a uint32 column is "
+                                 "outside this subset")
+        return dt, cs
+    _k, op, l, r = e
+    ld, lc = _expr_info(l, schema)
+    rd, rc = _expr_info(r, schema)
+    if np.dtype(np.uint32) in (ld, rd):
+        raise StromError(22, "SQL: uint32 columns in arithmetic are "
+                             "outside this subset (no SQL unsigned "
+                             "type to map the wraparound onto)")
+    if op == "/":
+        if ld.kind != "f" and rd.kind != "f":
+            raise StromError(22, "SQL: integer / integer is outside "
+                                 "this subset (PostgreSQL truncates; "
+                                 "use a float operand for float "
+                                 "division)")
+        return np.dtype(np.float32), lc | rc
+    if np.dtype(np.float32) in (ld, rd):
+        return np.dtype(np.float32), lc | rc
+    return np.dtype(np.int32), lc | rc
+
+
+def _eval_expr(e, cols):
+    """jnp evaluation of an expression tree over decoded columns —
+    dtype rules exactly as :func:`_expr_info` documents (the numpy
+    oracle in the tests mirrors this step for step)."""
+    import jax.numpy as jnp
+    k = e[0]
+    if k == "col":
+        return cols[e[1]]
+    if k == "lit":
+        v = e[1]
+        return jnp.float32(v) if isinstance(v, float) else jnp.int32(v)
+    if k == "neg":
+        return -_eval_expr(e[1], cols)
+    _k, op, l, r = e
+    a, b = _eval_expr(l, cols), _eval_expr(r, cols)
+    if op == "/" or a.dtype == jnp.float32 or b.dtype == jnp.float32:
+        a, b = a.astype(jnp.float32), b.astype(jnp.float32)
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    return a / b
+
+
+def _expr_str(e) -> str:
+    k = e[0]
+    if k == "col":
+        return f"c{e[1]}"
+    if k == "lit":
+        return str(e[1])
+    if k == "neg":
+        return f"-{_expr_str(e[1])}"
+    _k, op, l, r = e
+    return f"({_expr_str(l)} {op} {_expr_str(r)})"
+
+
 class _Item:
-    """One select-list item: ("col", c) or ("agg", fn, c|None, distinct);
+    """One select-list item: ("col", c), ("agg", fn, c|None, distinct),
+    or ("agge", fn, expression tree) for SUM/AVG over arithmetic;
     ``table`` is None for fact columns, a dimension name for qualified
     ``dim.cK`` references."""
 
     def __init__(self, kind, fn=None, col=None, distinct=False,
-                 label="", table=None):
+                 label="", table=None, expr=None):
         self.kind, self.fn, self.col = kind, fn, col
         self.distinct, self.label, self.table = distinct, label, table
+        self.expr = expr     # "agge": the argument tree
         self.alias = None   # AS name: relabels the output
 
 
@@ -220,6 +401,7 @@ def _parse_select_list(p: _P, n_cols: int) -> Optional[List[_Item]]:
             fn = t[1].lower()
             p.next()   # the '('
             distinct = False
+            expr = None
             if p.peek() == ("op", "*"):
                 p.next()
                 if fn != "count":
@@ -233,12 +415,35 @@ def _parse_select_list(p: _P, n_cols: int) -> Optional[List[_Item]]:
                     if fn != "count":
                         raise StromError(22, "SQL: DISTINCT only under "
                                              "COUNT in this subset")
-                tbl, col = _colref(p, n_cols)
-                base = f"{tbl}.c{col}" if tbl else f"c{col}"
-                label = (f"{fn}(distinct {base})" if distinct
-                         else f"{fn}({base})")
+                t2 = p.peek()
+                qualified = (t2 is not None and t2[0] == "name"
+                             and p.i + 1 < len(p.toks)
+                             and p.toks[p.i + 1] == ("op", "."))
+                if distinct or qualified:
+                    tbl, col = _colref(p, n_cols)
+                else:
+                    e = _parse_expr(p, n_cols)
+                    if e[0] == "col":
+                        tbl, col = None, e[1]
+                    elif fn not in ("sum", "avg"):
+                        raise StromError(22, f"SQL: {fn.upper()} over "
+                                             f"an expression is outside "
+                                             f"this subset (SUM/AVG "
+                                             f"take arithmetic)")
+                    else:
+                        tbl, col, expr = None, None, e
+                if expr is not None:
+                    label = f"{fn}({_expr_str(expr)})"
+                else:
+                    base = f"{tbl}.c{col}" if tbl else f"c{col}"
+                    label = (f"{fn}(distinct {base})" if distinct
+                             else f"{fn}({base})")
             p.expect_op(")")
-            items.append(_Item("agg", fn, col, distinct, label, tbl))
+            if expr is not None:
+                items.append(_Item("agge", fn, label=label, expr=expr))
+            else:
+                items.append(_Item("agg", fn, col, distinct, label,
+                                   tbl))
         else:
             tbl, c = _colref(p, n_cols)
             label = f"{tbl}.c{c}" if tbl else f"c{c}"
@@ -261,10 +466,14 @@ def self_is_call(p: _P) -> bool:
 
 def _parse_cond_leaf(p: _P, n_cols: int) -> tuple:
     """One comparison: ("cmp", col, op, lit) | ("between", col, lo, hi)
-    | ("in", col, [lits])."""
-    t = p.next()
-    if t[0] in ("num", "str"):   # literal cmp col -> flip
-        lit = _lit(t)
+    | ("in", col, [lits]) — or, when either side carries arithmetic or
+    a second column, ("cmpe", lexpr, op, rexpr).  The simple shapes
+    keep their dedicated forms so index promotion and string-dictionary
+    translation stay exactly as before."""
+    # a bare string literal can only open `'lit' cmp cN` — it cannot
+    # start an expression
+    if p.peek() is not None and p.peek()[0] == "str":
+        lit = _lit(p.next())
         op = p.next()
         if op[0] != "op" or op[1] not in _CMPS:
             raise StromError(22, f"SQL: expected comparison, got "
@@ -272,24 +481,35 @@ def _parse_cond_leaf(p: _P, n_cols: int) -> tuple:
         c = _col(p.next(), n_cols)
         flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
         return ("cmp", c, flip.get(op[1], op[1]), lit)
-    c = _col(t, n_cols)
-    if p.kw("between"):
-        lo = _lit(p.next())
-        p.expect_kw("and")
-        hi = _lit(p.next())
-        return ("between", c, lo, hi)
-    if p.kw("in"):
-        p.expect_op("(")
-        lits = [_lit(p.next())]
-        while p.peek() == ("op", ","):
-            p.next()
-            lits.append(_lit(p.next()))
-        p.expect_op(")")
-        return ("in", c, lits)
+    left = _parse_expr(p, n_cols)
+    if left[0] == "col":
+        c = left[1]
+        if p.kw("between"):
+            lo = _plit(p)
+            p.expect_kw("and")
+            hi = _plit(p)
+            return ("between", c, lo, hi)
+        if p.kw("in"):
+            p.expect_op("(")
+            lits = [_plit(p)]
+            while p.peek() == ("op", ","):
+                p.next()
+                lits.append(_plit(p))
+            p.expect_op(")")
+            return ("in", c, lits)
     op = p.next()
     if op[0] != "op" or op[1] not in _CMPS:
         raise StromError(22, f"SQL: expected comparison, got {op[1]!r}")
-    return ("cmp", c, op[1], _lit(p.next()))
+    if p.peek() is not None and p.peek()[0] == "str":
+        right = ("lit", _lit(p.next()))
+    else:
+        right = _parse_expr(p, n_cols)
+    if left[0] == "col" and right[0] == "lit":
+        return ("cmp", left[1], op[1], right[1])
+    if left[0] == "lit" and right[0] == "col":   # literal cmp col: flip
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        return ("cmp", right[1], flip.get(op[1], op[1]), left[1])
+    return ("cmpe", left, op[1], right)
 
 
 def _parse_where(p: _P, n_cols: int):
@@ -300,10 +520,17 @@ def _parse_where(p: _P, n_cols: int):
         if p.kw("not"):
             return ("not", [factor()])
         if p.peek() == ("op", "("):
-            p.next()
-            t = expr()
-            p.expect_op(")")
-            return t
+            # '(' is ambiguous: a condition group OR an arithmetic
+            # subexpression ("(c0 + c1) > 5").  Try the group reading
+            # first and backtrack to the expression leaf on failure.
+            mark = p.i
+            try:
+                p.next()
+                t = expr()
+                p.expect_op(")")
+                return t
+            except StromError:
+                p.i = mark
         return ("leaf", _parse_cond_leaf(p, n_cols))
 
     def term():
@@ -342,7 +569,7 @@ def _parse_having(p: _P, n_cols: int) -> List[tuple]:
         op = p.next()
         if op[0] != "op" or op[1] not in _CMPS:
             raise StromError(22, "SQL: HAVING needs a comparison")
-        lit = _lit(p.next())
+        lit = _plit(p)
         if isinstance(lit, _Str):
             raise StromError(22, "SQL: HAVING against a string literal "
                                  "is outside this subset (aggregates "
@@ -374,9 +601,25 @@ def _dict_cache(source):
     return get
 
 
-def _translate_cond(cond, dicts) -> Optional[tuple]:
+def _translate_cond(cond, dicts, schema=None) -> Optional[tuple]:
     """One leaf onto dictionary-code space (see the module docstring);
     None = the leaf is vacuously TRUE (``!= 'absent string'``)."""
+    if cond[0] == "cmpe":
+        # expression comparison: validate the subset here (both sides
+        # type-check, no dictionary columns — codes are ranks, and
+        # arithmetic over ranks would be silent nonsense)
+        _k, l, op, r = cond
+        ld, lc = _expr_info(l, schema)
+        rd, rc = _expr_info(r, schema)
+        for cc in sorted(lc | rc):
+            if dicts(cc) is not None:
+                raise StromError(22, f"SQL: c{cc} (string column) in an "
+                                     f"expression comparison")
+        if np.dtype(np.uint32) in (ld, rd) and ld != rd:
+            raise StromError(22, "SQL: comparing uint32 with a "
+                                 "different type is outside this "
+                                 "subset")
+        return cond
     has_str = any(isinstance(x, _Str) for x in
                   (cond[2:] if cond[0] != "in" else cond[2]))
     c = cond[1]
@@ -429,16 +672,16 @@ def _translate_cond(cond, dicts) -> Optional[tuple]:
     return ("in", c, [x for x in codes if x is not None])
 
 
-def _translate_tree(tree, dicts):
+def _translate_tree(tree, dicts, schema=None):
     """Translate every leaf; vacuously-true leaves simplify out (a true
     child erases an OR, drops from an AND).  None = no filter at all."""
     if tree is None:
         return None
     kind = tree[0]
     if kind == "leaf":
-        cond = _translate_cond(tree[1], dicts)
+        cond = _translate_cond(tree[1], dicts, schema)
         return None if cond is None else ("leaf", cond)
-    kids = [_translate_tree(t, dicts) for t in tree[1]]
+    kids = [_translate_tree(t, dicts, schema) for t in tree[1]]
     if kind == "not":
         # NOT over a vacuously-true child is vacuously FALSE: keep a
         # match-nothing leaf so the truth value survives simplification
@@ -481,6 +724,16 @@ def _cmp_np(op: str):
 def _leaf_mask(cond, cols):
     """jnp mask for one leaf condition."""
     import jax.numpy as jnp
+    if cond[0] == "cmpe":
+        _k, l, op, r = cond
+        a, b = _eval_expr(l, cols), _eval_expr(r, cols)
+        if a.dtype != b.dtype:     # validated: only int/float mixing
+            a, b = a.astype(jnp.float32), b.astype(jnp.float32)
+        fns = {"=": jnp.equal, "==": jnp.equal,
+               "!=": jnp.not_equal, "<>": jnp.not_equal,
+               "<": jnp.less, "<=": jnp.less_equal,
+               ">": jnp.greater, ">=": jnp.greater_equal}
+        return fns[op](a, b)
     if cond[0] == "cmp":
         _, c, op, lit = cond
         fns = {"=": jnp.equal, "==": jnp.equal,
@@ -580,6 +833,139 @@ def _having_fn(havings: List[tuple], agg_cols: List[int]):
 _JOIN_TYPES = ("inner", "left", "semi", "anti")
 
 
+def _build_star(q: Query, joins, items, tables, group_cols, havings,
+                order, limit, off, dicts, schema):
+    """The >=2-JOIN statement (star schema in ONE statement, round 5):
+    every dimension probes in the same fused scan kernel
+    (`Query.star_join`).  Faces: additive aggregates — COUNT(*),
+    SUM/AVG over fact columns or expressions, SUM/AVG(dim.cK) — or row
+    materialization (fact columns + dim payloads).  The reference's
+    scan inherits arbitrary join composition from the executor above it
+    (`pgsql/nvme_strom.c:941-979`); this serves the star core of it."""
+    from .strings import dict_path_for
+    if group_cols is not None or havings or order is not None:
+        raise StromError(22, "SQL: GROUP BY/HAVING/ORDER BY with JOIN "
+                             "are outside this subset")
+    if items is None:
+        raise StromError(22, "SQL: JOIN needs an explicit select list")
+    dim_names = [dname for _h, dname, _pc, _kc in joins]
+    for it in items:
+        if it.table is not None and it.table not in dim_names:
+            raise StromError(22, f"SQL: unknown table {it.table!r}")
+    # per-dim payload columns referenced in the select list
+    payload: dict = {}
+    for it in items:
+        if it.table is not None:
+            payload.setdefault(it.table, set()).add(it.col)
+    specs = []
+    for how, dname, pc, kc in joins:
+        if not tables or dname not in tables:
+            raise StromError(22, f"SQL: JOIN table {dname!r} not bound "
+                                 f"(pass tables={{{dname!r}: (path, "
+                                 f"schema)}})")
+        dpath, dschema = tables[dname]
+        if not 0 <= kc < dschema.n_cols:
+            raise StromError(22, f"SQL: {dname}.c{kc} out of range")
+        # two string columns carry codes from SEPARATE dictionaries
+        # (same refusal as the single join)
+        if dicts(pc) is not None or (
+                isinstance(dpath, str)
+                and os.path.exists(dict_path_for(dpath, kc))):
+            raise StromError(22, "SQL: JOIN on string-dictionary "
+                                 "columns is outside this subset "
+                                 "(separate dictionaries make codes "
+                                 "incomparable)")
+        cols_ref = sorted(payload.get(dname, ()))
+        if len(cols_ref) > 1:
+            raise StromError(22, f"SQL: one {dname}.cK column per "
+                                 f"dimension in this subset")
+        if cols_ref and how in ("semi", "anti"):
+            raise StromError(22, f"SQL: {how.upper()} JOIN does not "
+                                 f"expose {dname} columns (EXISTS "
+                                 f"semantics)")
+        vc = cols_ref[0] if cols_ref else None
+        if vc is not None and not 0 <= vc < dschema.n_cols:
+            raise StromError(22, f"SQL: {dname}.c{vc} out of range")
+        specs.append({"probe_col": pc, "table": dpath,
+                      "schema": dschema, "key_col": kc,
+                      "value_col": vc, "how": how})
+    dim_idx = {dname: i for i, dname in enumerate(dim_names)}
+    agg_items = [it for it in items if it.kind in ("agg", "agge")]
+    if agg_items and len(agg_items) != len(items):
+        raise StromError(22, "SQL: JOIN mixes aggregates and bare "
+                             "columns")
+    if agg_items:
+        if limit is not None:
+            raise StromError(22, "SQL: LIMIT on a join aggregate")
+        exprs, eidx = [], {}
+        for it in agg_items:
+            if it.kind == "agge":
+                eidx[id(it)] = len(exprs)
+                exprs.append(it.expr)
+                continue
+            ok = (it.fn == "count" and it.col is None
+                  and not it.distinct) or \
+                 (it.fn in ("sum", "avg") and not it.distinct
+                  and it.col is not None)
+            if not ok:
+                raise StromError(22, f"SQL: {it.label} with a star "
+                                     f"join is outside this subset")
+            if it.table is None and it.fn in ("sum", "avg") \
+                    and dicts(it.col) is not None:
+                raise StromError(22, f"SQL: {it.label} over a string "
+                                     f"column")
+        q = q.star_join(specs, exprs=exprs)
+
+        def assemble(res, agg_items=agg_items, eidx=eidx,
+                     dim_idx=dim_idx):
+            out = {}
+            n = int(res["count"])
+            for it in agg_items:
+                if it.kind == "agge":
+                    s = np.asarray(res["esums"][eidx[id(it)]]).item()
+                    out[it.label] = s if it.fn == "sum" else \
+                        (s / n if n else None)
+                elif it.fn == "count":
+                    out[it.label] = n
+                elif it.table is None:
+                    s = np.asarray(res["sums"][it.col]).item()
+                    out[it.label] = s if it.fn == "sum" else \
+                        (s / n if n else None)
+                else:
+                    i = dim_idx[it.table]
+                    s = np.asarray(res["pay_sums"][i]).item()
+                    if it.fn == "sum":
+                        out[it.label] = s
+                    else:   # AVG over the dim payload skips NULLs
+                        hits = n - int(np.asarray(res["null_counts"][i]))
+                        out[it.label] = s / hits if hits else None
+            return out
+        return q, assemble
+    # row face: fact columns + dim payloads
+    fact_cols = []
+    for it in items:
+        if it.table is None and it.col not in fact_cols:
+            fact_cols.append(it.col)
+    q = q.star_join(specs, materialize=True, fact_cols=fact_cols,
+                    limit=limit, offset=off)
+
+    def assemble(res, items=items, dim_idx=dim_idx, joins=joins):
+        out = {}
+        for it in items:
+            if it.table is None:
+                out[it.label] = np.asarray(res[f"c{it.col}"])
+            else:
+                out[it.label] = np.asarray(
+                    res[f"pay{dim_idx[it.table]}"])
+        for how, dname, _pc, _kc in joins:
+            if how == "left":   # the per-dim NULL indicator
+                out[f"matched_{dname}"] = np.asarray(
+                    res[f"m{dim_idx[dname]}"])
+        out["positions"] = np.asarray(res["positions"])
+        return out
+    return q, assemble
+
+
 def parse_sql(sql: str, source, schema,
               tables: Optional[dict] = None,
               workers: int = 0) -> Tuple[Query, "callable"]:
@@ -621,17 +1007,21 @@ def _parse_sql_raw(sql: str, source, schema,
     t = p.next()
     if t[0] != "name":
         raise StromError(22, f"SQL: FROM needs a table name, got {t[1]!r}")
-    join = None          # (how, dim_name, probe_col, dim_key_col)
-    nxt = p.peek()
-    how = "inner"
-    joining = False
-    if nxt and nxt[0] == "name" and nxt[1].lower() in _JOIN_TYPES:
-        how = p.next()[1].lower()
-        p.expect_kw("join")      # "FROM t LEFT ..." can be nothing else
-        joining = True
-    else:
-        joining = p.kw("join")
-    if joining:
+    joins: List[tuple] = []   # (how, dim_name, probe_col, dim_key_col)
+    while True:
+        nxt = p.peek()
+        how = "inner"
+        joining = False
+        if nxt and nxt[0] == "name" and nxt[1].lower() in _JOIN_TYPES:
+            how = p.next()[1].lower()
+            p.expect_kw("join")  # "FROM t LEFT ..." can be nothing else
+            joining = True
+        else:
+            joining = p.kw("join")
+        if not joining:
+            if how != "inner":
+                raise StromError(22, "SQL: join type without JOIN")
+            break
         dn = p.next()
         if dn[0] != "name":
             raise StromError(22, "SQL: JOIN needs a table name")
@@ -643,12 +1033,13 @@ def _parse_sql_raw(sql: str, source, schema,
         if None not in sides or dn[1] not in sides:
             raise StromError(22, f"SQL: ON must equate a fact column "
                                  f"with a {dn[1]}.cK column")
-        join = (how, dn[1], sides[None], sides[dn[1]])
-    elif how != "inner":
-        raise StromError(22, "SQL: join type without JOIN")
+        if any(j[1] == dn[1] for j in joins):
+            raise StromError(22, f"SQL: table {dn[1]!r} joined twice")
+        joins.append((how, dn[1], sides[None], sides[dn[1]]))
+    join = joins[0] if len(joins) == 1 else None
     where_tree = _parse_where(p, n_cols) if p.kw("where") else None
     dicts = _dict_cache(source)
-    where_tree = _translate_tree(where_tree, dicts)
+    where_tree = _translate_tree(where_tree, dicts, schema)
     group_cols: Optional[List[int]] = None
     if p.kw("group"):
         p.expect_kw("by")
@@ -695,16 +1086,16 @@ def _parse_sql_raw(sql: str, source, schema,
         order = (okey, desc)
     limit = offset = None
     if p.kw("limit"):
-        limit = int(_lit(p.next()))
+        limit = int(_plit(p))
     if p.kw("offset"):
-        offset = int(_lit(p.next()))
+        offset = int(_plit(p))
     left = p.peek()
     if left is not None:
         raise StromError(22, f"SQL: trailing input at {left[1]!r}")
     if havings and group_cols is None:
         raise StromError(22, "SQL: HAVING requires GROUP BY")
 
-    if join is None and items is not None:
+    if not joins and items is not None:
         for it in items:
             if it.table is not None:
                 raise StromError(22, f"SQL: {it.label} references a "
@@ -724,6 +1115,11 @@ def _parse_sql_raw(sql: str, source, schema,
         group_cols = seen      # DISTINCT == GROUP BY the select list
     q = _apply_where(Query(source, schema, workers=workers), where_tree)
     off = offset or 0
+
+    # --- STAR (>= 2 JOINs probed in one pass) -----------------------------
+    if len(joins) >= 2:
+        return _build_star(q, joins, items, tables, group_cols, havings,
+                           order, limit, off, dicts, schema)
 
     # --- JOIN -------------------------------------------------------------
     if join is not None:
@@ -753,6 +1149,11 @@ def _parse_sql_raw(sql: str, source, schema,
                                  "(separate dictionaries make codes "
                                  "incomparable)")
         for it in items:
+            if it.kind == "agge":
+                raise StromError(22, f"SQL: {it.label} with a single "
+                                     f"JOIN is outside this subset "
+                                     f"(star statements serve "
+                                     f"expression aggregates)")
             if it.table is not None and it.table != dname:
                 raise StromError(22, f"SQL: unknown table {it.table!r}")
         dim_cols = sorted({it.col for it in items if it.table == dname})
@@ -824,6 +1225,11 @@ def _parse_sql_raw(sql: str, source, schema,
                                  "select list (group cols + aggregates)")
         agg_cols: List[int] = []
         for it in items:
+            if it.kind == "agge":
+                raise StromError(22, f"SQL: {it.label} under GROUP BY "
+                                     f"is outside this subset "
+                                     f"(expression aggregates are "
+                                     f"scalar-only)")
             if it.kind == "col":
                 if it.col not in group_cols:
                     raise StromError(22, f"SQL: c{it.col} is neither "
@@ -955,9 +1361,44 @@ def _parse_sql_raw(sql: str, source, schema,
     if limit is not None:
         raise StromError(22, "SQL: LIMIT on a scalar aggregate")
     aggs = [it for it in items if it.kind == "agg"]
-    if len(aggs) != len(items):
+    agges = [it for it in items if it.kind == "agge"]
+    if len(aggs) + len(agges) != len(items):
         raise StromError(22, "SQL: mixing bare columns with aggregates "
                              "needs GROUP BY")
+    if agges:
+        # any expression aggregate routes the WHOLE list through the
+        # fused expression kernel (plain SUM(cN) becomes the ("col", c)
+        # tree) — one scan, one result contract
+        trees, tmap = [], {}
+        for it in items:
+            if it.kind == "agge":
+                tmap[id(it)] = len(trees)
+                trees.append(it.expr)
+            elif it.fn == "count" and it.col is None and not it.distinct:
+                pass
+            elif it.fn in ("sum", "avg") and not it.distinct:
+                if dicts(it.col) is not None:
+                    raise StromError(22, f"SQL: {it.label} over a "
+                                         f"string column")
+                tmap[id(it)] = len(trees)
+                trees.append(("col", it.col))
+            else:
+                raise StromError(22, f"SQL: {it.label} cannot combine "
+                                     f"with expression aggregates")
+        q = q.aggregate_exprs(trees)
+
+        def assemble(res, items=items, tmap=tmap):
+            out = {}
+            n = int(res["count"])
+            for it in items:
+                if it.kind == "agg" and it.fn == "count":
+                    out[it.label] = n
+                    continue
+                s = np.asarray(res["esums"][tmap[id(it)]]).item()
+                out[it.label] = s if it.fn == "sum" else \
+                    (s / n if n else None)
+            return out
+        return q, assemble
     if len(aggs) == 1 and aggs[0].distinct:
         q = q.count_distinct(aggs[0].col)
         lbl = aggs[0].label
@@ -1044,6 +1485,7 @@ def create_table_as(dest_path: str, sql: str, source, schema,
                              f"(overwrite=True replaces it)")
     out = sql_query(sql, source, schema, tables=tables, **run_kw)
     out.pop("_analyze", None)
+    out.pop("_workers", None)      # scan telemetry, not data
     out.pop("positions", None)     # row provenance, not data
     # the LEFT row face's NULL indicator ("matched") stays: it becomes
     # an int32 0/1 column — dropping it would silently erase which

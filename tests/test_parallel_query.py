@@ -269,3 +269,27 @@ def test_spill_three_key_cols_still_enomem(tmp_path):
     with pytest.raises(StromError) as ei:
         q.run()
     assert ei.value.errno == 12
+
+
+def test_workers_invalid_query_clean_refusal(table):
+    """Plan validation runs BEFORE fan-out: a query the serial path
+    refuses must raise the same clean StromError, not crash N workers."""
+    path, schema, *_ = table
+    q = Query(path, schema).aggregate(cols=[9])
+    with pytest.raises(StromError) as ei:
+        q.run(workers=2)
+    assert ei.value.errno == 22 and "out of range" in str(ei.value)
+
+
+def test_workers_ctas_drops_telemetry(table, tmp_path):
+    """CREATE TABLE AS over a parallel scan: the _workers telemetry key
+    must not materialize as a table column."""
+    from nvme_strom_tpu.scan.sql import create_table_as, sql_query
+    path, schema, c0, *_ = table
+    dest = str(tmp_path / "roll.heap")
+    dsch, n = create_table_as(dest, "SELECT COUNT(*) AS n FROM t "
+                                    "WHERE c0 > 0",
+                              path, schema, workers=2)
+    assert (n, dsch.n_cols) == (1, 1)
+    out = sql_query("SELECT c0 FROM t", dest, dsch)
+    assert int(out["c0"][0]) == int((c0 > 0).sum())

@@ -501,6 +501,14 @@ def test_pos_word_roundtrip(vals):
 # SQL WHERE-tree property: random AND/OR/NOT trees vs a numpy oracle
 # ---------------------------------------------------------------------------
 
+_sql_exprs = st.recursive(
+    st.one_of(st.tuples(st.just("col"), st.integers(0, 1)),
+              st.tuples(st.just("lit"), st.integers(-9, 9))),
+    lambda kids: st.tuples(st.just("bin"),
+                           st.sampled_from(["+", "-", "*"]),
+                           kids, kids),
+    max_leaves=4)
+
 _sql_conds = st.deferred(lambda: st.one_of(
     st.tuples(st.just("cmp"), st.integers(0, 1),
               st.sampled_from(["=", "!=", "<", "<=", ">", ">="]),
@@ -509,6 +517,10 @@ _sql_conds = st.deferred(lambda: st.one_of(
               st.integers(-20, 0), st.integers(0, 20)),
     st.tuples(st.just("in"), st.integers(0, 1),
               st.lists(st.integers(-20, 20), min_size=1, max_size=4)),
+    # round-5 expression comparisons: arithmetic on either side
+    st.tuples(st.just("cmpe"), _sql_exprs,
+              st.sampled_from(["=", "!=", "<", "<=", ">", ">="]),
+              _sql_exprs),
 ))
 
 _sql_tree = st.recursive(
@@ -521,6 +533,28 @@ _sql_tree = st.recursive(
     max_leaves=6)
 
 
+def _expr_to_sql(e) -> str:
+    if e[0] == "col":
+        return f"c{e[1]}"
+    if e[0] == "lit":
+        return str(e[1])
+    return f"({_expr_to_sql(e[2])} {e[1]} {_expr_to_sql(e[3])})"
+
+
+def _expr_oracle(e, cols):
+    """int32 evaluation, exactly the documented expression semantics
+    (arithmetic at the storage width — wraparound included)."""
+    if e[0] == "col":
+        return cols[e[1]]
+    if e[0] == "lit":
+        return np.int32(e[1])
+    a = _expr_oracle(e[2], cols)
+    b = _expr_oracle(e[3], cols)
+    fn = {"+": np.add, "-": np.subtract, "*": np.multiply}[e[1]]
+    with np.errstate(over="ignore"):
+        return fn(np.int32(a), np.int32(b))
+
+
 def _tree_to_sql(t) -> str:
     kind = t[0]
     if kind == "leaf":
@@ -529,6 +563,8 @@ def _tree_to_sql(t) -> str:
             return f"c{c[1]} {c[2]} {c[3]}"
         if c[0] == "between":
             return f"c{c[1]} BETWEEN {c[2]} AND {c[3]}"
+        if c[0] == "cmpe":
+            return f"{_expr_to_sql(c[1])} {c[2]} {_expr_to_sql(c[3])}"
         return f"c{c[1]} IN ({', '.join(str(v) for v in c[2])})"
     if kind == "not":
         return f"NOT ({_tree_to_sql(t[1][0])})"
@@ -541,11 +577,14 @@ def _tree_oracle(t, c0, c1):
     kind = t[0]
     if kind == "leaf":
         c = t[1]
+        import operator as op
+        fns = {"=": op.eq, "!=": op.ne, "<": op.lt, "<=": op.le,
+               ">": op.gt, ">=": op.ge}
+        if c[0] == "cmpe":
+            return fns[c[2]](_expr_oracle(c[1], cols),
+                             _expr_oracle(c[3], cols))
         v = cols[c[1]]
         if c[0] == "cmp":
-            import operator as op
-            fns = {"=": op.eq, "!=": op.ne, "<": op.lt, "<=": op.le,
-                   ">": op.gt, ">=": op.ge}
             return fns[c[2]](v, c[3])
         if c[0] == "between":
             return (v >= c[2]) & (v <= c[3])
@@ -590,7 +629,10 @@ def test_sql_where_tree_matches_numpy_oracle(tree):
     _cfg.set("debug_no_threshold", True)
     sql = f"SELECT COUNT(*) FROM t WHERE {_tree_to_sql(tree)}"
     out = sql_query(sql, path, schema)
-    want = int(_tree_oracle(tree, c0, c1).sum())
+    # literal-only comparisons reduce to a scalar that broadcasts over
+    # every row (SQL: WHERE 3 < 5 selects everything)
+    want = int(np.broadcast_to(_tree_oracle(tree, c0, c1),
+                               c0.shape).sum())
     assert out["count(*)"] == want, sql
 
 

@@ -132,8 +132,9 @@ def test_sql_rejects_out_of_subset(table):
     path, schema, *_ = table
     bad = [
         ("SELECT c0 FROM t WHERE c0 = 1 OR", "end of statement"),
-        ("SELECT c0 FROM t WHERE (c0 = 1 OR c1 = 2",
-         "end of statement"),
+        # an unterminated group fails the group reading, backtracks to
+        # the arithmetic reading (round 5), and reports ITS mismatch
+        ("SELECT c0 FROM t WHERE (c0 = 1 OR c1 = 2", "expected ')'"),
         ("SELECT c9 FROM t", "out of range"),
         ("SELECT c0, SUM(c1) FROM t", "GROUP BY"),
         # mixed-dtype aggregation set (int32 SUM + float32 HAVING SUM)

@@ -109,3 +109,40 @@ def test_q5_ctas_rollup_requeries(star, tmp_path):
                     roll, g)
     totals = {r: int(qty[region == r].sum()) for r in REGIONS}
     assert out["c0"][0] == max(totals, key=totals.get)
+
+
+def test_q6_two_dimension_star_single_statement(star, tmp_path):
+    """The round-4 VERDICT done-bar: a star query over TWO dimensions in
+    ONE statement (sku -> price, day -> weekday flag), each probed in
+    the same fused scan kernel, vs the numpy oracle."""
+    fact, fs, dim, ds, region, sku, qty, day, price = star
+    # day dimension: 30 days, payload = promo multiplier
+    dd_schema = HeapSchema(n_cols=2, visibility=False,
+                           dtypes=("int32", "int32"))
+    dk = np.arange(0, 30, dtype=np.int32)
+    promo = ((dk % 7) < 2).astype(np.int32)
+    dday = str(tmp_path / "dday.heap")
+    build_heap_file(dday, [dk, promo], dd_schema)
+    out = sql_query(
+        "SELECT COUNT(*) AS n, SUM(c2) AS units, SUM(d.c1) AS rev, "
+        "SUM(dd.c1) AS promo_lines FROM t "
+        "JOIN d ON c1 = d.c0 JOIN dd ON c3 = dd.c0 "
+        "WHERE c0 = 'apac'",
+        fact, fs, tables={"d": (dim, ds), "dd": (dday, dd_schema)})
+    m = (region == "apac") & (sku < 150)      # every day has a dim row
+    assert out["n"] == int(m.sum())
+    assert out["units"] == int(qty[m].sum())
+    np.testing.assert_allclose(out["rev"], float(price[sku[m]].sum()),
+                               rtol=1e-4)
+    assert out["promo_lines"] == int(promo[day[m]].sum())
+
+
+def test_q7_expression_aggregates_and_predicates(star):
+    """Round-5 expressions: SUM over arithmetic and column-vs-column
+    WHERE in one statement, vs the numpy oracle."""
+    fact, fs, dim, ds, region, sku, qty, day, price = star
+    out = sql_query("SELECT COUNT(*) AS n, SUM(c2 * c3) AS wt "
+                    "FROM t WHERE c2 > c3 - 20", fact, fs)
+    m = qty > (day - 20)
+    assert out["n"] == int(m.sum())
+    assert out["wt"] == int((qty[m] * day[m]).sum())
