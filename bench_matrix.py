@@ -441,6 +441,129 @@ print(f"GBPS={{nbytes/dt/(1<<30):.3f}}")
 """
 
 
+_SCAN_CPU = _COMMON + """
+# transport-independent pipeline proof (VERDICT r4 weak #2): the SAME
+# heap scan + filter with the compute on the HOST CPU backend — no
+# device tunnel anywhere.  Divided by ssd2ram_seq (same SSD leg, no
+# compute) in the derived block: cpu_pipeline_efficiency isolates the
+# pipeline's overlap quality from the throttled device transport.
+import jax
+jax.config.update("jax_platforms", "cpu")
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file, PAGE_SIZE
+from nvme_strom_tpu.scan.executor import TableScanner
+from nvme_strom_tpu.ops.filter_xla import scan_filter_step
+path = {path!r} + ".heap"
+schema = HeapSchema(n_cols=2, visibility=True)
+t = schema.tuples_per_page
+n_pages = size // PAGE_SIZE
+if not (os.path.exists(path) and os.path.getsize(path) == n_pages * PAGE_SIZE):
+    rng = np.random.default_rng(0)
+    n = t * n_pages
+    build_heap_file(path, [rng.integers(-1000, 1000, n).astype(np.int32),
+                           rng.integers(0, 100, n).astype(np.int32)], schema)
+th = np.int32(100)
+fn = lambda pages: scan_filter_step(pages, th)
+from nvme_strom_tpu.config import config as _cfg
+warm = np.zeros(((int(_cfg.get("chunk_size")) // PAGE_SIZE), PAGE_SIZE),
+                np.uint8)
+jax.block_until_ready(fn(jax.device_put(warm)))
+best = 0.0
+for _ in range(3):   # best-of-3 (shared-host disk noise)
+    drop_page_cache(path)
+    with TableScanner(path, schema, numa_bind=False) as sc:
+        t0 = time.monotonic()
+        out = sc.scan_filter(fn)
+        best = max(best, n_pages * PAGE_SIZE / (time.monotonic() - t0))
+print(f"GBPS={{best/(1<<30):.3f}}")
+"""
+
+_CTAS_WRITE = _COMMON + """
+# CREATE TABLE AS materialization (VERDICT r4 weak #6: the write path
+# benched) — scan + filter + re-encode + write a derived table; bytes
+# WRITTEN per second, anchored to raw_seq_write in the derived block.
+import jax
+jax.config.update("jax_platforms", "cpu")
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file, PAGE_SIZE
+from nvme_strom_tpu.scan.sql import create_table_as
+path = {path!r} + ".heap"
+dest = {path!r} + ".ctas.heap"
+schema = HeapSchema(n_cols=2, visibility=True)
+t = schema.tuples_per_page
+n_pages = size // PAGE_SIZE
+if not (os.path.exists(path) and os.path.getsize(path) == n_pages * PAGE_SIZE):
+    rng = np.random.default_rng(0)
+    n = t * n_pages
+    build_heap_file(path, [rng.integers(-1000, 1000, n).astype(np.int32),
+                           rng.integers(0, 100, n).astype(np.int32)], schema)
+best = 0.0
+try:
+    for _ in range(3):
+        drop_page_cache(path)
+        t0 = time.monotonic()
+        create_table_as(dest, "SELECT c0, c1 FROM t", path, schema,
+                        overwrite=True)
+        dt = time.monotonic() - t0
+        best = max(best, os.path.getsize(dest) / dt)
+finally:
+    if os.path.exists(dest):
+        os.unlink(dest)
+print(f"GBPS={{best/(1<<30):.3f}}")
+"""
+
+_CKPT_SAVE = _COMMON + """
+# checkpoint SAVE through the engine's async O_DIRECT write queue
+# (data/checkpoint._save_leaves_direct) — the write twin of
+# ckpt_restore, anchored to raw_seq_write in the derived block.
+from nvme_strom_tpu.data import save_checkpoint
+path = {path!r} + ".cksave.strom"
+rng = np.random.default_rng(1)
+arr = rng.standard_normal(size // 4).astype(np.float32)
+best = 0.0
+try:
+    for _ in range(3):
+        t0 = time.monotonic()
+        save_checkpoint(path, {{"w": arr}}, direct=True)
+        best = max(best, size / (time.monotonic() - t0))
+finally:
+    if os.path.exists(path):
+        os.unlink(path)
+print(f"GBPS={{best/(1<<30):.3f}}")
+"""
+
+_HEAVY_SCAN = _COMMON + """
+# CPU-bound filter (60-leaf OR tree) at {workers} worker processes
+# (0 = serial, jit warmed outside the timed window; workers pay their
+# real spawn + jit cost INSIDE it — the honest end-to-end comparison
+# the parallel_speedup ratio divides).
+import jax
+jax.config.update("jax_platforms", "cpu")
+from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file, PAGE_SIZE
+from nvme_strom_tpu.scan.sql import sql_query
+path = {path!r} + ".hv.heap"
+schema = HeapSchema(n_cols=2)
+t = schema.tuples_per_page
+n_pages = size // PAGE_SIZE
+if not (os.path.exists(path) and os.path.getsize(path) == n_pages * PAGE_SIZE):
+    rng = np.random.default_rng(0)
+    n = t * n_pages
+    build_heap_file(path, [rng.integers(0, 1_000_000, n).astype(np.int32),
+                           rng.integers(0, 100, n).astype(np.int32)],
+                    schema)
+stmt = ("SELECT COUNT(*) AS n FROM t WHERE " +
+        " OR ".join(f"(c0 > {{k * 16000}} AND c0 < {{k * 16000 + 900}})"
+                    for k in range(60)))
+w = {workers}
+if not w:
+    sql_query(stmt, path, schema)        # warm the serial jit
+drop_page_cache(path)
+t0 = time.monotonic()
+r = sql_query(stmt, path, schema, **({{"workers": w}} if w else {{}}))
+dt = time.monotonic() - t0
+print("rows:", r["n"])
+print(f"GBPS={{n_pages * PAGE_SIZE / dt / (1<<30):.3f}}")
+"""
+
+
 def main() -> int:
     from bench import hold_bench_lock
     _lock = hold_bench_lock("bench_matrix.py")   # released on exit
@@ -486,6 +609,16 @@ def main() -> int:
          _GROUPBY_CHIP.format(size=size, use_pallas=0), None),
         ("ckpt_restore", "checkpoint -> HBM direct restore",
          _CKPT.format(size=size, path=base), None),
+        ("scan_filter_cpu", "heap scan + CPU-backend filter (no tunnel)",
+         _SCAN_CPU.format(size=size, path=base), None),
+        ("ctas_write", "CREATE TABLE AS materialization (write leg)",
+         _CTAS_WRITE.format(size=size, path=base), None),
+        ("ckpt_save", "checkpoint save via O_DIRECT write queue",
+         _CKPT_SAVE.format(size=size, path=base), None),
+        ("scan_heavy_serial", "60-leaf OR filter, serial",
+         _HEAVY_SCAN.format(size=size, path=base, workers=0), None),
+        ("scan_heavy_workers4", "60-leaf OR filter, 4 worker processes",
+         _HEAVY_SCAN.format(size=size, path=base, workers=4), None),
     ]
     # BENCH_ROWS=a,b,c re-runs only those rows and merges over the existing
     # BENCH_MATRIX.json — device rows depend on the host tunnel's token
@@ -564,7 +697,9 @@ def _write_matrix(size_mb: int, results: dict, captured_at: dict) -> str:
     raww = results.get("raw_seq_write", 0.0)
     pct_of_raw = {k: round(v / raw, 3) for k, v in results.items()
                   if raw and k not in ("raw_seq_read", "raw_seq_write",
-                                       "ram2ssd_seq")
+                                       "ram2ssd_seq", "ctas_write",
+                                       "ckpt_save", "scan_heavy_serial",
+                                       "scan_heavy_workers4")
                   and not k.endswith("_chip")}
     if raww and "ram2ssd_seq" in results:
         # the write leg's denominator is the raw WRITE bandwidth
@@ -572,8 +707,27 @@ def _write_matrix(size_mb: int, results: dict, captured_at: dict) -> str:
     ceiling = min(raw, h2d) if raw and h2d else 0.0
     overlap_efficiency = {
         k: round(results[k] / ceiling, 3)
-        for k in ("ssd2tpu_seq", "ssd2tpu_mq32")
+        for k in ("ssd2tpu_seq", "ssd2tpu_mq32", "scan_filter",
+                  "ckpt_restore")
         if ceiling and k in results}
+    # transport-independent twin (VERDICT r4 weak #2): the CPU-backend
+    # scan+filter against the same-host SSD->RAM engine row
+    cpu_pipeline_efficiency = (
+        round(results["scan_filter_cpu"] / results["ssd2ram_seq"], 3)
+        if results.get("ssd2ram_seq") and results.get("scan_filter_cpu")
+        else None)
+    if raww:
+        # write-leg rows anchor to the raw WRITE denominator
+        for k in ("ctas_write", "ckpt_save"):
+            if k in results:
+                pct_of_raw[k] = round(results[k] / raww, 3)
+    # the Gather analog's end-to-end wall-clock win (spawn + jit costs
+    # included on the worker side)
+    parallel_speedup = (
+        round(results["scan_heavy_workers4"] /
+              results["scan_heavy_serial"], 3)
+        if results.get("scan_heavy_serial")
+        and results.get("scan_heavy_workers4") else None)
     # the pallas kernel's justification: on-chip GB/s vs the XLA twin on
     # the identical batch (>1.0 = the hand kernel earns its keep)
     pallas_vs_xla = (round(results["filter_pallas_chip"] /
@@ -612,8 +766,15 @@ def _write_matrix(size_mb: int, results: dict, captured_at: dict) -> str:
                                   "of record",
                    "pct_of_raw": pct_of_raw,
                    "overlap_efficiency": overlap_efficiency,
+                   "cpu_pipeline_efficiency": cpu_pipeline_efficiency,
+                   "parallel_speedup": parallel_speedup,
                    "pallas_vs_xla": pallas_vs_xla,
-                   "pallas_vs_xla_groupby": pallas_vs_xla_groupby}, f,
+                   "pallas_vs_xla_groupby": pallas_vs_xla_groupby,
+                   "groupby_kernel_routing":
+                       "auto=xla for value-keyed GROUP BY and float "
+                       "aggregations (pallas_vs_xla_groupby < 1 across "
+                       "r4/r5 sessions; the pallas filter kernel keeps "
+                       "auto=pallas on chip at pallas_vs_xla > 1)"}, f,
                   indent=2)
         f.write("\n")
     os.replace(tmp, path)

@@ -46,6 +46,22 @@ from .config import config
 from .log import pr_info, pr_warn
 from .eligibility import probe_backing
 from .stats import stats
+
+#: live sessions, for the stat exporter's pre-publish fold (weak: the
+#: registry must never keep a closed session alive)
+import weakref as _weakref
+
+_live_sessions: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def _fold_live_native_stats() -> None:
+    for s in list(_live_sessions):
+        try:
+            if getattr(s, "_native", None) is not None \
+                    and not s._closed:
+                s._fold_native_stats()
+        except Exception:   # noqa: BLE001 — observability, not control
+            pass
 from .stripe import StripeMap
 
 __all__ = [
@@ -835,6 +851,14 @@ class Session:
         self._buf_lock = threading.Condition(threading.Lock())
         self._next_handle = 1
         self._next_task = 1
+        # zero-cooperation observability (round 5): any process opening
+        # a Session becomes visible to `tpu_stat -l` / `-p PID` without
+        # opting in, the way every workload shows in the reference's
+        # /proc counters (utils/nvme_stat.c:168-175); STROM_STAT_EXPORT=0
+        # gates it off
+        stats.default_export_start()
+        _live_sessions.add(self)
+        stats.add_export_hook(_fold_live_native_stats)
         self._slots: List[Dict[int, DmaTask]] = [dict() for _ in range(_N_TASK_SLOTS)]
         self._slot_cv = [threading.Condition() for _ in range(_N_TASK_SLOTS)]
         self._id_lock = threading.Lock()

@@ -24,10 +24,31 @@ _WORDS = PAGE_SIZE // 4
 DEFAULT_SCHEMA = HeapSchema(n_cols=2, visibility=True)
 
 
-def decode_pages(pages_u8: jax.Array, schema: HeapSchema = DEFAULT_SCHEMA):
-    """(B, 8192) uint8 pages -> dict of (B, T) int32 columns + valid mask.
+class Cols(list):
+    """Decoded column list with the per-column NULL masks riding along:
+    ``cols[c]`` is the (B, T) value array (zeros under NULL — the
+    builder's convention), ``cols.nulls`` maps nullable column index ->
+    (B, T) bool (True = NULL).  A plain list subclass so every existing
+    ``cols[c]`` consumer is untouched."""
 
-    Pure bitcast/slice — zero data movement beyond what XLA fuses."""
+    def __init__(self, items, nulls=None):
+        super().__init__(items)
+        self.nulls = dict(nulls or {})
+
+
+def decode_pages(pages_u8: jax.Array, schema: HeapSchema = DEFAULT_SCHEMA):
+    """(B, 8192) uint8 pages -> (columns, valid mask).
+
+    Pure bitcast/slice — zero data movement beyond what XLA fuses.
+    8-byte columns (int64/float64) bitcast from word PAIRS and require
+    ``jax_enable_x64`` (without it jnp would silently truncate — an
+    exactness violation, so it refuses instead).  Nullable columns'
+    validity bitmaps decode into ``cols.nulls`` (True = NULL)."""
+    from ..api import StromError
+    if schema.has_wide and not jax.config.jax_enable_x64:
+        raise StromError(22, "schema has int64/float64 columns: enable "
+                             "jax_enable_x64 (8-byte decode would "
+                             "silently truncate at 32 bits)")
     b = pages_u8.shape[0]
     words = jax.lax.bitcast_convert_type(
         pages_u8.reshape(b, _WORDS, 4), jnp.int32).reshape(b, _WORDS)
@@ -40,15 +61,29 @@ def decode_pages(pages_u8: jax.Array, schema: HeapSchema = DEFAULT_SCHEMA):
         s, e = schema.col_word_range(c)
         col = words[:, s:e]
         dt = schema.col_dtype(c)
-        if dt != np.dtype(np.int32):
+        if dt.itemsize == 8:
+            # (B, 2T) words -> (B, T, 2) -> one 8-byte lane per tuple
+            col = jax.lax.bitcast_convert_type(
+                col.reshape(b, t, 2), jnp.dtype(dt))
+        elif dt != np.dtype(np.int32):
             # typed columns are a bitcast — layout is dtype-independent
             col = jax.lax.bitcast_convert_type(col, jnp.dtype(dt))
         cols.append(col)
+    nulls = {}
+    for c in range(schema.n_cols):
+        if not schema.col_nullable(c):
+            continue
+        s, e = schema.validity_word_range(c)
+        vw = words[:, s:e]                      # (B, ceil(T/32))
+        wi, bi = idx // 32, idx % 32            # (1, T)
+        bits = (vw[:, wi.reshape(-1)].reshape(b, t)
+                >> bi.astype(jnp.int32)) & 1
+        nulls[c] = bits == 0
     if schema.visibility:
         s, e = schema.col_word_range(schema.n_cols)
         visible = words[:, s:e] != 0
         valid = valid & visible
-    return cols, valid
+    return Cols(cols, nulls), valid
 
 
 def global_row_positions(pages_u8: jax.Array, schema: HeapSchema):
@@ -81,15 +116,31 @@ def scan_filter_step(pages_u8: jax.Array, threshold: jax.Array):
 
 def make_filter_fn(schema: HeapSchema, predicate):
     """Build a jitted page-batch filter: ``predicate(cols) -> bool (B, T)``.
-    Returns selected count, per-column masked sums."""
+    Returns selected count, per-column masked sums — NULL-aware: a
+    nullable column's sum skips its NULL rows (SQL SUM semantics), and
+    ``nncounts`` (per-column non-NULL selected-row counts, the
+    COUNT(col)/AVG(col) denominators) appears whenever the schema has
+    nullable columns."""
+    any_null = any(schema.col_nullable(c) for c in range(schema.n_cols))
 
     @jax.jit
     def run(pages_u8):
         cols, valid = decode_pages(pages_u8, schema)
         sel = valid & predicate(cols)
-        return {
+
+        def colmask(c):
+            n = cols.nulls.get(c)
+            return sel if n is None else sel & ~n
+
+        out = {
             "count": jnp.sum(sel.astype(jnp.int32)),
-            "sums": [jnp.sum(jnp.where(sel, c, 0)) for c in cols],
+            "sums": [jnp.sum(jnp.where(colmask(c), v, 0))
+                     for c, v in enumerate(cols)],
         }
+        if any_null:
+            out["nncounts"] = [
+                jnp.sum(colmask(c).astype(jnp.int32))
+                for c in range(schema.n_cols)]
+        return out
 
     return run

@@ -31,11 +31,16 @@ def combine_groupby(acc: dict, out: dict) -> dict:
     """Batch-fold combiner for grouped results (pass as
     ``TableScanner.scan_filter(..., combine=combine_groupby)`` or to
     ``distributed_scan_filter``): counts/sums/sumsqs add, mins/maxs meet."""
-    return {"count": acc["count"] + out["count"],
-            "sums": acc["sums"] + out["sums"],
-            "sumsqs": acc["sumsqs"] + out["sumsqs"],
-            "mins": jnp.minimum(acc["mins"], out["mins"]),
-            "maxs": jnp.maximum(acc["maxs"], out["maxs"])}
+    folded = {"count": acc["count"] + out["count"],
+              "sums": acc["sums"] + out["sums"],
+              "sumsqs": acc["sumsqs"] + out["sumsqs"],
+              "mins": jnp.minimum(acc["mins"], out["mins"]),
+              "maxs": jnp.maximum(acc["maxs"], out["maxs"])}
+    if "nncounts" in acc and "nncounts" in out:
+        # per-column non-NULL counts (the XLA kernel emits them for
+        # nullable schemas; the pallas twin never sees one)
+        folded["nncounts"] = acc["nncounts"] + out["nncounts"]
+    return folded
 
 def acc_dtypes(agg_dt: np.dtype):
     """THE accumulation convention, in one place — returns
@@ -76,10 +81,16 @@ def _check_agg_cols(schema: HeapSchema, agg_cols):
                          f"dtype, got {sorted(str(d) for d in dts)}; "
                          f"split into one groupby per dtype")
     dt = dts.pop()
-    if dt not in (np.dtype(np.int32), np.dtype(np.uint32),
-                  np.dtype(np.float32)):
-        raise ValueError(f"groupby aggregates int32, uint32, or float32 "
-                         f"columns (got {dt})")
+    if dt in (np.dtype(np.int64), np.dtype(np.float64)):
+        # 8-byte aggregation rides the XLA path under x64 (round 5)
+        if not jax.config.jax_enable_x64:
+            raise ValueError(f"aggregating {dt} columns requires "
+                             f"jax_enable_x64 (32-bit accumulation "
+                             f"would silently truncate)")
+    elif dt not in (np.dtype(np.int32), np.dtype(np.uint32),
+                    np.dtype(np.float32)):
+        raise ValueError(f"groupby aggregates int32, uint32, float32, "
+                         f"int64, or float64 columns (got {dt})")
     return cols_idx, dt
 
 
@@ -116,16 +127,25 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
         keys = jnp.where(sel, keys, G)  # overflow bucket, sliced off below
         flat_keys = keys.reshape(-1)
         onehot = jax.nn.one_hot(flat_keys, G + 1, dtype=jnp.int32)[:, :G]
+        # NULL-aware aggregation (round 5): a nullable column's NULL
+        # rows contribute nothing to its sums (stored zeros already do
+        # that for + paths) and are excluded from its min/max/sumsq
+        # masks; group COUNT stays the row count (SQL COUNT(*))
+        nullm = [getattr(cols, "nulls", {}).get(i) for i in cols_idx]
+        flat_nn = [sel.reshape(-1) if m is None
+                   else (sel & ~m).reshape(-1) for m in nullm]
         vals = jnp.stack([c.reshape(-1) for c in (cols[i] for i in cols_idx)],
                          axis=-1)                       # (N, V)
         count = jnp.sum(onehot, axis=0)                 # (G,)
         flat_sel = sel.reshape(-1)
-        if agg_dt.kind == "i":
-            # the MXU path: (N,G)x(N,V)->(G,V) integer contraction.  Exact
-            # per batch within int32; under x64 the accumulator (and the
-            # cross-batch fold) widens to int64, matching scan_filter_step's
-            # convention — without x64, sums past 2^31 wrap (as any int32
-            # engine would)
+        if agg_dt.kind == "i" and np.dtype(acc_np).itemsize == 4:
+            # the MXU path: (N,G)x(N,V)->(G,V) integer contraction,
+            # exact within int32 (sums past 2^31 wrap, as any int32
+            # engine would).  Only when the ACCUMULATOR is 32-bit: an
+            # s64 dot_general does not lower on TPU (the X64-rewriter
+            # has no dot rule — found live on v5e), so int64
+            # accumulation (x64 mode, and int64 columns) rides
+            # segment_sum below instead
             sums = jax.lax.dot_general(
                 onehot, vals, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.dtype(acc_np)).T   # (V, G)
@@ -148,19 +168,28 @@ def make_groupby_fn(schema: HeapSchema, key_fn: Callable, n_groups: int, *,
         sq_t = jnp.dtype(sq_np)
         sumsqs = jnp.stack([
             jax.ops.segment_sum(
-                jnp.where(flat_sel, v.astype(sq_t) * v.astype(sq_t), 0.0),
+                jnp.where(m, v.astype(sq_t) * v.astype(sq_t), 0.0),
                 flat_keys, num_segments=G + 1)[:G]
-            for v in vals.T])
+            for v, m in zip(vals.T, flat_nn)])
         mins = jnp.stack([
-            jax.ops.segment_min(jnp.where(flat_sel, v, hi), flat_keys,
+            jax.ops.segment_min(jnp.where(m, v, hi), flat_keys,
                                 num_segments=G + 1)[:G]
-            for v in vals.T])
+            for v, m in zip(vals.T, flat_nn)])
         maxs = jnp.stack([
-            jax.ops.segment_max(jnp.where(flat_sel, v, lo), flat_keys,
+            jax.ops.segment_max(jnp.where(m, v, lo), flat_keys,
                                 num_segments=G + 1)[:G]
-            for v in vals.T])
-        return {"count": count, "sums": sums, "sumsqs": sumsqs,
-                "mins": mins, "maxs": maxs}
+            for v, m in zip(vals.T, flat_nn)])
+        out = {"count": count, "sums": sums, "sumsqs": sumsqs,
+               "mins": mins, "maxs": maxs}
+        if any(m is not None for m in nullm):
+            # per-column non-NULL group counts: AVG/VAR/STD over a
+            # nullable column divide by THESE, not the row count
+            # (review finding: sums skipped NULLs, denominators did not)
+            out["nncounts"] = jnp.stack([
+                jax.ops.segment_sum(m.astype(jnp.int32), flat_keys,
+                                    num_segments=G + 1)[:G]
+                for m in flat_nn])
+        return out
 
     return run
 

@@ -127,6 +127,17 @@ def build_index(table_path: str, schema, col, *,
     single-column builds (VERDICT r3 #4)."""
     from .query import Query
 
+    for c in (col if isinstance(col, (tuple, list)) else [col]):
+        if 0 <= int(c) < schema.n_cols:
+            if schema.col_nullable(int(c)):
+                raise StromError(_errno.EINVAL,
+                                 f"build_index: c{c} is nullable — "
+                                 f"sidecars hold no NULL entries and "
+                                 f"the scan paths could disagree")
+            if schema.col_dtype(int(c)).itemsize != 4:
+                raise StromError(_errno.EINVAL,
+                                 f"build_index: c{c} is 8-byte — "
+                                 f"sidecar keys are 4-byte words")
     # stamp BEFORE the scan: a table modified mid-build then mismatches
     # the stamp and open_index fails stale (stamping after would bless an
     # index holding pre-modification data)

@@ -58,6 +58,9 @@ def _fetch_gather_fn(schema: HeapSchema, cols: tuple):
     def gather(pages_u8, page_idx, slot):
         dcols, valid = decode_pages(pages_u8, schema)
         out = {f"col{c}": dcols[c][page_idx, slot] for c in cols}
+        for c in cols:
+            if c in dcols.nulls:     # True = NULL (round 5)
+                out[f"null{c}"] = dcols.nulls[c][page_idx, slot]
         out["valid"] = valid[page_idx, slot]
         return out
 
@@ -78,6 +81,18 @@ class _GroupSpill(Exception):
     def __init__(self, seen: int):
         self.seen = seen
         super().__init__(f"group key discovery passed {seen} distinct")
+
+
+class _HostCols(dict):
+    """Host-side column mapping that quacks like the device decode's
+    ``Cols`` for predicate evaluation: ``cols[c]`` values plus
+    ``cols.nulls`` masks — the index-path recheck must see the same
+    NULL facts the scan kernels see (review finding: a plain dict
+    dropped them, and NULL rows' stored zeros matched residuals)."""
+
+    def __init__(self, items, nulls=None):
+        super().__init__(items)
+        self.nulls = dict(nulls or {})
 
 
 class _SortedGroupAcc:
@@ -271,6 +286,22 @@ class Query:
         self._pred = predicate
         return self
 
+    def _null_guard(self, pred, *cols_):
+        """SQL comparison semantics on nullable columns: NULL cmp x is
+        never true — wrap a structured predicate so NULL rows of the
+        referenced columns can't match (their STORED word is 0, which a
+        bare ``col == 0`` would otherwise select)."""
+        nn = tuple(c for c in cols_ if self.schema.col_nullable(c))
+        if not nn:
+            return pred
+
+        def wrapped(cols, base=pred, nn=nn):
+            m = base(cols)
+            for c in nn:
+                m = m & ~cols.nulls[c]
+            return m
+        return wrapped
+
     def _set_structured(self, *, eq=None, rng=None, members=None) -> None:
         """Install exactly one structured filter (the others clear; a
         stale residual from a previous filter generation must never
@@ -320,7 +351,9 @@ class Query:
                 self._pred = lambda cols: cols[c0] != cols[c0]
                 self._set_structured(eq=((c0, c1), None))  # index: empty
             else:
-                self._pred = lambda cols: (cols[c0] == v0) & (cols[c1] == v1)
+                self._pred = self._null_guard(
+                    lambda cols: (cols[c0] == v0) & (cols[c1] == v1),
+                    c0, c1)
                 self._set_structured(eq=((c0, c1), (v0, v1)))
             return self
         if not 0 <= col < self.schema.n_cols:
@@ -334,7 +367,8 @@ class Query:
             self._pred = lambda cols: cols[col] != cols[col]
             self._set_structured(eq=(int(col), None))  # index: empty
         else:
-            self._pred = lambda cols: cols[col] == v
+            self._pred = self._null_guard(
+                lambda cols: cols[col] == v, col)
             self._set_structured(eq=(int(col), v))
         return self
 
@@ -368,7 +402,7 @@ class Query:
             import jax.numpy as jnp
             return jnp.isin(cols[col], members)
 
-        self._pred = pred
+        self._pred = self._null_guard(pred, col)
         self._set_structured(members=(int(col), members))
         return self
 
@@ -445,7 +479,7 @@ class Query:
                 m = m & (cols[col] <= nhi)
             return m
 
-        self._pred = pred
+        self._pred = self._null_guard(pred, col)
         self._set_structured(rng=(int(col), nlo, nhi))
         return self
 
@@ -521,9 +555,14 @@ class Query:
             if not 0 <= c < self.schema.n_cols:
                 raise StromError(22, f"group_by_cols column {c} out of "
                                      f"range")
-            if self.schema.col_dtype(c).kind not in "iu":
-                raise StromError(22, "group_by_cols keys must be integer "
-                                     "columns")
+            if self.schema.col_dtype(c).kind not in "iu" \
+                    or self.schema.col_dtype(c).itemsize != 4:
+                raise StromError(22, "group_by_cols keys must be 4-byte "
+                                     "integer columns")
+            if self.schema.col_nullable(c):
+                raise StromError(22, f"group_by_cols: c{c} is nullable "
+                                     f"(NULL group keys are outside "
+                                     f"this subset)")
         if max_groups < 1:
             raise StromError(22, "max_groups must be >= 1")
         self._op = "group_by"
@@ -731,6 +770,14 @@ class Query:
     def top_k(self, col: int, k: int, *, largest: bool = True) -> "Query":
         """Terminal: k best values of *col* + their global row positions."""
         self._require_no_terminal()
+        if 0 <= int(col) < self.schema.n_cols:
+            if self.schema.col_nullable(int(col)):
+                raise StromError(22, f"top_k over the nullable c{col} "
+                                     f"is outside this subset (no NULL "
+                                     f"ordering)")
+            if self.schema.col_dtype(int(col)).itemsize != 4:
+                raise StromError(22, f"top_k supports 4-byte columns "
+                                     f"(c{col} is 8-byte)")
         self._op = "top_k"
         self._terminal_set = True
         self._topk = (int(col), int(k), largest)
@@ -819,6 +866,11 @@ class Query:
             check_join_how(how)
         except ValueError as e:
             raise StromError(22, str(e)) from None
+        if 0 <= int(probe_col) < self.schema.n_cols \
+                and self.schema.col_nullable(int(probe_col)):
+            raise StromError(22, f"join probe column c{probe_col} is "
+                                 f"nullable (NULL keys never match; "
+                                 f"outside this subset)")
         if limit is not None and limit < 0:
             raise StromError(22, "join limit must be >= 0")
         if offset < 0:
@@ -897,7 +949,16 @@ class Query:
         if not exprs:
             raise StromError(22, "aggregate_exprs needs >= 1 expression")
         for e in exprs:
-            _expr_info(e, self.schema)   # raises EINVAL outside subset
+            _dt, cs = _expr_info(e, self.schema)
+            for c in cs:
+                if self.schema.col_nullable(c):
+                    # a NULL operand makes the whole expression NULL —
+                    # the fused kernel has no per-row NULL propagation,
+                    # so refuse instead of summing stored zeros
+                    raise StromError(22, f"SQL: expression aggregates "
+                                         f"over the nullable c{c} are "
+                                         f"outside this subset (NULL "
+                                         f"propagation)")
         self._op = "aggregate"
         self._terminal_set = True
         self._agg_exprs = exprs
@@ -953,9 +1014,10 @@ class Query:
             if not 0 <= pc < self.schema.n_cols:
                 raise StromError(22, f"star_join probe column {pc} out "
                                      f"of range")
-            if self.schema.col_dtype(pc) != np.dtype(np.int32):
+            if self.schema.col_dtype(pc) != np.dtype(np.int32) \
+                    or self.schema.col_nullable(pc):
                 raise StromError(22, "star_join probe columns must be "
-                                     "int32")
+                                     "non-nullable int32")
             bs = j["schema"]
             if isinstance(j["table"], os.PathLike):
                 j["table"] = str(j["table"])
@@ -995,7 +1057,14 @@ class Query:
         if exprs:
             from .sql import _expr_info
             for e in exprs:
-                _expr_info(e, self.schema)
+                _dt, cs = _expr_info(e, self.schema)
+                for c in cs:
+                    if self.schema.col_nullable(c):
+                        raise StromError(22, f"SQL: expression "
+                                             f"aggregates over the "
+                                             f"nullable c{c} are "
+                                             f"outside this subset "
+                                             f"(NULL propagation)")
         if materialize:
             if limit is not None and limit < 0:
                 raise StromError(22, "star_join limit must be >= 0")
@@ -1171,6 +1240,13 @@ class Query:
         if mode == "mesh":
             return "xla", "mesh mode: XLA partitions the reduction and " \
                           "inserts collectives (pallas does not auto-shard)"
+        if self.schema.has_wide or any(self.schema.nullable or ()):
+            # the Mosaic kernels decode the 4-byte non-null layout;
+            # wide (int64/float64) regions and validity bitmaps decode
+            # on the XLA path (round 5)
+            return "xla", ("wide/nullable page layout decodes on the "
+                           "XLA path (the pallas kernels serve the "
+                           "4-byte non-null layout)")
         if self._op == "aggregate":
             if on_tpu:
                 return "pallas", "single-pass SMEM-accumulator kernel " \
@@ -1193,6 +1269,16 @@ class Query:
                 # dtypes Mosaic cannot hold in SMEM on real hardware
                 return "xla", "x64 accumulators (i64/f64) exceed the " \
                               "pallas kernel's SMEM dtype support"
+            from ..ops.groupby import _check_agg_cols as _cac
+            if _cac(self.schema, agg)[1].kind == "f":
+                # measured routing decision (VERDICT r4 weak #4 / next
+                # #8): pallas_vs_xla_groupby < 1.0 for float
+                # aggregations across r4/r5 sessions — recorded in
+                # BENCH_MATRIX's groupby_kernel_routing
+                return "xla", ("float aggregation routes to XLA "
+                               "(bench: pallas_vs_xla_groupby < 1.0 — "
+                               "the pallas GROUP BY earns its keep on "
+                               "int accumulators only)")
             if on_tpu and g <= _PALLAS_MAX_GROUPS:
                 return "pallas", f"G={g} within the static-unroll bound " \
                                  f"({_PALLAS_MAX_GROUPS})"
@@ -1569,9 +1655,15 @@ class Query:
             if self._agg_cols is not None:
                 keep = list(self._agg_cols)
                 inner = fn
-                fn = lambda pages: (lambda o: {
-                    "count": o["count"],
-                    "sums": [o["sums"][c] for c in keep]})(inner(pages))
+
+                def project(o, keep=keep):
+                    out = {"count": o["count"],
+                           "sums": [o["sums"][c] for c in keep]}
+                    if "nncounts" in o:   # NULL-aware denominators
+                        out["nncounts"] = [o["nncounts"][c]
+                                           for c in keep]
+                    return out
+                fn = lambda pages: project(inner(pages))
             return fn, None
         if self._op == "group_by":
             key_fn, g, agg, _having = self._group
@@ -1757,6 +1849,11 @@ class Query:
                       "group_by": self._run_groupby_indexed,
                       "join": self._run_join_indexed,
                       }.get(self._op)
+            if self._op == "aggregate" and self._agg_exprs is not None:
+                # expression sums have no host emulation (the fused
+                # kernel IS the implementation); scan instead of
+                # returning the wrong result shape
+                runner = None
             if (self._op == "join" and self._join_src is not None
                     and self._join_strategy()[0] == "partitioned"):
                 # index-served joins probe the build host-side; a
@@ -1866,18 +1963,26 @@ class Query:
         having = self._group[3]
         count = np.asarray(out["count"])
         sums = np.asarray(out["sums"])
+        # AVG/VAR denominators: per-column non-NULL counts when the
+        # kernel emitted them (nullable aggregate columns), else the
+        # group row count — an all-NULL group's average is NaN (SQL
+        # NULL), exactly like an empty group's
+        nn = np.asarray(out["nncounts"]) if "nncounts" in out else None
+        base = nn if nn is not None else count
         with np.errstate(divide="ignore", invalid="ignore"):
-            denom = np.maximum(count, 1)
-            avgs = np.where(count > 0, sums / denom, np.nan)
+            denom = np.maximum(base, 1)
+            avgs = np.where(base > 0, sums / denom, np.nan)
         res = {"count": count, "sums": sums,
                "mins": np.asarray(out["mins"]),
                "maxs": np.asarray(out["maxs"]), "avgs": avgs}
+        if nn is not None:
+            res["nncounts"] = nn
         if "sumsqs" in out:
             sumsqs = np.asarray(out["sumsqs"], dtype=np.float64)
             with np.errstate(divide="ignore", invalid="ignore"):
                 # clamp: E[x^2]-E[x]^2 can dip epsilon-negative in floats
                 vars_ = np.maximum(
-                    np.where(count > 0, sumsqs / denom - np.square(avgs),
+                    np.where(base > 0, sumsqs / denom - np.square(avgs),
                              np.nan), 0.0)
             res["sumsqs"] = sumsqs
             res["vars"] = vars_
@@ -1908,6 +2013,14 @@ class Query:
         from .index import pack_pair
         cols_, agg, _user_having, _mg = self._group_cols
         agg_idx, agg_dt = _check_agg_cols(self.schema, agg)
+        for c in agg_idx:
+            if self.schema.col_nullable(c):
+                raise StromError(22, f"group_by_cols: c{c} is nullable "
+                                     f"and the key set exceeded "
+                                     f"max_groups — high-cardinality "
+                                     f"GROUP BY over nullable "
+                                     f"aggregates is outside this "
+                                     f"subset")
         acc_np, sq_np, lo, hi = acc_dtypes(agg_dt)
         dts = [self.schema.col_dtype(c) for c in cols_]
         if len(cols_) == 1:
@@ -2000,7 +2113,7 @@ class Query:
         spec = {
             "source": self.source,
             "schema": (self.schema.n_cols, self.schema.visibility,
-                       self.schema.dtypes),
+                       self.schema.dtypes, self.schema.nullable),
             "chunk_size": int(_cfg.get("chunk_size")),
             # leader-side runtime state workers must mirror: the config
             # snapshot (join_broadcast_max, scan knobs, ...) and the
@@ -2031,8 +2144,9 @@ class Query:
     def _from_worker_spec(cls, spec: dict) -> "Query":
         """Rebuild the leader's query inside a worker process from the
         picklable spec (inverse of :meth:`_worker_spec`)."""
-        n_cols, vis, dts = spec["schema"]
-        schema = HeapSchema(n_cols=n_cols, visibility=vis, dtypes=dts)
+        n_cols, vis, dts, nullable = spec["schema"]
+        schema = HeapSchema(n_cols=n_cols, visibility=vis, dtypes=dts,
+                            nullable=nullable)
         q = cls(spec["source"], schema)
         if spec["eq"] is not None:
             col, v = spec["eq"]
@@ -2209,8 +2323,13 @@ class Query:
                     for i in range(len(fields))]
             stop = None if limit is None else offset + limit
             arrs = [a[offset:stop] for a in arrs]
-            out = {f"col{c}": v for c, v in zip(cols, arrs[:-1])}
-            out["positions"] = arrs[-1]
+            named = dict(zip(fields, arrs))
+            out = {f"col{c}": named[f"f{i}"]
+                   for i, c in enumerate(cols)}
+            for i, c in enumerate(cols):
+                if f"n{i}" in named:
+                    out[f"null{c}"] = named[f"n{i}"]
+            out["positions"] = named["pos"]
             out["count"] = np.int64(len(out["positions"]))
             return _tag(out)
         accs = [p["acc"] for p in partials if p["acc"]]
@@ -2237,6 +2356,10 @@ class Query:
                       np.dtype(np.float32)):
             raise StromError(22, f"{opname} supports int32/uint32/"
                                  f"float32 columns (got {dt})")
+        if self.schema.col_nullable(col):
+            raise StromError(22, f"{opname} over the nullable c{col} is "
+                                 f"outside this subset (no NULL "
+                                 f"ordering)")
         return dt
 
     @staticmethod
@@ -2265,6 +2388,8 @@ class Query:
             out = {"mask": valid.reshape(-1)}
             for i, c in enumerate(cols):
                 out[f"f{i}"] = dcols[c].reshape(-1)
+                if c in dcols.nulls:   # NULL masks ride along (round 5)
+                    out[f"n{i}"] = dcols.nulls[c].reshape(-1)
             if want_positions:   # distinct never reads them — skip the
                 out["pos"] = global_row_positions(   # decode + D2H
                     pages, self.schema).reshape(-1)
@@ -2272,6 +2397,10 @@ class Query:
 
         fields = [f"f{i}" for i in range(len(cols))]
         dtypes = [self.schema.col_dtype(c) for c in cols]
+        for i, c in enumerate(cols):
+            if self.schema.col_nullable(c):
+                fields.append(f"n{i}")
+                dtypes.append(np.dtype(bool))
         if want_positions:
             fields.append("pos")
             dtypes.append(self._pos_dtype())
@@ -2471,7 +2600,10 @@ class Query:
             pb = pos[b0:b0 + batch]
             out = self.fetch(pb, cols=cols_all, session=session,
                              device=device)
-            colsd = {c: np.asarray(out[f"col{c}"]) for c in cols_all}
+            colsd = _HostCols(
+                {c: np.asarray(out[f"col{c}"]) for c in cols_all},
+                nulls={c: np.asarray(out[f"null{c}"]).astype(bool)
+                       for c in cols_all if f"null{c}" in out})
             mask = np.asarray(self._residual(colsd)) \
                 .astype(bool).reshape(-1)
             # an invisible row's decoded values are garbage: never let
@@ -2560,15 +2692,30 @@ class Query:
         sumsqs = np.zeros((V, g), sq_t)
         mins = np.full((V, g), hi, agg_dt)
         maxs = np.full((V, g), lo, agg_dt)
+        any_null = any(self.schema.col_nullable(c)
+                       for c in range(self.schema.n_cols))
+        nncounts = np.zeros((V, g), np.int32)
         for vi, ci in enumerate(cols_idx):
             v = cols[ci].reshape(-1)[sel]
-            np.add.at(sums[vi], keys, v.astype(acc_t))
-            np.add.at(sumsqs[vi], keys, v.astype(sq_t) * v.astype(sq_t))
-            np.minimum.at(mins[vi], keys, v)
-            np.maximum.at(maxs[vi], keys, v)
-        return self._finalize({"count": count, "sums": sums,
-                               "sumsqs": sumsqs, "mins": mins,
-                               "maxs": maxs})
+            # NULL exclusion mirrors the kernel: NULL rows add nothing
+            # to sums and never touch min/max/sumsq (review finding:
+            # the host emulation absorbed the stored zeros)
+            if f"null{ci}" in out:
+                nv = ~np.asarray(out[f"null{ci}"])[keep] \
+                    .reshape(-1)[sel]
+            else:
+                nv = np.ones(len(v), bool)
+            vv, kk = v[nv], keys[nv]
+            np.add.at(sums[vi], kk, vv.astype(acc_t))
+            np.add.at(sumsqs[vi], kk, vv.astype(sq_t) * vv.astype(sq_t))
+            np.minimum.at(mins[vi], kk, vv)
+            np.maximum.at(maxs[vi], kk, vv)
+            np.add.at(nncounts[vi], kk, 1)
+        res = {"count": count, "sums": sums, "sumsqs": sumsqs,
+               "mins": mins, "maxs": maxs}
+        if any_null:
+            res["nncounts"] = nncounts
+        return self._finalize(res)
 
     def _run_join_indexed(self, idx, device, session) -> dict:
         """Join over index-resolved rows (JOIN ... WHERE key = v): only
@@ -2675,13 +2822,25 @@ class Query:
         pos = self._index_positions(idx, session, device)
         out = self.fetch(pos, cols=agg_cols, session=session,
                          device=device)
-        keep = out["valid"]
-        sums = []
+        keep = np.asarray(out["valid"]).astype(bool)
+        any_null = any(self.schema.col_nullable(c)
+                       for c in range(self.schema.n_cols))
+        sums, nncounts = [], []
         for c in agg_cols:
             v = out[f"col{c}"][keep]
             acc = acc_dtypes(self.schema.col_dtype(c))[0]
+            # stored NULL words are zero, so plain sums already skip
+            # them; the DENOMINATORS must not (COUNT(c)/AVG(c))
             sums.append(np.sum(v, dtype=acc))
-        return {"count": np.int32(int(keep.sum())), "sums": sums}
+            if f"null{c}" in out:
+                nncounts.append(np.int32(int(
+                    (keep & ~np.asarray(out[f"null{c}"])).sum())))
+            else:
+                nncounts.append(np.int32(int(keep.sum())))
+        res = {"count": np.int32(int(keep.sum())), "sums": sums}
+        if any_null:    # key present iff the kernel path would emit it
+            res["nncounts"] = nncounts
+        return res
 
     def _run_topk_indexed(self, idx, device, session) -> dict:
         """top_k over index-resolved rows: fetch only matching pages,
@@ -2719,8 +2878,12 @@ class Query:
         arrs = self._collect_rows(plan, gather, "mask", fields, dtypes,
                                   device, session, limit=limit,
                                   offset=offset)
-        out = {f"col{c}": v for c, v in zip(cols, arrs[:-1])}
-        out["positions"] = arrs[-1]
+        named = dict(zip(fields, arrs))
+        out = {f"col{c}": named[f"f{i}"] for i, c in enumerate(cols)}
+        for i, c in enumerate(cols):
+            if f"n{i}" in named:    # True = NULL (round 5)
+                out[f"null{c}"] = named[f"n{i}"]
+        out["positions"] = named["pos"]
         out["count"] = np.int64(len(out["positions"]))
         return out
 

@@ -484,6 +484,10 @@ def _parse_cond_leaf(p: _P, n_cols: int) -> tuple:
     left = _parse_expr(p, n_cols)
     if left[0] == "col":
         c = left[1]
+        if p.kw("is"):
+            neg = p.kw("not")
+            p.expect_kw("null")
+            return ("isnull", c, neg)
         if p.kw("between"):
             lo = _plit(p)
             p.expect_kw("and")
@@ -604,6 +608,13 @@ def _dict_cache(source):
 def _translate_cond(cond, dicts, schema=None) -> Optional[tuple]:
     """One leaf onto dictionary-code space (see the module docstring);
     None = the leaf is vacuously TRUE (``!= 'absent string'``)."""
+    if cond[0] == "isnull":
+        _k, c, neg = cond
+        if schema is not None and not schema.col_nullable(c):
+            # IS NULL on a non-nullable column: constant-fold exactly
+            # (always false / always true)
+            return None if neg else ("in", c, [])
+        return cond
     if cond[0] == "cmpe":
         # expression comparison: validate the subset here (both sides
         # type-check, no dictionary columns — codes are ranks, and
@@ -721,9 +732,37 @@ def _cmp_np(op: str):
             ">": np.greater, ">=": np.greater_equal}[op]
 
 
+def _expr_cols_of(e) -> set:
+    if e[0] == "col":
+        return {e[1]}
+    if e[0] == "lit":
+        return set()
+    if e[0] == "neg":
+        return _expr_cols_of(e[1])
+    return _expr_cols_of(e[2]) | _expr_cols_of(e[3])
+
+
+def _not_null(cols, refs, mask):
+    """SQL comparison semantics: NULL cmp x is never true — AND away
+    the NULL rows of every referenced nullable column."""
+    for c in refs:
+        n = getattr(cols, "nulls", {}).get(c)
+        if n is not None:
+            mask = mask & ~n
+    return mask
+
+
 def _leaf_mask(cond, cols):
-    """jnp mask for one leaf condition."""
+    """jnp mask for one leaf condition (NULL rows of referenced
+    nullable columns never match, per SQL three-valued logic)."""
     import jax.numpy as jnp
+    if cond[0] == "isnull":
+        _k, c, neg = cond
+        n = getattr(cols, "nulls", {}).get(c)
+        if n is None:              # untranslated non-nullable leaf
+            base = jnp.zeros(cols[c].shape, bool)
+            return ~base if neg else base
+        return ~n if neg else n
     if cond[0] == "cmpe":
         _k, l, op, r = cond
         a, b = _eval_expr(l, cols), _eval_expr(r, cols)
@@ -733,23 +772,24 @@ def _leaf_mask(cond, cols):
                "!=": jnp.not_equal, "<>": jnp.not_equal,
                "<": jnp.less, "<=": jnp.less_equal,
                ">": jnp.greater, ">=": jnp.greater_equal}
-        return fns[op](a, b)
+        return _not_null(cols, _expr_cols_of(l) | _expr_cols_of(r),
+                         fns[op](a, b))
     if cond[0] == "cmp":
         _, c, op, lit = cond
         fns = {"=": jnp.equal, "==": jnp.equal,
                "!=": jnp.not_equal, "<>": jnp.not_equal,
                "<": jnp.less, "<=": jnp.less_equal,
                ">": jnp.greater, ">=": jnp.greater_equal}
-        return fns[op](cols[c], lit)
+        return _not_null(cols, {c}, fns[op](cols[c], lit))
     if cond[0] == "between":
         _, c, lo, hi = cond
-        return (cols[c] >= lo) & (cols[c] <= hi)
+        return _not_null(cols, {c}, (cols[c] >= lo) & (cols[c] <= hi))
     _, c, lits = cond
     import jax.numpy as jnp
     one = jnp.zeros(cols[c].shape, bool)
     for v in lits:
         one = one | (cols[c] == v)
-    return one
+    return _not_null(cols, {c}, one)
 
 
 def _tree_mask(tree, cols):
@@ -1352,7 +1392,17 @@ def _parse_sql_raw(sql: str, source, schema,
         def assemble(res, cols=cols):
             sel = cols if cols is not None else \
                 [int(k[3:]) for k in res if k.startswith("col")]
-            out = {f"c{c}": np.asarray(res[f"col{c}"]) for c in sel}
+            out = {}
+            for c in sel:
+                arr = np.asarray(res[f"col{c}"])
+                if f"null{c}" in res:
+                    # nullable column: real NULLs at the result edge
+                    # (object array with None — never a sentinel value)
+                    m = np.asarray(res[f"null{c}"]).astype(bool)
+                    obj = arr.astype(object)
+                    obj[m] = None
+                    arr = obj
+                out[f"c{c}"] = arr
             out["positions"] = np.asarray(res["positions"])
             return out
         return q, assemble
@@ -1424,6 +1474,11 @@ def _parse_sql_raw(sql: str, source, schema,
                 sum_cols.append(it.col)
         elif it.fn == "count" and it.col is None:
             pass
+        elif it.fn == "count" and not it.distinct:
+            # COUNT(cN): non-NULL count (round 5) — rides the same
+            # projected column slot so nncounts stays aligned
+            if it.col not in sum_cols:
+                sum_cols.append(it.col)
         else:
             raise StromError(22, f"SQL: {it.label} cannot combine with "
                                  f"other aggregates without GROUP BY")
@@ -1432,13 +1487,22 @@ def _parse_sql_raw(sql: str, source, schema,
     def assemble(res, aggs=aggs, sum_cols=sum_cols):
         out = {}
         n = int(res["count"])
+        nnc = res.get("nncounts")   # present iff the schema has
+        #                             nullable columns (NULL-aware)
+
+        def denom(col):
+            return int(np.asarray(nnc[sum_cols.index(col)])) \
+                if nnc is not None else n
         for it in aggs:
-            if it.fn == "count":
+            if it.fn == "count" and it.col is None:
                 out[it.label] = n
+            elif it.fn == "count":
+                out[it.label] = denom(it.col)
             else:
                 s = np.asarray(res["sums"][sum_cols.index(it.col)])
+                d = denom(it.col)
                 out[it.label] = s.item() if it.fn == "sum" else \
-                    (s.item() / n if n else None)
+                    (s.item() / d if d else None)
         return out
     return q, assemble
 
@@ -1487,11 +1551,40 @@ def create_table_as(dest_path: str, sql: str, source, schema,
     out.pop("_analyze", None)
     out.pop("_workers", None)      # scan telemetry, not data
     out.pop("positions", None)     # row provenance, not data
-    # the LEFT row face's NULL indicator ("matched") stays: it becomes
-    # an int32 0/1 column — dropping it would silently erase which
-    # rows were unpartnered
-    cols, dts, dict_cols = [], [], {}
+    # LEFT-join NULL indicators become REAL NULLS (round 5): the
+    # unaliased dim payload labels ("<dim>.cK") turn into nullable
+    # columns masked by their indicator, and the indicator column
+    # drops.  Aliased payloads keep the indicator (the label link is
+    # gone), preserving the round-4 int32-indicator behavior.
+    null_of: dict = {}
+    matched = out.get("matched")
+    if matched is not None:
+        m = ~np.asarray(matched).astype(bool)
+        hits = [lbl for lbl in out if "." in lbl]
+        if hits:
+            for lbl in hits:
+                null_of[lbl] = m
+            out.pop("matched")
+    for key in [k for k in out if k.startswith("matched_")]:
+        dname = key[len("matched_"):]
+        m = ~np.asarray(out[key]).astype(bool)
+        hits = [lbl for lbl in out if lbl.startswith(dname + ".")]
+        if hits:
+            for lbl in hits:
+                null_of[lbl] = m
+            out.pop(key)
+    cols, dts, dict_cols, nullable, nulls = [], [], {}, [], {}
     n_rows = None
+
+    def add(label, arr, dt, mask):
+        if mask is not None and mask.any():
+            nulls[len(cols)] = mask
+            nullable.append(True)
+        else:
+            nullable.append(False)
+        cols.append(arr)
+        dts.append(dt)
+
     for label, v in out.items():
         if v is None:
             # a NULL scalar aggregate (MIN over zero rows): the heap
@@ -1511,33 +1604,53 @@ def create_table_as(dest_path: str, sql: str, source, schema,
             raise StromError(22, f"CREATE TABLE AS: column {label!r} "
                                  f"has {len(arr)} rows, expected "
                                  f"{n_rows} (mixed result faces)")
-        if arr.dtype.kind == "O":      # strings: fresh dictionary
-            d = StringDict(arr.tolist())
-            dict_cols[len(cols)] = d
-            cols.append(d.encode(arr.tolist()))
-            dts.append("uint32")
-        elif arr.dtype.kind == "f":
-            cols.append(arr.astype(np.float32))
-            dts.append("float32")
+        mask = null_of.get(label)
+        if arr.dtype.kind == "O":
+            present = [x for x in arr if x is not None]
+            if any(isinstance(x, str) for x in present):
+                if len(present) != len(arr) or mask is not None:
+                    raise StromError(22, f"CREATE TABLE AS: {label!r} "
+                                         f"mixes strings and NULLs "
+                                         f"(nullable string columns "
+                                         f"are outside this subset)")
+                d = StringDict(arr.tolist())
+                dict_cols[len(cols)] = d
+                add(label, d.encode(arr.tolist()), "uint32", None)
+                continue
+            # numeric object column with None holes (a nullable source
+            # column projected through SQL): real NULLs round-trip
+            om = np.array([x is None for x in arr], dtype=bool)
+            mask = om if mask is None else (mask | om)
+            isf = any(isinstance(x, float) for x in present)
+            arr = np.array([0 if x is None else x for x in arr],
+                           dtype=np.float64 if isf else np.int64)
+        if arr.dtype.kind == "f":
+            f32 = arr.astype(np.float32)
+            if mask is not None:
+                f32 = np.where(mask, np.float32(0), f32)
+            add(label, f32, "float32", mask)
         elif arr.dtype.kind == "u":
             if len(arr) and int(arr.max()) > 0xFFFFFFFF:
                 raise StromError(34, f"CREATE TABLE AS: {label!r} "
                                      f"exceeds uint32")
-            cols.append(arr.astype(np.uint32))
-            dts.append("uint32")
+            add(label, arr.astype(np.uint32), "uint32", mask)
         else:
-            if len(arr) and (int(arr.min()) < -(1 << 31)
-                             or int(arr.max()) >= (1 << 31)):
+            live = arr if mask is None else arr[~mask]
+            if len(live) and (int(live.min()) < -(1 << 31)
+                              or int(live.max()) >= (1 << 31)):
                 raise StromError(34, f"CREATE TABLE AS: {label!r} "
                                      f"exceeds int32")
-            cols.append(arr.astype(np.int32))
-            dts.append("int32")
+            i32 = np.where(mask, 0, arr).astype(np.int32) \
+                if mask is not None else arr.astype(np.int32)
+            add(label, i32, "int32", mask)
     if not cols:
         raise StromError(22, "CREATE TABLE AS: the statement returned "
                              "no columns")
     dest_schema = _HS(n_cols=len(cols), visibility=False,
-                      dtypes=tuple(dts))
-    build_heap_file(dest_path, cols, dest_schema)
+                      dtypes=tuple(dts),
+                      nullable=tuple(nullable) if any(nullable)
+                      else None)
+    build_heap_file(dest_path, cols, dest_schema, nulls=nulls or None)
     for c, d in dict_cols.items():
         save_dict(dest_path, c, d)
     return dest_schema, n_rows

@@ -150,7 +150,11 @@ def main(argv=None) -> int:
                     help="number of data columns in the schema")
     ap.add_argument("--dtypes", default=None,
                     help="comma-separated per-column dtypes (int32/uint32/"
-                         "float32; default all int32)")
+                         "float32/int64/float64; default all int32)")
+    ap.add_argument("--nullable", default=None, metavar="C[,C...]",
+                    help="columns carrying a NULL validity bitmap "
+                         "(round 5; IS [NOT] NULL, NULL-aware "
+                         "COUNT/SUM/AVG)")
     ap.add_argument("--visibility", action="store_true",
                     help="schema carries a per-tuple visibility column")
     ap.add_argument("--where", default=None, metavar="EXPR",
@@ -280,8 +284,17 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     dtypes = tuple(args.dtypes.split(",")) if args.dtypes else None
+    nullable = None
+    if args.nullable:
+        try:
+            nn = {int(c) for c in args.nullable.split(",")}
+        except ValueError:
+            ap.error("--nullable takes column indices: C[,C...]")
+        if any(not 0 <= c < args.cols for c in nn):
+            ap.error("--nullable column out of range")
+        nullable = tuple(c in nn for c in range(args.cols))
     schema = HeapSchema(n_cols=args.cols, visibility=args.visibility,
-                        dtypes=dtypes)
+                        dtypes=dtypes, nullable=nullable)
     agg_cols = [int(c) for c in args.agg_cols.split(",")] \
         if args.agg_cols else None
 

@@ -6,16 +6,22 @@ interval mode printing per-stage **average latencies** with adaptive units
 in-flight DMA; ``-v`` adds the request-build/submit stages and the four
 debug counters (`:116-166`).
 
-The counter source is the JSON snapshot exported by running tools/sessions
-(``stats.start_export()``), standing in for the reference's /proc reads.
+The counter source is the JSON snapshot exported by running
+tools/sessions.  Since round 5 every Session exports to a per-pid file
+under ``/dev/shm`` by DEFAULT (zero cooperation — an unmodified workload
+is monitorable, like nvme_stat reading the kernel's counters from any
+terminal, `utils/nvme_stat.c:168-175`): ``-l`` lists live sessions,
+``-p PID`` attaches to one, and with NO file/pid a single live session
+is picked up automatically.
 
-Usage: tpu_stat [-v] [--json] [-f STAT_FILE] [interval]
+Usage: tpu_stat [-v] [--json] [-l] [-p PID] [-f STAT_FILE] [interval]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -80,25 +86,98 @@ def _header(verbose: bool) -> str:
     return " ".join(cols)
 
 
+def _list_sessions() -> int:
+    """`tpu_stat -l`: every per-pid export under the shared dir, with
+    liveness, snapshot age, and headline counters."""
+    from ..stats import list_exports
+    rows = list_exports()
+    if not rows:
+        print("no exporting sessions found", file=sys.stderr)
+        return 1
+    print("   pid  state  age     reqs        bytes  file")
+    for pid, path, alive in rows:
+        snap = _read(path)
+        if snap is None:
+            print(f"{pid:>6}  unreadable {path}")
+            continue
+        try:
+            # snapshot timestamps are CLOCK_MONOTONIC (epoch-free by
+            # design); the publish file's mtime carries the wall age
+            age = max(0.0, time.time() - os.stat(path).st_mtime)
+        except OSError:
+            age = 0.0
+        c = snap.get("counters", {})
+        state = "live " if alive else "stale"
+        print(f"{pid:>6}  {state}  {age:5.1f}s {c.get('nr_submit_dma', 0):>6} "
+              f"{c.get('total_dma_length', 0):>12}  {path}")
+        if not alive:
+            # stale files survive a SIGKILL; prune them as we report
+            # (the reference's counters vanish with the module the same
+            # way) — best-effort, another tpu_stat may race the unlink
+            try:
+                os.unlink(path)
+                print(f"{'':6}  (pruned)")
+            except OSError:
+                pass
+    return 0
+
+
 def main(argv=None) -> int:
-    from ..stats import DEFAULT_STAT_EXPORT
+    from ..stats import DEFAULT_STAT_EXPORT, list_exports, pid_export_path
     ap = argparse.ArgumentParser(prog="tpu_stat", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("interval", nargs="?", type=float, default=None)
     ap.add_argument("-v", "--verbose", action="store_true")
-    ap.add_argument("-f", "--file", default=DEFAULT_STAT_EXPORT,
+    ap.add_argument("-f", "--file", default=None,
                     help="stat export file to watch")
+    ap.add_argument("-l", "--list", action="store_true",
+                    help="list exporting sessions (per-pid files), "
+                         "pruning stale ones")
+    ap.add_argument("-p", "--pid", type=int, default=None,
+                    help="attach to a session by pid (its per-pid "
+                         "export file)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="one-shot machine-readable snapshot (counters + "
                          "per-member breakdown) for scripts/monitoring")
     args = ap.parse_args(argv)
     if args.as_json and args.interval is not None:
         ap.error("--json is one-shot; drop the interval")
+    if args.list:
+        if args.file or args.pid or args.interval is not None:
+            ap.error("-l lists sessions; drop the other selectors")
+        return _list_sessions()
+    if args.file and args.pid is not None:
+        ap.error("-f and -p are exclusive selectors")
+    if args.pid is not None:
+        args.file = pid_export_path(args.pid)
+    elif args.file is None:
+        # no selector: the legacy well-known file WHEN FRESH (a tool is
+        # actively exporting there), else a SOLE live per-pid session
+        # (the zero-cooperation default) — a stale legacy file from a
+        # long-dead tool must not shadow a live workload
+        args.file = DEFAULT_STAT_EXPORT
+        fresh = False
+        try:
+            fresh = (time.time() - os.stat(args.file).st_mtime) < 5.0
+        except OSError:
+            pass
+        if not fresh or _read(args.file) is None:
+            live = [(p, f) for p, f, alive in list_exports() if alive]
+            if len(live) == 1:
+                args.file = live[0][1]
+                print(f"watching pid {live[0][0]} ({args.file})",
+                      file=sys.stderr)
+            elif live:
+                print("several live sessions — pick one:",
+                      file=sys.stderr)
+                _list_sessions()
+                return 1
 
     snap = _read(args.file)
     if snap is None:
         print(f"no stats at {args.file} — is a tool/session running with "
-              f"stats export on?", file=sys.stderr)
+              f"stats export on? (`tpu_stat -l` lists sessions)",
+              file=sys.stderr)
         return 1
 
     if args.as_json:

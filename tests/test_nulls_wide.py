@@ -1,0 +1,404 @@
+"""NULLs and 8-byte types (round 5): validity bitmaps in the page
+layout, int64/float64 columns, NULL-aware aggregate semantics, IS [NOT]
+NULL, and LEFT-join NULLs materializing as real NULLs in CTAS output.
+
+Reference parity: the reference scans real PG heap pages where every
+tuple can carry nulls and 8-byte types, preserved through the tuple
+walk (`pgsql/nvme_strom.c:767-811,941-979`).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.api import StromError
+from nvme_strom_tpu.scan.heap import (HeapSchema, build_heap_file,
+                                      build_pages, read_column,
+                                      read_nulls, validate_heap_header)
+from nvme_strom_tpu.scan.query import Query
+from nvme_strom_tpu.scan.sql import create_table_as, sql_query
+
+
+@pytest.fixture()
+def x64():
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)
+
+
+@pytest.fixture(scope="module")
+def ntable(tmp_path_factory):
+    d = tmp_path_factory.mktemp("nulls")
+    rng = np.random.default_rng(4)
+    n = 20_000
+    c0 = rng.integers(0, 100, n).astype(np.int32)
+    c1 = rng.integers(-50, 50, n).astype(np.int32)
+    c2 = rng.normal(size=n).astype(np.float32)
+    n1 = rng.random(n) < 0.25
+    n2 = rng.random(n) < 0.1
+    schema = HeapSchema(n_cols=3, dtypes=("int32", "int32", "float32"),
+                        nullable=(False, True, True))
+    path = str(d / "t.heap")
+    build_heap_file(path, [c0, c1, c2], schema, nulls={1: n1, 2: n2})
+    return path, schema, c0, c1, c2, n1, n2
+
+
+# ---------------------------------------------------------------------------
+# page format
+# ---------------------------------------------------------------------------
+
+def test_heap_layout_back_compat():
+    """All-4-byte schemas keep the round-1 tuples-per-page formula, so
+    every existing heap file decodes unchanged."""
+    for nc, vis in [(1, False), (2, True), (4, False), (7, True)]:
+        s = HeapSchema(n_cols=nc, visibility=vis)
+        assert s.tuples_per_page == \
+            (8192 - 64) // (4 * (nc + (1 if vis else 0)))
+
+
+def test_heap_roundtrip_wide_and_nullable():
+    rng = np.random.default_rng(0)
+    n = 5000
+    schema = HeapSchema(n_cols=4, visibility=True,
+                        dtypes=("int64", "int32", "float64", "float32"),
+                        nullable=(True, True, False, False))
+    c0 = rng.integers(-(1 << 60), 1 << 60, n).astype(np.int64)
+    c1 = rng.integers(-100, 100, n).astype(np.int32)
+    c2 = rng.normal(size=n).astype(np.float64)
+    c3 = rng.normal(size=n).astype(np.float32)
+    n0 = rng.random(n) < 0.3
+    n1 = rng.random(n) < 0.1
+    pages = build_pages([c0, c1, c2, c3], schema, nulls={0: n0, 1: n1})
+    assert (read_column(pages, schema, 0) == np.where(n0, 0, c0)).all()
+    assert (read_column(pages, schema, 1) == np.where(n1, 0, c1)).all()
+    assert (read_column(pages, schema, 2) == c2).all()
+    assert (read_column(pages, schema, 3) == c3).all()
+    assert (read_nulls(pages, schema, 0) == n0).all()
+    assert (read_nulls(pages, schema, 1) == n1).all()
+
+
+def test_heap_header_carries_wide_and_null_masks(tmp_path):
+    schema = HeapSchema(n_cols=2, dtypes=("int64", "int32"),
+                        nullable=(False, True))
+    p = str(tmp_path / "w.heap")
+    build_heap_file(p, [np.zeros(10, np.int64), np.ones(10, np.int32)],
+                    schema)
+    validate_heap_header(p, schema)
+    with pytest.raises(ValueError):
+        validate_heap_header(p, HeapSchema(n_cols=2))
+
+
+def test_xla_decode_matches_host_oracle(ntable):
+    from nvme_strom_tpu.ops.filter_xla import decode_pages
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    raw = np.fromfile(path, np.uint8).reshape(-1, 8192)
+
+    @jax.jit
+    def dec(p):
+        cols, valid = decode_pages(p, schema)
+        # Cols is kernel-internal (not a pytree); return plain leaves
+        return list(cols), cols.nulls, valid
+
+    cols, nulls, valid = dec(raw)
+    v = np.asarray(valid).reshape(-1)
+    got1 = np.asarray(cols[1]).reshape(-1)[v]
+    assert (got1 == np.where(n1, 0, c1)).all()
+    assert (np.asarray(nulls[1]).reshape(-1)[v] == n1).all()
+    assert (np.asarray(nulls[2]).reshape(-1)[v] == n2).all()
+
+
+# ---------------------------------------------------------------------------
+# SQL semantics
+# ---------------------------------------------------------------------------
+
+def test_is_null_and_not_null(ntable):
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    r = sql_query("SELECT COUNT(*) AS k FROM t WHERE c1 IS NULL",
+                  path, schema)
+    assert r["k"] == int(n1.sum())
+    r = sql_query("SELECT COUNT(*) AS k FROM t "
+                  "WHERE c1 IS NOT NULL AND c0 < 50", path, schema)
+    assert r["k"] == int((~n1 & (c0 < 50)).sum())
+    # IS NULL on a non-nullable column constant-folds to false/true
+    r = sql_query("SELECT COUNT(*) AS k FROM t WHERE c0 IS NULL",
+                  path, schema)
+    assert r["k"] == 0
+    r = sql_query("SELECT COUNT(*) AS k FROM t WHERE c0 IS NOT NULL",
+                  path, schema)
+    assert r["k"] == len(c0)
+
+
+def test_null_aware_scalar_aggregates(ntable):
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    r = sql_query("SELECT COUNT(*) AS n, COUNT(c1) AS nn, "
+                  "SUM(c1) AS s, AVG(c1) AS a FROM t", path, schema)
+    assert r["n"] == len(c1)
+    assert r["nn"] == int((~n1).sum())
+    assert r["s"] == int(c1[~n1].sum())
+    assert r["a"] == pytest.approx(c1[~n1].mean())
+
+
+def test_comparisons_exclude_null_rows(ntable):
+    """The stored word under NULL is 0 — a bare `c1 = 0` must not
+    select NULL rows (SQL three-valued logic)."""
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    r = sql_query("SELECT COUNT(*) AS k FROM t WHERE c1 = 0",
+                  path, schema)
+    assert r["k"] == int(((c1 == 0) & ~n1).sum())
+    r = sql_query("SELECT COUNT(*) AS k FROM t WHERE c1 > c0 - 60",
+                  path, schema)
+    assert r["k"] == int(((c1 > c0 - 60) & ~n1).sum())
+    # the structured Query face agrees
+    out = Query(path, schema).where_eq(1, 0).aggregate().run()
+    assert int(out["count"]) == int(((c1 == 0) & ~n1).sum())
+    out = Query(path, schema).where_range(1, None, 5).aggregate().run()
+    assert int(out["count"]) == int(((c1 <= 5) & ~n1).sum())
+
+
+def test_null_aware_group_by(ntable):
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    r = sql_query("SELECT c0, SUM(c1) AS s, MIN(c1) AS mn, "
+                  "MAX(c1) AS mx FROM t WHERE c0 < 5 GROUP BY c0",
+                  path, schema)
+    for i, k in enumerate(np.asarray(r["c0"])):
+        m = (c0 == k) & ~n1
+        assert r["s"][i] == c1[m].sum()
+        assert r["mn"][i] == c1[m].min()
+        assert r["mx"][i] == c1[m].max()
+
+
+def test_projection_returns_real_none(ntable):
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    r = sql_query("SELECT c1 FROM t WHERE c0 = 7 LIMIT 30",
+                  path, schema)
+    for v, p in zip(r["c1"], r["positions"]):
+        assert (v is None) == bool(n1[p])
+        if v is not None:
+            assert v == c1[p]
+
+
+def test_workers_see_nullable_schema(ntable):
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    r = sql_query("SELECT COUNT(c1) AS nn, SUM(c1) AS s FROM t",
+                  path, schema, workers=2)
+    assert r["nn"] == int((~n1).sum())
+    assert r["s"] == int(c1[~n1].sum())
+
+
+# ---------------------------------------------------------------------------
+# CTAS: real NULLs out
+# ---------------------------------------------------------------------------
+
+def test_ctas_nullable_roundtrip(ntable, tmp_path):
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    dest = str(tmp_path / "d.heap")
+    dsch, _n = create_table_as(dest, "SELECT c0, c1 FROM t WHERE c0 = 7",
+                               path, schema)
+    assert dsch.nullable == (False, True)
+    r = sql_query("SELECT COUNT(*) AS n, COUNT(c1) AS nn FROM t",
+                  dest, dsch)
+    m = c0 == 7
+    assert r["n"] == int(m.sum())
+    assert r["nn"] == int((m & ~n1).sum())
+
+
+def test_ctas_left_join_real_nulls(ntable, tmp_path):
+    """The round-4 VERDICT gap: LEFT-join NULLs become REAL NULLs in
+    CTAS output, not an int32 indicator column."""
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    dk = np.arange(0, 50, dtype=np.int32)
+    dv = (dk * 2).astype(np.int32)
+    dim = str(tmp_path / "dim.heap")
+    ds = HeapSchema(n_cols=2)
+    build_heap_file(dim, [dk, dv], ds)
+    dest = str(tmp_path / "lj.heap")
+    dsch, n = create_table_as(
+        dest, "SELECT c0, dd.c1 FROM t LEFT JOIN dd ON c0 = dd.c0 "
+              "LIMIT 400", path, schema, tables={"dd": (dim, ds)})
+    # two columns only — the indicator became the NULL mask
+    assert dsch.n_cols == 2 and dsch.nullable == (False, True)
+    r = sql_query("SELECT c0, c1 FROM t", dest, dsch)
+    for k, pay in zip(r["c0"], r["c1"]):
+        if k < 50:
+            assert pay == 2 * k
+        else:
+            assert pay is None
+
+
+def test_ctas_null_scalar_still_refused(ntable, tmp_path):
+    path, schema, *_ = ntable
+    with pytest.raises(StromError) as ei:
+        create_table_as(str(tmp_path / "x.heap"),
+                        "SELECT MAX(c0) FROM t WHERE c0 > 1000",
+                        path, schema)
+    assert ei.value.errno == 22
+
+
+# ---------------------------------------------------------------------------
+# 8-byte types
+# ---------------------------------------------------------------------------
+
+def test_int64_float64_scan(x64, tmp_path):
+    rng = np.random.default_rng(9)
+    n = 10_000
+    c0 = rng.integers(0, 50, n).astype(np.int32)
+    w = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+    f = rng.normal(size=n).astype(np.float64)
+    ws = HeapSchema(n_cols=3, dtypes=("int32", "int64", "float64"))
+    wp = str(tmp_path / "w.heap")
+    build_heap_file(wp, [c0, w, f], ws)
+    r = sql_query("SELECT COUNT(*) AS n, SUM(c1) AS s, SUM(c2) AS g "
+                  "FROM t WHERE c0 < 40", wp, ws)
+    m = c0 < 40
+    assert r["n"] == int(m.sum())
+    assert r["s"] == int(w[m].sum())
+    assert abs(int(r["s"])) > (1 << 31)    # 64 bits genuinely needed
+    assert r["g"] == pytest.approx(float(f[m].sum()), rel=1e-12)
+    # filters compare at full width
+    big = int(1) << 40
+    r = sql_query(f"SELECT COUNT(*) AS k FROM t WHERE c1 > {big // 2}",
+                  wp, ws)
+    assert r["k"] == int((w > big // 2).sum())
+
+
+def test_int64_group_by_aggregation(x64, tmp_path):
+    rng = np.random.default_rng(10)
+    n = 8_000
+    k = rng.integers(0, 6, n).astype(np.int32)
+    w = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+    ws = HeapSchema(n_cols=2, dtypes=("int32", "int64"))
+    wp = str(tmp_path / "g.heap")
+    build_heap_file(wp, [k, w], ws)
+    r = sql_query("SELECT c0, SUM(c1) AS s, MIN(c1) AS mn FROM t "
+                  "GROUP BY c0", wp, ws)
+    for i, kk in enumerate(np.asarray(r["c0"])):
+        assert r["s"][i] == w[k == kk].sum()
+        assert r["mn"][i] == w[k == kk].min()
+
+
+def test_wide_without_x64_clean_refusal(tmp_path):
+    ws = HeapSchema(n_cols=1, dtypes=("int64",))
+    wp = str(tmp_path / "w.heap")
+    build_heap_file(wp, [np.arange(10, dtype=np.int64)], ws)
+    with pytest.raises(StromError) as ei:
+        sql_query("SELECT COUNT(*) FROM t", wp, ws)
+    assert "x64" in str(ei.value)
+
+
+def test_subset_refusals(ntable, x64, tmp_path):
+    path, schema, *_ = ntable
+    from nvme_strom_tpu.scan.index import build_index
+    # ORDER BY / top_k / group keys / index over nullable
+    with pytest.raises(StromError):
+        Query(path, schema).order_by(1).run()
+    with pytest.raises(StromError):
+        Query(path, schema).top_k(1, 3)
+    with pytest.raises(StromError):
+        Query(path, schema).group_by_cols(1)
+    with pytest.raises(StromError):
+        build_index(path, schema, 1)
+    # 8-byte sort/index refusals
+    ws = HeapSchema(n_cols=1, dtypes=("int64",))
+    wp = str(tmp_path / "w8.heap")
+    build_heap_file(wp, [np.arange(10, dtype=np.int64)], ws)
+    with pytest.raises(StromError):
+        Query(wp, ws).top_k(0, 1)
+    with pytest.raises(StromError):
+        build_index(wp, ws, 0)
+
+
+# ---------------------------------------------------------------------------
+# access-path agreement (round-5 review findings: the sidecar path must
+# answer NULL queries identically to the seqscan)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def indexed_nullable(tmp_path):
+    from nvme_strom_tpu.config import config
+    config.set("debug_no_threshold", True)
+    rng = np.random.default_rng(4)
+    n = 30_000
+    c0 = rng.integers(0, 50, n).astype(np.int32)
+    c1 = rng.integers(0, 50, n).astype(np.int32)
+    c2 = rng.integers(10, 60, n).astype(np.int32)
+    n1 = rng.random(n) < 0.5
+    schema = HeapSchema(n_cols=3, nullable=(False, True, False))
+    path = str(tmp_path / "t.heap")
+    build_heap_file(path, [c0, c1, c2], schema, nulls={1: n1})
+    return path, schema, c0, c1, c2, n1
+
+
+def _both_paths(stmt, path, schema):
+    from nvme_strom_tpu.scan.index import build_index
+    try:
+        os.unlink(path + ".idx0")
+    except OSError:
+        pass
+    seq = sql_query(stmt, path, schema)
+    build_index(path, schema, 0)
+    idx = sql_query(stmt, path, schema)
+    os.unlink(path + ".idx0")
+    return seq, idx
+
+
+def test_index_residual_respects_nulls(indexed_nullable):
+    path, schema, c0, c1, c2, n1 = indexed_nullable
+    seq, idx = _both_paths(
+        "SELECT COUNT(*) AS k FROM t WHERE c0 = 5 AND c1 < 10",
+        path, schema)
+    want = int(((c0 == 5) & (c1 < 10) & ~n1).sum())
+    assert seq["k"] == idx["k"] == want
+    seq, idx = _both_paths(
+        "SELECT COUNT(*) AS k FROM t WHERE c0 = 5 AND c1 IS NULL",
+        path, schema)
+    want = int(((c0 == 5) & n1).sum())
+    assert seq["k"] == idx["k"] == want
+
+
+def test_index_expr_aggregate_falls_to_scan(indexed_nullable):
+    path, schema, c0, c1, c2, n1 = indexed_nullable
+    seq, idx = _both_paths("SELECT SUM(c2 * 2) AS s FROM t WHERE c0 = 5",
+                           path, schema)
+    assert seq["s"] == idx["s"] == int((c2[c0 == 5] * 2).sum())
+
+
+def test_index_null_aware_count_avg(indexed_nullable):
+    path, schema, c0, c1, c2, n1 = indexed_nullable
+    seq, idx = _both_paths(
+        "SELECT COUNT(c1) AS nc, AVG(c1) AS a FROM t WHERE c0 = 5",
+        path, schema)
+    m = (c0 == 5) & ~n1
+    assert seq["nc"] == idx["nc"] == int(m.sum())
+    assert seq["a"] == pytest.approx(c1[m].mean())
+    assert idx["a"] == pytest.approx(c1[m].mean())
+
+
+def test_group_by_avg_uses_nonnull_denominator(indexed_nullable):
+    path, schema, c0, c1, c2, n1 = indexed_nullable
+    r = sql_query("SELECT c0, AVG(c1) AS a FROM t WHERE c0 < 5 "
+                  "GROUP BY c0", path, schema)
+    for i, k in enumerate(np.asarray(r["c0"])):
+        m = (c0 == k) & ~n1
+        assert r["a"][i] == pytest.approx(c1[m].mean())
+
+
+def test_expr_aggregate_over_nullable_refused(indexed_nullable):
+    path, schema, *_ = indexed_nullable
+    with pytest.raises(StromError) as ei:
+        sql_query("SELECT SUM(c1 - c0) AS s FROM t", path, schema)
+    assert "NULL propagation" in str(ei.value)
+
+
+def test_index_groupby_min_respects_nulls(indexed_nullable):
+    path, schema, c0, c1, c2, n1 = indexed_nullable
+    seq, idx = _both_paths(
+        "SELECT c2, MIN(c1) AS mn FROM t WHERE c0 = 5 GROUP BY c2",
+        path, schema)
+    np.testing.assert_array_equal(np.asarray(seq["mn"]),
+                                  np.asarray(idx["mn"]))
+    for i, k in enumerate(np.asarray(seq["c2"])[:10]):
+        m = (c0 == 5) & (c2 == k) & ~n1
+        if m.any():
+            assert seq["mn"][i] == c1[m].min()
