@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress test probe-loop clean
+.PHONY: all native tsan stress stress-faults test probe-loop clean
 
 all: native
 
@@ -10,6 +10,14 @@ tsan:
 
 stress:
 	$(MAKE) -C csrc stress
+
+# Randomized fault-plan stress on the loopback fake (fixed seed, so CI
+# failures reproduce): transient plans must heal byte-identically through
+# the retry/fallback ladder, persistent plans must latch within the task
+# deadline.  Override STROM_STRESS_SEED / STROM_STRESS_ROUNDS to widen.
+stress-faults:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.stress_faults
+	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q -m faults
 
 STRESS_FILE := /tmp/strom_stress_src.bin
 

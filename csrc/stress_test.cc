@@ -140,5 +140,51 @@ int main(int argc, char** argv) {
     return 1;
   }
   nstpu_engine_destroy(eng);
-  return failures.load() ? 1 : 0;
+  if (failures.load()) return 1;
+
+  // failover phase (PR 1): NSTPU_DISABLE_URING makes io_uring setup fail,
+  // so an AUTO engine must come up on the threadpool and still serve I/O —
+  // the graceful-degradation contract the Python engine's backend fallback
+  // relies on, exercised under the same sanitizer build
+  setenv("NSTPU_DISABLE_URING", "1", 1);
+  uint64_t feng = nstpu_engine_create2(NSTPU_BACKEND_AUTO, 32, 4);
+  unsetenv("NSTPU_DISABLE_URING");
+  if (!feng) {
+    fprintf(stderr, "FAIL: AUTO engine create with uring disabled\n");
+    return 1;
+  }
+  int fbackend = nstpu_engine_backend(feng);
+  if (fbackend != NSTPU_BACKEND_THREADPOOL) {
+    fprintf(stderr, "FAIL: expected threadpool failover, got backend=%d\n",
+            fbackend);
+    nstpu_engine_destroy(feng);
+    return 1;
+  }
+  {
+    int fd = open(path, O_RDONLY);
+    void* buf = mmap(nullptr, reqs_per_task * req_sz, PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    int frc = 0;
+    for (int i = 0; i < 4; i++) {
+      nstpu_req reqs[reqs_per_task];
+      for (int r = 0; r < reqs_per_task; r++) {
+        reqs[r].fd = fd;
+        reqs[r].flags = 0;
+        reqs[r].file_off = ((uint64_t)(i * reqs_per_task + r) % span) * req_sz;
+        reqs[r].len = req_sz;
+        reqs[r].dest_off = r * req_sz;
+      }
+      int64_t tid = nstpu_submit(feng, buf, reqs, reqs_per_task);
+      if (tid < 0 || nstpu_wait(feng, tid, 30000) != 0) frc = 1;
+    }
+    munmap(buf, reqs_per_task * req_sz);
+    close(fd);
+    nstpu_engine_destroy(feng);
+    if (frc) {
+      fprintf(stderr, "FAIL: threadpool failover engine I/O errored\n");
+      return 1;
+    }
+  }
+  printf("failover: AUTO with NSTPU_DISABLE_URING -> threadpool OK\n");
+  return 0;
 }

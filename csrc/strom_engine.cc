@@ -325,8 +325,16 @@ struct Engine {
     for (auto& row : member_ctr)
       for (auto& c : row) c.store(0);
     depth = queue_depth > 0 ? (unsigned)queue_depth : 32u;
-    if (want_backend == NSTPU_BACKEND_AUTO ||
-        want_backend == NSTPU_BACKEND_IO_URING) {
+    // NSTPU_DISABLE_URING=1 makes io_uring setup "fail" deterministically:
+    // AUTO falls over to the threadpool (the graceful-degradation path the
+    // stress test exercises), an explicit IO_URING request fails honestly
+    const char* no_uring = getenv("NSTPU_DISABLE_URING");
+    bool uring_disabled = no_uring && *no_uring && *no_uring != '0';
+    if (uring_disabled && want_backend == NSTPU_BACKEND_IO_URING)
+      return false;
+    if (!uring_disabled &&
+        (want_backend == NSTPU_BACKEND_AUTO ||
+         want_backend == NSTPU_BACKEND_IO_URING)) {
       unsigned nr = nrings_want ? nrings_want : want_rings();
       bool ok = true;
       for (unsigned i = 0; i < nr; i++) {

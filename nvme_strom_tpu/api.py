@@ -31,20 +31,65 @@ found (mostly) resident in the host page cache and took the write-back path.
 from __future__ import annotations
 
 import enum
+import errno as _errno
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
 __all__ = [
-    "StromError", "FsKind", "FileInfo", "BufferInfo", "DmaTaskState",
-    "MemCopyResult", "StatInfo", "STAT_FIELDS",
+    "StromError", "ErrorClass", "FsKind", "FileInfo", "BufferInfo",
+    "DmaTaskState", "MemCopyResult", "StatInfo", "STAT_FIELDS",
 ]
 
 
-class StromError(OSError):
-    """Engine error carrying an errno-style code (reference returns -errno)."""
+class ErrorClass(enum.Enum):
+    """Fault taxonomy for the I/O runtime.
 
-    def __init__(self, errno_: int, msg: str):
+    The reference latches a single raw errno per task (kmod/nvme_strom.c
+    first-error retention); here each error additionally carries a class
+    that drives the recovery policy: TRANSIENT errors are retried (and may
+    degrade to the buffered path), CORRUPTION triggers re-read then a
+    latched EBADMSG, TIMEOUT is latched by the task watchdog, PERSISTENT
+    fails fast with no retry.
+    """
+
+    TRANSIENT = "transient"
+    PERSISTENT = "persistent"
+    CORRUPTION = "corruption"
+    TIMEOUT = "timeout"
+
+
+# default errno -> class mapping; explicit error_class wins
+_TRANSIENT_ERRNOS = frozenset((
+    _errno.EIO, _errno.EAGAIN, _errno.EBUSY, _errno.EINTR, _errno.ENOMEM,
+))
+_CORRUPTION_ERRNOS = frozenset((_errno.EBADMSG, _errno.EILSEQ))
+
+
+def _classify_errno(errno_: int) -> ErrorClass:
+    if errno_ == _errno.ETIMEDOUT:
+        return ErrorClass.TIMEOUT
+    if errno_ in _CORRUPTION_ERRNOS:
+        return ErrorClass.CORRUPTION
+    if errno_ in _TRANSIENT_ERRNOS:
+        return ErrorClass.TRANSIENT
+    return ErrorClass.PERSISTENT
+
+
+class StromError(OSError):
+    """Engine error carrying an errno-style code (reference returns -errno)
+    plus a recovery class (:class:`ErrorClass`).  The class defaults from
+    the errno (EIO/EAGAIN/EBUSY/EINTR/ENOMEM transient, EBADMSG/EILSEQ
+    corruption, ETIMEDOUT timeout, everything else persistent) and can be
+    pinned explicitly by the raiser."""
+
+    def __init__(self, errno_: int, msg: str,
+                 error_class: Optional[ErrorClass] = None):
         super().__init__(errno_, msg)
+        self.error_class = error_class or _classify_errno(errno_)
+
+    @property
+    def transient(self) -> bool:
+        return self.error_class is ErrorClass.TRANSIENT
 
 
 class FsKind(enum.IntEnum):
@@ -166,6 +211,22 @@ STAT_FIELDS: Tuple[str, ...] = (
     # scan_dispatch_batch = K) this moves once per K batches/spans, so
     # nr_kernel_dispatch / batches ~ 1/K on coalesced paths
     "nr_kernel_dispatch",
+    # fault-tolerance layer (PR 1): retry/degradation accounting.  The
+    # reference has no retry tier (EIO fails the task outright); these
+    # count each recovery action so operators can see a degrading device
+    # before it turns into latched errors.
+    "nr_io_retry",            # direct-read attempts repeated after a
+    #                           transient error (per-chunk, per-attempt)
+    "nr_io_fallback",         # extents degraded to the buffered path
+    #                           after retries were exhausted
+    "nr_backend_fallback",    # native engine setup/submit failures that
+    #                           fell back to the threadpool/python path
+    "nr_task_timeout",        # DMA tasks latched ETIMEDOUT by the watchdog
+    "nr_chunk_cancelled",     # chunks skipped because their task already
+    #                           failed (watchdog/first-error cancellation)
+    "nr_csum_fail",           # page checksum mismatches observed
+    "nr_csum_reread",         # re-reads issued to heal a checksum mismatch
+    "nr_member_quarantine",   # member quarantine transitions (entries)
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

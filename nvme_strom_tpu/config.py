@@ -199,6 +199,44 @@ class Config:
                      "callback blocks until DMA drains, kmod/pmemmap.c:"
                      "149-208 — here the transport itself can die, so "
                      "the drain must be bounded)"))
+        # fault-tolerance layer (PR 1): retry / deadline / checksum knobs
+        reg(Var("io_retries", 3, "int", minval=0, maxval=64,
+                help="max re-attempts of a direct read after a TRANSIENT "
+                     "error before degrading to the buffered path "
+                     "(0 = fail on first error, reference behaviour)"))
+        reg(Var("retry_backoff_ms", 5.0, "float", minval=0.0,
+                help="exponential-backoff base delay between direct-read "
+                     "retries (doubles per attempt, jittered)"))
+        reg(Var("retry_backoff_max_ms", 1000.0, "float", minval=0.0,
+                help="backoff ceiling per retry sleep"))
+        reg(Var("retry_jitter", 0.5, "float", minval=0.0, maxval=1.0,
+                help="uniform jitter fraction applied to each backoff "
+                     "sleep (0.5 = delay drawn from [0.5d, 1.0d])"))
+        reg(Var("io_fallback", True, "bool",
+                help="degrade to the buffered read path for an extent "
+                     "after transient-retry exhaustion, and to the "
+                     "threadpool backend when io_uring setup/submit "
+                     "fails (off = latch the error instead)"))
+        reg(Var("task_deadline_s", 60.0, "float", minval=0.0,
+                help="per-DMA-task deadline: the watchdog latches "
+                     "ETIMEDOUT on tasks RUNNING past this and cancels "
+                     "their not-yet-started chunks, so memcpy_wait can "
+                     "never hang (0 = no deadline)"))
+        reg(Var("checksum_verify", False, "bool",
+                help="verify per-page crc32c (heap page header word 7) "
+                     "after chunks land; mismatches re-read then latch "
+                     "EBADMSG.  Checksummed loads ride the instrumented "
+                     "python I/O path"))
+        reg(Var("checksum_retries", 2, "int", minval=0, maxval=16,
+                help="re-reads attempted on a checksum mismatch before "
+                     "the task latches a CORRUPTION error"))
+        reg(Var("quarantine_after", 8, "int", minval=1, maxval=1 << 20,
+                help="consecutive direct-read failures on one stripe "
+                     "member before it is quarantined (reads route "
+                     "buffered until quarantine_s expires)"))
+        reg(Var("quarantine_s", 30.0, "float", minval=0.0,
+                help="seconds a quarantined member stays on the "
+                     "buffered path before the direct path is re-probed"))
         reg(Var("join_build_host_max", 256 << 20, "size", minval=1 << 12,
                 help="largest on-disk build-side table loaded whole "
                      "(one projection scan) when partitioning a join "

@@ -34,6 +34,7 @@ import ctypes.util
 import errno as _errno
 import mmap
 import os
+import random
 import struct
 import threading
 import time
@@ -43,6 +44,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from .api import BufferInfo, DmaTaskState, FileInfo, FsKind, MemCopyResult, StromError
 from .config import config
+from .fault import MemberHealth, RetryPolicy
 from .log import pr_info, pr_warn
 from .eligibility import probe_backing
 from .stats import stats
@@ -820,9 +822,9 @@ _N_TASK_SLOTS = 512  # reference uses 512 hash slots (kmod/nvme_strom.c:639-644)
 
 class DmaTask:
     __slots__ = ("task_id", "state", "errno_", "errmsg", "pending", "frozen",
-                 "result", "t_submit", "buf_handle")
+                 "result", "t_submit", "buf_handle", "deadline", "expired")
 
-    def __init__(self, task_id: int):
+    def __init__(self, task_id: int, deadline_s: float = 0.0):
         self.task_id = task_id
         self.state = DmaTaskState.RUNNING
         self.errno_ = 0
@@ -832,6 +834,11 @@ class DmaTask:
         self.result: Optional[MemCopyResult] = None
         self.t_submit = time.monotonic_ns()
         self.buf_handle: Optional[int] = None
+        # watchdog deadline (monotonic seconds; 0 = none) — overdue tasks
+        # are latched ETIMEDOUT so memcpy_wait can never hang (PR 1)
+        self.deadline = (time.monotonic() + deadline_s) if deadline_s > 0 \
+            else 0.0
+        self.expired = False   # set by the watchdog; chunks check and bail
 
 
 class Session:
@@ -873,30 +880,62 @@ class Session:
         # every request into the region skips per-request page pinning.
         self._fixed_regs: Dict[int, int] = {}
         self._fixed_lock = threading.Lock()
+        # fault-tolerance layer (PR 1): retry policy, per-member health,
+        # and the task watchdog
+        self._retry = RetryPolicy.from_config()
+        self._member_health = MemberHealth()
+        self._retry_rng = random.Random(os.getpid() ^ id(self))
+        self._watchdog_stop = threading.Event()
+        self._watchdog = threading.Thread(target=self._watchdog_loop,
+                                          daemon=True,
+                                          name="strom-task-watchdog")
+        self._watchdog.start()
         # native engine: the GIL-free executor for planned request batches
         self._native = None
         want = io_backend or config.get("io_backend")
+        fallback_ok = bool(config.get("io_fallback"))
         if want != "python":
             from . import _native as _nat
             if _nat.native_available():
+                # NSTPU_RINGS env keeps working as the experiment
+                # override; the config var is the durable setting.
+                # Malformed values fall back (the C side's atol was
+                # just as tolerant) — a typo must not kill Session().
                 try:
-                    # NSTPU_RINGS env keeps working as the experiment
-                    # override; the config var is the durable setting.
-                    # Malformed values fall back (the C side's atol was
-                    # just as tolerant) — a typo must not kill Session().
-                    try:
-                        rings = int(os.environ.get("NSTPU_RINGS", ""))
-                    except ValueError:
-                        rings = int(config.get("engine_rings"))
+                    rings = int(os.environ.get("NSTPU_RINGS", ""))
+                except ValueError:
+                    rings = int(config.get("engine_rings"))
+                try:
                     self._native = _nat.NativeEngine(
                         want if want in ("io_uring", "threadpool") else "auto",
                         config.get("queue_depth"), rings=rings)
-                except StromError:
-                    if want != "auto":
+                except StromError as e:
+                    # degrade one tier at a time: io_uring setup failure
+                    # falls back to the native threadpool, a dead native
+                    # engine falls back to the Python pool (io_fallback
+                    # gates both; explicit non-auto without fallback
+                    # keeps the old fail-fast contract)
+                    if want == "io_uring" and fallback_ok:
+                        stats.add("nr_backend_fallback")
+                        pr_warn("io_uring setup failed (%s); falling back "
+                                "to threadpool backend", e)
+                        try:
+                            self._native = _nat.NativeEngine(
+                                "threadpool", config.get("queue_depth"),
+                                rings=rings)
+                        except StromError:
+                            pass
+                    if self._native is None and want != "auto" \
+                            and not fallback_ok:
                         raise
             elif want != "auto":
-                raise StromError(_errno.ENOSYS,
-                                f"io_backend={want} requires the native engine")
+                if not fallback_ok:
+                    raise StromError(
+                        _errno.ENOSYS,
+                        f"io_backend={want} requires the native engine")
+                stats.add("nr_backend_fallback")
+                pr_warn("io_backend=%s unavailable (no native engine); "
+                        "falling back to python path", want)
         self.backend_name = (self._native.backend_name if self._native
                              else "python")
         pr_info("session open: backend=%s workers=%d",
@@ -1020,11 +1059,45 @@ class Session:
         with self._id_lock:
             tid = self._next_task
             self._next_task += 1
-        task = DmaTask(tid)
+        task = DmaTask(tid, deadline_s=float(config.get("task_deadline_s")))
         s = self._slot_of(tid)
         with self._slot_cv[s]:
             self._slots[s][tid] = task
         return task
+
+    def _watchdog_loop(self) -> None:
+        """Latch ETIMEDOUT on tasks RUNNING past their deadline (PR 1).
+
+        The reference can only hang forever when DMA never completes
+        (its wait is interruptible but the task stays RUNNING); here the
+        watchdog force-fails overdue tasks — waiters wake immediately,
+        not-yet-started chunks see the latched error and cancel, and
+        in-flight native waits abandon (``_await_native``)."""
+        while not self._watchdog_stop.wait(0.05):
+            now = time.monotonic()
+            expired: List[str] = []
+            for s, cv in enumerate(self._slot_cv):
+                with cv:
+                    for task in self._slots[s].values():
+                        if (task.state is not DmaTaskState.RUNNING
+                                or not task.deadline
+                                or now <= task.deadline):
+                            continue
+                        task.expired = True
+                        if task.errno_ == 0:
+                            task.errno_ = _errno.ETIMEDOUT
+                            task.errmsg = (
+                                f"dma task {task.task_id} exceeded its "
+                                f"{config.get('task_deadline_s')}s deadline "
+                                f"({task.pending} chunks outstanding)")
+                            stats.add("nr_task_timeout")
+                        # latch FAILED now (pending chunks drain later and
+                        # cannot flip it back: errno_ is already set)
+                        task.state = DmaTaskState.FAILED
+                        cv.notify_all()
+                        expired.append(task.errmsg)
+            for msg in expired:   # outside the locks: slow stderr must
+                pr_warn("watchdog: %s", msg)   # not stall completions
 
     def _task_get(self, task: DmaTask) -> None:
         s = self._slot_of(task.task_id)
@@ -1161,13 +1234,18 @@ class Session:
             # the native engine executes the batch GIL-free when the source
             # reads through plain fds (test fakes that override the read leg
             # take the Python path so injection still works)
+            # checksum-verified loads ride the instrumented python path
+            # (the verify+re-read ladder lives in _do_request)
             use_native = (self._native is not None and reqs
+                          and not config.get("checksum_verify")
                           and type(source).read_member_direct
                           is Source.read_member_direct)
+            pool_reqs = list(reqs) if not use_native else []
             if use_native:
                 fds = source.member_fds()
                 native_reqs = []
                 native_members = []
+                native_rs = []
                 for r in reqs:
                     if r.buffered or fds[r.member] < 0:
                         # misaligned tails: synchronous buffered copy, like
@@ -1186,30 +1264,43 @@ class Session:
                         native_reqs.append((fds[r.member], r.file_off,
                                             r.length, r.dest_off))
                         native_members.append(r.member)
+                        native_rs.append(r)
                 if native_reqs:
-                    self._members_used.update(native_members)
-                    addr = ctypes.addressof(ctypes.c_char.from_buffer(dest))
-                    nid = self._native.submit(addr, native_reqs,
-                                              members=native_members)
-                    self._task_get(task)
                     try:
-                        self._pool.submit(self._await_native, task, nid)
-                    except BaseException as e:
-                        self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
-                        raise
-            else:
-                for r in reqs:
-                    self._task_get(task)
-                    cur = stats.gauge_add("cur_dma_count", 1)
-                    stats.gauge_max("max_dma_count", cur)
-                    stats.count_clock("submit_dma", 0)
-                    stats.add("total_dma_length", r.length)
-                    try:
-                        self._pool.submit(self._do_request, task, source, r, dest)
-                    except BaseException as e:
-                        stats.gauge_add("cur_dma_count", -1)
-                        self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
-                        raise
+                        self._members_used.update(native_members)
+                        addr = ctypes.addressof(
+                            ctypes.c_char.from_buffer(dest))
+                        nid = self._native.submit(addr, native_reqs,
+                                                  members=native_members)
+                        self._task_get(task)
+                        try:
+                            self._pool.submit(self._await_native, task, nid)
+                        except BaseException as e:
+                            self._task_put(task, StromError(
+                                _errno.ESHUTDOWN, str(e)))
+                            raise
+                    except StromError as e:
+                        # native submit failure degrades to the Python
+                        # pool path for this batch instead of failing the
+                        # whole memcpy (tentpole degradation tier 3)
+                        if not config.get("io_fallback"):
+                            raise
+                        stats.add("nr_backend_fallback")
+                        pr_warn("native submit failed (%s); batch falls "
+                                "back to the python pool path", e)
+                        pool_reqs = native_rs
+            for r in pool_reqs:
+                self._task_get(task)
+                cur = stats.gauge_add("cur_dma_count", 1)
+                stats.gauge_max("max_dma_count", cur)
+                stats.count_clock("submit_dma", 0)
+                stats.add("total_dma_length", r.length)
+                try:
+                    self._pool.submit(self._do_request, task, source, r, dest)
+                except BaseException as e:
+                    stats.gauge_add("cur_dma_count", -1)
+                    self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
+                    raise
         except BaseException:
             self._task_put(task, StromError(_errno.ECANCELED, "submit aborted"))
             # reference waits out in-flight DMA on submit error (:1781-1784)
@@ -1270,10 +1361,12 @@ class Session:
             use_native = (self._native is not None and reqs
                           and type(sink).write_member_direct
                           is Source.write_member_direct)
+            pool_reqs = list(reqs) if not use_native else []
             if use_native:
                 fds = sink.member_fds()
                 native_reqs = []
                 native_members = []
+                native_rs = []
                 for r in reqs:
                     if r.buffered or fds[r.member] < 0:
                         # misaligned tails: synchronous buffered write,
@@ -1290,30 +1383,41 @@ class Session:
                         native_reqs.append((fds[r.member], r.file_off,
                                             r.length, r.dest_off))
                         native_members.append(r.member)
+                        native_rs.append(r)
                 if native_reqs:
-                    self._members_used.update(native_members)
-                    addr = ctypes.addressof(ctypes.c_char.from_buffer(src))
-                    nid = self._native.submit(addr, native_reqs, write=True,
-                                              members=native_members)
-                    self._task_get(task)
                     try:
-                        self._pool.submit(self._await_native, task, nid)
-                    except BaseException as e:
-                        self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
-                        raise
-            else:
-                for r in reqs:
-                    self._task_get(task)
-                    cur = stats.gauge_add("cur_dma_count", 1)
-                    stats.gauge_max("max_dma_count", cur)
-                    stats.count_clock("submit_dma", 0)
-                    stats.add("total_dma_length", r.length)
-                    try:
-                        self._pool.submit(self._do_write_request, task, sink, r, src)
-                    except BaseException as e:
-                        stats.gauge_add("cur_dma_count", -1)
-                        self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
-                        raise
+                        self._members_used.update(native_members)
+                        addr = ctypes.addressof(
+                            ctypes.c_char.from_buffer(src))
+                        nid = self._native.submit(addr, native_reqs,
+                                                  write=True,
+                                                  members=native_members)
+                        self._task_get(task)
+                        try:
+                            self._pool.submit(self._await_native, task, nid)
+                        except BaseException as e:
+                            self._task_put(task, StromError(
+                                _errno.ESHUTDOWN, str(e)))
+                            raise
+                    except StromError as e:
+                        if not config.get("io_fallback"):
+                            raise
+                        stats.add("nr_backend_fallback")
+                        pr_warn("native write submit failed (%s); batch "
+                                "falls back to the python pool path", e)
+                        pool_reqs = native_rs
+            for r in pool_reqs:
+                self._task_get(task)
+                cur = stats.gauge_add("cur_dma_count", 1)
+                stats.gauge_max("max_dma_count", cur)
+                stats.count_clock("submit_dma", 0)
+                stats.add("total_dma_length", r.length)
+                try:
+                    self._pool.submit(self._do_write_request, task, sink, r, src)
+                except BaseException as e:
+                    stats.gauge_add("cur_dma_count", -1)
+                    self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
+                    raise
         except BaseException:
             self._task_put(task, StromError(_errno.ECANCELED, "submit aborted"))
             try:
@@ -1336,18 +1440,41 @@ class Session:
 
     def _do_write_request(self, task: DmaTask, sink: Source,
                           r: Request, src: memoryview) -> None:
+        if task.errno_:
+            stats.add("nr_chunk_cancelled")
+            stats.gauge_add("cur_dma_count", -1)
+            self._task_put(task, None)
+            return
         err: Optional[StromError] = None
         t0 = time.monotonic_ns()
+        attempt = 0
         try:
             piece = src[r.dest_off:r.dest_off + r.length]
-            if r.buffered:
-                sink.write_member_buffered(r.member, r.file_off, piece)
-            else:
-                sink.write_member_direct(r.member, r.file_off, piece)
+            while True:
+                try:
+                    if r.buffered:
+                        sink.write_member_buffered(r.member, r.file_off,
+                                                   piece)
+                    else:
+                        sink.write_member_direct(r.member, r.file_off,
+                                                 piece)
+                    break
+                except (StromError, OSError) as e:
+                    se = e if isinstance(e, StromError) else \
+                        StromError(e.errno or _errno.EIO, str(e))
+                    # transient write errors retry under the same policy;
+                    # no buffered degradation (a half-direct half-buffered
+                    # write would need a sync to be durable)
+                    if not se.transient or r.buffered \
+                            or attempt >= self._retry.attempts \
+                            or task.errno_:
+                        raise se
+                    stats.add("nr_io_retry")
+                    stats.member_error(r.member, retried=True)
+                    self._retry.sleep(attempt, self._retry_rng)
+                    attempt += 1
         except StromError as e:
             err = e
-        except OSError as e:
-            err = StromError(e.errno or _errno.EIO, str(e))
         except BaseException as e:
             err = StromError(_errno.EIO, f"unexpected write failure: {e!r}")
         finally:
@@ -1357,15 +1484,22 @@ class Session:
 
     def _do_request(self, task: DmaTask, source: Source,
                     r: Request, dest: memoryview) -> None:
+        if task.errno_:
+            # task already failed (first-error latch or watchdog expiry):
+            # cancel this chunk instead of reading into a buffer whose
+            # waiter has already been woken with an error
+            stats.add("nr_chunk_cancelled")
+            stats.gauge_add("cur_dma_count", -1)
+            self._task_put(task, None)
+            return
         err: Optional[StromError] = None
         t0 = time.monotonic_ns()
         try:
+            piece = dest[r.dest_off:r.dest_off + r.length]
             if r.buffered:
-                source.read_member_buffered(r.member, r.file_off,
-                                            dest[r.dest_off:r.dest_off + r.length])
+                source.read_member_buffered(r.member, r.file_off, piece)
             else:
-                source.read_member_direct(r.member, r.file_off,
-                                          dest[r.dest_off:r.dest_off + r.length])
+                self._read_direct_resilient(task, source, r, piece)
         except StromError as e:
             err = e
         except OSError as e:
@@ -1376,6 +1510,82 @@ class Session:
             stats.member_add(r.member, r.length, time.monotonic_ns() - t0)
             stats.gauge_add("cur_dma_count", -1)
             self._task_put(task, err)
+
+    def _read_direct_resilient(self, task: DmaTask, source: Source,
+                               r: Request, piece: memoryview) -> None:
+        """One direct-read extent with the full recovery ladder (PR 1):
+        quarantined members go straight to the buffered path; TRANSIENT
+        errors retry under the RetryPolicy (backoff + jitter), then the
+        extent degrades to a buffered read; PERSISTENT errors fail fast;
+        optional crc32c verification re-reads on mismatch and latches a
+        CORRUPTION error after ``checksum_retries`` failed heals."""
+        fallback_ok = bool(config.get("io_fallback"))
+        if fallback_ok and self._member_health.quarantined(r.member):
+            stats.add("nr_io_fallback")
+            source.read_member_buffered(r.member, r.file_off, piece)
+            return
+        attempt = 0
+        while True:
+            try:
+                source.read_member_direct(r.member, r.file_off, piece)
+                self._member_health.record_success(r.member)
+                break
+            except (StromError, OSError) as e:
+                se = e if isinstance(e, StromError) else \
+                    StromError(e.errno or _errno.EIO, str(e))
+                if not se.transient:
+                    raise se
+                self._member_health.record_failure(r.member)
+                # stop burning attempts once the task already failed or
+                # expired — the result can no longer be delivered
+                if attempt < self._retry.attempts and not task.errno_:
+                    stats.add("nr_io_retry")
+                    stats.member_error(r.member, retried=True)
+                    self._retry.sleep(attempt, self._retry_rng)
+                    attempt += 1
+                    continue
+                stats.member_error(r.member)
+                if fallback_ok and not task.errno_:
+                    # retries exhausted: degrade this extent to the
+                    # buffered path (the reference's page-cache
+                    # arbitration, reused as an error path)
+                    stats.add("nr_io_fallback")
+                    source.read_member_buffered(r.member, r.file_off,
+                                                piece)
+                    break
+                raise se
+        if config.get("checksum_verify"):
+            self._verify_chunk_checksums(source, r, piece)
+
+    def _verify_chunk_checksums(self, source: Source, r: Request,
+                                piece: memoryview) -> None:
+        """Post-landing crc32c verification for one extent: pages that
+        carry a checksum (heap header word 7) are recomputed; mismatches
+        are re-read up to ``checksum_retries`` times, then latch EBADMSG
+        (CORRUPTION).  File offsets must be page-aligned for pages to be
+        addressable — misaligned extents are skipped (they are buffered
+        legs anyway)."""
+        from .scan.heap import PAGE_SIZE, verify_page_checksums
+        if r.file_off % PAGE_SIZE:
+            return
+        bad = verify_page_checksums(piece)
+        rereads = int(config.get("checksum_retries"))
+        while bad:
+            stats.add("nr_csum_fail", len(bad))
+            if rereads <= 0:
+                first = r.file_off + bad[0] * PAGE_SIZE
+                raise StromError(
+                    _errno.EBADMSG,
+                    f"page checksum mismatch at file offset {first} "
+                    f"({len(bad)} bad page(s), re-reads exhausted)")
+            rereads -= 1
+            stats.add("nr_csum_reread", len(bad))
+            for p in bad:
+                off = p * PAGE_SIZE
+                source.read_member_direct(
+                    r.member, r.file_off + off,
+                    piece[off:off + PAGE_SIZE])
+            bad = verify_page_checksums(piece)
 
     def _await_native(self, task: DmaTask, native_id: int) -> None:
         err: Optional[StromError] = None
@@ -1391,6 +1601,11 @@ class Session:
                         # stuck fd (the reference's release path is bounded)
                         err = StromError(_errno.ETIMEDOUT,
                                         "native I/O abandoned at session close")
+                        break
+                    if task.expired:
+                        # watchdog latched ETIMEDOUT already (waiters are
+                        # awake); stop pinning a pool thread on the stuck
+                        # batch — err stays None so the latch is untouched
                         break
                     continue
                 err = e
@@ -1457,6 +1672,8 @@ class Session:
                         reaped.append(tid)
                     del self._slots[s][tid]
         self._abandon_native = True  # bound pool shutdown on stuck native I/O
+        self._watchdog_stop.set()
+        self._watchdog.join(timeout=2.0)
         self._pool.shutdown(wait=True)
         # detach close hooks from long-lived (pool) buffers so a closed
         # session is not pinned in their callback lists; the engine close

@@ -1,0 +1,121 @@
+"""Fault-tolerance policy for the I/O runtime (PR 1).
+
+The reference retains a task's first error until the caller reaps it
+(kmod/nvme_strom.c first-error latch) but has no recovery tier: any EIO
+fails the whole memcpy.  Production SSD fleets see transient medium
+errors, congested members and torn reads; this module supplies the policy
+half of the recovery stack:
+
+* :class:`RetryPolicy` — bounded attempts with exponential backoff +
+  jitter, built from the ``io_retries`` / ``retry_backoff_ms`` /
+  ``retry_backoff_max_ms`` / ``retry_jitter`` config vars.
+* :class:`MemberHealth` — per-stripe-member consecutive-failure counters
+  feeding a quarantine decision (``quarantine_after`` failures route the
+  member's reads to the buffered path for ``quarantine_s`` seconds), the
+  error-side analog of the reference's per-disk part_stat accounting.
+
+The mechanism half (where retries and fallbacks actually happen) lives in
+``engine.Session._do_request``; corruption re-reads in ``hbm.staging``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+from .config import config
+from .stats import stats
+
+__all__ = ["RetryPolicy", "MemberHealth"]
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry schedule for TRANSIENT I/O errors.
+
+    ``attempts`` is the number of *re*-tries after the first failure; the
+    backoff before retry ``i`` (0-based) is ``base * 2**i`` clamped to
+    ``ceiling``, scaled by a uniform jitter in ``[1 - jitter, 1]`` so a
+    striped set's members don't retry in lockstep.
+    """
+
+    attempts: int = 3
+    backoff_s: float = 0.005
+    backoff_max_s: float = 1.0
+    jitter: float = 0.5
+
+    @classmethod
+    def from_config(cls) -> "RetryPolicy":
+        return cls(attempts=int(config.get("io_retries")),
+                   backoff_s=float(config.get("retry_backoff_ms")) / 1e3,
+                   backoff_max_s=float(config.get("retry_backoff_max_ms")) / 1e3,
+                   jitter=float(config.get("retry_jitter")))
+
+    def delay(self, attempt: int, rng: random.Random = None) -> float:
+        """Sleep before retry number ``attempt`` (0-based)."""
+        d = min(self.backoff_s * (2 ** attempt), self.backoff_max_s)
+        if d <= 0:
+            return 0.0
+        scale = 1.0 - (rng or random).uniform(0.0, self.jitter)
+        return d * scale
+
+    def sleep(self, attempt: int, rng: random.Random = None) -> None:
+        d = self.delay(attempt, rng)
+        if d > 0:
+            time.sleep(d)
+
+
+class MemberHealth:
+    """Per-member consecutive-failure tracking with timed quarantine.
+
+    A member accumulating ``quarantine_after`` consecutive direct-read
+    failures is quarantined: :meth:`quarantined` returns True for
+    ``quarantine_s`` seconds and the engine routes that member's extents
+    straight to the buffered path (no direct attempts, no retry storms
+    against a dying disk).  Any direct-read success resets the streak and
+    lifts an active quarantine early.  Transitions and counters surface
+    through ``stats.member_snapshot()`` / ``tpu_stat -v``.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._streak: dict = {}      # member -> consecutive failures
+        self._until: dict = {}       # member -> quarantine expiry (monotonic)
+
+    def record_failure(self, member: int) -> bool:
+        """Account one failure; returns True if this pushed the member
+        into quarantine."""
+        threshold = int(config.get("quarantine_after"))
+        hold = float(config.get("quarantine_s"))
+        with self._lock:
+            n = self._streak.get(member, 0) + 1
+            self._streak[member] = n
+            if n >= threshold and hold > 0 \
+                    and member not in self._until:
+                self._until[member] = time.monotonic() + hold
+                stats.member_quarantine(member, True)
+                return True
+        return False
+
+    def record_success(self, member: int) -> None:
+        with self._lock:
+            self._streak[member] = 0
+            if self._until.pop(member, None) is not None:
+                stats.member_quarantine(member, False)
+
+    def quarantined(self, member: int) -> bool:
+        with self._lock:
+            until = self._until.get(member)
+            if until is None:
+                return False
+            if time.monotonic() >= until:
+                # expiry: allow a direct re-probe; streak keeps history
+                # so one more failure re-enters immediately
+                del self._until[member]
+                self._streak[member] = \
+                    max(0, int(config.get("quarantine_after")) - 1)
+                stats.member_quarantine(member, False)
+                return False
+            return True

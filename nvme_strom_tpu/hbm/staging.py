@@ -22,6 +22,7 @@ no reallocation.
 
 from __future__ import annotations
 
+import errno as _errno
 import time
 from functools import partial
 from typing import List, Optional, Sequence
@@ -310,13 +311,20 @@ class StagingPipeline:
                 nonlocal nr_ssd, nr_ram
                 bufidx, task_id, batch, elem_start, nbytes = slot
                 res = self.session.memcpy_wait(task_id)
+                _, dbuf = self._bufs[bufidx]
+                # last line of defense before bytes become device state:
+                # verify page checksums in the staging ring itself, so the
+                # write-back (page-cache) tier is covered too, not just the
+                # direct reads the engine already verified
+                if config.get("checksum_verify"):
+                    self._verify_staged(source, res.chunk_ids, chunk_size,
+                                        dbuf.view()[:nbytes])
                 out_ids.extend(res.chunk_ids)
                 nr_ssd += res.nr_ssd2dev
                 nr_ram += res.nr_ram2dev
                 # staged batch -> device (async H2D), landed with an async
                 # donated update; nothing here blocks
                 t0 = time.monotonic_ns()
-                _, dbuf = self._bufs[bufidx]
                 dev = list(hbm.array.devices())[0]
                 host = np.frombuffer(dbuf.view()[:nbytes], dtype=device_dtype)
                 dev_chunk, fence = h2d_transfer(host, dev)
@@ -374,6 +382,40 @@ class StagingPipeline:
                                  chunk_ids=out_ids)
         finally:
             self.registry.release(hbm)
+
+    def _verify_staged(self, source: Source, chunk_ids: Sequence[int],
+                       chunk_size: int, view: memoryview) -> None:
+        """Verify heap-page checksums for a landed staging batch.
+
+        ``chunk_ids[i]`` occupies staging bytes ``[i*chunk_size,
+        (i+1)*chunk_size)`` (the post-reorder slot contract), which maps a
+        bad page straight back to its file offset for the buffered re-read.
+        After ``checksum_retries`` failed heals the CORRUPTION error is
+        raised — the caller's except path reaps in-flight tasks, so the
+        latch discipline matches a direct-read corruption failure."""
+        from ..scan.heap import PAGE_SIZE, verify_page_checksums
+        if chunk_size % PAGE_SIZE:
+            return          # pages straddle chunks: geometry unverifiable
+        bad = verify_page_checksums(view)
+        rereads = int(config.get("checksum_retries"))
+        while bad:
+            stats.add("nr_csum_fail", len(bad))
+            if rereads <= 0:
+                boff = bad[0] * PAGE_SIZE
+                foff = (chunk_ids[boff // chunk_size] * chunk_size
+                        + boff % chunk_size)
+                raise StromError(
+                    _errno.EBADMSG,
+                    f"page checksum mismatch in staging ring at file offset "
+                    f"{foff} ({len(bad)} bad page(s), re-reads exhausted)")
+            rereads -= 1
+            stats.add("nr_csum_reread", len(bad))
+            for p in bad:
+                boff = p * PAGE_SIZE
+                foff = (chunk_ids[boff // chunk_size] * chunk_size
+                        + boff % chunk_size)
+                source.read_buffered(foff, view[boff:boff + PAGE_SIZE])
+            bad = verify_page_checksums(view)
 
     def drain(self) -> None:
         """Block until every outstanding device op has completed (bounded

@@ -82,6 +82,10 @@ def _query_worker(spec: dict, cursor_name: str, lock, out_q) -> None:
     """Worker entry (spawned process): rebuild the query from the spec,
     scan shared-cursor chunks, report the picklable partial."""
     import os
+    if spec.get("_test_crash_worker"):
+        # test hook: die like an OOM-kill/segfault — no report, no
+        # cleanup — so the leader's death detection is testable in CI
+        os._exit(42)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     cursor = None
     try:
@@ -126,13 +130,45 @@ def run_query_workers(spec: dict, n_workers: int, *,
     procs = [ctx.Process(target=_query_worker,
                          args=(spec, cursor.name, lock, q))
              for _ in range(n_workers)]
+    import queue as _queue
+    import time as _time
     try:
         for p in procs:
             p.start()
         results: List[dict] = []
         errors: List[str] = []
-        for _ in procs:
-            kind, payload = q.get(timeout=timeout_s)
+        # poll instead of one blocking get: a worker killed by the OOM
+        # killer (or a segfault) never reports, and a bare
+        # q.get(timeout=600) would sit out the whole deadline.  Short
+        # get timeouts + liveness checks surface the death in seconds,
+        # with a small grace window for the queue feeder thread to flush
+        # a report that raced the exit.
+        deadline = _time.monotonic() + timeout_s
+        grace_until = None
+        while len(results) + len(errors) < len(procs):
+            try:
+                kind, payload = q.get(timeout=0.25)
+            except _queue.Empty:
+                now = _time.monotonic()
+                reported = len(results) + len(errors)
+                if now > deadline:
+                    raise RuntimeError(
+                        f"parallel scan timed out after {timeout_s:.0f}s: "
+                        f"{len(procs) - reported} worker(s) never reported")
+                alive = sum(p.is_alive() for p in procs)
+                if alive < len(procs) - reported:
+                    if grace_until is None:
+                        grace_until = now + 2.0
+                    elif now > grace_until:
+                        dead = [(p.pid, p.exitcode) for p in procs
+                                if not p.is_alive()]
+                        raise RuntimeError(
+                            "parallel scan worker died without reporting "
+                            f"(pid, exitcode of exited workers: {dead}); "
+                            f"{reported}/{len(procs)} partials received")
+                else:
+                    grace_until = None
+                continue
             (results if kind == "ok" else errors).append(payload)
         for p in procs:
             p.join(timeout=60)

@@ -1977,6 +1977,16 @@ class Query:
                "maxs": np.asarray(out["maxs"]), "avgs": avgs}
         if nn is not None:
             res["nncounts"] = nn
+            if (nn == 0).any():
+                # all-NULL groups: SQL says MIN/MAX/SUM are NULL, not
+                # the kernel's ±INT_MAX / 0 accumulator identities —
+                # surface NULL as NaN at the result edge (the same face
+                # avgs already wears), converting to float only when an
+                # all-NULL group actually exists
+                void = nn == 0
+                for k in ("sums", "mins", "maxs"):
+                    res[k] = np.where(void, np.nan,
+                                      res[k].astype(np.float64))
         if "sumsqs" in out:
             sumsqs = np.asarray(out["sumsqs"], dtype=np.float64)
             with np.errstate(divide="ignore", invalid="ignore"):
@@ -2334,7 +2344,20 @@ class Query:
             return _tag(out)
         accs = [p["acc"] for p in partials if p["acc"]]
         if not accs:
-            return {}
+            # empty table: no worker claimed a chunk, so no partial
+            # accumulator exists.  Synthesize the terminal's normal
+            # zero-row result (count=0, zero sums/nncounts, empty
+            # groups) by running its kernel over one all-zero page —
+            # n_tuples=0 decodes to zero valid rows, so the shapes,
+            # dtypes and keys match a real scan exactly; a bare {}
+            # crashed every consumer that indexed the result
+            import jax
+            from .heap import PAGE_SIZE
+            if self._op == "star":
+                self._resolve_star_builds(None, None)
+            fn0, _combine0 = self._build_fn("xla")
+            acc0 = fn0(np.zeros((1, PAGE_SIZE), np.uint8))
+            return _tag(self._finalize(jax.tree.map(np.asarray, acc0)))
         if self._op == "group_by":
             from ..ops.groupby import combine_groupby
             combine = combine_groupby

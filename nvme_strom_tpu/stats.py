@@ -79,6 +79,10 @@ class StatRegistry:
         # Indexed by position within the (striped) source; single-file
         # sources are member 0.
         self._members: dict = {}
+        # fault accounting per member (PR 1): member -> [errors, retries,
+        # quarantines_entered, quarantined_now].  Kept separate from the
+        # hot-path request triple so the common case stays a 3-add.
+        self._member_health: dict = {}
 
     def enabled(self) -> bool:
         return bool(config.get("stat_info"))
@@ -124,11 +128,40 @@ class StatRegistry:
             m[1] += nbytes
             m[2] += ns
 
-    def member_snapshot(self) -> dict:
-        """{member: {"nreq", "bytes", "clk_ns"}} snapshot."""
+    def member_error(self, member: int, *, retried: bool = False) -> None:
+        """Account one direct-read failure (and optionally the retry it
+        triggered) against a stripe member — the per-disk error half of
+        the part_stat analog, feeding the quarantine policy."""
+        if not self.enabled():
+            return
         with self._lock:
-            return {k: {"nreq": v[0], "bytes": v[1], "clk_ns": v[2]}
-                    for k, v in sorted(self._members.items())}
+            h = self._member_health.setdefault(member, [0, 0, 0, False])
+            h[0] += 1
+            if retried:
+                h[1] += 1
+
+    def member_quarantine(self, member: int, active: bool) -> None:
+        """Record a quarantine transition for a member (entry bumps the
+        counter; exit just clears the live flag)."""
+        with self._lock:
+            h = self._member_health.setdefault(member, [0, 0, 0, False])
+            if active and not h[3]:
+                h[2] += 1
+                self._c["nr_member_quarantine"] += 1
+            h[3] = active
+
+    def member_snapshot(self) -> dict:
+        """{member: {"nreq", "bytes", "clk_ns"[, "errors", "retries",
+        "quarantines", "quarantined"]}} snapshot; health keys appear once
+        a member has seen any fault accounting."""
+        with self._lock:
+            out = {k: {"nreq": v[0], "bytes": v[1], "clk_ns": v[2]}
+                   for k, v in sorted(self._members.items())}
+            for k, h in self._member_health.items():
+                d = out.setdefault(k, {"nreq": 0, "bytes": 0, "clk_ns": 0})
+                d.update(errors=h[0], retries=h[1], quarantines=h[2],
+                         quarantined=bool(h[3]))
+            return out
 
     @contextmanager
     def stage(self, name: str):

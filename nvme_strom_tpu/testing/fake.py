@@ -51,23 +51,70 @@ def expected_bytes(offset: int, length: int, *, seed: int = 0) -> bytes:
 
 @dataclass
 class FaultPlan:
-    """Deterministic fault injection for the direct-read path."""
+    """Deterministic fault injection for the read path.
+
+    Fault tiers map onto the engine's error taxonomy (PR 1):
+
+    * ``fail_offsets`` — PERSISTENT bad regions: the direct read *and* the
+      buffered fallback both fail, so retries exhaust and the task latches
+      EIO (the "dead blocks" plan).
+    * ``fail_every_nth`` / ``fail_rate`` — TRANSIENT periodic/randomized
+      EIO on the direct path only; a retry or the buffered fallback
+      succeeds (``fail_rate`` draws per-request from ``random.Random
+      (seed)`` so stress runs are reproducible).
+    * ``latency_s`` / ``slow_member``+``slow_s`` — slow-device and
+      slow-member plans for deadline/watchdog and quarantine tests.
+    * ``corrupt_offsets`` — persistent bit-flips (re-reads stay corrupt:
+      exercises the latched CORRUPTION error), ``corrupt_once_offsets`` —
+      torn reads that heal on re-read (each offset flips exactly once).
+    """
 
     fail_offsets: Set[int] = field(default_factory=set)   # file_off -> EIO
     fail_every_nth: int = 0                               # every Nth direct read fails
+    fail_rate: float = 0.0                                # P(transient EIO) per direct read
+    seed: int = 0                                         # rng seed for fail_rate
     latency_s: float = 0.0                                # per-request injected delay
+    slow_member: Optional[int] = None                     # member with extra latency
+    slow_s: float = 0.0                                   # the extra latency
     corrupt_offsets: Set[int] = field(default_factory=set)  # flip a byte at offset
+    corrupt_once_offsets: Set[int] = field(default_factory=set)  # flip once
     _count: int = 0
+    _rng: object = field(default=None, repr=False)
 
-    def check(self, file_off: int, length: int) -> None:
+    def check(self, file_off: int, length: int,
+              member: Optional[int] = None) -> None:
         self._count += 1
         if self.latency_s:
             time.sleep(self.latency_s)
+        if self.slow_s and member is not None and member == self.slow_member:
+            time.sleep(self.slow_s)
         if self.fail_every_nth and self._count % self.fail_every_nth == 0:
             raise StromError(_errno.EIO, f"injected periodic fault #{self._count}")
+        if self.fail_rate > 0.0:
+            if self._rng is None:
+                import random
+                self._rng = random.Random(self.seed)
+            if self._rng.random() < self.fail_rate:
+                raise StromError(_errno.EIO,
+                                 f"injected random fault #{self._count}")
+        self.check_buffered(file_off, length)
+
+    def check_buffered(self, file_off: int, length: int) -> None:
+        """The persistent tier only: consulted by the buffered fallback so
+        dead regions stay dead on every path."""
         for off in self.fail_offsets:
             if file_off <= off < file_off + length:
                 raise StromError(_errno.EIO, f"injected fault at {off}")
+
+    def apply_corruption(self, file_off: int, dest: memoryview) -> None:
+        for off in self.corrupt_offsets:
+            if file_off <= off < file_off + len(dest):
+                dest[off - file_off] = dest[off - file_off] ^ 0xFF
+        hit = [off for off in self.corrupt_once_offsets
+               if file_off <= off < file_off + len(dest)]
+        for off in hit:
+            dest[off - file_off] = dest[off - file_off] ^ 0xFF
+            self.corrupt_once_offsets.discard(off)
 
 
 class FakeNvmeSource(PlainSource):
@@ -85,11 +132,15 @@ class FakeNvmeSource(PlainSource):
         self.force_cached_fraction = force_cached_fraction
 
     def read_member_direct(self, member: int, file_off: int, dest: memoryview) -> None:
-        self.fault_plan.check(file_off, len(dest))
+        self.fault_plan.check(file_off, len(dest), member=member)
         super().read_member_direct(member, file_off, dest)
-        for off in self.fault_plan.corrupt_offsets:
-            if file_off <= off < file_off + len(dest):
-                dest[off - file_off] = dest[off - file_off] ^ 0xFF
+        self.fault_plan.apply_corruption(file_off, dest)
+
+    def read_member_buffered(self, member: int, file_off: int, dest: memoryview) -> None:
+        # the engine's degraded tier reads through here: persistent bad
+        # regions must fail it too, transient/periodic plans must not
+        self.fault_plan.check_buffered(file_off, len(dest))
+        super().read_member_buffered(member, file_off, dest)
 
     def cached_fraction(self, offset: int, length: int) -> float:
         if self.force_cached_fraction is not None:

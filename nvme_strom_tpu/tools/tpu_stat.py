@@ -74,6 +74,13 @@ def _row(cur: dict, prev: dict, verbose: bool) -> str:
             f"{d.get('nr_debug2', 0):6d}",
             f"{d.get('nr_debug3', 0):6d}",
             f"{d.get('nr_debug4', 0):6d}",
+            # fault-tolerance tier (PR 1): recovery actions this interval —
+            # a degrading device shows here before it latches errors
+            f"{d.get('nr_io_retry', 0):5d}",
+            f"{d.get('nr_io_fallback', 0):6d}",
+            f"{d.get('nr_task_timeout', 0):4d}",
+            f"{d.get('nr_csum_fail', 0):5d}",
+            f"{d.get('nr_member_quarantine', 0):5d}",
         ]
     return " ".join(cols)
 
@@ -82,7 +89,8 @@ def _header(verbose: bool) -> str:
     cols = ["submit ", "wait   ", "dma-lat", " avg-sz", " wrong", "  cur", "  max"]
     if verbose:
         cols += ["plan   ", "sq-sub ", "enters", "resub ", "sqfull",
-                 "h2d   ", "fixed "]
+                 "h2d   ", "fixed ", "retry", "fallbk", " tmo", " csum",
+                 "quar "]
     return " ".join(cols)
 
 
@@ -194,10 +202,13 @@ def main(argv=None) -> int:
             # per-stripe-member breakdown (part_stat_add analog): a slow
             # member shows as an outlier avg-lat at similar req/byte counts
             print("per-member:")
-            print("  member   reqs        bytes   avg-lat")
+            print("  member   reqs        bytes   avg-lat  errs  retry  quar")
             for m, v in sorted(snap["members"].items(), key=lambda kv: int(kv[0])):
+                health = f"{v.get('errors', 0):>5} {v.get('retries', 0):>6} " \
+                         f"{v.get('quarantines', 0):>5}" \
+                         + ("  QUARANTINED" if v.get("quarantined") else "")
                 print(f"  {int(m):>6} {v['nreq']:>6} {v['bytes']:>12} "
-                      f"  {show_avg(v['clk_ns'], v['nreq'])}")
+                      f"  {show_avg(v['clk_ns'], v['nreq'])} {health}")
         return 0
 
     prev = snap["counters"]

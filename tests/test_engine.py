@@ -347,6 +347,11 @@ def test_failed_task_retained_until_session_close(tmp_data_file):
 
 
 def test_first_error_wins(tmp_data_file):
+    # recovery ladder off: this test pins the raw first-error latch
+    # semantics (with retries/fallback on, a periodic plan heals — see
+    # test_transient_eio_retries_to_success)
+    config.set("io_retries", 0)
+    config.set("io_fallback", False)
     plan = FaultPlan(fail_every_nth=1)  # every request fails
     src = FakeNvmeSource(tmp_data_file, fault_plan=plan, force_cached_fraction=0.0)
     try:

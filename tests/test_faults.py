@@ -1,0 +1,417 @@
+"""Fault-tolerance layer tests (PR 1): retry ladder, buffered degradation,
+task deadlines/watchdog, checksum verify + re-read, member quarantine, and
+the parallel-scan worker-death detector.  All hardware-free: faults come
+from :class:`~nvme_strom_tpu.testing.fake.FaultPlan` tiers."""
+
+import errno
+import os
+import time
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu import Session, StromError, config, stats
+from nvme_strom_tpu.api import ErrorClass
+from nvme_strom_tpu.engine import PlainSource
+from nvme_strom_tpu.testing import FakeNvmeSource, FaultPlan, make_test_file
+from nvme_strom_tpu.testing.fake import expected_bytes
+
+CHUNK = 64 << 10
+
+
+def _counter_delta(before, after, name):
+    return after.counters.get(name, 0) - before.counters.get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# transient retry tier
+# ---------------------------------------------------------------------------
+
+def test_transient_eio_retries_to_success(tmp_data_file):
+    """A periodic transient EIO plan heals inside the retry ladder: the
+    copy is byte-identical and the retry counter moved (the ISSUE's
+    10%-EIO acceptance shape, deterministic via fail_every_nth)."""
+    config.set("dma_max_size", CHUNK)   # one request per chunk
+    plan = FaultPlan(fail_every_nth=3)
+    src = FakeNvmeSource(tmp_data_file, fault_plan=plan,
+                         force_cached_fraction=0.0)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(8 * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(8)), CHUNK)
+            sess.memcpy_wait(res.dma_task_id)
+            got = bytes(buf.view()[:8 * CHUNK])
+    finally:
+        src.close()
+    assert got == expected_bytes(0, 8 * CHUNK)
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_io_retry") > 0
+
+
+def test_random_eio_load_byte_identical(tmp_data_file):
+    """The acceptance criterion: ~10% random transient EIO across a
+    multi-chunk copy still produces byte-identical data, with nonzero
+    retry accounting in stat_info."""
+    config.set("dma_max_size", CHUNK)
+    plan = FaultPlan(fail_rate=0.10, seed=7)
+    src = FakeNvmeSource(tmp_data_file, fault_plan=plan,
+                         force_cached_fraction=0.0)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(32 * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(32)), CHUNK)
+            sess.memcpy_wait(res.dma_task_id)
+            got = bytes(buf.view()[:32 * CHUNK])
+    finally:
+        src.close()
+    assert got == expected_bytes(0, 32 * CHUNK)
+    after = stats.snapshot(reset_max=False)
+    assert (_counter_delta(before, after, "nr_io_retry")
+            + _counter_delta(before, after, "nr_io_fallback")) > 0
+
+
+def test_persistent_eio_latches_errno(tmp_data_file):
+    """A dead region fails the direct read AND the buffered fallback, so
+    retries exhaust and memcpy_wait surfaces the latched EIO promptly —
+    never a hang."""
+    config.set("io_retries", 1)
+    plan = FaultPlan(fail_offsets={3 * CHUNK + 100})
+    src = FakeNvmeSource(tmp_data_file, fault_plan=plan,
+                         force_cached_fraction=0.0)
+    try:
+        with Session() as sess:
+            handle, _ = sess.alloc_dma_buffer(8 * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(8)), CHUNK)
+            t0 = time.monotonic()
+            with pytest.raises(StromError) as ei:
+                sess.memcpy_wait(res.dma_task_id, timeout=30.0)
+            assert time.monotonic() - t0 < 30.0
+            assert ei.value.errno == errno.EIO
+    finally:
+        src.close()
+
+
+def test_buffered_fallback_byte_identical(tmp_data_file):
+    """With every direct read failing and retries off, each extent
+    degrades to the buffered path — byte-identical result, fallback
+    counter moved."""
+    config.set("io_retries", 0)
+    plan = FaultPlan(fail_every_nth=1)
+    src = FakeNvmeSource(tmp_data_file, fault_plan=plan,
+                         force_cached_fraction=0.0)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(8 * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(8)), CHUNK)
+            sess.memcpy_wait(res.dma_task_id)
+            got = bytes(buf.view()[:8 * CHUNK])
+    finally:
+        src.close()
+    assert got == expected_bytes(0, 8 * CHUNK)
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_io_fallback") > 0
+
+
+def test_fallback_disabled_surfaces_error(tmp_data_file):
+    config.set("io_retries", 0)
+    config.set("io_fallback", False)
+    plan = FaultPlan(fail_every_nth=1)
+    src = FakeNvmeSource(tmp_data_file, fault_plan=plan,
+                         force_cached_fraction=0.0)
+    try:
+        with Session() as sess:
+            handle, _ = sess.alloc_dma_buffer(CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, [0], CHUNK)
+            with pytest.raises(StromError) as ei:
+                sess.memcpy_wait(res.dma_task_id)
+            assert ei.value.errno == errno.EIO
+            assert ei.value.error_class is ErrorClass.TRANSIENT
+    finally:
+        src.close()
+
+
+# ---------------------------------------------------------------------------
+# deadlines / watchdog
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_latches_etimedout(tmp_data_file):
+    """An overdue task is latched ETIMEDOUT by the watchdog and its
+    remaining chunks are cancelled: memcpy_wait returns the error well
+    before the injected I/O time would have elapsed."""
+    config.set("task_deadline_s", 0.25)
+    config.set("dma_max_size", CHUNK)
+    plan = FaultPlan(latency_s=0.8)   # each request alone outlives the deadline
+    src = FakeNvmeSource(tmp_data_file, fault_plan=plan,
+                         force_cached_fraction=0.0)
+    try:
+        with Session() as sess:
+            handle, _ = sess.alloc_dma_buffer(4 * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(4)), CHUNK)
+            t0 = time.monotonic()
+            with pytest.raises(StromError) as ei:
+                sess.memcpy_wait(res.dma_task_id, timeout=30.0)
+            assert time.monotonic() - t0 < 20.0
+            assert ei.value.errno == errno.ETIMEDOUT
+            assert ei.value.error_class is ErrorClass.TIMEOUT
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False)
+    assert after.counters.get("nr_task_timeout", 0) > 0
+
+
+def test_deadline_disabled_no_timeout(tmp_data_file):
+    config.set("task_deadline_s", 0.0)
+    plan = FaultPlan(latency_s=0.05)
+    src = FakeNvmeSource(tmp_data_file, fault_plan=plan,
+                         force_cached_fraction=0.0)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(4 * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(4)), CHUNK)
+            sess.memcpy_wait(res.dma_task_id)
+            assert bytes(buf.view()[:4 * CHUNK]) == expected_bytes(0, 4 * CHUNK)
+    finally:
+        src.close()
+
+
+# ---------------------------------------------------------------------------
+# checksums
+# ---------------------------------------------------------------------------
+
+def _heap_source(tmp_path, plan):
+    """A checksummed heap file wrapped in a faulty fake source; returns
+    (source, pages_bytes)."""
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 4   # 4 pages
+    c0 = np.arange(n, dtype=np.int32)
+    c1 = (n - np.arange(n)).astype(np.int32)
+    path = str(tmp_path / "csum.heap")
+    build_heap_file(path, [c0, c1], schema)
+    with open(path, "rb") as f:
+        data = f.read()
+    return FakeNvmeSource(path, fault_plan=plan,
+                          force_cached_fraction=0.0), data
+
+
+def test_corruption_once_heals_by_reread(tmp_path):
+    """A torn read (bit flip that heals on re-read) is detected by the
+    page checksum and repaired transparently."""
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE
+    config.set("checksum_verify", True)
+    plan = FaultPlan(corrupt_once_offsets={PAGE_SIZE + 200})
+    src, data = _heap_source(tmp_path, plan)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(len(data))
+            res = sess.memcpy_ssd2ram(src, handle,
+                                      list(range(len(data) // PAGE_SIZE)),
+                                      PAGE_SIZE)
+            sess.memcpy_wait(res.dma_task_id)
+            got = bytes(buf.view()[:len(data)])
+    finally:
+        src.close()
+    assert got == data
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_csum_fail") > 0
+    assert _counter_delta(before, after, "nr_csum_reread") > 0
+
+
+def test_corruption_persistent_latches_ebadmsg(tmp_path):
+    """A persistent bit flip stays corrupt on every re-read: after
+    checksum_retries heals the task latches the CORRUPTION error."""
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE
+    config.set("checksum_verify", True)
+    config.set("checksum_retries", 2)
+    plan = FaultPlan(corrupt_offsets={2 * PAGE_SIZE + 300})
+    src, data = _heap_source(tmp_path, plan)
+    try:
+        with Session() as sess:
+            handle, _ = sess.alloc_dma_buffer(len(data))
+            res = sess.memcpy_ssd2ram(src, handle,
+                                      list(range(len(data) // PAGE_SIZE)),
+                                      PAGE_SIZE)
+            with pytest.raises(StromError) as ei:
+                sess.memcpy_wait(res.dma_task_id, timeout=30.0)
+            assert ei.value.errno == errno.EBADMSG
+            assert ei.value.error_class is ErrorClass.CORRUPTION
+    finally:
+        src.close()
+
+
+def test_checksum_off_passes_corruption(tmp_path):
+    """Control: with verification off the flip sails through — proving
+    the detection above is the checksum layer, not the transport."""
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE
+    plan = FaultPlan(corrupt_offsets={2 * PAGE_SIZE + 300})
+    src, data = _heap_source(tmp_path, plan)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(len(data))
+            res = sess.memcpy_ssd2ram(src, handle,
+                                      list(range(len(data) // PAGE_SIZE)),
+                                      PAGE_SIZE)
+            sess.memcpy_wait(res.dma_task_id)
+            got = bytes(buf.view()[:len(data)])
+    finally:
+        src.close()
+    assert got != data
+    assert len(got) == len(data)
+
+
+def test_staging_ring_verify_catches_writeback_corruption(tmp_path):
+    """On-disk corruption riding the write-back (page-cache) tier skips
+    the engine's direct-read verify; the staging ring's post-landing
+    check is the last line of defense and must latch EBADMSG."""
+    import jax
+
+    from nvme_strom_tpu.hbm import HbmRegistry, StagingPipeline
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE
+    config.set("checksum_verify", True)
+    config.set("checksum_retries", 1)
+    src, data = _heap_source(tmp_path, FaultPlan())
+    # corrupt the file itself: every read path (direct, buffered,
+    # re-read) sees the same flipped byte
+    with open(src.path, "r+b") as f:
+        f.seek(PAGE_SIZE + 500)
+        b = f.read(1)
+        f.seek(PAGE_SIZE + 500)
+        f.write(bytes([b[0] ^ 0xFF]))
+    src.force_cached_fraction = 1.0     # all chunks ride write-back
+    reg = HbmRegistry()
+    try:
+        with Session() as sess:
+            h = reg.map_device_memory(len(data))
+            try:
+                with StagingPipeline(sess, staging_bytes=2 * PAGE_SIZE,
+                                     hbm_registry=reg) as pipe:
+                    with pytest.raises(StromError) as ei:
+                        pipe.memcpy_ssd2dev(
+                            src, h, list(range(len(data) // PAGE_SIZE)),
+                            PAGE_SIZE)
+                    assert ei.value.errno == errno.EBADMSG
+                    assert ei.value.error_class is ErrorClass.CORRUPTION
+            finally:
+                reg.unmap(h)
+    finally:
+        src.close()
+
+
+# ---------------------------------------------------------------------------
+# member quarantine
+# ---------------------------------------------------------------------------
+
+def test_member_quarantine_enters_and_routes_buffered(tmp_data_file):
+    """Consecutive failures on one member trip the quarantine: the
+    transition is counted and subsequent extents route buffered."""
+    config.set("io_retries", 0)
+    config.set("dma_max_size", CHUNK)
+    config.set("quarantine_after", 2)
+    config.set("quarantine_s", 60.0)
+    plan = FaultPlan(fail_every_nth=1)   # every direct read fails
+    src = FakeNvmeSource(tmp_data_file, fault_plan=plan,
+                         force_cached_fraction=0.0)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(8 * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(8)), CHUNK)
+            sess.memcpy_wait(res.dma_task_id)
+            got = bytes(buf.view()[:8 * CHUNK])
+    finally:
+        src.close()
+    assert got == expected_bytes(0, 8 * CHUNK)
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_member_quarantine") >= 1
+    snap = stats.member_snapshot()
+    assert any(v.get("quarantines", 0) >= 1 for v in snap.values())
+
+
+# ---------------------------------------------------------------------------
+# randomized stress (short CI slice of `make stress-faults`)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_randomized_fault_plans_short(monkeypatch):
+    """A handful of seeded random fault plans through the stress driver:
+    transient plans heal byte-identically, persistent plans latch."""
+    from nvme_strom_tpu.testing import stress_faults
+    monkeypatch.setenv("STROM_STRESS_ROUNDS", "6")
+    assert stress_faults.main() == 0
+
+
+# ---------------------------------------------------------------------------
+# parallel-scan worker death (satellite: scan/parallel.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nw", [2])
+def test_worker_death_raises_descriptive_error_fast(tmp_path, nw):
+    """A worker killed before reporting raises a descriptive
+    RuntimeError in seconds, not after the 600s queue timeout."""
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.parallel import run_query_workers
+    from nvme_strom_tpu.scan.query import Query
+    schema = HeapSchema(n_cols=2, visibility=True)
+    n = schema.tuples_per_page * 4
+    c0 = np.arange(n, dtype=np.int32)
+    path = str(tmp_path / "wd.heap")
+    build_heap_file(path, [c0, c0], schema,
+                    visibility=np.ones(n, np.int32))
+    q = Query(path, schema).aggregate(cols=[0])
+    spec = q._worker_spec(None)
+    spec["_test_crash_worker"] = True
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="died without reporting"):
+        run_query_workers(spec, nw, timeout_s=600.0)
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_empty_table_workers_zero_result(tmp_path):
+    """No worker claims a chunk on an empty table: the leader
+    synthesizes the terminal's normal zero-row result instead of {}."""
+    from nvme_strom_tpu.scan.heap import HeapSchema
+    from nvme_strom_tpu.scan.query import Query
+    path = str(tmp_path / "empty.heap")
+    open(path, "wb").close()
+    schema = HeapSchema(n_cols=2, visibility=True)
+    out = Query(path, schema).where_range(0, 1, None) \
+        .aggregate(cols=[1]).run(workers=2)
+    assert int(out["count"]) == 0
+    assert [int(s) for s in out["sums"]] == [0]
+
+
+# ---------------------------------------------------------------------------
+# all-NULL group sentinels (satellite: ops/groupby via Query._finalize)
+# ---------------------------------------------------------------------------
+
+def test_allnull_group_min_max_sum_are_null(tmp_path):
+    """A group whose aggregate column is entirely NULL reports NaN (SQL
+    NULL) for MIN/MAX/SUM at the result edge — not the kernel's
+    ±INT_MAX / 0 accumulator identities."""
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.query import Query
+    schema = HeapSchema(n_cols=2, visibility=False,
+                        dtypes=("int32", "int32"),
+                        nullable=(False, True))
+    n = schema.tuples_per_page * 2
+    key = (np.arange(n) % 4).astype(np.int32)
+    val = np.arange(n, dtype=np.int32)
+    nulls = {1: key == 2}            # group 2's aggregate is all NULL
+    path = str(tmp_path / "ng.heap")
+    build_heap_file(path, [key, val], schema, nulls=nulls)
+    out = Query(path, schema).group_by(lambda c: c[0], 4,
+                                       agg_cols=[1]).run()
+    nn = np.asarray(out["nncounts"])
+    assert nn[0][2] == 0 and nn[0][1] > 0
+    for k in ("mins", "maxs", "sums", "avgs"):
+        assert np.isnan(np.asarray(out[k], dtype=np.float64)[0][2]), k
+        assert np.isfinite(np.asarray(out[k], dtype=np.float64)[0][1]), k
+    # populated groups keep exact values
+    m = key == 1
+    assert np.asarray(out["mins"])[0][1] == val[m].min()
+    assert np.asarray(out["maxs"])[0][1] == val[m].max()
+    assert np.asarray(out["sums"])[0][1] == val[m].sum()
