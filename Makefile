@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults test probe-loop clean
+.PHONY: all native tsan stress stress-faults test check bench-smoke probe-loop clean
 
 all: native
 
@@ -45,6 +45,19 @@ test: native stress
 	  python -c "import jax; jax.config.update('jax_platforms','cpu'); \
 	  import __graft_entry__ as g; g.dryrun_multichip(8); \
 	  print('dryrun OK')"
+
+# Tiny CPU-only perf gate (PR 4): a 64MB smoke pass through the direct
+# read path that must move bytes (nonzero throughput) and emits one JSON
+# line for trend scrapes.  Small enough to ride in every `make check`;
+# the perf-marked pytest assertions run alongside it.
+bench-smoke:
+	@BENCH_SMOKE=1 JAX_PLATFORMS=cpu python bench.py | tee /tmp/strom_bench_smoke.out | \
+	python -c 'import json,sys; rows=[json.loads(l) for l in sys.stdin if l.lstrip().startswith("{")]; assert rows, "bench emitted no JSON row"; v=rows[-1].get("value") or 0; assert v > 0, "zero throughput: %r" % rows[-1]; print("bench-smoke ok: %s %s" % (v, rows[-1].get("unit", "")))'
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m perf
+
+# The everyday gate: tier-1 tests plus the perf smoke.
+check: bench-smoke
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on
 # a cadence and runs the full device bench set in the first healthy window,

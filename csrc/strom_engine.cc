@@ -66,6 +66,23 @@ int sys_io_uring_register(int fd, unsigned opcode, void* arg,
   return (int)syscall(__NR_io_uring_register, fd, opcode, arg, nr_args);
 }
 
+// Local mirror of the modern io_uring_rsrc_register: build-image UAPI
+// headers may predate the `flags` field (older headers call it `resv`;
+// the wire layout is identical), and IORING_RSRC_REGISTER_SPARSE with it.
+// The running kernel decides support at io_uring_register time either way.
+struct nstpu_rsrc_register {
+  uint32_t nr;
+  uint32_t flags;
+  uint64_t resv2;
+  uint64_t data;
+  uint64_t tags;
+};
+static_assert(sizeof(nstpu_rsrc_register) == sizeof(io_uring_rsrc_register),
+              "rsrc_register layout drifted from the kernel UAPI");
+#ifndef IORING_RSRC_REGISTER_SPARSE
+#define IORING_RSRC_REGISTER_SPARSE (1U << 0)
+#endif
+
 struct Uring {
   int fd = -1;
   unsigned sq_entries = 0, cq_entries = 0;
@@ -233,6 +250,34 @@ struct Engine {
   std::condition_variable inflight_cv;
   unsigned inflight = 0;
 
+  // queue-occupancy integral: the interval ending at each in-flight
+  // transition is accounted against the OLD level, so mean occupancy
+  // over a stats window is d(OCC_INTEGRAL_NS)/d(OCC_BUSY_NS) — the
+  // direct observable for "the submission window held the queue full".
+  // Aggregated across rings (the planner's queue_depth contract is
+  // per-engine, and tpu_stat shows one gauge).
+  std::mutex occ_m;
+  uint64_t occ_last_ns = 0;
+  uint64_t occ_cur = 0;
+
+  // per-request service-latency histogram: log2-ns buckets filled at
+  // completion (submit->completion per request, the per-chunk latency
+  // the adaptive sizer and tpu_stat percentiles consume)
+  std::atomic<uint64_t> lat_hist_[NSTPU_LAT_BUCKETS];
+
+  void occ_note(int delta) {
+    uint64_t now = now_ns();
+    std::lock_guard<std::mutex> lk(occ_m);
+    if (occ_last_ns && occ_cur) {
+      uint64_t dt = now - occ_last_ns;
+      ctr[NSTPU_CTR_OCC_INTEGRAL_NS].fetch_add(occ_cur * dt,
+                                               std::memory_order_relaxed);
+      ctr[NSTPU_CTR_OCC_BUSY_NS].fetch_add(dt, std::memory_order_relaxed);
+    }
+    occ_last_ns = now;
+    occ_cur = (uint64_t)((int64_t)occ_cur + delta);
+  }
+
   // io_uring backend: one ring per (member % nrings) — see RingCtx
   std::vector<RingCtx*> rings;
 
@@ -324,6 +369,7 @@ struct Engine {
     for (auto& c : ctr) c.store(0);
     for (auto& row : member_ctr)
       for (auto& c : row) c.store(0);
+    for (auto& b : lat_hist_) b.store(0);
     depth = queue_depth > 0 ? (unsigned)queue_depth : 32u;
     // NSTPU_DISABLE_URING=1 makes io_uring setup "fail" deterministically:
     // AUTO falls over to the threadpool (the graceful-degradation path the
@@ -355,7 +401,7 @@ struct Engine {
         // disables the READ_FIXED fast path, never the engine
         fixed_ok = true;
         for (auto* rx : rings) {
-          struct io_uring_rsrc_register rr;
+          struct nstpu_rsrc_register rr;
           memset(&rr, 0, sizeof rr);
           rr.nr = kFixedSlots;
           rr.flags = IORING_RSRC_REGISTER_SPARSE;
@@ -450,11 +496,15 @@ struct Engine {
 
   void finish_req(ReqCtx* rc, int err) {
     // per-member accounting at completion: requests, bytes, busy ns
+    uint64_t service_ns = now_ns() - rc->t_start;
     member_ctr[rc->member][0].fetch_add(1, std::memory_order_relaxed);
     member_ctr[rc->member][1].fetch_add(rc->orig_len,
                                         std::memory_order_relaxed);
-    member_ctr[rc->member][2].fetch_add(now_ns() - rc->t_start,
+    member_ctr[rc->member][2].fetch_add(service_ns,
                                         std::memory_order_relaxed);
+    // log2 bucket: 63 - clz(ns), clamped (ns|1 keeps clz defined at 0)
+    int bucket = 63 - __builtin_clzll(service_ns | 1);
+    lat_hist_[bucket].fetch_add(1, std::memory_order_relaxed);
     // drop the in-flight slot before waking the task's waiter, so a
     // post-wait stats snapshot never sees a stale cur_dma_count
     drop_inflight_slot(rc);
@@ -479,6 +529,7 @@ struct Engine {
       inflight_cv.notify_one();
       ctr[NSTPU_CTR_CUR_DMA_COUNT].fetch_sub(1, std::memory_order_relaxed);
     }
+    occ_note(-1);
   }
 
   // ---- io_uring backend --------------------------------------------------
@@ -761,6 +812,7 @@ struct Engine {
           ctr[NSTPU_CTR_CUR_DMA_COUNT].fetch_add(1, std::memory_order_relaxed)
           + 1;
       atomic_max(ctr[NSTPU_CTR_MAX_DMA_COUNT], cur);
+      occ_note(+1);
       ctr[NSTPU_CTR_TOTAL_DMA_LENGTH].fetch_add(reqs[i].len,
                                                 std::memory_order_relaxed);
       ctr[NSTPU_CTR_NR_SUBMIT_DMA].fetch_add(1, std::memory_order_relaxed);
@@ -872,6 +924,9 @@ struct Engine {
   }
 
   int stats(uint64_t* out, int32_t cap) {
+    // bring the occupancy integral current: it only advances on in-flight
+    // transitions, so a long steady interval would otherwise undercount
+    occ_note(0);
     int n = std::min<int32_t>(cap, NSTPU_CTR__COUNT);
     for (int i = 0; i < n; i++) out[i] = ctr[i].load(std::memory_order_relaxed);
     // read-and-reset max to current (kmod/nvme_strom.c:2087)
@@ -1058,6 +1113,16 @@ int nstpu_buf_unregister(uint64_t engine, int32_t slot) {
   Engine* e = lookup(engine);
   if (!e) return -ENOENT;
   return e->buf_unregister(slot);
+}
+
+int nstpu_engine_lat_hist(uint64_t engine, uint64_t* out, int32_t cap) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  if (!out || cap < 0) return -EINVAL;
+  int n = cap < NSTPU_LAT_BUCKETS ? cap : NSTPU_LAT_BUCKETS;
+  for (int i = 0; i < n; i++)
+    out[i] = e->lat_hist_[i].load(std::memory_order_relaxed);
+  return n;
 }
 
 int nstpu_engine_member_stats(uint64_t engine, int32_t member,

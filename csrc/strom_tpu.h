@@ -55,8 +55,17 @@ enum {
   NSTPU_CTR_NR_ENTER_DMA,       /* io_uring_enter submit syscalls (batched:
                                  * one covers a whole task's SQE batch, so
                                  * nr_enter_dma / nr_submit_dma ~ 1/N) */
+  NSTPU_CTR_OCC_INTEGRAL_NS,    /* sum(in_flight * dt) over in-flight
+                                 * transitions: mean queue occupancy over
+                                 * an interval is d(integral)/d(busy) */
+  NSTPU_CTR_OCC_BUSY_NS,        /* elapsed ns with in_flight > 0 */
   NSTPU_CTR__COUNT
 };
+
+/* log2-ns service-latency histogram depth for nstpu_engine_lat_hist():
+ * bucket b counts completed requests whose submit->completion time fell
+ * in [2^b, 2^(b+1)) ns. */
+#define NSTPU_LAT_BUCKETS 64
 
 /* request flags */
 #define NSTPU_REQ_WRITE 0x1   /* buffer -> file instead of file -> buffer */
@@ -130,6 +139,11 @@ int      nstpu_engine_reap(uint64_t engine, int64_t* failed_out, int32_t cap,
  * read-and-reset to the current in-flight count, like the reference's
  * STAT_INFO (kmod/nvme_strom.c:2087).  Returns entries written. */
 int      nstpu_engine_stats(uint64_t engine, uint64_t* out, int32_t cap);
+
+/* Copy the per-request service-latency histogram (NSTPU_LAT_BUCKETS
+ * log2-ns buckets, monotonic — callers delta successive reads).
+ * Returns entries written, or -errno. */
+int      nstpu_engine_lat_hist(uint64_t engine, uint64_t* out, int32_t cap);
 
 /* Per-member accounting: out3[0]=completed requests, out3[1]=bytes,
  * out3[2]=ns of request busy time.  Returns 0, -EINVAL for member out of

@@ -40,7 +40,16 @@ NATIVE_COUNTERS = (
     "total_write_length",
     "nr_fixed_dma",
     "nr_enter_dma",
+    # appended in API v1 (PR 4): queue-occupancy integral.  Older .so
+    # builds return fewer entries from nstpu_engine_stats; stats() simply
+    # omits the missing tail, so the binding stays compatible both ways.
+    "occ_integral_ns",
+    "occ_busy_ns",
 )
+
+#: log2-ns latency histogram depth — must match kNstpuLatBuckets in
+#: csrc/strom_engine.cc and stats.LAT_HIST_BUCKETS
+LAT_HIST_BUCKETS = 64
 
 REQ_WRITE = 0x1        # NSTPU_REQ_WRITE
 REQ_MEMBER_SHIFT = 8   # NSTPU_REQ_MEMBER_SHIFT
@@ -115,6 +124,12 @@ def _load() -> Optional[ctypes.CDLL]:
                                                  ctypes.c_int32]
         except AttributeError:  # pragma: no cover - older .so
             pass
+        try:
+            lib.nstpu_engine_lat_hist.argtypes = [
+                ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int32]
+        except AttributeError:  # pragma: no cover - older .so
+            pass
         _lib = lib
         return _lib
 
@@ -156,6 +171,7 @@ class NativeEngine:
             lib.nstpu_engine_backend(self._h), "unknown")
         self._prev_stats: Dict[str, int] = {}
         self._prev_members: Dict[int, Tuple[int, int, int]] = {}
+        self._prev_hist: List[int] = [0] * LAT_HIST_BUCKETS
         self._stats_lock = threading.Lock()
 
     def submit(self, dest_addr: int,
@@ -245,6 +261,28 @@ class NativeEngine:
                 else:
                     out[k] = v - prev.get(k, 0)
             return out
+
+    def lat_hist(self) -> Optional[List[int]]:
+        """Absolute per-request service-latency histogram (log2-ns
+        buckets), or None on an older .so without the export."""
+        if not hasattr(self._lib, "nstpu_engine_lat_hist"):
+            return None
+        out = (ctypes.c_uint64 * LAT_HIST_BUCKETS)()
+        n = self._lib.nstpu_engine_lat_hist(self._h, out, LAT_HIST_BUCKETS)
+        if n < 0:
+            return None
+        return list(out[:min(n, LAT_HIST_BUCKETS)])
+
+    def lat_hist_delta(self) -> Optional[List[int]]:
+        """Histogram bucket deltas since the previous call (serialized
+        like stats_delta so concurrent folders never double-count)."""
+        with self._stats_lock:
+            cur = self.lat_hist()
+            if cur is None:
+                return None
+            cur += [0] * (LAT_HIST_BUCKETS - len(cur))
+            prev, self._prev_hist = self._prev_hist, list(cur)
+            return [c - p for c, p in zip(cur, prev)]
 
     def member_stats_delta(self, members: Sequence[int]) -> Dict[int, Tuple[int, int, int]]:
         """Per-member (nreq, bytes, ns) deltas since the previous call,

@@ -227,6 +227,14 @@ STAT_FIELDS: Tuple[str, ...] = (
     "nr_csum_fail",           # page checksum mismatches observed
     "nr_csum_reread",         # re-reads issued to heal a checksum mismatch
     "nr_member_quarantine",   # member quarantine transitions (entries)
+    # queue-occupancy integral (PR 4 saturation work): occ_integral_ns
+    # accumulates sum(in_flight * dt) and occ_busy_ns the elapsed ns with
+    # in_flight > 0, so mean queue occupancy over an interval is
+    # d(occ_integral_ns) / d(occ_busy_ns) — the observable proof that the
+    # submission window held the device queue full across chunk
+    # boundaries instead of draining at each wait.
+    "occ_integral_ns",
+    "occ_busy_ns",
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

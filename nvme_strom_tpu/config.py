@@ -97,6 +97,14 @@ def _check_h2d_path(val: str, _cfg: "Config") -> None:
                           f"got {val!r}")
 
 
+def _check_coalesce_limit(val: int, cfg: "Config") -> None:
+    # 0 = coalescing off; otherwise the merge window must cover at least
+    # one dma_max_size request or planning could emit nothing mergeable
+    if val and val < cfg.get("dma_max_size"):
+        raise ConfigError(f"coalesce_limit {val} below dma_max_size "
+                          f"{cfg.get('dma_max_size')} (set 0 to disable)")
+
+
 def _check_buffer_multiple(val: int, cfg: "Config") -> None:
     chunk = cfg.get("chunk_size")
     if chunk and val % chunk:
@@ -260,6 +268,30 @@ class Config:
                      "(the reference's hard requirement, kmod/nvme_strom.c:"
                      "229-438); off by default because the engine can drive "
                      "any O_DIRECT file, at uncharacterized speed"))
+        # direct-path saturation knobs (PR 4): coalescing + pipelining
+        reg(Var("coalesce_limit", 8 << 20, "size", minval=0, maxval=256 << 20,
+                help="upper bound on a COALESCED direct read: file- and "
+                     "dest-contiguous extents within one member merge "
+                     "beyond dma_max_size up to this many bytes before "
+                     "submission (the reference's request-merge window, "
+                     "kmod/nvme_strom.c:1473-1505).  0 disables "
+                     "coalescing; must be >= dma_max_size when set",
+                validate=_check_coalesce_limit))
+        reg(Var("submit_window", 16, "int", minval=1, maxval=256,
+                help="chunks planned+submitted per submission slice of a "
+                     "multi-chunk read: the engine slices the chunk list "
+                     "into windows and pushes the next window while the "
+                     "previous is in flight, so queue occupancy does not "
+                     "drain at chunk-plan boundaries.  Smaller windows "
+                     "start the first I/O sooner but pay per-window "
+                     "submission overhead; 16 x 1MB chunks keeps both "
+                     "negligible on one disk"))
+        reg(Var("chunk_adaptive", True, "bool",
+                help="adapt the effective coalesced-request cap between "
+                     "dma_max_size and coalesce_limit from observed "
+                     "per-request service latency (AdaptiveH2DDepth "
+                     "analog on the SSD side); off pins the cap at "
+                     "coalesce_limit"))
         reg(Var("cache_arbitration", True, "bool",
                 help="probe the page cache and route hot chunks through the write-back path "
                      "(kmod/nvme_strom.c:1639-1663 analog)"))

@@ -211,6 +211,54 @@ class DeviceLoader:
                 except StromError:
                     pass
 
+    def epochs(self, n: Optional[int] = None) -> Iterator:
+        """Yield device batches for *n* epochs (forever when ``None``) with
+        the prefetch pipeline held full ACROSS epoch boundaries.
+
+        ``epoch()`` drains its in-flight ring when the epoch ends, so a
+        train loop calling it per epoch restarts the SSD pipeline cold
+        every ``batches_per_epoch`` steps; here the first batches of epoch
+        *e+1* are already in flight while the tail of epoch *e* is still
+        being consumed, so the device queue never drains at the boundary
+        (the cross-chunk submission-window discipline, one level up)."""
+        if self._closed:
+            raise StromError(_errno.EBADF, "loader closed")
+        k = self.chunks_per_batch
+        if self.batches_per_epoch == 0:
+            return
+
+        def batch_ids():
+            done = 0
+            while n is None or done < n:
+                e = self._epoch
+                self._epoch += 1
+                ids = self._epoch_ids(e)
+                for b in range(self.batches_per_epoch):
+                    yield ids[b * k:(b + 1) * k]
+                done += 1
+
+        from collections import deque
+        pending = deque()
+        g = 0  # global batch index: ring rotation ignores epoch boundaries
+        try:
+            for bid in batch_ids():
+                if len(pending) >= self.prefetch:
+                    # the next submit reuses the oldest ring's buffer, so
+                    # that batch must land on device first
+                    yield self._collect(*pending.popleft())
+                ring = g % self.prefetch
+                pending.append((ring, *self._submit(ring, bid)))
+                g += 1
+            while pending:
+                yield self._collect(*pending.popleft())
+        finally:
+            for item in pending:
+                try:
+                    self.session.memcpy_wait(item[2].dma_task_id,
+                                             timeout=30.0)
+                except StromError:
+                    pass
+
     def __iter__(self):
         return self.epoch()
 

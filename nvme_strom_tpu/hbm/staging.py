@@ -313,12 +313,14 @@ class StagingPipeline:
                 res = self.session.memcpy_wait(task_id)
                 _, dbuf = self._bufs[bufidx]
                 # last line of defense before bytes become device state:
-                # verify page checksums in the staging ring itself, so the
-                # write-back (page-cache) tier is covered too, not just the
-                # direct reads the engine already verified
+                # the direct tier was already verified by the engine at
+                # wait time (on this very retired slot — zero-copy, PR 4),
+                # so only the write-back (page-cache) tail still needs a
+                # staging-ring pass here
                 if config.get("checksum_verify"):
-                    self._verify_staged(source, res.chunk_ids, chunk_size,
-                                        dbuf.view()[:nbytes])
+                    self._verify_staged(
+                        source, res.chunk_ids[res.nr_ssd2dev:], chunk_size,
+                        dbuf.view()[res.nr_ssd2dev * chunk_size:nbytes])
                 out_ids.extend(res.chunk_ids)
                 nr_ssd += res.nr_ssd2dev
                 nr_ram += res.nr_ram2dev

@@ -25,7 +25,39 @@ from .api import STAT_FIELDS, StatInfo
 from .config import config
 
 __all__ = ["StatRegistry", "stats", "DEFAULT_STAT_EXPORT",
-           "STAT_EXPORT_DIR", "pid_export_path", "list_exports"]
+           "STAT_EXPORT_DIR", "pid_export_path", "list_exports",
+           "LAT_HIST_BUCKETS", "hist_percentiles"]
+
+#: per-request service-latency histogram: log2-ns buckets (bucket b covers
+#: [2^b, 2^(b+1)) ns), enough for 1ns..584y.  Matches the native engine's
+#: lat_hist so deltas fold 1:1.
+LAT_HIST_BUCKETS = 64
+
+
+def hist_percentiles(hist, qs=(0.50, 0.95, 0.99)):
+    """Percentile estimates (ns) from a log2 histogram, one per q in *qs*.
+
+    Each bucket's mass is placed at its geometric midpoint (1.5 * 2^b);
+    with power-of-two buckets the estimate is within ~1.5x of the true
+    value, which is the right resolution for latency triage (is p99 in
+    the us, ms, or s regime).  Returns None per q when the histogram is
+    empty."""
+    total = sum(hist)
+    out = []
+    for q in qs:
+        if total <= 0:
+            out.append(None)
+            continue
+        target = q * total
+        acc = 0
+        val = None
+        for b, n in enumerate(hist):
+            acc += n
+            if acc >= target and n:
+                val = (1 << b) + ((1 << b) >> 1)
+                break
+        out.append(val)
+    return out
 
 #: cross-process observability: the reference exposes counters through
 #: /proc/nvme-strom readable by nvme_stat from any process; here an exporter
@@ -83,6 +115,12 @@ class StatRegistry:
         # quarantines_entered, quarantined_now].  Kept separate from the
         # hot-path request triple so the common case stays a 3-add.
         self._member_health: dict = {}
+        # per-request service-latency histogram (log2-ns buckets) — the
+        # native engine keeps a matching one and its deltas fold in here
+        self._hist = [0] * LAT_HIST_BUCKETS
+        # last cur_dma_count transition timestamp for the occupancy
+        # integral (0 = no transition seen yet)
+        self._occ_last_ns = 0
 
     def enabled(self) -> bool:
         return bool(config.get("stat_info"))
@@ -113,8 +151,39 @@ class StatRegistry:
 
     def gauge_add(self, name: str, delta: int) -> int:
         with self._lock:
+            if name == "cur_dma_count":
+                # occupancy integral: account the interval that ends at
+                # this transition against the OLD in-flight level, so
+                # d(occ_integral_ns)/d(occ_busy_ns) is the time-weighted
+                # mean queue depth while the queue was non-empty
+                now = time.monotonic_ns()
+                cur = self._c["cur_dma_count"]
+                if self._occ_last_ns and cur > 0:
+                    dt = now - self._occ_last_ns
+                    self._c["occ_integral_ns"] += cur * dt
+                    self._c["occ_busy_ns"] += dt
+                self._occ_last_ns = now
             self._c[name] += delta
             return self._c[name]
+
+    def observe_latency(self, ns: int, n: int = 1) -> None:
+        """Record *n* request completions with service time *ns* into the
+        log2 latency histogram (tpu_stat derives p50/p95/p99 from it)."""
+        if not self.enabled():
+            return
+        b = min(max(int(ns), 1).bit_length() - 1, LAT_HIST_BUCKETS - 1)
+        with self._lock:
+            self._hist[b] += n
+
+    def merge_native_hist(self, deltas) -> None:
+        """Fold a native-engine latency-histogram *delta* (bucket counts)."""
+        with self._lock:
+            for i, v in enumerate(deltas[:LAT_HIST_BUCKETS]):
+                self._hist[i] += v
+
+    def lat_hist_snapshot(self) -> list:
+        with self._lock:
+            return list(self._hist)
 
     def member_add(self, member: int, nbytes: int, ns: int, n: int = 1) -> None:
         """Account one request against a stripe member (part_stat_add
@@ -277,7 +346,8 @@ class StatRegistry:
         snap = self.snapshot(debug=True, reset_max=False)
         payload = {"timestamp_ns": snap.timestamp_ns, "pid": os.getpid(),
                    "version": snap.version, "counters": snap.counters,
-                   "members": self.member_snapshot()}
+                   "members": self.member_snapshot(),
+                   "lat_hist": self.lat_hist_snapshot()}
         try:
             # mkstemp: O_EXCL private temp (no symlink following in shared
             # /tmp), then atomic replace

@@ -25,6 +25,8 @@ import os
 import sys
 import time
 
+from ..stats import hist_percentiles
+
 
 def show_avg(clk_ns: float, count: float) -> str:
     """Adaptive-unit average latency (reference show_avg8, nvme_stat.c:28-50)."""
@@ -48,7 +50,25 @@ def _read(path: str):
         return None
 
 
-def _row(cur: dict, prev: dict, verbose: bool) -> str:
+def _pshow(ns) -> str:
+    """One latency percentile with adaptive units (None = no samples)."""
+    return show_avg(ns, 1) if ns is not None else "   --  "
+
+
+def _hist_delta(cur: dict, prev: dict):
+    """Interval delta of the log2-ns latency histogram (tolerates either
+    snapshot missing it — e.g. attaching to an older exporter)."""
+    ch = cur.get("lat_hist") or []
+    ph = prev.get("lat_hist") or []
+    if not ch:
+        return None
+    ph = ph + [0] * (len(ch) - len(ph))
+    return [a - b for a, b in zip(ch, ph)]
+
+
+def _row(cur_snap: dict, prev_snap: dict, verbose: bool) -> str:
+    cur = cur_snap.get("counters", {})
+    prev = prev_snap.get("counters", {})
     d = {k: cur.get(k, 0) - prev.get(k, 0) for k in cur}
     g = cur  # gauges are point-in-time
     nsub = d.get("nr_submit_dma", 0)
@@ -82,6 +102,16 @@ def _row(cur: dict, prev: dict, verbose: bool) -> str:
             f"{d.get('nr_csum_fail', 0):5d}",
             f"{d.get('nr_member_quarantine', 0):5d}",
         ]
+        # saturation telemetry (PR 4): per-request service-latency
+        # percentiles over this interval and the mean device-queue
+        # occupancy while busy — occ ~ queue_depth means the submission
+        # window held the queue full; occ sagging toward 1 means the
+        # pipeline drained between chunks
+        hd = _hist_delta(cur_snap, prev_snap)
+        p50, p95, p99 = hist_percentiles(hd) if hd else (None, None, None)
+        occ_b = d.get("occ_busy_ns", 0)
+        occ = d.get("occ_integral_ns", 0) / occ_b if occ_b else 0.0
+        cols += [_pshow(p50), _pshow(p95), _pshow(p99), f"{occ:5.1f}"]
     return " ".join(cols)
 
 
@@ -90,7 +120,7 @@ def _header(verbose: bool) -> str:
     if verbose:
         cols += ["plan   ", "sq-sub ", "enters", "resub ", "sqfull",
                  "h2d   ", "fixed ", "retry", "fallbk", " tmo", " csum",
-                 "quar "]
+                 "quar ", "p50    ", "p95    ", "p99    ", "  occ"]
     return " ".join(cols)
 
 
@@ -198,6 +228,18 @@ def main(argv=None) -> int:
         width = max(len(k) for k in c)
         for k in sorted(c):
             print(f"  {k:<{width}} {c[k]}")
+        if args.verbose:
+            # lifetime latency percentiles + mean queue occupancy (PR 4)
+            hist = snap.get("lat_hist") or []
+            if any(hist):
+                p50, p95, p99 = hist_percentiles(hist)
+                print(f"latency: p50 {_pshow(p50).strip()}  "
+                      f"p95 {_pshow(p95).strip()}  "
+                      f"p99 {_pshow(p99).strip()}")
+            occ_b = c.get("occ_busy_ns", 0)
+            if occ_b:
+                print(f"mean queue occupancy (busy): "
+                      f"{c.get('occ_integral_ns', 0) / occ_b:.2f}")
         if args.verbose and snap.get("members"):
             # per-stripe-member breakdown (part_stat_add analog): a slow
             # member shows as an outlier avg-lat at similar req/byte counts
@@ -211,7 +253,7 @@ def main(argv=None) -> int:
                       f"  {show_avg(v['clk_ns'], v['nreq'])} {health}")
         return 0
 
-    prev = snap["counters"]
+    prev = snap
     n = 0
     try:
         while True:
@@ -221,8 +263,8 @@ def main(argv=None) -> int:
                 continue
             if n % 20 == 0:
                 print(_header(args.verbose), flush=True)
-            print(_row(snap["counters"], prev, args.verbose), flush=True)
-            prev = snap["counters"]
+            print(_row(snap, prev, args.verbose), flush=True)
+            prev = snap
             n += 1
     except KeyboardInterrupt:
         return 0

@@ -19,6 +19,15 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Optional dev dependency: without hypothesis the property suite cannot
+# even collect, which used to fail every marker-filtered run (e.g. the
+# bench-smoke perf gate) on a collection error unrelated to the filter.
+collect_ignore = []
+try:
+    import hypothesis  # noqa: F401
+except ImportError:
+    collect_ignore.append("test_property.py")
+
 
 @pytest.fixture()
 def tmp_data_file(tmp_path):

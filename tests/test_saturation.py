@@ -1,0 +1,311 @@
+"""Direct-path saturation tests (PR 4): cross-chunk submission windows,
+extent coalescing (vectored reads), adaptive chunk sizing, wait-time
+checksum verification on the zero-copy native path, and the new
+occupancy/latency telemetry."""
+
+import errno
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu import Session, StromError, config, stats
+from nvme_strom_tpu.api import ErrorClass
+from nvme_strom_tpu.engine import (AdaptiveChunkSizer, PlainSource, Request,
+                                   Source, plan_requests)
+from nvme_strom_tpu.testing import make_test_file
+
+CHUNK = 64 << 10
+
+
+def _counter_delta(before, after, name):
+    return after.counters.get(name, 0) - before.counters.get(name, 0)
+
+
+def _native_session_possible():
+    from nvme_strom_tpu import _native
+    return _native.native_available()
+
+
+# ---------------------------------------------------------------------------
+# cross-chunk pipelined submission
+# ---------------------------------------------------------------------------
+
+@pytest.mark.perf
+def test_submission_window_never_drains_queue(tmp_path):
+    """Queue occupancy must not hit zero between chunk windows: the
+    sliding submission window keeps later chunks' requests queued while
+    earlier ones are still in flight.  A regression that turns the
+    window into a barrier (drain at each window boundary) drops the
+    instrumented in-flight level to zero mid-task."""
+    n = 16
+    path = str(tmp_path / "win.bin")
+    make_test_file(path, n * CHUNK)
+    events = []   # (monotonic_ns, +1/-1) read start/end transitions
+    lock = threading.Lock()
+
+    class InstrumentedSource(PlainSource):
+        # class-level override -> the instrumented Python pool path
+        def read_member_direct(self, member, file_off, buf):
+            with lock:
+                events.append((time.monotonic_ns(), +1))
+            try:
+                super().read_member_direct(member, file_off, buf)
+                time.sleep(0.005)   # service time >> submission gaps
+            finally:
+                with lock:
+                    events.append((time.monotonic_ns(), -1))
+
+    config.set("dma_max_size", CHUNK)       # one request per chunk
+    config.set("submit_window", 4)          # several windows per task
+    config.set("cache_arbitration", False)  # keep every chunk direct
+    src = InstrumentedSource(path)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(n * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(n)), CHUNK)
+            assert res.nr_ssd2dev == n
+            sess.memcpy_wait(res.dma_task_id)
+    finally:
+        src.close()
+    assert len(events) == 2 * n
+    events.sort()
+    level = 0
+    zero_crossings = 0
+    for _, d in events:
+        level += d
+        if level == 0:
+            zero_crossings += 1
+    # the in-flight level reaches zero exactly once: at task completion,
+    # never between windows
+    assert zero_crossings == 1, (
+        f"queue drained {zero_crossings - 1} time(s) mid-task")
+
+
+# ---------------------------------------------------------------------------
+# extent coalescing
+# ---------------------------------------------------------------------------
+
+def _make_striped(tmp_path, n_members=2, stripe_chunk=CHUNK, total=8 * CHUNK):
+    from nvme_strom_tpu.engine import open_source
+    rng = np.random.default_rng(11)
+    paths = []
+    per_member = total // n_members
+    for i in range(n_members):
+        p = str(tmp_path / f"m{i}.bin")
+        with open(p, "wb") as f:
+            f.write(rng.integers(0, 256, per_member, dtype=np.uint8).tobytes())
+        paths.append(p)
+    return open_source(paths, stripe_chunk_size=stripe_chunk)
+
+
+def test_coalescing_produces_vectored_requests(tmp_path):
+    """Striped neighbours within one member are file-contiguous but land
+    at interleaved destinations: the coalescer must merge them into one
+    vectored request per member whose segments reproduce the classic
+    plan's byte map exactly."""
+    src = _make_striped(tmp_path)
+    try:
+        entries = [(i, i) for i in range(8)]
+        classic = plan_requests(src, entries, CHUNK, 0)
+        coalesced = plan_requests(src, entries, CHUNK, 0,
+                                  coalesce_limit=8 << 20)
+        assert len(coalesced) < len(classic)
+        assert any(r.dest_segs for r in coalesced)
+        for r in coalesced:
+            assert not r.buffered
+
+        def byte_map(reqs):
+            # (member, file_off) -> dest_off, per byte-run
+            m = {}
+            for r in reqs:
+                segs = r.dest_segs or ((r.dest_off, r.length),)
+                foff = r.file_off
+                for d, ln in segs:
+                    m[(r.member, foff, ln)] = d
+                    foff += ln
+            return m
+
+        # every classic extent is covered at the same destination
+        cm = byte_map(classic)
+        xm = byte_map(coalesced)
+        cover = {}
+        for (mem, foff, ln), d in xm.items():
+            for b in range(0, ln, CHUNK):
+                cover[(mem, foff + b)] = d + b
+        for (mem, foff, ln), d in cm.items():
+            assert cover[(mem, foff)] == d
+    finally:
+        src.close()
+
+
+def test_coalescing_byte_identity_across_stripes(tmp_path):
+    """End-to-end: the same striped copy with coalescing off and on must
+    land byte-identical data (the classic plan is the oracle)."""
+    src = _make_striped(tmp_path, n_members=2, total=16 * CHUNK)
+    config.set("cache_arbitration", False)
+
+    def run():
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(16 * CHUNK)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(16)), CHUNK)
+            sess.memcpy_wait(res.dma_task_id)
+            return bytes(buf.view()[:16 * CHUNK])
+
+    try:
+        config.set("coalesce_limit", 0)           # classic planning
+        want = run()
+        config.set("coalesce_limit", 8 << 20)     # vectored coalescing
+        config.set("chunk_adaptive", False)       # full cap, deterministic
+        got = run()
+    finally:
+        src.close()
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# zero-copy wait-time verification x fault ladder
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not _native_session_possible(),
+                    reason="native engine unavailable")
+def test_native_zero_copy_checksum_latches_ebadmsg(tmp_path):
+    """Checksum mismatch on a natively-landed (zero-copy) slot must still
+    walk the PR 1 ladder: re-read up to checksum_retries, then latch
+    EBADMSG/CORRUPTION at wait time.  The instance-level read trace
+    proves the landing reads did NOT go through the Python read leg
+    (native path held) while the heal re-reads did."""
+    from nvme_strom_tpu.scan.heap import PAGE_SIZE, HeapSchema, build_heap_file
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 4   # 4 pages
+    path = str(tmp_path / "csum.heap")
+    build_heap_file(path,
+                    [np.arange(n, dtype=np.int32),
+                     (n - np.arange(n)).astype(np.int32)], schema)
+    # corrupt page 2 ON DISK: every read path sees the same bad byte, so
+    # re-reads cannot heal and the error must latch
+    with open(path, "r+b") as f:
+        f.seek(2 * PAGE_SIZE + 300)
+        b = f.read(1)
+        f.seek(2 * PAGE_SIZE + 300)
+        f.write(bytes([b[0] ^ 0xFF]))
+    nbytes = os.path.getsize(path)
+
+    config.set("checksum_verify", True)
+    config.set("checksum_retries", 2)
+    config.set("cache_arbitration", False)
+    src = PlainSource(path)
+    calls = []
+    orig = src.read_member_direct
+
+    def traced(member, file_off, buf):
+        calls.append((member, file_off, len(buf)))
+        return orig(member, file_off, buf)
+
+    # instance attribute: type(src).read_member_direct is unchanged, so
+    # the native gate stays OPEN — but verify re-reads hit this trace
+    src.read_member_direct = traced
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            if sess._native is None:
+                pytest.skip("session came up without the native engine")
+            handle, _ = sess.alloc_dma_buffer(nbytes)
+            res = sess.memcpy_ssd2ram(src, handle,
+                                      list(range(nbytes // PAGE_SIZE)),
+                                      PAGE_SIZE)
+            with pytest.raises(StromError) as ei:
+                sess.memcpy_wait(res.dma_task_id, timeout=30.0)
+            assert ei.value.errno == errno.EBADMSG
+            assert ei.value.error_class is ErrorClass.CORRUPTION
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_csum_fail") > 0
+    assert _counter_delta(before, after, "nr_csum_reread") > 0
+    # landing was zero-copy: the only Python-leg reads are the heal
+    # re-reads of the corrupted page
+    assert calls, "verify never re-read"
+    for _, off, ln in calls:
+        assert 2 * PAGE_SIZE <= off < 3 * PAGE_SIZE
+        assert ln == PAGE_SIZE
+
+
+# ---------------------------------------------------------------------------
+# adaptive chunk sizing
+# ---------------------------------------------------------------------------
+
+def test_adaptive_chunk_sizer_tracks_latency():
+    s = AdaptiveChunkSizer(1 << 20, 8 << 20, decay_after=2)
+    assert s.effective == 8 << 20          # optimistic start
+    s.observe(AdaptiveChunkSizer.LAT_BUDGET_NS * 2)
+    assert s.effective == 4 << 20          # slow -> halve
+    for _ in range(8):
+        s.observe(AdaptiveChunkSizer.LAT_BUDGET_NS * 2)
+    assert s.effective == 1 << 20          # clamped at the floor
+    for _ in range(16):
+        s.observe(AdaptiveChunkSizer.LAT_BUDGET_NS // 100)
+    assert s.effective == 8 << 20          # sustained fast -> back to limit
+
+
+# ---------------------------------------------------------------------------
+# telemetry: occupancy gauge + latency histogram
+# ---------------------------------------------------------------------------
+
+def test_occupancy_and_histogram_counters_move(tmp_data_file):
+    from nvme_strom_tpu.stats import hist_percentiles
+    config.set("cache_arbitration", False)
+    src = PlainSource(tmp_data_file)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(4 << 20)
+            res = sess.memcpy_ssd2ram(src, handle, list(range(64)), CHUNK)
+            sess.memcpy_wait(res.dma_task_id)
+            after = sess.stat_info()
+    finally:
+        src.close()
+    assert _counter_delta(before, after, "occ_busy_ns") > 0
+    assert _counter_delta(before, after, "occ_integral_ns") > 0
+    # mean occupancy over the run is >= 1 whenever busy time is counted
+    busy = _counter_delta(before, after, "occ_busy_ns")
+    integ = _counter_delta(before, after, "occ_integral_ns")
+    assert integ >= busy
+    # the per-request latency histogram saw every direct request
+    hist = stats.lat_hist_snapshot()
+    assert sum(hist) > 0
+    p50, p95, p99 = hist_percentiles(hist)
+    assert p50 is not None and p50 <= p95 <= p99
+
+
+def test_hist_percentiles_empty_and_monotone():
+    from nvme_strom_tpu.stats import LAT_HIST_BUCKETS, hist_percentiles
+    assert hist_percentiles([0] * LAT_HIST_BUCKETS) == [None, None, None]
+    h = [0] * LAT_HIST_BUCKETS
+    h[10] = 90
+    h[20] = 10
+    p50, p95, p99 = hist_percentiles(h)
+    assert p50 == (1 << 10) + (1 << 9)
+    assert p95 == p99 == (1 << 20) + (1 << 19)
+
+
+# ---------------------------------------------------------------------------
+# cross-epoch loader pipelining
+# ---------------------------------------------------------------------------
+
+def test_loader_epochs_pipelines_across_boundary(tmp_path):
+    from nvme_strom_tpu.data import DeviceLoader, RecordDataset, write_records
+    p = str(tmp_path / "r.npr")
+    data = np.arange(64 * 16, dtype=np.float32).reshape(64, 16)
+    write_records(p, data)
+    ds = RecordDataset(p)
+    with DeviceLoader(ds, batch_records=16, chunk_size=4096, shuffle=3) as dl:
+        got = [np.asarray(b) for b in dl.epochs(2)]
+        assert len(got) == 2 * dl.batches_per_epoch
+    with DeviceLoader(ds, batch_records=16, chunk_size=4096, shuffle=3) as dl:
+        want = [np.asarray(b) for b in dl.epoch(0)] \
+            + [np.asarray(b) for b in dl.epoch(1)]
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
