@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults test check bench-smoke probe-loop clean
+.PHONY: all native tsan stress stress-faults test check bench-smoke bench-stripe probe-loop clean
 
 all: native
 
@@ -55,8 +55,19 @@ bench-smoke:
 	python -c 'import json,sys; rows=[json.loads(l) for l in sys.stdin if l.lstrip().startswith("{")]; assert rows, "bench emitted no JSON row"; v=rows[-1].get("value") or 0; assert v > 0, "zero throughput: %r" % rows[-1]; print("bench-smoke ok: %s %s" % (v, rows[-1].get("unit", "")))'
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m perf
 
-# The everyday gate: tier-1 tests plus the perf smoke.
-check: bench-smoke
+# Member-lane scale-out smoke (PR 5): the 2-member latency-bound
+# synthetic must beat single-member through the engine's per-member
+# submission lanes (ratio > 1.0) — deterministic on any disk, since the
+# synthetic curve is bounded by aggregate in-flight window, not media.
+# The full 1/2/4 curve (real files + synthetic, journaled to
+# STRIPE_SCALING.jsonl) is `python bench.py --stripe-scaling`.
+bench-stripe:
+	BENCH_SMOKE=1 BENCH_STRIPE_MEMBERS=1,2 BENCH_STRIPE_MIN_RATIO=1.0 \
+	  JAX_PLATFORMS=cpu python bench.py --stripe-scaling
+	@echo "bench-stripe ok"
+
+# The everyday gate: tier-1 tests plus the perf smokes.
+check: bench-smoke bench-stripe
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

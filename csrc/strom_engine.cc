@@ -23,6 +23,7 @@
 #include <errno.h>
 #include <fcntl.h>
 #include <linux/io_uring.h>
+#include <pthread.h>
 #include <sys/mman.h>
 #include <sys/syscall.h>
 #include <sys/uio.h>
@@ -211,22 +212,30 @@ struct ReqCtx {
   std::atomic<uint32_t> published{0};
 };
 
-// One io_uring with its own submit lock, completion reaper, and in-flight
-// window — the per-NVMe-device hardware queue analog: the reference
-// submits each merged request onto the owning device's own blk-mq queue
-// (kmod/nvme_strom.c:1201-1223) with independent in-flight across devices
-// (:1585-1586).  Stripe members map onto rings (member % nrings), so a
-// 4-member RAID-0 submits and completes on 4 independent queues instead
-// of funneling through one lock + one reaper.
+// One LANE: an independent queue pair with its own submit lock, completion
+// service threads, and in-flight window — the per-NVMe-device hardware
+// queue analog: the reference submits each merged request onto the owning
+// device's own blk-mq queue (kmod/nvme_strom.c:1201-1223) with independent
+// in-flight across devices (:1585-1586).  Stripe members map onto lanes
+// (member % nlanes), so a 4-member RAID-0 submits and completes on 4
+// independent queues instead of funneling through one lock + one reaper,
+// and a slow member queues behind itself, never behind its siblings.
+// On the io_uring backend a lane is a ring + reaper; on the threadpool
+// backend it is a request deque + worker set (ring.fd stays -1).
 struct RingCtx {
   Uring ring;
   std::mutex sq_m;
   std::thread reaper;
-  // per-ring bounded in-flight window (CQ can never overflow); members on
-  // different rings do not throttle each other
+  // per-lane bounded in-flight window (CQ can never overflow); members on
+  // different lanes do not throttle each other
   std::mutex win_m;
   std::condition_variable win_cv;
   unsigned win_inflight = 0;
+  // threadpool-lane queue (unused on the io_uring backend)
+  std::mutex q_m;
+  std::condition_variable q_cv;
+  std::deque<ReqCtx*> q;
+  std::vector<std::thread> workers;
 };
 
 // ---------------------------------------------------------------------------
@@ -244,28 +253,29 @@ struct Engine {
   std::atomic<int64_t> next_task{1};
   std::atomic<bool> stopping{false};
 
-  // bounded in-flight window for the THREADPOOL backend (io_uring rings
-  // each carry their own window in RingCtx)
-  std::mutex inflight_m;
-  std::condition_variable inflight_cv;
-  unsigned inflight = 0;
-
   // queue-occupancy integral: the interval ending at each in-flight
   // transition is accounted against the OLD level, so mean occupancy
   // over a stats window is d(OCC_INTEGRAL_NS)/d(OCC_BUSY_NS) — the
   // direct observable for "the submission window held the queue full".
-  // Aggregated across rings (the planner's queue_depth contract is
-  // per-engine, and tpu_stat shows one gauge).
+  // Aggregated across lanes (the planner's queue_depth contract is
+  // per-engine, and tpu_stat shows one gauge); the per-member integrals
+  // below are the per-lane breakdown tpu_stat -v shows per member.
   std::mutex occ_m;
   uint64_t occ_last_ns = 0;
   uint64_t occ_cur = 0;
+  uint64_t m_occ_last[NSTPU_MAX_MEMBERS] = {};
+  uint64_t m_occ_cur[NSTPU_MAX_MEMBERS] = {};
+  uint64_t m_occ_integral[NSTPU_MAX_MEMBERS] = {};
+  uint64_t m_occ_busy[NSTPU_MAX_MEMBERS] = {};
 
   // per-request service-latency histogram: log2-ns buckets filled at
   // completion (submit->completion per request, the per-chunk latency
-  // the adaptive sizer and tpu_stat percentiles consume)
+  // the adaptive sizer and tpu_stat percentiles consume); the per-member
+  // planes feed per-member percentiles and the per-member adaptive sizer
   std::atomic<uint64_t> lat_hist_[NSTPU_LAT_BUCKETS];
+  std::atomic<uint64_t> member_hist_[NSTPU_MAX_MEMBERS][NSTPU_LAT_BUCKETS];
 
-  void occ_note(int delta) {
+  void occ_note(int delta, int member = -1) {
     uint64_t now = now_ns();
     std::lock_guard<std::mutex> lk(occ_m);
     if (occ_last_ns && occ_cur) {
@@ -276,9 +286,35 @@ struct Engine {
     }
     occ_last_ns = now;
     occ_cur = (uint64_t)((int64_t)occ_cur + delta);
+    if (member >= 0 && member < NSTPU_MAX_MEMBERS) {
+      if (m_occ_last[member] && m_occ_cur[member]) {
+        uint64_t dt = now - m_occ_last[member];
+        m_occ_integral[member] += m_occ_cur[member] * dt;
+        m_occ_busy[member] += dt;
+      }
+      m_occ_last[member] = now;
+      m_occ_cur[member] = (uint64_t)((int64_t)m_occ_cur[member] + delta);
+    }
   }
 
-  // io_uring backend: one ring per (member % nrings) — see RingCtx
+  int member_occ(int32_t member, uint64_t* out2) {
+    if (member < 0 || member >= NSTPU_MAX_MEMBERS || !out2) return -EINVAL;
+    uint64_t now = now_ns();
+    std::lock_guard<std::mutex> lk(occ_m);
+    // bring the integral current: it only advances on transitions, so a
+    // long steady interval would otherwise undercount (stats() analog)
+    if (m_occ_last[member] && m_occ_cur[member]) {
+      uint64_t dt = now - m_occ_last[member];
+      m_occ_integral[member] += m_occ_cur[member] * dt;
+      m_occ_busy[member] += dt;
+      m_occ_last[member] = now;
+    }
+    out2[0] = m_occ_integral[member];
+    out2[1] = m_occ_busy[member];
+    return 0;
+  }
+
+  // one lane per (member % nlanes), BOTH backends — see RingCtx
   std::vector<RingCtx*> rings;
 
   // registered (fixed) buffer table — the PRP-list-pool analog
@@ -295,12 +331,6 @@ struct Engine {
   std::mutex fixed_m;
   FixedReg fixed[kFixedSlots];
   bool fixed_ok = false;
-
-  // threadpool backend
-  std::mutex q_m;
-  std::condition_variable q_cv;
-  std::deque<ReqCtx*> queue;
-  std::vector<std::thread> workers;
 
   Slot& slot_of(int64_t id) { return slots[id % kTaskSlots]; }
 
@@ -370,6 +400,8 @@ struct Engine {
     for (auto& row : member_ctr)
       for (auto& c : row) c.store(0);
     for (auto& b : lat_hist_) b.store(0);
+    for (auto& row : member_hist_)
+      for (auto& b : row) b.store(0);
     depth = queue_depth > 0 ? (unsigned)queue_depth : 32u;
     // NSTPU_DISABLE_URING=1 makes io_uring setup "fail" deterministically:
     // AUTO falls over to the threadpool (the graceful-degradation path the
@@ -421,9 +453,16 @@ struct Engine {
       if (want_backend == NSTPU_BACKEND_IO_URING) return false;
     }
     backend = NSTPU_BACKEND_THREADPOOL;
+    // same lane topology as the uring backend: nlanes independent
+    // deque+worker sets, member % nlanes routing, per-lane windows —
+    // the fallback path keeps the scale-out property
+    unsigned nlanes = nrings_want ? nrings_want : want_rings();
     unsigned nthreads = std::min(depth, 16u);
-    for (unsigned i = 0; i < nthreads; i++)
-      workers.emplace_back([this] { worker_loop(); });
+    unsigned per_lane = std::max(1u, nthreads / nlanes);
+    for (unsigned i = 0; i < nlanes; i++) rings.push_back(new RingCtx());
+    for (auto* rx : rings)
+      for (unsigned i = 0; i < per_lane; i++)
+        rx->workers.emplace_back([this, rx] { worker_loop(rx); });
     return true;
   }
 
@@ -446,11 +485,13 @@ struct Engine {
         rx->ring.destroy();
       }
     } else {
-      q_cv.notify_all();
-      for (auto& w : workers)
-        if (w.joinable()) w.join();
+      for (auto* rx : rings) {
+        rx->q_cv.notify_all();
+        rx->win_cv.notify_all();
+        for (auto& w : rx->workers)
+          if (w.joinable()) w.join();
+      }
     }
-    inflight_cv.notify_all();
   }
 
   // ---- task lifecycle ----------------------------------------------------
@@ -505,6 +546,7 @@ struct Engine {
     // log2 bucket: 63 - clz(ns), clamped (ns|1 keeps clz defined at 0)
     int bucket = 63 - __builtin_clzll(service_ns | 1);
     lat_hist_[bucket].fetch_add(1, std::memory_order_relaxed);
+    member_hist_[rc->member][bucket].fetch_add(1, std::memory_order_relaxed);
     // drop the in-flight slot before waking the task's waiter, so a
     // post-wait stats snapshot never sees a stale cur_dma_count
     drop_inflight_slot(rc);
@@ -513,23 +555,15 @@ struct Engine {
   }
 
   void drop_inflight_slot(ReqCtx* rc) {
-    if (backend == NSTPU_BACKEND_IO_URING) {
-      RingCtx& rx = ring_of(rc);
-      {
-        std::lock_guard<std::mutex> lk(rx.win_m);
-        rx.win_inflight--;
-      }
-      rx.win_cv.notify_one();
-      ctr[NSTPU_CTR_CUR_DMA_COUNT].fetch_sub(1, std::memory_order_relaxed);
-    } else {
-      {
-        std::lock_guard<std::mutex> lk(inflight_m);
-        inflight--;
-      }
-      inflight_cv.notify_one();
-      ctr[NSTPU_CTR_CUR_DMA_COUNT].fetch_sub(1, std::memory_order_relaxed);
+    // both backends: the window slot lives on the owning lane
+    RingCtx& rx = ring_of(rc);
+    {
+      std::lock_guard<std::mutex> lk(rx.win_m);
+      rx.win_inflight--;
     }
-    occ_note(-1);
+    rx.win_cv.notify_one();
+    ctr[NSTPU_CTR_CUR_DMA_COUNT].fetch_sub(1, std::memory_order_relaxed);
+    occ_note(-1, rc->member);
   }
 
   // ---- io_uring backend --------------------------------------------------
@@ -641,15 +675,16 @@ struct Engine {
 
   // ---- threadpool backend ------------------------------------------------
 
-  void worker_loop() {
+  void worker_loop(RingCtx* rxp) {
+    RingCtx& rx = *rxp;
     for (;;) {
       ReqCtx* rc;
       {
-        std::unique_lock<std::mutex> lk(q_m);
-        q_cv.wait(lk, [this] { return stopping.load() || !queue.empty(); });
-        if (queue.empty()) return;  // stopping
-        rc = queue.front();
-        queue.pop_front();
+        std::unique_lock<std::mutex> lk(rx.q_m);
+        rx.q_cv.wait(lk, [this, &rx] { return stopping.load() || !rx.q.empty(); });
+        if (rx.q.empty()) return;  // stopping
+        rc = rx.q.front();
+        rx.q.pop_front();
       }
       int err = 0;
       while (rc->remaining > 0) {
@@ -757,19 +792,23 @@ struct Engine {
                             now_ns()};
       task_get(t);
       bool shut = false;
-      if (uring) {
-        // member -> ring: each stripe member submits/completes on its own
-        // queue, like the reference's per-device blk-mq HW queues
+      {
+        // member -> lane: each stripe member submits/completes on its own
+        // queue, like the reference's per-device blk-mq HW queues; both
+        // backends carry the window on the lane, so a slow member only
+        // throttles submissions bound for itself
         rc->ring_idx = (uint8_t)(member % rings.size());
         RingCtx& rx = *rings[rc->ring_idx];
         std::unique_lock<std::mutex> lk(rx.win_m);
         if (rx.win_inflight >= depth) {
           ctr[NSTPU_CTR_NR_SQ_FULL].fetch_add(1, std::memory_order_relaxed);
-          // the window can only drain if our queued-but-unentered SQEs
-          // reach the kernel: flush before sleeping
-          lk.unlock();
-          flush_all();
-          lk.lock();
+          if (uring) {
+            // the window can only drain if our queued-but-unentered SQEs
+            // reach the kernel: flush before sleeping
+            lk.unlock();
+            flush_all();
+            lk.lock();
+          }
         }
         rx.win_cv.wait(lk, [this, &rx] {
           return rx.win_inflight < depth || stopping.load();
@@ -778,17 +817,6 @@ struct Engine {
           shut = true;
         else
           rx.win_inflight++;
-      } else {
-        std::unique_lock<std::mutex> lk(inflight_m);
-        if (inflight >= depth)
-          ctr[NSTPU_CTR_NR_SQ_FULL].fetch_add(1, std::memory_order_relaxed);
-        inflight_cv.wait(lk, [this] {
-          return inflight < depth || stopping.load();
-        });
-        if (stopping.load())
-          shut = true;
-        else
-          inflight++;
       }
       if (shut) {
         task_put(t, ESHUTDOWN);
@@ -812,7 +840,7 @@ struct Engine {
           ctr[NSTPU_CTR_CUR_DMA_COUNT].fetch_add(1, std::memory_order_relaxed)
           + 1;
       atomic_max(ctr[NSTPU_CTR_MAX_DMA_COUNT], cur);
-      occ_note(+1);
+      occ_note(+1, (int)member);
       ctr[NSTPU_CTR_TOTAL_DMA_LENGTH].fetch_add(reqs[i].len,
                                                 std::memory_order_relaxed);
       ctr[NSTPU_CTR_NR_SUBMIT_DMA].fetch_add(1, std::memory_order_relaxed);
@@ -828,11 +856,12 @@ struct Engine {
         if (batches[rc->ring_idx].size() >= depth)
           flush_ring_batch(t, batches[rc->ring_idx], *rings[rc->ring_idx]);
       } else {
+        RingCtx& rx = *rings[rc->ring_idx];
         {
-          std::lock_guard<std::mutex> lk(q_m);
-          queue.push_back(rc);
+          std::lock_guard<std::mutex> lk(rx.q_m);
+          rx.q.push_back(rc);
         }
-        q_cv.notify_one();
+        rx.q_cv.notify_one();
       }
     }
     if (uring) flush_all();
@@ -921,6 +950,30 @@ struct Engine {
       }
     }
     return nfailed < cap ? nfailed : (cap > 0 ? cap : 0);
+  }
+
+  // pin one lane's service threads (reaper + workers) to a CPU set — the
+  // NUMA lever: completion reaping and the landing memcpy stay on the
+  // member device's local node (pgsql NUMA pool analog, :1454-1526)
+  int lane_pin(int32_t lane, const int32_t* cpus, int32_t ncpus) {
+    if (stopping.load()) return -ESHUTDOWN;
+    if (lane < 0 || (size_t)lane >= rings.size() || !cpus || ncpus <= 0)
+      return -EINVAL;
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    for (int32_t i = 0; i < ncpus; i++)
+      if (cpus[i] >= 0 && cpus[i] < CPU_SETSIZE) CPU_SET(cpus[i], &set);
+    if (CPU_COUNT(&set) == 0) return -EINVAL;
+    RingCtx& rx = *rings[lane];
+    int rc = 0;
+    if (rx.reaper.joinable())
+      rc = pthread_setaffinity_np(rx.reaper.native_handle(), sizeof set, &set);
+    for (auto& w : rx.workers)
+      if (w.joinable()) {
+        int r = pthread_setaffinity_np(w.native_handle(), sizeof set, &set);
+        if (r) rc = r;
+      }
+    return rc ? -rc : 0;
   }
 
   int stats(uint64_t* out, int32_t cap) {
@@ -1027,7 +1080,7 @@ const char* nstpu_signature(void) {
 #define NSTPU_BUILD_TS __DATE__ " " __TIME__
 #endif
   return "strom_tpu native engine api " /* api version stringized below */
-         "v1, built " NSTPU_BUILD_TS
+         "v2, built " NSTPU_BUILD_TS
 #ifdef __clang__
          ", clang"
 #elif defined(__GNUC__)
@@ -1133,6 +1186,37 @@ int nstpu_engine_member_stats(uint64_t engine, int32_t member,
   for (int i = 0; i < 3; i++)
     out3[i] = e->member_ctr[member][i].load(std::memory_order_relaxed);
   return 0;
+}
+
+int nstpu_engine_nlanes(uint64_t engine) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  return (int)e->rings.size();
+}
+
+int nstpu_engine_lane_pin(uint64_t engine, int32_t lane, const int32_t* cpus,
+                          int32_t ncpus) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  return e->lane_pin(lane, cpus, ncpus);
+}
+
+int nstpu_engine_member_lat_hist(uint64_t engine, int32_t member,
+                                 uint64_t* out, int32_t cap) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  if (member < 0 || member >= NSTPU_MAX_MEMBERS || !out || cap < 0)
+    return -EINVAL;
+  int n = cap < NSTPU_LAT_BUCKETS ? cap : NSTPU_LAT_BUCKETS;
+  for (int i = 0; i < n; i++)
+    out[i] = e->member_hist_[member][i].load(std::memory_order_relaxed);
+  return n;
+}
+
+int nstpu_engine_member_occ(uint64_t engine, int32_t member, uint64_t* out2) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  return e->member_occ(member, out2);
 }
 
 }  // extern "C"

@@ -27,7 +27,7 @@
 extern "C" {
 #endif
 
-#define NSTPU_API_VERSION 1
+#define NSTPU_API_VERSION 2
 
 /* backends */
 #define NSTPU_BACKEND_AUTO       0
@@ -96,10 +96,12 @@ typedef struct nstpu_req {
 /* Engine lifecycle.  Returns an opaque handle (0 on failure).
  * queue_depth: io_uring SQ entries / thread-pool width.
  *
- * nstpu_engine_create2 additionally fixes the io_uring ring (queue)
- * count: stripe members map member % nrings, each ring with its own
- * submit lock, reaper, and queue_depth-deep in-flight window — the
- * per-NVMe-device hardware-queue analog (kmod/nvme_strom.c:1201-1223).
+ * nstpu_engine_create2 additionally fixes the lane (queue) count:
+ * stripe members map member % nrings, each lane with its own
+ * submit lock, reaper/workers, and queue_depth-deep in-flight window —
+ * the per-NVMe-device hardware-queue analog (kmod/nvme_strom.c:1201-1223).
+ * Both backends honor it: io_uring lanes are rings, threadpool lanes are
+ * independent deque+worker sets.
  * nrings <= 0 means the built-in default (env NSTPU_RINGS, else 1).
  * Measured guidance: rings = number of DISTINCT physical devices; on a
  * single backing disk extra rings only inflate in-flight and seek (A/B:
@@ -150,6 +152,41 @@ int      nstpu_engine_lat_hist(uint64_t engine, uint64_t* out, int32_t cap);
  * [0, NSTPU_MAX_MEMBERS), -ENOENT for a bad engine handle. */
 int      nstpu_engine_member_stats(uint64_t engine, int32_t member,
                                    uint64_t* out3);
+
+/* -- lane topology (API v2) ---------------------------------------------
+ * A LANE is one independent queue pair: an io_uring ring with its own
+ * submit lock + completion reaper, or (threadpool backend) one request
+ * deque with its own worker set.  Stripe members map lane = member %
+ * nlanes, so a slow member queues behind itself, never behind siblings —
+ * the per-NVMe-device blk-mq hardware-queue analog
+ * (kmod/nvme_strom.c:1201-1223, independent per-device in-flight
+ * :1585-1586). */
+
+/* Lane count of a live engine.  Returns >= 1, or -errno. */
+int      nstpu_engine_nlanes(uint64_t engine);
+
+/* Pin one lane's service threads (reaper + workers) to a CPU list — the
+ * NUMA-locality lever: the reference allocates DMA buffers on the
+ * device-local node (pgsql/nvme_strom.c:1454-1526); here the completion
+ * path is pinned to the member device's node so CQ reaping and the
+ * landing memcpy stay on local memory.  Returns 0; -EINVAL on bad
+ * lane/args; -ESHUTDOWN when the engine is stopping. */
+int      nstpu_engine_lane_pin(uint64_t engine, int32_t lane,
+                               const int32_t* cpus, int32_t ncpus);
+
+/* Per-member service-latency histogram (NSTPU_LAT_BUCKETS log2-ns
+ * buckets, monotonic — callers delta successive reads).  The per-member
+ * feed for the per-member adaptive chunk sizer and tpu_stat -v columns.
+ * Returns entries written, or -errno. */
+int      nstpu_engine_member_lat_hist(uint64_t engine, int32_t member,
+                                      uint64_t* out, int32_t cap);
+
+/* Per-member queue-occupancy integrals: out2[0] = sum(in_flight * dt) in
+ * ns, out2[1] = ns with that member's in_flight > 0.  Mean per-member
+ * occupancy over a window is d(out2[0])/d(out2[1]).  Monotonic.
+ * Returns 0, or -errno. */
+int      nstpu_engine_member_occ(uint64_t engine, int32_t member,
+                                 uint64_t* out2);
 
 /* Registered (fixed) buffers — the PRP-list-pool analog: the reference
  * pre-allocates DMA-coherent PRP arrays so the hot path never pays mapping

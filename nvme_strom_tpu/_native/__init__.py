@@ -130,6 +130,19 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int32]
         except AttributeError:  # pragma: no cover - older .so
             pass
+        try:  # API v2: lane topology + per-member hist/occupancy
+            lib.nstpu_engine_nlanes.argtypes = [ctypes.c_uint64]
+            lib.nstpu_engine_lane_pin.argtypes = [
+                ctypes.c_uint64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+            lib.nstpu_engine_member_lat_hist.argtypes = [
+                ctypes.c_uint64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_int32]
+            lib.nstpu_engine_member_occ.argtypes = [
+                ctypes.c_uint64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint64)]
+        except AttributeError:  # pragma: no cover - older .so
+            pass
         _lib = lib
         return _lib
 
@@ -172,6 +185,8 @@ class NativeEngine:
         self._prev_stats: Dict[str, int] = {}
         self._prev_members: Dict[int, Tuple[int, int, int]] = {}
         self._prev_hist: List[int] = [0] * LAT_HIST_BUCKETS
+        self._prev_member_hist: Dict[int, List[int]] = {}
+        self._prev_member_occ: Dict[int, Tuple[int, int]] = {}
         self._stats_lock = threading.Lock()
 
     def submit(self, dest_addr: int,
@@ -215,6 +230,24 @@ class NativeEngine:
     def buf_unregister(self, slot: int) -> None:
         if hasattr(self._lib, "nstpu_buf_unregister") and self._h:
             self._lib.nstpu_buf_unregister(self._h, slot)
+
+    def nlanes(self) -> int:
+        """Lane (queue-pair) count of this engine, 1 on an older .so."""
+        if not hasattr(self._lib, "nstpu_engine_nlanes"):
+            return 1
+        n = self._lib.nstpu_engine_nlanes(self._h)
+        return n if n > 0 else 1
+
+    def lane_pin(self, lane: int, cpus: Sequence[int]) -> bool:
+        """Pin one lane's reaper/worker threads to the given CPUs (the
+        NUMA-locality lever).  Returns True on success; False covers an
+        older .so, a bad lane, or a kernel that refuses the affinity —
+        callers lose only locality, never correctness."""
+        if not hasattr(self._lib, "nstpu_engine_lane_pin") or not cpus:
+            return False
+        arr = (ctypes.c_int32 * len(cpus))(*cpus)
+        return self._lib.nstpu_engine_lane_pin(self._h, lane, arr,
+                                               len(cpus)) == 0
 
     def member_stats(self, member: int) -> Tuple[int, int, int]:
         """(completed requests, bytes, busy ns) for one stripe member."""
@@ -283,6 +316,68 @@ class NativeEngine:
             cur += [0] * (LAT_HIST_BUCKETS - len(cur))
             prev, self._prev_hist = self._prev_hist, list(cur)
             return [c - p for c, p in zip(cur, prev)]
+
+    def member_lat_hist(self, member: int) -> Optional[List[int]]:
+        """Absolute per-member latency histogram, or None (older .so)."""
+        if not hasattr(self._lib, "nstpu_engine_member_lat_hist"):
+            return None
+        out = (ctypes.c_uint64 * LAT_HIST_BUCKETS)()
+        n = self._lib.nstpu_engine_member_lat_hist(self._h, member, out,
+                                                   LAT_HIST_BUCKETS)
+        if n < 0:
+            return None
+        return list(out[:min(n, LAT_HIST_BUCKETS)])
+
+    def member_lat_hist_delta(self, members: Sequence[int]
+                              ) -> Dict[int, List[int]]:
+        """Per-member histogram bucket deltas since the previous call
+        (serialized like stats_delta).  Members with no new completions
+        are omitted."""
+        if not hasattr(self._lib, "nstpu_engine_member_lat_hist"):
+            return {}
+        with self._stats_lock:
+            out: Dict[int, List[int]] = {}
+            for m in sorted({min(max(m, 0), MAX_MEMBERS - 1)
+                             for m in members}):
+                cur = self.member_lat_hist(m)
+                if cur is None:
+                    continue
+                cur += [0] * (LAT_HIST_BUCKETS - len(cur))
+                prev = self._prev_member_hist.get(m, [0] * LAT_HIST_BUCKETS)
+                delta = [c - p for c, p in zip(cur, prev)]
+                if any(delta):
+                    out[m] = delta
+                    self._prev_member_hist[m] = cur
+            return out
+
+    def member_occ(self, member: int) -> Optional[Tuple[int, int]]:
+        """Monotonic (occ_integral_ns, occ_busy_ns) for one member, or
+        None on an older .so."""
+        if not hasattr(self._lib, "nstpu_engine_member_occ"):
+            return None
+        out = (ctypes.c_uint64 * 2)()
+        if self._lib.nstpu_engine_member_occ(self._h, member, out) < 0:
+            return None
+        return out[0], out[1]
+
+    def member_occ_delta(self, members: Sequence[int]
+                         ) -> Dict[int, Tuple[int, int]]:
+        """Per-member (occ_integral_ns, occ_busy_ns) deltas since the
+        previous call (serialized like stats_delta)."""
+        if not hasattr(self._lib, "nstpu_engine_member_occ"):
+            return {}
+        with self._stats_lock:
+            out: Dict[int, Tuple[int, int]] = {}
+            for m in sorted({min(max(m, 0), MAX_MEMBERS - 1)
+                             for m in members}):
+                cur = self.member_occ(m)
+                if cur is None:
+                    continue
+                prev = self._prev_member_occ.get(m, (0, 0))
+                if cur != prev:
+                    out[m] = (cur[0] - prev[0], cur[1] - prev[1])
+                    self._prev_member_occ[m] = cur
+            return out
 
     def member_stats_delta(self, members: Sequence[int]) -> Dict[int, Tuple[int, int, int]]:
         """Per-member (nreq, bytes, ns) deltas since the previous call,

@@ -97,6 +97,18 @@ def _check_h2d_path(val: str, _cfg: "Config") -> None:
                           f"got {val!r}")
 
 
+def _check_numa_policy(val: str, _cfg: "Config") -> None:
+    if val in ("auto", "off"):
+        return
+    if val.startswith("node:"):
+        try:
+            if int(val[5:]) >= 0:
+                return
+        except ValueError:
+            pass
+    raise ConfigError(f"numa_policy must be auto|off|node:N, got {val!r}")
+
+
 def _check_coalesce_limit(val: int, cfg: "Config") -> None:
     # 0 = coalescing off; otherwise the merge window must cover at least
     # one dma_max_size request or planning could emit nothing mergeable
@@ -160,17 +172,33 @@ class Config:
                 validate=_check_io_backend))
         reg(Var("queue_depth", 32, "int", minval=1, maxval=4096,
                 help="io_uring submission queue depth / outstanding requests"))
-        reg(Var("engine_rings", 1, "int", minval=1, maxval=16,
-                help="io_uring queue (ring) count; stripe members map "
-                     "member mod rings, each ring an independent submit "
-                     "lock + reaper + queue_depth window (per-device "
-                     "blk-mq HW queue analog).  Set to the number of "
-                     "DISTINCT physical NVMe devices backing the stripe; "
-                     "default 1 because extra rings on a shared backing "
-                     "disk only inflate total in-flight and seek (A/B on "
-                     "this host: 4x32-deep measured ~30% below 1x32 on "
-                     "a one-disk 4-member RAID-0).  Env NSTPU_RINGS "
-                     "overrides for experiments."))
+        reg(Var("engine_rings", 0, "int", minval=0, maxval=16,
+                help="engine lane (queue) count; stripe members map "
+                     "member mod lanes, each lane an independent submit "
+                     "lock + reaper/workers + in-flight window (per-"
+                     "device blk-mq HW queue analog).  0 = AUTO: the "
+                     "session scales lanes to the stripe member count at "
+                     "first striped submit (single-file sources stay at "
+                     "one lane).  A fixed count pins it — set to the "
+                     "number of DISTINCT physical NVMe devices backing "
+                     "the stripe.  Env NSTPU_RINGS overrides for "
+                     "experiments."))
+        reg(Var("member_queue_depth", 0, "int", minval=0, maxval=4096,
+                help="per-lane in-flight window when the engine scales "
+                     "out to one lane per stripe member (engine_rings=0 "
+                     "auto, or explicit >1).  0 inherits queue_depth; "
+                     "lower it on shared backing disks where N full-"
+                     "depth lanes would just multiply seek"))
+        reg(Var("numa_policy", "auto", "str",
+                help="NUMA placement for per-member engine lanes: "
+                     "'auto' pins each member's reaper/worker threads to "
+                     "the CPUs of the member device's local node (sysfs "
+                     "probe; unknown node = leave unpinned), 'node:N' "
+                     "pins every lane to node N, 'off' never touches "
+                     "affinity.  The pgsql extension's node-local DMA "
+                     "buffer + backend binding analog "
+                     "(pgsql/nvme_strom.c:353-446,1126-1181)",
+                validate=_check_numa_policy))
         reg(Var("staging_buffers", 3, "int", minval=2, maxval=16,
                 help="pinned host staging buffers for the SSD->HBM pipeline (triple-buffered default)"))
         reg(Var("scan_dispatch_batch", 4, "int", minval=1, maxval=64,

@@ -48,6 +48,7 @@ from .fault import MemberHealth, RetryPolicy
 from .log import pr_info, pr_warn
 from .eligibility import probe_backing
 from .stats import stats
+from . import numa as _numa
 
 #: live sessions, for the stat exporter's pre-publish fold (weak: the
 #: registry must never keep a closed session alive)
@@ -829,7 +830,8 @@ def plan_requests(source: Source, chunk_entries: Sequence[Tuple[int, int]],
                   chunk_size: int, dest_base: int, *,
                   dma_max_size: Optional[int] = None,
                   dest_segment_shift: Optional[int] = None,
-                  coalesce_limit: Optional[int] = None) -> List[Request]:
+                  coalesce_limit: Union[int, Dict[int, int], None] = None
+                  ) -> List[Request]:
     """Merge chunk reads into large requests.
 
     *chunk_entries* is ``[(chunk_id, dest_slot), ...]``; chunk ``cid`` covers
@@ -849,6 +851,8 @@ def plan_requests(source: Source, chunk_entries: Sequence[Tuple[int, int]],
     that many bytes, turning into vectored reads (:attr:`Request.dest_segs`)
     when their destinations are scattered by stripe interleave.  Without it
     the output honours the classic ``length <= dma_max_size`` invariant.
+    A ``{member: limit}`` dict applies a per-member cap (the per-device
+    adaptive sizers, PR 5); members missing from the dict don't coalesce.
     """
     cap = dma_max_size or config.get("dma_max_size")
     bs = max(source.block_size, 512)
@@ -891,12 +895,17 @@ def plan_requests(source: Source, chunk_entries: Sequence[Tuple[int, int]],
                                   p.dest_off)
                 continue
         out.append(r)
-    if coalesce_limit and coalesce_limit > cap:
-        out = _coalesce_requests(out, coalesce_limit, dest_segment_shift)
+    if coalesce_limit:
+        if isinstance(coalesce_limit, dict):
+            if any(v > cap for v in coalesce_limit.values()):
+                out = _coalesce_requests(out, coalesce_limit,
+                                         dest_segment_shift)
+        elif coalesce_limit > cap:
+            out = _coalesce_requests(out, coalesce_limit, dest_segment_shift)
     return out
 
 
-def _coalesce_requests(reqs: List[Request], limit: int,
+def _coalesce_requests(reqs: List[Request], limit: Union[int, Dict[int, int]],
                        dest_segment_shift: Optional[int]) -> List[Request]:
     """Second merge pass (the reference's request-merge window applied
     beyond the per-command cap, kmod/nvme_strom.c:1473-1505): direct
@@ -909,15 +918,18 @@ def _coalesce_requests(reqs: List[Request], limit: int,
 
     Requests read into disjoint destination ranges, so pulling a later
     request forward into an earlier one never reorders observable
-    writes."""
+    writes.  *limit* may be a ``{member: limit}`` dict — each member's
+    run then merges under its own cap (per-member adaptive sizing)."""
+    caps = limit if isinstance(limit, dict) else None
     out: List[Request] = []
     last: dict = {}  # member -> index in out of its last direct request
     for r in reqs:
         idx = last.get(r.member)
         if idx is not None and not r.buffered:
+            lim = caps.get(r.member, 0) if caps is not None else limit
             p = out[idx]
             if (p.file_off + p.length == r.file_off
-                    and p.length + r.length <= limit):
+                    and p.length + r.length <= lim):
                 segs = p.dest_segs or ((p.dest_off, p.length),)
                 d, ln = segs[-1]
                 if d + ln == r.dest_off and (
@@ -1094,10 +1106,22 @@ class Session:
         self._retry = RetryPolicy.from_config()
         self._member_health = MemberHealth()
         self._retry_rng = random.Random(os.getpid() ^ id(self))
-        # adaptive chunk sizing (PR 4): the effective request cap tracks
-        # observed service latency, mirroring AdaptiveH2DDepth on the
-        # HBM side; created lazily on the first adaptive memcpy
-        self._chunk_sizer: Optional[AdaptiveChunkSizer] = None
+        # adaptive chunk sizing (PR 4, per-member since PR 5): one sizer
+        # per stripe member so the effective request cap converges per
+        # DEVICE — a slow member shrinks its own merges without throttling
+        # healthy siblings.  Created lazily on the first adaptive memcpy;
+        # single-file sources live under member 0.
+        self._chunk_sizers: Dict[int, AdaptiveChunkSizer] = {}
+        # lane scale-out (PR 5): the engine starts single-lane and is
+        # rebuilt with one queue pair per stripe member at the first
+        # striped submit (one-shot); swapped-out engines stay alive until
+        # close() so in-flight waits complete against the engine that
+        # accepted them
+        self._lane_lock = threading.Lock()
+        self._lanes_sized = False
+        self._old_engines: List[object] = []
+        # per-member executor lanes for the Python fallback path
+        self._member_pools: Dict[int, ThreadPoolExecutor] = {}
         self._watchdog_stop = threading.Event()
         self._watchdog = threading.Thread(target=self._watchdog_loop,
                                           daemon=True,
@@ -1463,13 +1487,20 @@ class Session:
             use_native = (self._native is not None and direct_ids
                           and type(source).read_member_direct
                           is Source.read_member_direct)
+            if use_native:
+                self._ensure_member_lanes(source)
             dma_max = int(config.get("dma_max_size"))
             # coalescing beyond dma_max is the native-queue saturation
             # lever; the pool path keeps classic per-extent planning so
             # fault injection and the retry ladder see every extent
             climit = int(config.get("coalesce_limit")) if use_native else 0
             if climit and config.get("chunk_adaptive"):
-                climit = self._adaptive_cap(dma_max, climit)
+                nmem_src = len(getattr(source, "members", ())) or 1
+                if nmem_src > 1:
+                    climit = {m: self._adaptive_cap(dma_max, climit, member=m)
+                              for m in range(nmem_src)}
+                else:
+                    climit = self._adaptive_cap(dma_max, climit)
             verify = bool(config.get("checksum_verify"))
             window = max(int(config.get("submit_window")), 1)
             entries = [(cid, i) for i, cid in enumerate(direct_ids)]
@@ -1527,11 +1558,15 @@ class Session:
                     self._members_used.update(native_members)
                     addr = ctypes.addressof(
                         ctypes.c_char.from_buffer(dest))
-                    nid = self._native.submit(addr, native_reqs,
-                                              members=native_members)
+                    # capture the engine: a concurrent lane scale-out may
+                    # swap self._native, and the wait must run against
+                    # the engine that accepted the batch
+                    nat = self._native
+                    nid = nat.submit(addr, native_reqs,
+                                     members=native_members)
                     self._task_get(task)
                     try:
-                        self._pool.submit(self._await_native, task, nid)
+                        self._pool.submit(self._await_native, task, nat, nid)
                     except BaseException as e:
                         self._task_put(task, StromError(
                             _errno.ESHUTDOWN, str(e)))
@@ -1628,6 +1663,7 @@ class Session:
                           is Source.write_member_direct)
             pool_reqs = list(reqs) if not use_native else []
             if use_native:
+                self._ensure_member_lanes(sink)
                 fds = sink.member_fds()
                 native_reqs = []
                 native_members = []
@@ -1654,12 +1690,14 @@ class Session:
                         self._members_used.update(native_members)
                         addr = ctypes.addressof(
                             ctypes.c_char.from_buffer(src))
-                        nid = self._native.submit(addr, native_reqs,
-                                                  write=True,
-                                                  members=native_members)
+                        nat = self._native
+                        nid = nat.submit(addr, native_reqs,
+                                         write=True,
+                                         members=native_members)
                         self._task_get(task)
                         try:
-                            self._pool.submit(self._await_native, task, nid)
+                            self._pool.submit(self._await_native, task, nat,
+                                              nid)
                         except BaseException as e:
                             self._task_put(task, StromError(
                                 _errno.ESHUTDOWN, str(e)))
@@ -1776,7 +1814,7 @@ class Session:
             stats.member_add(r.member, r.length, elapsed)
             if not r.buffered:
                 stats.observe_latency(elapsed)
-                szr = self._chunk_sizer
+                szr = self._chunk_sizers.get(r.member)
                 if szr is not None:
                     szr.observe(elapsed)
             stats.gauge_add("cur_dma_count", -1)
@@ -1897,11 +1935,13 @@ class Session:
                     piece[off:off + PAGE_SIZE])
             bad = verify_page_checksums(piece)
 
-    def _await_native(self, task: DmaTask, native_id: int) -> None:
+    def _await_native(self, task: DmaTask, eng, native_id: int) -> None:
+        # *eng* is the engine that accepted the batch — NOT self._native,
+        # which a lane scale-out may have swapped since submission
         err: Optional[StromError] = None
         while True:
             try:
-                self._native.wait(native_id, 500)
+                eng.wait(native_id, 500)
                 break
             except StromError as e:
                 if e.errno == _errno.ETIMEDOUT:
@@ -1925,40 +1965,175 @@ class Session:
                 break
         self._task_put(task, err)
 
-    def _adaptive_cap(self, floor: int, limit: int) -> int:
-        """Current effective coalescing cap from the adaptive sizer
+    def _adaptive_cap(self, floor: int, limit: int, member: int = 0) -> int:
+        """Current effective coalescing cap from *member*'s adaptive sizer
         (created lazily; recreated when the config bounds change)."""
-        szr = self._chunk_sizer
+        szr = self._chunk_sizers.get(member)
         if szr is None or szr.floor != floor or szr.limit != limit:
-            szr = self._chunk_sizer = AdaptiveChunkSizer(floor, limit)
+            szr = self._chunk_sizers[member] = AdaptiveChunkSizer(floor, limit)
         return szr.effective
+
+    # -- lane scale-out (PR 5) ---------------------------------------------
+    def _ensure_member_lanes(self, source: Source) -> None:
+        """One-shot at the first striped submit: rebuild the native engine
+        with one queue pair per stripe member (member i -> lane i % nlanes)
+        so a slow member queues behind itself, never behind siblings — the
+        per-NVMe-device blk-mq hardware-queue analog
+        (kmod/nvme_strom.c:1201-1223).  An explicit lane count (env
+        NSTPU_RINGS or config engine_rings > 0) keeps the operator's
+        choice; after sizing, lanes are NUMA-pinned per numa_policy."""
+        if self._native is None or self._lanes_sized:
+            return
+        members = getattr(source, "members", None)
+        nmem = len(members) if members else 0
+        if nmem <= 1:
+            return
+        with self._lane_lock:
+            if self._lanes_sized or self._native is None:
+                return
+            self._lanes_sized = True
+            try:
+                explicit = int(os.environ.get("NSTPU_RINGS", "")) > 0
+            except ValueError:
+                explicit = int(config.get("engine_rings")) > 0
+            want = min(nmem, 16)
+            if not explicit and self._native.nlanes() < want:
+                self._scale_out_lanes(want, nmem)
+            self._pin_lanes(members)
+
+    def _scale_out_lanes(self, nlanes: int, nmem: int) -> None:
+        """Swap in a fresh native engine with *nlanes* queue pairs.  Fixed
+        buffers are re-registered on the new engine under the fixed lock
+        (so concurrent map_buffer registrations can't be lost), stats are
+        folded first, and the old engine is retired to _old_engines —
+        in-flight batches hold a direct reference and drain there."""
+        from . import _native as _nat
+        depth = int(config.get("member_queue_depth")) \
+            or int(config.get("queue_depth"))
+        backend = self.backend_name
+        try:
+            eng = _nat.NativeEngine(
+                backend if backend in ("io_uring", "threadpool") else "auto",
+                depth, rings=nlanes)
+        except StromError as e:
+            pr_warn("lane scale-out to %d lanes failed (%s); keeping the "
+                    "single-lane engine", nlanes, e)
+            return
+        try:
+            self._fold_native_stats()
+        except StromError:
+            pass
+        with self._fixed_lock:
+            for key, (_slot, backing, cb) in list(self._fixed_regs.items()):
+                try:
+                    nslot = eng.buf_register(backing.addr, backing.length)
+                except Exception:
+                    nslot = None
+                self._fixed_regs[key] = (-1 if nslot is None else nslot,
+                                         backing, cb)
+            old, self._native = self._native, eng
+        self._old_engines.append(old)
+        self.backend_name = eng.backend_name
+        pr_info("engine scaled out: %d lane(s) for %d stripe members "
+                "(backend=%s depth=%d)", eng.nlanes(), nmem,
+                eng.backend_name, depth)
+
+    def _pin_lanes(self, members) -> None:
+        """NUMA-pin each lane's service threads (reaper + workers) to its
+        member's local node per ``numa_policy`` — the reference allocates
+        DMA buffers device-locally (pgsql/nvme_strom.c:1454-1526); pinning
+        the completion path keeps CQ reaping and the landing memcpy on
+        local memory.  Unknown topology (no sysfs, node -1) leaves lanes
+        floating rather than guessing."""
+        policy = str(config.get("numa_policy"))
+        if policy == "off" or self._native is None:
+            return
+        try:
+            nlanes = self._native.nlanes()
+        except Exception:
+            return
+        fixed_node = -1
+        if policy.startswith("node:"):
+            fixed_node = int(policy.split(":", 1)[1])
+        pinned = 0
+        for lane in range(nlanes):
+            node = fixed_node
+            if node < 0:
+                # auto: pin to the backing-device node of the lane's first
+                # member under the member % nlanes mapping (identity when
+                # one lane per member)
+                from .stripe import lane_members
+                served = lane_members(lane, len(members), nlanes)
+                if not served:
+                    continue
+                path = getattr(members[served[0]], "path", None)
+                if not path:
+                    continue
+                try:
+                    node = _numa.device_numa_node(path)
+                except Exception:
+                    node = -1
+            if node < 0:
+                continue
+            try:
+                cpus = _numa.node_cpus(node)
+            except Exception:
+                cpus = []
+            if cpus and self._native.lane_pin(lane, cpus):
+                pinned += 1
+        if pinned:
+            pr_info("NUMA: pinned %d/%d lane(s) (policy=%s)",
+                    pinned, nlanes, policy)
+
+    def _member_pool(self, member: int) -> ThreadPoolExecutor:
+        """Per-member executor lane for the Python path: a quarantined or
+        slow member's requests queue on their own workers instead of
+        occupying the shared pool ahead of healthy siblings (the Python
+        mirror of the native per-member lanes)."""
+        pool = self._member_pools.get(member)
+        if pool is None:
+            with self._lane_lock:
+                pool = self._member_pools.get(member)
+                if pool is None:
+                    width = int(config.get("member_queue_depth")) \
+                        or int(config.get("queue_depth"))
+                    pool = ThreadPoolExecutor(
+                        max_workers=max(1, min(width, 8)),
+                        thread_name_prefix=f"strom-io-m{member}")
+                    self._member_pools[member] = pool
+        return pool
 
     def _submit_pool_requests(self, task: DmaTask, source: Source,
                               reqs: Sequence[Request],
                               dest: memoryview) -> None:
         """Queue planned requests on the Python thread pool (the
         instrumented fallback executor; also the only path for sources
-        that override the direct-read leg, i.e. test fakes)."""
+        that override the direct-read leg, i.e. test fakes).  Striped
+        sources route each request to its member's own executor lane."""
+        multi = len(getattr(source, "members", ())) > 1
         for r in reqs:
             self._task_get(task)
             cur = stats.gauge_add("cur_dma_count", 1)
             stats.gauge_max("max_dma_count", cur)
             stats.count_clock("submit_dma", 0)
             stats.add("total_dma_length", r.length)
+            pool = self._member_pool(r.member) if multi else self._pool
             try:
-                self._pool.submit(self._do_request, task, source, r, dest)
+                pool.submit(self._do_request, task, source, r, dest)
             except BaseException as e:
                 stats.gauge_add("cur_dma_count", -1)
                 self._task_put(task, StromError(_errno.ESHUTDOWN, str(e)))
                 raise
 
     # -- stats + lifecycle -------------------------------------------------
-    def _fold_native_stats(self) -> dict:
-        """Fold the native engine's counter deltas into the global
+    def _fold_native_stats(self, eng=None) -> dict:
+        """Fold a native engine's counter deltas into the global
         registry (returns the raw delta dict).  Called from stat_info and
         from close() — a session must not take its I/O accounting to the
-        grave just because nobody snapshotted before it closed."""
-        d = self._native.stats_delta()
+        grave just because nobody snapshotted before it closed.  *eng*
+        defaults to the live engine; lane scale-out passes retired ones."""
+        eng = eng if eng is not None else self._native
+        d = eng.stats_delta()
         # nr/clk_ssd2dev + wait are counted per *Python* task already;
         # resubmit/sq_full ride the reference's spare debug counters
         stats.merge_native({
@@ -1973,21 +2148,38 @@ class Session:
             "occ_busy_ns": d.get("occ_busy_ns", 0),
         })
         # per-member deltas fold into the registry the same way
-        for m, (nreq, nbytes, ns) in self._native.member_stats_delta(
-                sorted(self._members_used)).items():
+        used = sorted(self._members_used)
+        for m, (nreq, nbytes, ns) in eng.member_stats_delta(used).items():
             stats.member_add(m, nbytes, ns, n=nreq)
-        # service-latency histogram: fold the native delta and feed the
-        # mean service time to the adaptive sizer (native requests never
-        # pass through _do_request, so this is their only observation path)
-        hd = self._native.lat_hist_delta()
+        # service-latency histograms: fold the native deltas and feed the
+        # mean service time to the adaptive sizers (native requests never
+        # pass through _do_request, so this is their only observation
+        # path).  Per-member histograms feed each member's own sizer; an
+        # older .so without them falls back to the global mean for all.
+        hd = eng.lat_hist_delta()
         if hd and any(hd):
             stats.merge_native_hist(hd)
-            szr = self._chunk_sizer
-            if szr is not None:
+            fed = False
+            for m, h in eng.member_lat_hist_delta(used).items():
+                stats.merge_member_hist(m, h)
+                total = sum(h)
+                if not total:
+                    continue
+                avg = sum(((1 << b) + ((1 << b) >> 1)) * c
+                          for b, c in enumerate(h)) // total
+                szr = self._chunk_sizers.get(m)
+                if szr is not None:
+                    szr.observe(avg)
+                    fed = True
+            if not fed and self._chunk_sizers:
                 total = sum(hd)
                 avg = sum(((1 << b) + ((1 << b) >> 1)) * c
                           for b, c in enumerate(hd)) // total
-                szr.observe(avg)
+                for szr in self._chunk_sizers.values():
+                    szr.observe(avg)
+        # per-member queue-occupancy integrals (lane depth visibility)
+        for m, (dint, dbusy) in eng.member_occ_delta(used).items():
+            stats.member_occ_add(m, dint, dbusy)
         return d
 
     def stat_info(self, *, debug: bool = False):
@@ -2026,6 +2218,9 @@ class Session:
         self._watchdog_stop.set()
         self._watchdog.join(timeout=2.0)
         self._pool.shutdown(wait=True)
+        for p in self._member_pools.values():
+            p.shutdown(wait=True)
+        self._member_pools = {}
         # detach close hooks from long-lived (pool) buffers so a closed
         # session is not pinned in their callback lists; the engine close
         # below frees every kernel-side fixed slot wholesale
@@ -2043,6 +2238,17 @@ class Session:
             except StromError:
                 pass
             self._native.close()
+        # engines retired by lane scale-out: every batch they accepted has
+        # drained (pool shutdown above joins the awaiters), so reap any
+        # residue, fold their remaining counters, and free them
+        for old in self._old_engines:
+            try:
+                old.reap(timeout_ms=2000)
+                self._fold_native_stats(old)
+                old.close()
+            except Exception:
+                pass
+        self._old_engines = []
         return reaped
 
     def __enter__(self):

@@ -298,19 +298,25 @@ class StagingPipeline:
             if dest_offset % itemsize:
                 raise StromError(22, "dest_offset not aligned to device dtype")
 
-            inflight = []  # (bufidx, engine_task_id, batch, dev_elem_start)
-            out_ids: List[int] = []
+            # (bufidx, engine_task_id, batch, dev_elem_start, nbytes, out_pos)
+            inflight = []
+            # positional: batches may retire OUT OF ORDER (per-member lane
+            # fan-in below), but entry i must still name the chunk at
+            # device slot i
+            out_ids: List[Optional[int]] = [None] * len(chunk_ids)
             nr_ssd = nr_ram = 0
             elem_cursor = dest_offset // itemsize
+            chunk_cursor = 0
             total_bytes_needed = dest_offset + len(chunk_ids) * chunk_size
             if total_bytes_needed > hbm.nbytes:
                 raise StromError(34, f"device buffer too small: need "
                                      f"{total_bytes_needed} > {hbm.nbytes}")
 
-            def retire(slot) -> None:
+            def retire(slot, res=None) -> None:
                 nonlocal nr_ssd, nr_ram
-                bufidx, task_id, batch, elem_start, nbytes = slot
-                res = self.session.memcpy_wait(task_id)
+                bufidx, task_id, batch, elem_start, nbytes, out_pos = slot
+                if res is None:
+                    res = self.session.memcpy_wait(task_id)
                 _, dbuf = self._bufs[bufidx]
                 # last line of defense before bytes become device state:
                 # the direct tier was already verified by the engine at
@@ -321,7 +327,7 @@ class StagingPipeline:
                     self._verify_staged(
                         source, res.chunk_ids[res.nr_ssd2dev:], chunk_size,
                         dbuf.view()[res.nr_ssd2dev * chunk_size:nbytes])
-                out_ids.extend(res.chunk_ids)
+                out_ids[out_pos:out_pos + len(batch)] = res.chunk_ids
                 nr_ssd += res.nr_ssd2dev
                 nr_ram += res.nr_ram2dev
                 # staged batch -> device (async H2D), landed with an async
@@ -339,14 +345,35 @@ class StagingPipeline:
                 self._barriers[bufidx] = fence
                 stats.count_clock("debug3", time.monotonic_ns() - t0)
 
+            def retire_one() -> None:
+                # fan-in from the member lanes (PR 5): retire the FIRST
+                # COMPLETED in-flight batch rather than strictly the
+                # oldest — with per-member queue pairs a batch striped
+                # onto fast members finishes ahead of an older batch
+                # queued behind a slow lane, and its staging buffer and
+                # H2D leg must not wait on that lane.  Positional out_ids
+                # keep the device-slot contract intact.
+                for i, slot in enumerate(inflight):
+                    try:
+                        res = self.session.memcpy_wait(slot[1], timeout=0.0)
+                    except StromError as e:
+                        if e.errno == _errno.ETIMEDOUT:
+                            continue
+                        inflight.pop(i)  # failed: wait already reaped it
+                        raise
+                    retire(inflight.pop(i), res)
+                    return
+                # none complete yet: block on the oldest (the classic
+                # submit-ahead/wait-behind ring of ssd2ram_test,
+                # utils/ssd2ram_test.c:139-226)
+                retire(inflight.pop(0))
+
             try:
                 for batch in batches:
-                    # if every staging buffer is in flight, retire the
-                    # oldest first (the submit-ahead/wait-behind ring
-                    # discipline of ssd2ram_test, utils/ssd2ram_test.c:
-                    # 139-226)
+                    # if every staging buffer is in flight, retire a
+                    # completed batch first
                     if len(inflight) >= self.n_buffers:
-                        retire(inflight.pop(0))
+                        retire_one()
                     used = {s[0] for s in inflight}
                     bufidx = next(i for i in range(self.n_buffers)
                                   if i not in used)
@@ -363,10 +390,11 @@ class StagingPipeline:
                     task = self.session.memcpy_ssd2ram(source, handle,
                                                        batch, chunk_size)
                     inflight.append((bufidx, task.dma_task_id, batch,
-                                     elem_cursor, nbytes))
+                                     elem_cursor, nbytes, chunk_cursor))
                     elem_cursor += nbytes // itemsize
+                    chunk_cursor += len(batch)
                 while inflight:
-                    retire(inflight.pop(0))
+                    retire_one()
             except BaseException:
                 # backend loss (or any mid-command failure): reap the
                 # in-flight SSD tasks, bounded, so the task table retains

@@ -271,6 +271,8 @@ def make_star_fn(schema: HeapSchema, joins, *,
 
     Returns per batch: ``count`` (emitted rows), ``sums`` — per-column
     masked sums over every fact column (acc_dtypes convention),
+    ``nncounts`` — per-column emitted non-NULL counts (the AVG
+    denominators; equal to ``count`` for non-nullable columns),
     ``pay_sums`` — one entry per dimension: the payload sum over
     emitted rows that HIT that dimension (None-valued dims — semi/anti —
     contribute 0), ``null_counts`` — per dimension, emitted rows without
@@ -292,6 +294,12 @@ def make_star_fn(schema: HeapSchema, joins, *,
                                           schema.col_dtype(c).type(0)),
                                 dtype=acc)
                         for c, acc in zip(sum_cols, accs)]}
+        # AVG(fact col) denominators: NULL cells decode as 0 so the
+        # masked sums already skip them — the non-NULL counts must too
+        nulls = getattr(cols, "nulls", {})
+        out["nncounts"] = [
+            jnp.sum((emit & ~nulls[c]).astype(jnp.int32))
+            if c in nulls else out["count"] for c in sum_cols]
         pay_sums, null_counts = [], []
         for (pc, keys, vals, how), (hit, pay) in zip(joins, probes):
             if vals is None:

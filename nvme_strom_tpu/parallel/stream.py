@@ -16,6 +16,7 @@ the full table.
 
 from __future__ import annotations
 
+import errno as _errno
 from typing import List, Optional
 
 import jax
@@ -145,9 +146,9 @@ class ShardedBatchStream:
         return ring, tasks
 
     def _collect(self, ring, tasks) -> jax.Array:
-        shards = []
-        for k, (dev, res) in enumerate(tasks):
-            done = self.session.memcpy_wait(res.dma_task_id)
+        shards: List[Optional[jax.Array]] = [None] * len(tasks)
+
+        def place(k, done) -> None:
             _handle, buf = self._bufs[k][ring]
             # slot i holds chunk chunk_ids[i]: with a partially cached
             # source the engine fronts direct-I/O chunks and tails
@@ -155,7 +156,29 @@ class ShardedBatchStream:
             host = reorder_chunks(np.frombuffer(buf.view(), np.uint8),
                                   PAGE_SIZE, done.chunk_ids,
                                   sorted(done.chunk_ids)).reshape(-1, PAGE_SIZE)
-            shards.append(safe_device_put(host, dev))
+            shards[k] = safe_device_put(host, tasks[k][0])
+
+        # completion fan-in (PR 5): with per-member engine lanes the
+        # shards' SSD DMAs finish independently, so start each device's
+        # H2D as soon as ITS shard lands instead of serializing the whole
+        # batch behind shard 0's lane
+        remaining = list(range(len(tasks)))
+        while remaining:
+            progressed = False
+            for k in list(remaining):
+                try:
+                    done = self.session.memcpy_wait(
+                        tasks[k][1].dma_task_id, timeout=0.0)
+                except StromError as e:
+                    if e.errno == _errno.ETIMEDOUT:
+                        continue
+                    raise
+                place(k, done)
+                remaining.remove(k)
+                progressed = True
+            if remaining and not progressed:
+                k = remaining.pop(0)
+                place(k, self.session.memcpy_wait(tasks[k][1].dma_task_id))
         arr = jax.make_array_from_single_device_arrays(
             self._shape, self.sharding, shards)
         self._fence[ring] = arr

@@ -742,14 +742,24 @@ def _expr_cols_of(e) -> set:
     return _expr_cols_of(e[2]) | _expr_cols_of(e[3])
 
 
-def _not_null(cols, refs, mask):
-    """SQL comparison semantics: NULL cmp x is never true — AND away
-    the NULL rows of every referenced nullable column."""
+def _null_mask(cols, refs):
+    """OR of the NULL masks of every referenced nullable column — the
+    rows where a comparison is UNKNOWN rather than false (SQL 3VL).
+    None when no referenced column is nullable (nothing can be
+    unknown, the common all-NOT-NULL schema)."""
+    u = None
     for c in refs:
         n = getattr(cols, "nulls", {}).get(c)
         if n is not None:
-            mask = mask & ~n
-    return mask
+            u = n if u is None else (u | n)
+    return u
+
+
+def _not_null(cols, refs, mask):
+    """SQL comparison semantics: NULL cmp x is never true — AND away
+    the NULL rows of every referenced nullable column."""
+    u = _null_mask(cols, refs)
+    return mask if u is None else mask & ~u
 
 
 def _leaf_mask(cond, cols):
@@ -792,16 +802,57 @@ def _leaf_mask(cond, cols):
     return _not_null(cols, {c}, one)
 
 
-def _tree_mask(tree, cols):
+def _leaf_unknown(cond, cols):
+    """UNKNOWN mask for one leaf: rows where a referenced nullable
+    column is NULL.  IS [NOT] NULL is the one predicate that is never
+    unknown.  None = no row can be unknown."""
+    if cond[0] == "isnull":
+        return None
+    if cond[0] == "cmpe":
+        refs = _expr_cols_of(cond[1]) | _expr_cols_of(cond[3])
+    else:
+        refs = {cond[1]}
+    return _null_mask(cols, refs)
+
+
+def _or_unknown(a, b):
+    if a is None:
+        return b
+    return a if b is None else (a | b)
+
+
+def _tree_masks(tree, cols):
+    """Kleene 3VL masks for a subtree: ``(true, unknown)``, with
+    *unknown* None when no NULL can reach the subtree.  FALSE is
+    whatever is neither.  NOT swaps TRUE/FALSE and keeps UNKNOWN
+    unknown — a plain ``~true`` wrongly admitted NULL rows; AND is
+    false if any operand is false, OR is true if any operand is true
+    (truth dominates unknown on the side that decides the row)."""
     if tree[0] == "leaf":
-        return _leaf_mask(tree[1], cols)
+        return _leaf_mask(tree[1], cols), _leaf_unknown(tree[1], cols)
     if tree[0] == "not":
-        return ~_tree_mask(tree[1][0], cols)
-    masks = [_tree_mask(t, cols) for t in tree[1]]
-    out = masks[0]
-    for m in masks[1:]:
-        out = (out & m) if tree[0] == "and" else (out | m)
-    return out
+        t, u = _tree_masks(tree[1][0], cols)
+        return (~t if u is None else ~t & ~u), u
+    t, u = _tree_masks(tree[1][0], cols)
+    for kid in tree[1][1:]:
+        t2, u2 = _tree_masks(kid, cols)
+        if tree[0] == "and":
+            if u is not None or u2 is not None:
+                f1 = ~t if u is None else ~t & ~u
+                f2 = ~t2 if u2 is None else ~t2 & ~u2
+                u = _or_unknown(u, u2) & ~f1 & ~f2
+            t = t & t2
+        else:
+            t = t | t2
+            if u is not None or u2 is not None:
+                u = _or_unknown(u, u2) & ~t
+    return t, u
+
+
+def _tree_mask(tree, cols):
+    """The WHERE answer is the definitely-TRUE mask (UNKNOWN rows drop,
+    per SQL).  Workers rebuild this from the shipped ``_tree``."""
+    return _tree_masks(tree, cols)[0]
 
 
 def _promotable(cond) -> bool:
@@ -969,8 +1020,13 @@ def _build_star(q: Query, joins, items, tables, group_cols, havings,
                     out[it.label] = n
                 elif it.table is None:
                     s = np.asarray(res["sums"][it.col]).item()
-                    out[it.label] = s if it.fn == "sum" else \
-                        (s / n if n else None)
+                    if it.fn == "sum":
+                        out[it.label] = s
+                    else:   # AVG skips NULL cells: non-NULL denominator
+                        nnc = res.get("nncounts")
+                        nn = n if nnc is None \
+                            else int(np.asarray(nnc[it.col]))
+                        out[it.label] = s / nn if nn else None
                 else:
                     i = dim_idx[it.table]
                     s = np.asarray(res["pay_sums"][i]).item()

@@ -118,6 +118,13 @@ class StatRegistry:
         # per-request service-latency histogram (log2-ns buckets) — the
         # native engine keeps a matching one and its deltas fold in here
         self._hist = [0] * LAT_HIST_BUCKETS
+        # per-member latency histograms and queue-occupancy integrals
+        # (PR 5 lane scale-out): member -> [64 buckets] / [integral_ns,
+        # busy_ns].  Populated from the native engine's per-member deltas;
+        # the python pool path feeds _members only (its per-request service
+        # times are already member-attributed there).
+        self._member_hist: dict = {}
+        self._member_occ: dict = {}
         # last cur_dma_count transition timestamp for the occupancy
         # integral (0 = no transition seen yet)
         self._occ_last_ns = 0
@@ -185,6 +192,23 @@ class StatRegistry:
         with self._lock:
             return list(self._hist)
 
+    def merge_member_hist(self, member: int, deltas) -> None:
+        """Fold a native per-member latency-histogram delta (PR 5): the
+        per-lane slow-member signal that the aggregate histogram hides."""
+        with self._lock:
+            h = self._member_hist.setdefault(member, [0] * LAT_HIST_BUCKETS)
+            for i, v in enumerate(deltas[:LAT_HIST_BUCKETS]):
+                h[i] += v
+
+    def member_occ_add(self, member: int, integral_ns: int,
+                       busy_ns: int) -> None:
+        """Fold a per-member queue-occupancy delta: mean in-flight depth
+        for the member's lane over a window is d(integral)/d(busy)."""
+        with self._lock:
+            o = self._member_occ.setdefault(member, [0, 0])
+            o[0] += integral_ns
+            o[1] += busy_ns
+
     def member_add(self, member: int, nbytes: int, ns: int, n: int = 1) -> None:
         """Account one request against a stripe member (part_stat_add
         analog): a slow member in a 4-way set becomes visible in
@@ -230,6 +254,17 @@ class StatRegistry:
                 d = out.setdefault(k, {"nreq": 0, "bytes": 0, "clk_ns": 0})
                 d.update(errors=h[0], retries=h[1], quarantines=h[2],
                          quarantined=bool(h[3]))
+            for k, hist in self._member_hist.items():
+                d = out.setdefault(k, {"nreq": 0, "bytes": 0, "clk_ns": 0})
+                p50, p95, _ = hist_percentiles(hist)
+                if p50 is not None:
+                    d["p50_ns"] = p50
+                if p95 is not None:
+                    d["p95_ns"] = p95
+            for k, o in self._member_occ.items():
+                d = out.setdefault(k, {"nreq": 0, "bytes": 0, "clk_ns": 0})
+                d["occ_integral_ns"] = o[0]
+                d["occ_busy_ns"] = o[1]
             return out
 
     @contextmanager

@@ -20,9 +20,29 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Sequence, Tuple
 
-__all__ = ["StripeZone", "StripeMap", "StripeExtent"]
+__all__ = ["StripeZone", "StripeMap", "StripeExtent", "lane_of",
+           "lane_members"]
 
 SECTOR = 512
+
+
+def lane_of(member: int, nlanes: int) -> int:
+    """Engine queue pair (lane) serving *member* — the single definition
+    of the member->lane mapping shared by the native engine (member %
+    nlanes, csrc/strom_engine.cc ring_of), the Python per-member pools,
+    and the NUMA lane pinning; one lane per member when nlanes >= the
+    member count (the per-NVMe-device hardware-queue analog,
+    kmod/nvme_strom.c:1201-1223)."""
+    return member % max(nlanes, 1)
+
+
+def lane_members(lane: int, n_members: int, nlanes: int) -> List[int]:
+    """Members served by *lane* under the member % nlanes mapping (the
+    inverse of :func:`lane_of`); empty for a lane beyond the lane count."""
+    nlanes = max(nlanes, 1)
+    if lane < 0 or lane >= nlanes:
+        return []
+    return list(range(lane, n_members, nlanes))
 
 
 @dataclass(frozen=True)

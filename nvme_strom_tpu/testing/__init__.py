@@ -1,4 +1,5 @@
-from .fake import FakeNvmeSource, FaultPlan, backend_fault, make_test_file
+from .fake import (FakeNvmeSource, FakeStripedNvmeSource, FaultPlan,
+                   backend_fault, make_test_file)
 
-__all__ = ["FakeNvmeSource", "FaultPlan", "backend_fault",
-           "make_test_file"]
+__all__ = ["FakeNvmeSource", "FakeStripedNvmeSource", "FaultPlan",
+           "backend_fault", "make_test_file"]

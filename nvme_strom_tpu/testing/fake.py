@@ -18,7 +18,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Set
 
 from ..api import StromError
-from ..engine import PlainSource
+from ..engine import PlainSource, StripedSource
 
 
 def make_test_file(path: str, size: int, *, seed: int = 0) -> None:
@@ -152,6 +152,48 @@ class FakeNvmeSource(PlainSource):
         # explicit hints count, not the ambient dirtiness of a freshly
         # written test file (which would route everything write-back and
         # bypass the direct path the fault plan instruments)
+        if self.force_cached_fraction is not None:
+            from ..engine import Source
+            return Source.hot_fraction(self, offset, length)
+        return super().hot_fraction(offset, length)
+
+
+class FakeStripedNvmeSource(StripedSource):
+    """Striped loopback 'NVMe set': N member files plus per-member
+    injected latency/faults (PR 5).
+
+    Same injection tiers as :class:`FakeNvmeSource`, but the member index
+    flows into the plan so ``slow_member`` / per-lane quarantine scenarios
+    exercise the engine's per-member submission lanes: the overridden read
+    leg routes the whole task down the Python pool path, where each member
+    of a striped source gets its own worker pool — a slow or failing
+    member stalls only its own lane while siblings drain.
+    """
+
+    def __init__(self, paths, stripe_chunk_size: int, *,
+                 fault_plan: Optional[FaultPlan] = None,
+                 block_size: int = 512,
+                 force_cached_fraction: Optional[float] = None):
+        super().__init__(paths, stripe_chunk_size, block_size)
+        self.fault_plan = fault_plan or FaultPlan()
+        self.force_cached_fraction = force_cached_fraction
+
+    def read_member_direct(self, member: int, file_off: int, dest: memoryview) -> None:
+        self.fault_plan.check(file_off, len(dest), member=member)
+        super().read_member_direct(member, file_off, dest)
+        self.fault_plan.apply_corruption(file_off, dest)
+
+    def read_member_buffered(self, member: int, file_off: int, dest: memoryview) -> None:
+        self.fault_plan.check_buffered(file_off, len(dest))
+        super().read_member_buffered(member, file_off, dest)
+
+    def cached_fraction(self, offset: int, length: int) -> float:
+        if self.force_cached_fraction is not None:
+            return self.force_cached_fraction
+        return super().cached_fraction(offset, length)
+
+    def hot_fraction(self, offset: int, length: int) -> float:
+        # forced verdicts own arbitration (see FakeNvmeSource.hot_fraction)
         if self.force_cached_fraction is not None:
             from ..engine import Source
             return Source.hot_fraction(self, offset, length)

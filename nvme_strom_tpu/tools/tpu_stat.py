@@ -242,15 +242,24 @@ def main(argv=None) -> int:
                       f"{c.get('occ_integral_ns', 0) / occ_b:.2f}")
         if args.verbose and snap.get("members"):
             # per-stripe-member breakdown (part_stat_add analog): a slow
-            # member shows as an outlier avg-lat at similar req/byte counts
+            # member shows as an outlier avg-lat/p50 at similar req/byte
+            # counts; occ is the member lane's mean in-flight depth while
+            # busy (PR 5 per-member queue pairs) — a healthy scaled-out
+            # stripe shows every member near its lane depth
             print("per-member:")
-            print("  member   reqs        bytes   avg-lat  errs  retry  quar")
+            print("  member   reqs        bytes   avg-lat  p50      p95    "
+                  "  occ  errs  retry  quar")
             for m, v in sorted(snap["members"].items(), key=lambda kv: int(kv[0])):
+                occ_b = v.get("occ_busy_ns", 0)
+                occ = (f"{v.get('occ_integral_ns', 0) / occ_b:5.1f}"
+                       if occ_b else "   --")
                 health = f"{v.get('errors', 0):>5} {v.get('retries', 0):>6} " \
                          f"{v.get('quarantines', 0):>5}" \
                          + ("  QUARANTINED" if v.get("quarantined") else "")
                 print(f"  {int(m):>6} {v['nreq']:>6} {v['bytes']:>12} "
-                      f"  {show_avg(v['clk_ns'], v['nreq'])} {health}")
+                      f"  {show_avg(v['clk_ns'], v['nreq'])} "
+                      f"{_pshow(v.get('p50_ns'))} {_pshow(v.get('p95_ns'))} "
+                      f"{occ} {health}")
         return 0
 
     prev = snap

@@ -402,3 +402,39 @@ def test_index_groupby_min_respects_nulls(indexed_nullable):
         m = (c0 == 5) & (c2 == k) & ~n1
         if m.any():
             assert seq["mn"][i] == c1[m].min()
+
+
+def test_not_is_kleene_three_valued(ntable):
+    """`WHERE NOT c1 = 0` must NOT pass NULL rows: NOT(UNKNOWN) stays
+    UNKNOWN and the WHERE drops it (PostgreSQL three-valued logic) —
+    a plain `~mask` negation admitted every NULL row here."""
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    r = sql_query("SELECT COUNT(*) AS k FROM t WHERE NOT c1 = 0",
+                  path, schema)
+    assert r["k"] == int(((c1 != 0) & ~n1).sum())
+    # double negation round-trips (NOT NOT p == p under Kleene)
+    r = sql_query("SELECT COUNT(*) AS k FROM t WHERE NOT (NOT c1 > 5)",
+                  path, schema)
+    assert r["k"] == int(((c1 > 5) & ~n1).sum())
+    # De Morgan through the combinators: NOT(a OR b) true iff both
+    # operands are definitely false
+    r = sql_query("SELECT COUNT(*) AS k FROM t "
+                  "WHERE NOT (c1 > 0 OR c2 > 0.0)", path, schema)
+    assert r["k"] == int(((c1 <= 0) & ~n1 & (c2 <= 0) & ~n2).sum())
+    # NOT(a AND b): false operand decides even when the other is NULL
+    r = sql_query("SELECT COUNT(*) AS k FROM t "
+                  "WHERE NOT (c1 > 0 AND c2 > 0.0)", path, schema)
+    want = int((((c1 <= 0) & ~n1) | ((c2 <= 0) & ~n2)).sum())
+    assert r["k"] == want
+    # NOT under AND with a definite sibling
+    r = sql_query("SELECT COUNT(*) AS k FROM t "
+                  "WHERE c0 < 50 AND NOT c1 = 0", path, schema)
+    assert r["k"] == int(((c0 < 50) & (c1 != 0) & ~n1).sum())
+
+
+def test_not_kleene_under_workers(ntable):
+    """The Kleene masks rebuild identically from the shipped tree."""
+    path, schema, c0, c1, c2, n1, n2 = ntable
+    r = sql_query("SELECT COUNT(*) AS k FROM t WHERE NOT c1 = 0",
+                  path, schema, workers=2)
+    assert r["k"] == int(((c1 != 0) & ~n1).sum())

@@ -1,0 +1,248 @@
+"""Per-member engine lanes (PR 5): lane mapping, lazy scale-out at the
+first striped submit, NUMA-policy fallbacks, per-lane fault isolation,
+and the per-member latency/occupancy rollups.  Hardware-free: the native
+path runs against real files via io_uring/threadpool lanes; injection
+scenarios ride the striped loopback fake through the Python member
+pools."""
+
+import errno
+import os
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu import Session, StromError, config, stats
+from nvme_strom_tpu.engine import StripedSource, reorder_chunks
+from nvme_strom_tpu.stripe import lane_members, lane_of
+from nvme_strom_tpu.testing import (FakeStripedNvmeSource, FaultPlan,
+                                    make_test_file)
+
+CHUNK = 256 << 10
+STRIPE = 64 << 10
+
+
+def _make_members(tmp_path, n=4, size=1 << 20, tag="m"):
+    paths = []
+    for i in range(n):
+        p = str(tmp_path / f"{tag}{i}.bin")
+        make_test_file(p, size, seed=100 + i)
+        paths.append(p)
+    return paths
+
+
+def _expected_stream(paths, stripe_chunk):
+    """The logical byte stream a RAID-0 read of equal members returns."""
+    parts = [open(p, "rb").read() for p in paths]
+    nm = len(parts)
+    total = sum(len(p) for p in parts)
+    out = bytearray(total)
+    for i in range(total // stripe_chunk):
+        m, row = i % nm, i // nm
+        out[i * stripe_chunk:(i + 1) * stripe_chunk] = \
+            parts[m][row * stripe_chunk:(row + 1) * stripe_chunk]
+    return bytes(out)
+
+
+def _read_all(sess, src, chunk=CHUNK):
+    total = src.size // chunk * chunk
+    handle, buf = sess.alloc_dma_buffer(total)
+    want = list(range(total // chunk))
+    res = sess.memcpy_ssd2ram(src, handle, want, chunk)
+    sess.memcpy_wait(res.dma_task_id)
+    host = reorder_chunks(np.frombuffer(buf.view()[:total], np.uint8),
+                          chunk, res.chunk_ids, want)
+    return bytes(host), total
+
+
+class DirectStripe(StripedSource):
+    """Freshly-written members are fully page-cached; forcing the verdict
+    keeps every chunk on the direct/native path."""
+
+    def cached_fraction(self, offset, length):
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# lane mapping
+# ---------------------------------------------------------------------------
+
+def test_lane_mapping_roundtrip():
+    """lane_of and lane_members are inverses under member % nlanes."""
+    for nlanes in (1, 2, 3, 4):
+        for member in range(8):
+            lane = lane_of(member, nlanes)
+            assert 0 <= lane < nlanes
+            assert member in lane_members(lane, 8, nlanes)
+    # every member lands in exactly one lane
+    seen = [m for lane in range(3) for m in lane_members(lane, 8, 3)]
+    assert sorted(seen) == list(range(8))
+    assert lane_members(5, 8, 3) == []
+    assert lane_of(7, 0) == 0   # degenerate lane count clamps
+
+
+# ---------------------------------------------------------------------------
+# lazy scale-out on the native path
+# ---------------------------------------------------------------------------
+
+def test_lanes_scale_to_member_count(tmp_path):
+    """The first striped submit rebuilds the engine with one queue pair
+    per member; the copy stays byte-identical across the swap and the
+    per-member latency/occupancy rollups populate."""
+    paths = _make_members(tmp_path)
+    src = DirectStripe(paths, stripe_chunk_size=STRIPE)
+    before = stats.member_snapshot()
+    try:
+        with Session() as sess:
+            if sess._native is None:
+                pytest.skip("native engine not active")
+            assert sess._native.nlanes() == 1
+            got, total = _read_all(sess, src)
+            assert sess._native.nlanes() == 4
+            sess.stat_info()
+    finally:
+        src.close()
+    assert got == _expected_stream(paths, STRIPE)[:total]
+    after = stats.member_snapshot()
+    for m in range(4):
+        assert after[m]["nreq"] > before.get(m, {}).get("nreq", 0)
+        # service-latency percentiles + lane occupancy (tpu_stat -v cols)
+        assert after[m].get("p50_ns", 0) > 0
+        assert after[m].get("occ_busy_ns", 0) > 0
+
+
+def test_explicit_ring_count_wins(tmp_path):
+    """engine_rings > 0 is an operator override: no auto scale-out."""
+    config.set("engine_rings", 2)
+    paths = _make_members(tmp_path, n=4)
+    src = DirectStripe(paths, stripe_chunk_size=STRIPE)
+    try:
+        with Session() as sess:
+            if sess._native is None:
+                pytest.skip("native engine not active")
+            got, total = _read_all(sess, src)
+            assert sess._native.nlanes() == 2
+    finally:
+        src.close()
+    assert got == _expected_stream(paths, STRIPE)[:total]
+
+
+# ---------------------------------------------------------------------------
+# NUMA policy fallbacks
+# ---------------------------------------------------------------------------
+
+def test_numa_auto_without_topology(tmp_path, monkeypatch):
+    """numa_policy=auto on a host with no sysfs NUMA topology (every
+    device node unknown) must leave lanes floating — scale-out still
+    happens, nothing raises, and no pin is attempted."""
+    import nvme_strom_tpu.numa as numa
+    monkeypatch.setattr(numa, "device_numa_node", lambda path: -1)
+    calls = []
+    monkeypatch.setattr(numa, "node_cpus",
+                        lambda node: calls.append(node) or [])
+    paths = _make_members(tmp_path)
+    src = DirectStripe(paths, stripe_chunk_size=STRIPE)
+    try:
+        with Session() as sess:
+            if sess._native is None:
+                pytest.skip("native engine not active")
+            got, total = _read_all(sess, src)
+            assert sess._native.nlanes() == 4
+    finally:
+        src.close()
+    assert got == _expected_stream(paths, STRIPE)[:total]
+    assert calls == []   # unknown node: never asked for a cpu set
+
+
+def test_numa_fixed_node_policy(tmp_path, monkeypatch):
+    """numa_policy=node:N pins every lane to that node's cpus (libnuma
+    not required — the cpu list comes from the numa helpers, which fall
+    back to sysfs/all-cpus)."""
+    import nvme_strom_tpu.numa as numa
+    monkeypatch.setattr(numa, "node_cpus", lambda node: [0])
+    config.set("numa_policy", "node:0")
+    paths = _make_members(tmp_path, n=2)
+    src = DirectStripe(paths, stripe_chunk_size=STRIPE)
+    try:
+        with Session() as sess:
+            if sess._native is None:
+                pytest.skip("native engine not active")
+            got, total = _read_all(sess, src)
+            assert sess._native.nlanes() == 2
+    finally:
+        src.close()
+    assert got == _expected_stream(paths, STRIPE)[:total]
+
+
+def test_numa_policy_validation():
+    from nvme_strom_tpu.config import ConfigError
+    config.set("numa_policy", "off")
+    config.set("numa_policy", "node:3")
+    config.set("numa_policy", "auto")
+    with pytest.raises(ConfigError):
+        config.set("numa_policy", "sideways")
+
+
+# ---------------------------------------------------------------------------
+# per-lane fault isolation (Python member pools)
+# ---------------------------------------------------------------------------
+
+def test_slow_member_byte_identity(tmp_path):
+    """A slow member (FaultPlan slow_member) delays only its own lane;
+    the assembled stream stays byte-identical across the stripes."""
+    paths = _make_members(tmp_path, n=4, size=512 << 10, tag="s")
+    plan = FaultPlan(slow_member=2, slow_s=0.02)
+    src = FakeStripedNvmeSource(paths, STRIPE, fault_plan=plan,
+                                force_cached_fraction=0.0)
+    try:
+        with Session(io_backend="python") as sess:
+            got, total = _read_all(sess, src)
+    finally:
+        src.close()
+    assert got == _expected_stream(paths, STRIPE)[:total]
+
+
+class _FailMemberPlan(FaultPlan):
+    """Every direct read of one member fails transiently (a dying disk in
+    the set); the buffered tier still serves it."""
+
+    def __init__(self, member):
+        super().__init__()
+        self.fail_member = member
+
+    def check(self, file_off, length, member=None):
+        super().check(file_off, length, member=member)
+        if member == self.fail_member:
+            raise StromError(errno.EIO, "injected member failure")
+
+
+def test_failing_member_quarantines_without_stalling_siblings(tmp_path):
+    """A member whose direct reads always fail transiently quarantines
+    onto the buffered path while the sibling lanes keep draining: the
+    task completes byte-identical, the bad member shows errors +
+    quarantine in the per-member stats, siblings show none."""
+    config.set("io_retries", 0)
+    config.set("quarantine_after", 2)
+    bad = 1
+    before = stats.member_snapshot()
+    paths = _make_members(tmp_path, n=4, size=512 << 10, tag="q")
+    src = FakeStripedNvmeSource(paths, STRIPE,
+                                fault_plan=_FailMemberPlan(bad),
+                                force_cached_fraction=0.0)
+    try:
+        with Session(io_backend="python") as sess:
+            got, total = _read_all(sess, src)
+    finally:
+        src.close()
+    assert got == _expected_stream(paths, STRIPE)[:total]
+    after = stats.member_snapshot()
+
+    def delta(m, field):
+        return after.get(m, {}).get(field, 0) \
+            - before.get(m, {}).get(field, 0)
+
+    assert delta(bad, "errors") > 0
+    assert delta(bad, "quarantines") >= 1
+    for m in range(4):
+        assert delta(m, "nreq") > 0          # every lane drained
+        if m != bad:
+            assert delta(m, "errors") == 0   # isolation: siblings clean
