@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults test check bench-smoke bench-stripe probe-loop clean
+.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe probe-loop clean
 
 all: native
 
@@ -18,6 +18,15 @@ stress:
 stress-faults:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.stress_faults
 	JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q -m faults
+
+# Deterministic member-survival gate (PR 6): seeded fault schedules
+# (fail-stop, flaky, slow member, corrupt-once, fail-stop-then-rejoin)
+# through the mirrored striped fake plus one native leg, asserting byte
+# identity, bounded latency and legal health transitions.  Override
+# STROM_CHAOS_SEED / STROM_CHAOS_ROUNDS to widen.
+chaos:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.chaos
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m chaos
 
 STRESS_FILE := /tmp/strom_stress_src.bin
 
@@ -66,8 +75,9 @@ bench-stripe:
 	  JAX_PLATFORMS=cpu python bench.py --stripe-scaling
 	@echo "bench-stripe ok"
 
-# The everyday gate: tier-1 tests plus the perf smokes.
-check: bench-smoke bench-stripe
+# The everyday gate: tier-1 tests plus the perf smokes and the seeded
+# member-survival schedules.
+check: bench-smoke bench-stripe chaos
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

@@ -227,6 +227,15 @@ STAT_FIELDS: Tuple[str, ...] = (
     "nr_csum_fail",           # page checksum mismatches observed
     "nr_csum_reread",         # re-reads issued to heal a checksum mismatch
     "nr_member_quarantine",   # member quarantine transitions (entries)
+    # member-health state machine + hedging + mirroring (PR 6)
+    "nr_member_failed",       # members driven to FAILED (persistent error)
+    "nr_member_rejoin",       # REJOINING -> HEALTHY warmup completions
+    "nr_canary_probe",        # background canary probes issued
+    "nr_hedge_issued",        # hedge legs actually launched (latch expired)
+    "nr_hedge_won",           # hedge legs that delivered the bytes first
+    "nr_hedge_cancelled",     # hedge legs discarded after the primary won
+    "nr_mirror_read",         # extents served from a member's mirror at
+    #                           direct speed (degraded-mode striping)
     # queue-occupancy integral (PR 4 saturation work): occ_integral_ns
     # accumulates sum(in_flight * dt) and occ_busy_ns the elapsed ns with
     # in_flight > 0, so mean queue occupancy over an interval is

@@ -109,6 +109,16 @@ def _check_numa_policy(val: str, _cfg: "Config") -> None:
     raise ConfigError(f"numa_policy must be auto|off|node:N, got {val!r}")
 
 
+def _check_hedge_policy(val: str, _cfg: "Config") -> None:
+    if val not in ("off", "p99", "fixed"):
+        raise ConfigError(f"hedge_policy must be off|p99|fixed, got {val!r}")
+
+
+def _check_mirror(val: str, _cfg: "Config") -> None:
+    if val not in ("none", "paired"):
+        raise ConfigError(f"mirror must be none|paired, got {val!r}")
+
+
 def _check_coalesce_limit(val: int, cfg: "Config") -> None:
     # 0 = coalescing off; otherwise the merge window must cover at least
     # one dma_max_size request or planning could emit nothing mergeable
@@ -272,7 +282,47 @@ class Config:
                      "buffered until quarantine_s expires)"))
         reg(Var("quarantine_s", 30.0, "float", minval=0.0,
                 help="seconds a quarantined member stays on the "
-                     "buffered path before the direct path is re-probed"))
+                     "buffered path before the health machine moves it "
+                     "to REJOINING and the token-bucket warmup re-probes "
+                     "the direct path"))
+        # member-health state machine + hedging + mirroring (PR 6)
+        reg(Var("suspect_ratio", 6.0, "float", minval=1.0,
+                help="a member whose service-latency p99 drifts past "
+                     "suspect_ratio x the stripe median p99 (log2-ns "
+                     "histograms, >=2 members with samples) is marked "
+                     "SUSPECT: still served direct, but hedge-eligible; "
+                     "it recovers at half the ratio (hysteresis)"))
+        reg(Var("hedge_policy", "off", "str",
+                help="hedged reads on the Python member-pool path: 'off' "
+                     "never hedges, 'fixed' re-issues a chunk still in "
+                     "flight after hedge_ms on the mirror member (or the "
+                     "buffered path), 'p99' derives the latch from the "
+                     "member's own p99 with hedge_ms as the floor; first "
+                     "completion wins, the loser is discarded",
+                validate=_check_hedge_policy))
+        reg(Var("hedge_ms", 20.0, "float", minval=0.0,
+                help="hedge latch for hedge_policy=fixed, and the latch "
+                     "floor for hedge_policy=p99"))
+        reg(Var("mirror", "none", "str",
+                help="default stripe mirror map for striped sources: "
+                     "'paired' treats member 2k+1 as a byte-identical "
+                     "replica of member 2k (RAID-10 style) so a failed "
+                     "member's extents are served from its mirror at "
+                     "direct speed; 'none' stripes every member (RAID-0)",
+                validate=_check_mirror))
+        reg(Var("canary_interval_s", 1.0, "float", minval=0.0,
+                help="period of the background canary prober: FAILED "
+                     "members get a small direct read to detect recovery "
+                     "(-> REJOINING), REJOINING members accumulate warmup "
+                     "successes toward HEALTHY (0 = no canaries)"))
+        reg(Var("rejoin_successes", 8, "int", minval=1, maxval=1 << 20,
+                help="consecutive direct-read/canary successes a "
+                     "REJOINING member needs before it is HEALTHY again"))
+        reg(Var("rejoin_tokens_s", 16.0, "float", minval=0.0,
+                help="token-bucket refill rate (direct reads per second) "
+                     "allowed onto a REJOINING member during warmup; "
+                     "requests past the bucket ride the mirror/buffered "
+                     "path (0 = no throttle: rejoin at full rate)"))
         reg(Var("join_build_host_max", 256 << 20, "size", minval=1 << 12,
                 help="largest on-disk build-side table loaded whole "
                      "(one projection scan) when partitioning a join "

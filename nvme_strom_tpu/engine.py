@@ -42,9 +42,10 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
-from .api import BufferInfo, DmaTaskState, FileInfo, FsKind, MemCopyResult, StromError
+from .api import (BufferInfo, DmaTaskState, ErrorClass, FileInfo, FsKind,
+                  MemCopyResult, StromError)
 from .config import config
-from .fault import MemberHealth, RetryPolicy
+from .fault import HealthState, MemberHealthMachine, RetryPolicy
 from .log import pr_info, pr_warn
 from .eligibility import probe_backing
 from .stats import stats
@@ -196,6 +197,13 @@ class Source:
     def member_fds(self) -> List[int]:
         """O_DIRECT fds, one per member."""
         raise NotImplementedError
+
+    def mirror_of(self, member: int) -> Optional[int]:
+        """Member holding a byte-identical replica of *member* (same
+        member offsets), or None when the source has no redundancy.
+        Striped sources opened with ``mirror='paired'`` override this;
+        it is the basis for degraded-mode striping and hedged reads."""
+        return None
 
     def cached_fraction(self, offset: int, length: int) -> float:
         """Fraction of the range resident in the host page cache
@@ -645,13 +653,24 @@ class StripedSource(Source):
     """RAID-0 striped member set resolved with :class:`StripeMap`."""
 
     def __init__(self, paths: Sequence[str], stripe_chunk_size: int,
-                 block_size: int = 512, writable: bool = False):
+                 block_size: int = 512, writable: bool = False,
+                 mirror: Optional[str] = None):
+        if mirror is None:
+            mirror = str(config.get("mirror"))
+        if writable and mirror == "paired":
+            raise StromError(_errno.EINVAL,
+                             "mirror='paired' is read-path only: the write "
+                             "planner does not replicate to pair partners")
         self.members = [_FileMember(p, writable) for p in paths]
-        self.map = StripeMap([m.size for m in self.members], stripe_chunk_size)
+        self.map = StripeMap([m.size for m in self.members],
+                             stripe_chunk_size, mirror=mirror)
         self.size = self.map.total_size
         self.block_size = block_size
         self.stripe_chunk_size = stripe_chunk_size
         self.writable = writable
+
+    def mirror_of(self, member: int) -> Optional[int]:
+        return self.map.mirror_of(member)
 
     def extents(self, offset: int, length: int) -> List[Extent]:
         return [Extent(e.member, e.member_offset, e.length, e.logical_offset)
@@ -692,7 +711,8 @@ def open_source(spec: Union[str, Sequence[str]], *,
                 stripe_chunk_size: Optional[int] = None,
                 segment_size: Optional[int] = None,
                 block_size: Optional[int] = None,
-                writable: bool = False) -> Source:
+                writable: bool = False,
+                mirror: Optional[str] = None) -> Source:
     """Open a plain, striped, or segmented source from a path spec."""
     if isinstance(spec, str):
         info = check_file(spec)
@@ -701,7 +721,7 @@ def open_source(spec: Union[str, Sequence[str]], *,
     paths = list(spec)
     if stripe_chunk_size:
         return StripedSource(paths, stripe_chunk_size, block_size or 512,
-                             writable)
+                             writable, mirror=mirror)
     if segment_size:
         return SegmentedSource(paths, segment_size, block_size or 512,
                                writable)
@@ -1104,8 +1124,18 @@ class Session:
         # fault-tolerance layer (PR 1): retry policy, per-member health,
         # and the task watchdog
         self._retry = RetryPolicy.from_config()
-        self._member_health = MemberHealth()
+        self._member_health = MemberHealthMachine()
         self._retry_rng = random.Random(os.getpid() ^ id(self))
+        # resilience tier (PR 6): striped sources seen by submits, probed
+        # by the background canary thread while any member is FAILED or
+        # REJOINING (weak: canaries must never keep a closed source alive)
+        self._canary_sources: "_weakref.WeakSet" = _weakref.WeakSet()
+        self._canary_buf = None
+        self._canary_stop = threading.Event()
+        self._canary = threading.Thread(target=self._canary_loop,
+                                        daemon=True,
+                                        name="strom-canary")
+        self._canary.start()
         # adaptive chunk sizing (PR 4, per-member since PR 5): one sizer
         # per stripe member so the effective request cap converges per
         # DEVICE — a slow member shrinks its own merges without throttling
@@ -1336,6 +1366,52 @@ class Session:
             for msg in expired:   # outside the locks: slow stderr must
                 pr_warn("watchdog: %s", msg)   # not stall completions
 
+    def _canary_loop(self) -> None:
+        """Background canary prober (PR 6): every ``canary_interval_s``,
+        members the health machine flags (FAILED: detect recovery;
+        REJOINING: advance warmup without client traffic) get one small
+        direct read against each registered striped source.  A FAILED
+        member that answers moves to REJOINING; warmup successes ramp a
+        REJOINING member back to HEALTHY through the token bucket instead
+        of a recovery cliff."""
+        while True:
+            interval = float(config.get("canary_interval_s"))
+            if self._canary_stop.wait(interval if interval > 0 else 0.5):
+                return
+            if interval <= 0:
+                continue
+            cands = self._member_health.canary_candidates()
+            if not cands:
+                continue
+            for src in list(self._canary_sources):
+                nmem = len(getattr(src, "members", ()))
+                for m in cands:
+                    if m >= nmem or self._canary_stop.is_set():
+                        continue
+                    self._canary_probe(src, m)
+
+    def _canary_probe(self, source: Source, member: int) -> None:
+        """One canary: a small direct read at member offset 0 (O_DIRECT
+        needs an aligned buffer, so the scratch page is mmap-backed)."""
+        try:
+            size = getattr(source.members[member], "size", 0)
+            blk = max(int(getattr(source, "block_size", 512)), 512)
+            length = min(PAGE_SIZE, size // blk * blk)
+            if length <= 0:
+                return
+            if self._canary_buf is None:
+                self._canary_buf = mmap.mmap(-1, PAGE_SIZE)
+            source.read_member_direct(
+                member, 0, memoryview(self._canary_buf)[:length])
+        except (StromError, OSError) as e:
+            if getattr(e, "errno", None) == _errno.EBADF:
+                return   # source closed under the prober: not a verdict
+            self._member_health.record_canary(member, False)
+        except Exception:
+            return       # a broken probe must never kill the thread
+        else:
+            self._member_health.record_canary(member, True)
+
     def _task_get(self, task: DmaTask) -> None:
         s = self._slot_of(task.task_id)
         with self._slot_cv[s]:
@@ -1489,6 +1565,10 @@ class Session:
                           is Source.read_member_direct)
             if use_native:
                 self._ensure_member_lanes(source)
+            if len(getattr(source, "members", ())) > 1:
+                # resilience tier (PR 6): striped sources become canary
+                # targets so FAILED members are re-probed in background
+                self._canary_sources.add(source)
             dma_max = int(config.get("dma_max_size"))
             # coalescing beyond dma_max is the native-queue saturation
             # lever; the pool path keeps classic per-extent planning so
@@ -1505,6 +1585,18 @@ class Session:
             window = max(int(config.get("submit_window")), 1)
             entries = [(cid, i) for i, cid in enumerate(direct_ids)]
             fds = source.member_fds() if use_native else None
+            # degraded-mode striping on the native path (PR 6): extents of
+            # a member the health machine routes away (QUARANTINED/FAILED)
+            # are submitted against the mirror partner's fd — and lane —
+            # at direct speed, instead of collapsing to the buffered path
+            mirror_remap: Dict[int, int] = {}
+            if use_native:
+                for m in range(len(fds)):
+                    if self._member_health.routes_away(m):
+                        mir = source.mirror_of(m)
+                        if mir is not None and \
+                                not self._member_health.routes_away(mir):
+                            mirror_remap[m] = mir
             native_failed = False
             for w in range(0, len(entries), window):
                 with stats.stage("setup_prps"):
@@ -1540,17 +1632,23 @@ class Session:
                         # per-segment submissions for the native engine —
                         # its deep per-ring queue already holds them all;
                         # the vectored form pays off on the preadv pool path
+                        m_eff = mirror_remap.get(r.member, r.member)
+                        if m_eff != r.member:
+                            stats.add("nr_mirror_read")
                         foff = r.file_off
                         for dseg, lseg in r.dest_segs:
-                            native_reqs.append((fds[r.member], foff, lseg,
+                            native_reqs.append((fds[m_eff], foff, lseg,
                                                 dseg))
-                            native_members.append(r.member)
+                            native_members.append(m_eff)
                             foff += lseg
                         native_rs.append(r)
                     else:
-                        native_reqs.append((fds[r.member], r.file_off,
+                        m_eff = mirror_remap.get(r.member, r.member)
+                        if m_eff != r.member:
+                            stats.add("nr_mirror_read")
+                        native_reqs.append((fds[m_eff], r.file_off,
                                             r.length, r.dest_off))
-                        native_members.append(r.member)
+                        native_members.append(m_eff)
                         native_rs.append(r)
                 if not native_reqs:
                     continue
@@ -1814,6 +1912,11 @@ class Session:
             stats.member_add(r.member, r.length, elapsed)
             if not r.buffered:
                 stats.observe_latency(elapsed)
+                if err is None:
+                    # health-machine latency feed (PR 6): per-member p99
+                    # drift past suspect_ratio x the stripe median marks
+                    # the member SUSPECT (hedge-eligible)
+                    self._member_health.observe_latency(r.member, elapsed)
                 szr = self._chunk_sizers.get(r.member)
                 if szr is not None:
                     szr.observe(elapsed)
@@ -1822,21 +1925,32 @@ class Session:
 
     def _read_direct_resilient(self, task: DmaTask, source: Source,
                                r: Request, dest: memoryview) -> None:
-        """One direct-read extent with the full recovery ladder (PR 1):
-        quarantined members go straight to the buffered path; TRANSIENT
-        errors retry under the RetryPolicy (backoff + jitter), then the
-        extent degrades to a buffered read; PERSISTENT errors fail fast;
-        optional crc32c verification re-reads on mismatch and latches a
-        CORRUPTION error after ``checksum_retries`` failed heals.
+        """One direct-read extent with the full recovery ladder (PR 1,
+        extended PR 6): members the health machine routes away serve from
+        their mirror partner at direct speed (degraded-mode striping),
+        falling back to the buffered path; TRANSIENT errors retry under
+        the RetryPolicy (backoff + jitter) then degrade mirror-first;
+        PERSISTENT errors drive the member to FAILED and fail over the
+        same way, so a mid-task fail-stop stays byte-identical; with
+        ``hedge_policy`` armed, a plain extent still in flight past the
+        hedge latch races a mirror/buffered hedge leg, first completion
+        wins; optional crc32c verification re-reads on mismatch and
+        latches a CORRUPTION error after ``checksum_retries`` failed
+        heals.
 
         Coalesced (vectored) requests read all destination segments in one
         preadv; the recovery ladder treats the whole vectored extent as one
         unit, exactly as a plain extent."""
+        health = self._member_health
+        mirror = source.mirror_of(r.member)
         if r.dest_segs:
             views = [dest[d:d + l] for d, l in r.dest_segs]
 
             def _direct() -> None:
                 source.read_member_direct_v(r.member, r.file_off, views)
+
+            def _mirror_read() -> None:
+                source.read_member_direct_v(mirror, r.file_off, views)
 
             def _buffered() -> None:
                 foff = r.file_off
@@ -1849,26 +1963,71 @@ class Session:
             def _direct() -> None:
                 source.read_member_direct(r.member, r.file_off, piece)
 
+            def _mirror_read() -> None:
+                source.read_member_direct(mirror, r.file_off, piece)
+
             def _buffered() -> None:
                 source.read_member_buffered(r.member, r.file_off, piece)
 
         fallback_ok = bool(config.get("io_fallback"))
-        if fallback_ok and self._member_health.quarantined(r.member):
-            stats.add("nr_io_fallback")
-            _buffered()
-            return
+
+        def _try_mirror() -> bool:
+            """Degraded-mode striping: serve the extent from the pair
+            partner at direct speed.  A mirror failure counts against the
+            mirror and falls through to the next rung of the ladder."""
+            if mirror is None or not health.allow_direct(mirror):
+                return False
+            tm = time.monotonic_ns()
+            try:
+                _mirror_read()
+            except (StromError, OSError) as e:
+                me = e if isinstance(e, StromError) else \
+                    StromError(e.errno or _errno.EIO, str(e))
+                health.record_failure(
+                    mirror, fatal=me.error_class is ErrorClass.PERSISTENT)
+                stats.member_error(mirror)
+                return False
+            stats.add("nr_mirror_read")
+            health.record_success(mirror)
+            health.observe_latency(mirror, time.monotonic_ns() - tm)
+            return True
+
+        done = False
+        if (mirror is not None or fallback_ok) \
+                and not health.allow_direct(r.member):
+            # routed away (QUARANTINED/FAILED, or REJOINING beyond its
+            # warmup tokens): mirror at direct speed first, buffered next
+            if _try_mirror():
+                done = True
+            elif fallback_ok:
+                stats.add("nr_io_fallback")
+                _buffered()
+                done = True
+        if not done and not r.dest_segs:
+            hd = health.hedge_delay_s(r.member)
+            if hd is not None and len(getattr(source, "members", ())) > 1:
+                done = self._read_hedged(task, source, r, piece, hd, mirror)
         attempt = 0
-        while True:
+        while not done:
             try:
                 _direct()
-                self._member_health.record_success(r.member)
+                health.record_success(r.member)
                 break
             except (StromError, OSError) as e:
                 se = e if isinstance(e, StromError) else \
                     StromError(e.errno or _errno.EIO, str(e))
                 if not se.transient:
+                    # fail-stop: the member is gone.  Its mirror keeps the
+                    # task alive at direct speed (byte identity across
+                    # mid-task member loss); otherwise latch the error.
+                    health.record_failure(
+                        r.member,
+                        fatal=se.error_class is ErrorClass.PERSISTENT)
+                    stats.member_error(r.member)
+                    if _try_mirror():
+                        break
                     raise se
-                self._member_health.record_failure(r.member)
+                health.record_failure(r.member)
                 # stop burning attempts once the task already failed or
                 # expired — the result can no longer be delivered
                 if attempt < self._retry.attempts and not task.errno_:
@@ -1878,7 +2037,11 @@ class Session:
                     attempt += 1
                     continue
                 stats.member_error(r.member)
-                if fallback_ok and not task.errno_:
+                if task.errno_:
+                    raise se
+                if _try_mirror():
+                    break
+                if fallback_ok:
                     # retries exhausted: degrade this extent to the
                     # buffered path (the reference's page-cache
                     # arbitration, reused as an error path)
@@ -1888,6 +2051,132 @@ class Session:
                 raise se
         if config.get("checksum_verify"):
             self._verify_request_checksums(source, r, dest)
+
+    def _read_hedged(self, task: DmaTask, source: Source, r: Request,
+                     piece: memoryview, delay_s: float,
+                     mirror: Optional[int]) -> bool:
+        """Hedged read of one plain extent (Python pool path): the primary
+        direct read races a hedge leg armed after *delay_s* — the mirror
+        partner at direct speed when one exists, else the buffered path.
+        Both legs land in private scratch buffers and the first completion
+        copies into the destination under the winner lock; the loser is
+        discarded (safe cancellation: a torn destination is impossible and
+        a late loser never overwrites the winner).
+
+        Returns True when either leg delivered the extent, False when
+        there is nothing to hedge onto (the caller runs the plain ladder);
+        raises when the primary failed and the hedge could not save it."""
+        health = self._member_health
+        use_mirror = mirror is not None and health.allow_direct(mirror)
+        fallback_ok = bool(config.get("io_fallback"))
+        if not use_mirror and not fallback_ok:
+            return False
+        lock = threading.Lock()
+        won = threading.Event()            # a winner has landed in dest
+        hedge_settled = threading.Event()  # the hedge leg has exited
+        prim_settled = threading.Event()   # the primary leg has exited
+        state = {"winner": None, "prim_ok": False, "prim_err": None}
+
+        def _finish(who: str, scratch) -> bool:
+            with lock:
+                if state["winner"] is None and not task.errno_:
+                    state["winner"] = who
+                    piece[:] = scratch
+                    won.set()
+                    return True
+            return False
+
+        def _hedge_leg() -> None:
+            try:
+                if won.wait(delay_s) or task.errno_:
+                    return            # primary beat the latch: never issued
+                with lock:
+                    if state["winner"] is not None:
+                        return
+                stats.add("nr_hedge_issued")
+                # page-aligned scratch: the direct leg is an O_DIRECT
+                # pread and a heap bytearray would EINVAL it
+                scratch = mmap.mmap(-1, max(r.length, 1))
+                mv = memoryview(scratch)[:r.length]
+                try:
+                    if use_mirror:
+                        source.read_member_direct(mirror, r.file_off, mv)
+                    else:
+                        source.read_member_buffered(r.member, r.file_off, mv)
+                except (StromError, OSError):
+                    if use_mirror:
+                        health.record_failure(mirror)
+                    stats.add("nr_hedge_cancelled")
+                    return
+                if use_mirror:
+                    health.record_success(mirror)
+                    stats.add("nr_mirror_read")
+                if _finish("hedge", scratch):
+                    stats.add("nr_hedge_won")
+                else:
+                    stats.add("nr_hedge_cancelled")
+            finally:
+                hedge_settled.set()
+
+        def _primary_leg() -> None:
+            scratch = mmap.mmap(-1, max(r.length, 1))   # O_DIRECT-aligned
+            mv = memoryview(scratch)[:r.length]
+            attempt = 0
+            try:
+                while True:
+                    try:
+                        source.read_member_direct(r.member, r.file_off, mv)
+                        health.record_success(r.member)
+                        break
+                    except (StromError, OSError) as e:
+                        se = e if isinstance(e, StromError) else \
+                            StromError(e.errno or _errno.EIO, str(e))
+                        if se.transient and attempt < self._retry.attempts \
+                                and not task.errno_ and not won.is_set():
+                            health.record_failure(r.member)
+                            stats.add("nr_io_retry")
+                            stats.member_error(r.member, retried=True)
+                            self._retry.sleep(attempt, self._retry_rng)
+                            attempt += 1
+                            continue
+                        # terminal primary failure: exactly one health
+                        # debit for this chunk even when the hedge already
+                        # won — a hedged chunk must not double-count
+                        # toward quarantine
+                        health.record_failure(
+                            r.member,
+                            fatal=se.error_class is ErrorClass.PERSISTENT)
+                        stats.member_error(r.member)
+                        state["prim_err"] = se
+                        return
+                state["prim_ok"] = True
+                _finish("primary", scratch)
+            finally:
+                prim_settled.set()
+
+        # both legs race off-thread so the extent completes at the FIRST
+        # landing — the lane worker is not pinned behind a slow primary
+        # after its hedge has already delivered (the hedge would otherwise
+        # only save failed reads, never slow ones)
+        self._pool.submit(_hedge_leg)
+        self._pool.submit(_primary_leg)
+        while not won.wait(0.05):
+            if prim_settled.is_set() and hedge_settled.is_set():
+                break
+        with lock:
+            if state["winner"] is not None:
+                return True
+        # no winner and both legs settled: either the task already
+        # latched an error (nothing left to deliver) or the primary
+        # failed terminally and the hedge could not save it
+        if state["prim_ok"]:
+            return True
+        primary_err = state["prim_err"]
+        if fallback_ok and not task.errno_:
+            stats.add("nr_io_fallback")
+            source.read_member_buffered(r.member, r.file_off, piece)
+            return True
+        raise primary_err
 
     def _verify_request_checksums(self, source: Source, r: Request,
                                   dest: memoryview) -> None:
@@ -2162,6 +2451,10 @@ class Session:
             fed = False
             for m, h in eng.member_lat_hist_delta(used).items():
                 stats.merge_member_hist(m, h)
+                # suspect detection covers the native path too: the lane
+                # reaper's per-member latency view folds into the health
+                # machine's own histograms (PR 6)
+                self._member_health.observe_hist(m, h)
                 total = sum(h)
                 if not total:
                     continue
@@ -2217,6 +2510,8 @@ class Session:
         self._abandon_native = True  # bound pool shutdown on stuck native I/O
         self._watchdog_stop.set()
         self._watchdog.join(timeout=2.0)
+        self._canary_stop.set()
+        self._canary.join(timeout=2.0)
         self._pool.shutdown(wait=True)
         for p in self._member_pools.values():
             p.shutdown(wait=True)

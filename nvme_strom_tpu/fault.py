@@ -1,4 +1,4 @@
-"""Fault-tolerance policy for the I/O runtime (PR 1).
+"""Fault-tolerance policy for the I/O runtime (PR 1, extended PR 6).
 
 The reference retains a task's first error until the caller reaps it
 (kmod/nvme_strom.c first-error latch) but has no recovery tier: any EIO
@@ -9,26 +9,42 @@ half of the recovery stack:
 * :class:`RetryPolicy` — bounded attempts with exponential backoff +
   jitter, built from the ``io_retries`` / ``retry_backoff_ms`` /
   ``retry_backoff_max_ms`` / ``retry_jitter`` config vars.
-* :class:`MemberHealth` — per-stripe-member consecutive-failure counters
-  feeding a quarantine decision (``quarantine_after`` failures route the
-  member's reads to the buffered path for ``quarantine_s`` seconds), the
-  error-side analog of the reference's per-disk part_stat accounting.
+* :class:`MemberHealthMachine` — a per-stripe-member health state machine
+  (PR 6) replacing the binary quarantine flag::
 
-The mechanism half (where retries and fallbacks actually happen) lives in
-``engine.Session._do_request``; corruption re-reads in ``hbm.staging``.
+      healthy <-> suspect          (latency: p99 > suspect_ratio x median)
+      healthy/suspect -> quarantined  (quarantine_after consecutive
+                                       transient failures, quarantine_s hold)
+      healthy/suspect -> failed       (PERSISTENT error: the disk is gone)
+      quarantined --timer--> rejoining
+      failed --canary success--> rejoining
+      rejoining --rejoin_successes--> healthy   (token-bucket warmup)
+      rejoining --transient failure--> quarantined  (fresh hold)
+      rejoining --persistent failure--> failed
+
+  SUSPECT members stay on the direct path but are prime hedge targets;
+  QUARANTINED/FAILED members route to their mirror (degraded striping)
+  or the buffered path; REJOINING members take direct traffic at the
+  ``rejoin_tokens_s`` token-bucket rate instead of a recovery cliff.
+
+The mechanism half (where retries, hedges and fallbacks actually happen)
+lives in ``engine.Session``; corruption re-reads in ``hbm.staging``.
 """
 
 from __future__ import annotations
 
+import enum
 import random
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 from .config import config
-from .stats import stats
+from .stats import LAT_HIST_BUCKETS, hist_percentiles, stats
 
-__all__ = ["RetryPolicy", "MemberHealth"]
+__all__ = ["RetryPolicy", "HealthState", "MemberHealthMachine",
+           "MemberHealth"]
 
 
 @dataclass(frozen=True)
@@ -67,55 +83,330 @@ class RetryPolicy:
             time.sleep(d)
 
 
-class MemberHealth:
-    """Per-member consecutive-failure tracking with timed quarantine.
+class HealthState(enum.Enum):
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"
+    QUARANTINED = "quarantined"
+    FAILED = "failed"
+    REJOINING = "rejoining"
 
-    A member accumulating ``quarantine_after`` consecutive direct-read
-    failures is quarantined: :meth:`quarantined` returns True for
-    ``quarantine_s`` seconds and the engine routes that member's extents
-    straight to the buffered path (no direct attempts, no retry storms
-    against a dying disk).  Any direct-read success resets the streak and
-    lifts an active quarantine early.  Transitions and counters surface
-    through ``stats.member_snapshot()`` / ``tpu_stat -v``.
+
+# Every edge the machine may take; the chaos harness asserts observed
+# transition logs stay inside this set.
+ALLOWED_TRANSITIONS = frozenset({
+    (HealthState.HEALTHY, HealthState.SUSPECT),
+    (HealthState.SUSPECT, HealthState.HEALTHY),
+    (HealthState.HEALTHY, HealthState.QUARANTINED),
+    (HealthState.SUSPECT, HealthState.QUARANTINED),
+    (HealthState.HEALTHY, HealthState.FAILED),
+    (HealthState.SUSPECT, HealthState.FAILED),
+    (HealthState.QUARANTINED, HealthState.FAILED),
+    (HealthState.QUARANTINED, HealthState.REJOINING),
+    (HealthState.FAILED, HealthState.REJOINING),
+    (HealthState.REJOINING, HealthState.HEALTHY),
+    (HealthState.REJOINING, HealthState.QUARANTINED),
+    # a PERSISTENT error during warmup (or from a straggler read issued
+    # before the fail-stop) re-fails the member outright
+    (HealthState.REJOINING, HealthState.FAILED),
+})
+
+# decay the per-member latency histogram once it holds this many samples
+# so SUSPECT can clear after the member recovers
+_HIST_DECAY_AT = 2048
+# minimum samples before a member's p99 participates in suspect math
+_SUSPECT_MIN_SAMPLES = 32
+# evaluate the suspect predicate every N observations (it walks every
+# member's histogram; per-request would be wasteful)
+_SUSPECT_EVERY = 32
+
+
+@dataclass
+class _Member:
+    state: HealthState = HealthState.HEALTHY
+    since: float = 0.0
+    streak: int = 0              # consecutive direct-read failures
+    until: float = 0.0           # quarantine expiry (monotonic)
+    rejoin_ok: int = 0           # warmup successes accumulated
+    tokens: float = 1.0          # rejoin token bucket level
+    tokens_t: float = 0.0        # last refill timestamp
+    hist: List[int] = field(default_factory=lambda: [0] * LAT_HIST_BUCKETS)
+    hist_n: int = 0
+
+
+class MemberHealthMachine:
+    """Per-member health state machine with latency-driven suspicion,
+    timed quarantine, fail-stop detection, and token-bucket rejoin.
+
+    Thread-safe; one instance per :class:`engine.Session`.  Transitions
+    are appended to a bounded log (:meth:`transitions`) and mirrored into
+    the global stats registry (``stats.member_state`` + the PR 1
+    ``member_quarantine`` counters, which keep their exact semantics:
+    entering QUARANTINED bumps ``nr_member_quarantine`` and the member's
+    ``quarantines``; leaving clears the live flag).
     """
+
+    _LOG_MAX = 512
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._streak: dict = {}      # member -> consecutive failures
-        self._until: dict = {}       # member -> quarantine expiry (monotonic)
+        self._m: Dict[int, _Member] = {}
+        self._log: List[Tuple[int, str, str, float]] = []
 
-    def record_failure(self, member: int) -> bool:
-        """Account one failure; returns True if this pushed the member
-        into quarantine."""
-        threshold = int(config.get("quarantine_after"))
-        hold = float(config.get("quarantine_s"))
+    # -- internals -------------------------------------------------------
+
+    def _rec(self, member: int) -> _Member:
+        rec = self._m.get(member)
+        if rec is None:
+            rec = _Member(since=time.monotonic())
+            self._m[member] = rec
+        return rec
+
+    def _to(self, member: int, rec: _Member, new: HealthState,
+            now: float) -> None:
+        old = rec.state
+        if old is new:
+            return
+        if len(self._log) < self._LOG_MAX:
+            self._log.append((member, old.value, new.value, now))
+        if new is HealthState.QUARANTINED:
+            stats.member_quarantine(member, True)
+        elif old is HealthState.QUARANTINED:
+            stats.member_quarantine(member, False)
+        if new is HealthState.FAILED:
+            stats.add("nr_member_failed")
+        if old is HealthState.REJOINING and new is HealthState.HEALTHY:
+            stats.add("nr_member_rejoin")
+        if new is HealthState.REJOINING:
+            rec.rejoin_ok = 0
+            rec.tokens = 1.0
+            rec.tokens_t = now
+        rec.state = new
+        rec.since = now
+        stats.member_state(member, new.value)
+
+    def _expire(self, member: int, rec: _Member, now: float) -> None:
+        """QUARANTINED -> REJOINING once the hold lapses (the PR 1 cliff
+        back to healthy becomes a warmup)."""
+        if rec.state is HealthState.QUARANTINED and rec.until \
+                and now >= rec.until:
+            rec.streak = 0
+            self._to(member, rec, HealthState.REJOINING, now)
+
+    def _take_token(self, rec: _Member, now: float) -> bool:
+        rate = float(config.get("rejoin_tokens_s"))
+        if rate <= 0:
+            return True
+        cap = max(1.0, float(int(config.get("rejoin_successes"))))
+        rec.tokens = min(cap, rec.tokens + (now - rec.tokens_t) * rate)
+        rec.tokens_t = now
+        if rec.tokens >= 1.0:
+            rec.tokens -= 1.0
+            return True
+        return False
+
+    # -- failure / success accounting -----------------------------------
+
+    def record_failure(self, member: int, *, fatal: bool = False) -> bool:
+        """Account one direct-read failure; ``fatal`` (a PERSISTENT
+        error) drives the member straight to FAILED.  Returns True if
+        this call moved the member off the direct path."""
+        now = time.monotonic()
         with self._lock:
-            n = self._streak.get(member, 0) + 1
-            self._streak[member] = n
-            if n >= threshold and hold > 0 \
-                    and member not in self._until:
-                self._until[member] = time.monotonic() + hold
-                stats.member_quarantine(member, True)
+            rec = self._rec(member)
+            self._expire(member, rec, now)
+            if fatal:
+                if rec.state is HealthState.FAILED:
+                    return False
+                rec.streak = 0
+                self._to(member, rec, HealthState.FAILED, now)
+                return True
+            if rec.state in (HealthState.QUARANTINED, HealthState.FAILED):
+                return False
+            rec.streak += 1
+            hold = float(config.get("quarantine_s"))
+            if rec.state is HealthState.REJOINING:
+                # warmup failure: back behind a fresh hold, no cliff retry
+                rec.until = now + hold if hold > 0 else 0.0
+                self._to(member, rec, HealthState.QUARANTINED, now)
+                return True
+            if rec.streak >= int(config.get("quarantine_after")) and hold > 0:
+                rec.until = now + hold
+                self._to(member, rec, HealthState.QUARANTINED, now)
                 return True
         return False
 
     def record_success(self, member: int) -> None:
+        now = time.monotonic()
         with self._lock:
-            self._streak[member] = 0
-            if self._until.pop(member, None) is not None:
-                stats.member_quarantine(member, False)
+            rec = self._m.get(member)
+            if rec is None:
+                return
+            self._expire(member, rec, now)
+            rec.streak = 0
+            if rec.state in (HealthState.QUARANTINED, HealthState.FAILED):
+                # a direct read got through anyway: begin warmup, counting
+                # this success toward it
+                self._to(member, rec, HealthState.REJOINING, now)
+                rec.rejoin_ok = 1
+            elif rec.state is HealthState.REJOINING:
+                rec.rejoin_ok += 1
+                if rec.rejoin_ok >= int(config.get("rejoin_successes")):
+                    self._to(member, rec, HealthState.HEALTHY, now)
+
+    def record_canary(self, member: int, ok: bool) -> None:
+        """Account one background canary probe: success moves FAILED to
+        REJOINING and advances a REJOINING warmup; failure sends a
+        REJOINING member back behind a fresh quarantine hold."""
+        stats.add("nr_canary_probe")
+        if ok:
+            self.record_success(member)
+        else:
+            now = time.monotonic()
+            with self._lock:
+                rec = self._m.get(member)
+                if rec is not None and rec.state is HealthState.REJOINING:
+                    hold = float(config.get("quarantine_s"))
+                    rec.until = now + hold if hold > 0 else 0.0
+                    rec.streak = 0
+                    self._to(member, rec, HealthState.QUARANTINED, now)
+
+    # -- latency-driven suspicion ---------------------------------------
+
+    def observe_latency(self, member: int, ns: int) -> None:
+        """Feed one direct-read service time into the member's log2-ns
+        histogram; every ``_SUSPECT_EVERY`` samples re-evaluate the
+        suspect predicate (p99 > ``suspect_ratio`` x the stripe median
+        p99, lower-median across members with enough samples)."""
+        b = min(max(int(ns), 1).bit_length() - 1, LAT_HIST_BUCKETS - 1)
+        with self._lock:
+            rec = self._rec(member)
+            rec.hist[b] += 1
+            rec.hist_n += 1
+            if rec.hist_n >= _HIST_DECAY_AT:
+                rec.hist = [v >> 1 for v in rec.hist]
+                rec.hist_n = sum(rec.hist)
+            if rec.hist_n % _SUSPECT_EVERY:
+                return
+            if rec.state not in (HealthState.HEALTHY, HealthState.SUSPECT):
+                return
+            p99s = {}
+            for m, r in self._m.items():
+                if r.hist_n >= _SUSPECT_MIN_SAMPLES:
+                    p = hist_percentiles(r.hist, (0.99,))[0]
+                    if p is not None:
+                        p99s[m] = p
+            mine = p99s.get(member)
+            if mine is None or len(p99s) < 2:
+                return
+            med = sorted(p99s.values())[(len(p99s) - 1) // 2]
+            if med <= 0:
+                return
+            ratio = float(config.get("suspect_ratio"))
+            now = time.monotonic()
+            if rec.state is HealthState.HEALTHY and mine > ratio * med:
+                self._to(member, rec, HealthState.SUSPECT, now)
+            elif rec.state is HealthState.SUSPECT \
+                    and mine <= (ratio / 2.0) * med:
+                self._to(member, rec, HealthState.HEALTHY, now)
+
+    def observe_hist(self, member: int, deltas) -> None:
+        """Fold a native per-member latency-histogram delta (the lane
+        reaper's view) so suspect detection also covers the native path."""
+        with self._lock:
+            rec = self._rec(member)
+            for i, v in enumerate(deltas[:LAT_HIST_BUCKETS]):
+                rec.hist[i] += v
+                rec.hist_n += v
+
+    # -- routing queries -------------------------------------------------
+
+    def allow_direct(self, member: int) -> bool:
+        """May the engine issue a direct read against this member right
+        now?  HEALTHY/SUSPECT: yes.  QUARANTINED/FAILED: no.  REJOINING:
+        one warmup token per request."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._m.get(member)
+            if rec is None:
+                return True
+            self._expire(member, rec, now)
+            if rec.state in (HealthState.HEALTHY, HealthState.SUSPECT):
+                return True
+            if rec.state is HealthState.REJOINING:
+                return self._take_token(rec, now)
+            return False
 
     def quarantined(self, member: int) -> bool:
+        """PR 1 compatibility predicate: True when the member's extents
+        must route away from the direct path."""
+        return not self.allow_direct(member)
+
+    def routes_away(self, member: int) -> bool:
+        """True for QUARANTINED/FAILED — the native-path mirror-remap
+        predicate (no token consumed, REJOINING serves native traffic)."""
+        now = time.monotonic()
         with self._lock:
-            until = self._until.get(member)
-            if until is None:
+            rec = self._m.get(member)
+            if rec is None:
                 return False
-            if time.monotonic() >= until:
-                # expiry: allow a direct re-probe; streak keeps history
-                # so one more failure re-enters immediately
-                del self._until[member]
-                self._streak[member] = \
-                    max(0, int(config.get("quarantine_after")) - 1)
-                stats.member_quarantine(member, False)
-                return False
-            return True
+            self._expire(member, rec, now)
+            return rec.state in (HealthState.QUARANTINED,
+                                 HealthState.FAILED)
+
+    def hedge_delay_s(self, member: int) -> Optional[float]:
+        """Hedge latch for a chunk on *member*, or None when hedging is
+        off.  ``fixed`` uses ``hedge_ms``; ``p99`` derives the latch from
+        the member's own p99 with ``hedge_ms`` as the floor."""
+        policy = str(config.get("hedge_policy"))
+        if policy == "off":
+            return None
+        floor = float(config.get("hedge_ms")) / 1e3
+        if policy == "fixed":
+            return floor
+        with self._lock:
+            rec = self._m.get(member)
+            p99 = None
+            if rec is not None and rec.hist_n >= 16:
+                p99 = hist_percentiles(rec.hist, (0.99,))[0]
+        if not p99:
+            return floor
+        return max(p99 / 1e9, floor)
+
+    # -- introspection ---------------------------------------------------
+
+    def state(self, member: int) -> HealthState:
+        with self._lock:
+            rec = self._m.get(member)
+            if rec is None:
+                return HealthState.HEALTHY
+            self._expire(member, rec, time.monotonic())
+            return rec.state
+
+    def time_in_state(self, member: int) -> float:
+        with self._lock:
+            rec = self._m.get(member)
+            if rec is None:
+                return 0.0
+            return max(0.0, time.monotonic() - rec.since)
+
+    def canary_candidates(self) -> List[int]:
+        """Members the background prober should touch: FAILED (detect
+        recovery) and REJOINING (advance warmup without client traffic).
+        QUARANTINED waits out its timer."""
+        with self._lock:
+            return [m for m, r in self._m.items()
+                    if r.state in (HealthState.FAILED,
+                                   HealthState.REJOINING)]
+
+    def transitions(self, member: Optional[int] = None
+                    ) -> List[Tuple[int, str, str, float]]:
+        """Bounded transition log ``[(member, from, to, t_monotonic)]`` in
+        order — the chaos harness asserts these walk ALLOWED_TRANSITIONS."""
+        with self._lock:
+            if member is None:
+                return list(self._log)
+            return [t for t in self._log if t[0] == member]
+
+
+# PR 1 name, kept for external callers; the engine now uses the machine.
+MemberHealth = MemberHealthMachine

@@ -125,6 +125,10 @@ class StatRegistry:
         # times are already member-attributed there).
         self._member_hist: dict = {}
         self._member_occ: dict = {}
+        # health-state machine surface (PR 6): member -> (state_name,
+        # entered_monotonic).  Written on every transition by
+        # fault.MemberHealthMachine; tpu_stat renders state + time-in-state.
+        self._member_state: dict = {}
         # last cur_dma_count transition timestamp for the occupancy
         # integral (0 = no transition seen yet)
         self._occ_last_ns = 0
@@ -243,10 +247,17 @@ class StatRegistry:
                 self._c["nr_member_quarantine"] += 1
             h[3] = active
 
+    def member_state(self, member: int, state: str) -> None:
+        """Record a health-state transition for a member (PR 6): the state
+        name plus its entry time surface as ``state``/``state_s`` in
+        :meth:`member_snapshot`."""
+        with self._lock:
+            self._member_state[member] = (state, time.monotonic())
+
     def member_snapshot(self) -> dict:
         """{member: {"nreq", "bytes", "clk_ns"[, "errors", "retries",
-        "quarantines", "quarantined"]}} snapshot; health keys appear once
-        a member has seen any fault accounting."""
+        "quarantines", "quarantined", "state", "state_s"]}} snapshot;
+        health keys appear once a member has seen any fault accounting."""
         with self._lock:
             out = {k: {"nreq": v[0], "bytes": v[1], "clk_ns": v[2]}
                    for k, v in sorted(self._members.items())}
@@ -265,6 +276,11 @@ class StatRegistry:
                 d = out.setdefault(k, {"nreq": 0, "bytes": 0, "clk_ns": 0})
                 d["occ_integral_ns"] = o[0]
                 d["occ_busy_ns"] = o[1]
+            now = time.monotonic()
+            for k, (st, since) in self._member_state.items():
+                d = out.setdefault(k, {"nreq": 0, "bytes": 0, "clk_ns": 0})
+                d["state"] = st
+                d["state_s"] = round(now - since, 3)
             return out
 
     @contextmanager

@@ -67,17 +67,32 @@ class StripeMap:
     """Logical->member address resolution for an N-way RAID-0 stripe set."""
 
     def __init__(self, member_sizes: Sequence[int], chunk_size: int,
-                 member_offsets: Sequence[int] | None = None):
+                 member_offsets: Sequence[int] | None = None,
+                 mirror: str = "none"):
         if chunk_size <= 0 or chunk_size % SECTOR:
             raise ValueError(f"chunk_size {chunk_size} must be a positive multiple of {SECTOR}")
         if not member_sizes:
             raise ValueError("need at least one member")
+        if mirror not in ("none", "paired"):
+            raise ValueError(f"mirror must be 'none' or 'paired', got {mirror!r}")
         self.chunk_size = chunk_size
         self.n_members = len(member_sizes)
+        self.mirror = mirror
         # partition start offsets (reference adds these at kmod/nvme_strom.c:904-906)
         self.member_offsets = tuple(member_offsets or [0] * self.n_members)
         # usable size per member = whole chunks only (md rounds down to chunks)
         usable = [size // chunk_size * chunk_size for size in member_sizes]
+        if mirror == "paired":
+            # RAID-10 style: member 2k+1 is a byte-identical replica of
+            # member 2k.  Only the primaries are addressable; a pair's
+            # usable depth is the smaller of the two so every primary
+            # chunk has a mirror chunk.
+            if self.n_members < 2 or self.n_members % 2:
+                raise ValueError("mirror='paired' needs an even member "
+                                 f"count >= 2, got {self.n_members}")
+            for k in range(0, self.n_members, 2):
+                pair = min(usable[k], usable[k + 1])
+                usable[k], usable[k + 1] = pair, 0
         self.zones = self._build_zones(usable)
         self.total_size = sum(z.zone_len for z in self.zones)
         self._pow2 = (chunk_size & (chunk_size - 1)) == 0
@@ -102,6 +117,17 @@ class StripeMap:
             logical += zlen
             consumed = next_cut
         return zones
+
+    def mirror_of(self, member: int):
+        """The member holding a byte-identical replica of *member*'s data
+        (its pair partner under ``mirror='paired'``), or None when the set
+        has no redundancy.  Offsets are interchangeable between partners —
+        the basis for degraded-mode striping and mirror-leg hedges."""
+        if self.mirror != "paired":
+            return None
+        if member < 0 or member >= self.n_members:
+            return None
+        return member ^ 1
 
     # -- point resolution --------------------------------------------------
     def _find_zone(self, offset: int) -> StripeZone:

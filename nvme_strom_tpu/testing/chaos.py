@@ -1,0 +1,399 @@
+"""Deterministic chaos harness for the member-health stack (``make
+chaos``, PR 6).
+
+Each scenario drives a seeded fault schedule — fail-stop, flaky, slow
+member, corrupt-once, fail-stop-then-rejoin — through a mirrored striped
+loopback set (plus one native-engine leg against real files) and checks
+the survival contract:
+
+* the copy is BYTE-IDENTICAL to the healthy stream (degraded striping
+  served the failed member's extents from its mirror at direct speed, or
+  the buffered/re-read tiers healed the damage),
+* the run stays inside a bounded deadline — never a hang, and
+* every observed health transition walks an edge of
+  :data:`fault.ALLOWED_TRANSITIONS` (e.g. a fail-stopped member goes
+  ``healthy -> failed`` and, once the device answers canary probes
+  again, ``failed -> rejoining -> healthy`` — no teleporting).
+
+The schedule is fixed by ``STROM_CHAOS_SEED`` (default 1234) so CI
+failures reproduce; ``STROM_CHAOS_ROUNDS`` sweeps the scenario list
+multiple times with fresh derived seeds.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+STRIPE = 64 << 10
+CHUNK = 256 << 10
+MEMBER_SIZE = 1 << 20          # per member: 4 members -> 2MB logical (paired)
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def make_mirrored_members(dirpath: str, n_pairs: int = 2,
+                          size: int = MEMBER_SIZE, tag: str = "m"):
+    """2*n_pairs member files where member 2k+1 is a byte-identical copy
+    of member 2k — the ``mirror='paired'`` on-disk layout."""
+    from .fake import make_test_file
+    paths = []
+    for k in range(n_pairs):
+        p = os.path.join(dirpath, f"{tag}{2 * k}.bin")
+        make_test_file(p, size, seed=100 + k)
+        q = os.path.join(dirpath, f"{tag}{2 * k + 1}.bin")
+        shutil.copyfile(p, q)
+        paths += [p, q]
+    return paths
+
+
+def expected_mirrored_stream(paths, stripe_chunk: int = STRIPE) -> bytes:
+    """The logical stream of a paired set: RAID-0 over the even-indexed
+    primaries only (odd members are replicas, not address space)."""
+    parts = [open(p, "rb").read() for p in paths[::2]]
+    nm = len(parts)
+    total = sum(len(p) for p in parts)
+    out = bytearray(total)
+    for i in range(total // stripe_chunk):
+        m, row = i % nm, i // nm
+        out[i * stripe_chunk:(i + 1) * stripe_chunk] = \
+            parts[m][row * stripe_chunk:(row + 1) * stripe_chunk]
+    return bytes(out)
+
+
+def read_all(sess, src, chunk: int = CHUNK, timeout: float = 60.0):
+    """Drive a whole-source memcpy and return the reordered byte stream."""
+    import numpy as np
+
+    from ..engine import reorder_chunks
+    total = src.size // chunk * chunk
+    handle, buf = sess.alloc_dma_buffer(total)
+    want = list(range(total // chunk))
+    res = sess.memcpy_ssd2ram(src, handle, want, chunk)
+    sess.memcpy_wait(res.dma_task_id, timeout=timeout)
+    host = reorder_chunks(np.frombuffer(buf.view()[:total], np.uint8),
+                          chunk, res.chunk_ids, want)
+    return bytes(host), total
+
+
+def assert_transitions_legal(sess, scenario: str) -> None:
+    """Every logged health transition must be an ALLOWED_TRANSITIONS edge."""
+    from ..fault import ALLOWED_TRANSITIONS
+    allowed = {(a.value, b.value) for a, b in ALLOWED_TRANSITIONS}
+    for member, frm, to, _t in sess._member_health.transitions():
+        if (frm, to) not in allowed:
+            raise AssertionError(
+                f"{scenario}: illegal health transition {frm}->{to} "
+                f"on member {member}")
+
+
+def _counter(name: str) -> int:
+    from ..stats import stats
+    return stats.snapshot(reset_max=False).counters.get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# scenarios — each returns a short tag for the tally
+# ---------------------------------------------------------------------------
+
+def scenario_fail_stop(rng: random.Random, dirpath: str) -> str:
+    """A mirrored member fail-stops mid-task: the copy must complete
+    byte-identical with the dead member's extents served by its mirror,
+    and the member must land in FAILED."""
+    from ..config import config
+    from ..engine import Session
+    from ..fault import HealthState
+    from .fake import FakeStripedNvmeSource, FaultPlan
+
+    config.set("io_retries", 1)
+    config.set("task_deadline_s", 30.0)
+    config.set("canary_interval_s", 0.0)   # no probes: FAILED must hold
+    victim = rng.choice([0, 2])
+    plan = FaultPlan(failstop_member=victim,
+                     failstop_after=rng.randrange(2, 8))
+    paths = make_mirrored_members(dirpath, tag=f"fs{rng.randrange(1 << 16)}-")
+    src = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                fault_plan=plan, force_cached_fraction=0.0,
+                                mirror="paired")
+    mirrors_before = _counter("nr_mirror_read")
+    try:
+        with Session() as sess:
+            got, total = read_all(sess, src)
+            assert got == expected_mirrored_stream(paths)[:total], \
+                "fail_stop: degraded copy diverged from healthy stream"
+            # a straggler success from a pre-fail-stop read may have begun
+            # a (doomed) warmup, so REJOINING is also a legal endpoint
+            assert sess._member_health.state(victim) in \
+                (HealthState.FAILED, HealthState.REJOINING), \
+                f"fail_stop: member {victim} ended " \
+                f"{sess._member_health.state(victim)}"
+            assert_transitions_legal(sess, "fail_stop")
+    finally:
+        src.close()
+    assert _counter("nr_mirror_read") > mirrors_before, \
+        "fail_stop: no extent was served from the mirror"
+    return "fail_stop"
+
+
+def scenario_flaky(rng: random.Random, dirpath: str) -> str:
+    """Randomized transient EIO across the whole set: the retry ladder
+    (plus mirror legs) must heal every chunk."""
+    from ..config import config
+    from ..engine import Session
+    from .fake import FakeStripedNvmeSource, FaultPlan
+
+    config.set("io_retries", rng.choice([2, 3]))
+    config.set("retry_backoff_ms", 1.0)
+    config.set("task_deadline_s", 30.0)
+    plan = FaultPlan(fail_rate=rng.choice([0.05, 0.1, 0.2]),
+                     seed=rng.randrange(1 << 30))
+    paths = make_mirrored_members(dirpath, tag=f"fl{rng.randrange(1 << 16)}-")
+    src = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                fault_plan=plan, force_cached_fraction=0.0,
+                                mirror="paired")
+    try:
+        with Session() as sess:
+            got, total = read_all(sess, src)
+            assert got == expected_mirrored_stream(paths)[:total], \
+                "flaky: healed copy diverged from healthy stream"
+            assert_transitions_legal(sess, "flaky")
+    finally:
+        src.close()
+    return "flaky"
+
+
+def scenario_slow_hedge(rng: random.Random, dirpath: str) -> str:
+    """One member serves every read slowly: hedged reads re-issue its
+    chunks on the mirror and the task finishes inside a latency bound a
+    pure-primary run could not meet."""
+    from ..config import config
+    from ..engine import Session
+    from .fake import FakeStripedNvmeSource, FaultPlan
+
+    slow_s = 0.15
+    config.set("io_retries", 1)
+    config.set("task_deadline_s", 30.0)
+    config.set("hedge_policy", "fixed")
+    config.set("hedge_ms", 5.0)
+    victim = rng.choice([0, 2])
+    plan = FaultPlan(slow_member=victim, slow_s=slow_s)
+    paths = make_mirrored_members(dirpath, tag=f"sl{rng.randrange(1 << 16)}-")
+    src = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                fault_plan=plan, force_cached_fraction=0.0,
+                                mirror="paired")
+    issued_before = _counter("nr_hedge_issued")
+    won_before = _counter("nr_hedge_won")
+    try:
+        with Session() as sess:
+            t0 = time.monotonic()
+            got, total = read_all(sess, src)
+            wall = time.monotonic() - t0
+            assert got == expected_mirrored_stream(paths)[:total], \
+                "slow: hedged copy diverged from healthy stream"
+            # every one of the victim's chunks costs slow_s on the primary
+            # leg; hedges must keep the task well under the serial cost
+            n_victim = (total // STRIPE) // 2
+            assert wall < n_victim * slow_s, \
+                f"slow: {wall:.2f}s suggests hedges never won " \
+                f"(serial primary cost ~{n_victim * slow_s:.2f}s)"
+            assert_transitions_legal(sess, "slow")
+    finally:
+        src.close()
+    assert _counter("nr_hedge_issued") > issued_before, \
+        "slow: no hedge was ever issued"
+    assert _counter("nr_hedge_won") > won_before, \
+        "slow: hedges issued but none won against a member "\
+        f"{slow_s * 1e3:.0f}ms slow"
+    return "slow"
+
+
+def scenario_corrupt_once(rng: random.Random, dirpath: str) -> str:
+    """A torn read (bit flip that heals on re-read): page checksums must
+    catch it and the re-read tier must repair it transparently."""
+    import numpy as np
+
+    from ..config import config
+    from ..engine import Session
+    from ..scan.heap import PAGE_SIZE, HeapSchema, build_heap_file
+    from .fake import FakeNvmeSource, FaultPlan
+
+    config.set("checksum_verify", True)
+    config.set("task_deadline_s", 30.0)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 4
+    path = os.path.join(dirpath, f"co{rng.randrange(1 << 16)}.heap")
+    build_heap_file(path, [np.arange(n, dtype=np.int32),
+                           (n - np.arange(n)).astype(np.int32)], schema)
+    with open(path, "rb") as f:
+        data = f.read()
+    page = rng.randrange(len(data) // PAGE_SIZE)
+    plan = FaultPlan(corrupt_once_offsets={page * PAGE_SIZE
+                                           + rng.randrange(64, PAGE_SIZE)})
+    src = FakeNvmeSource(path, fault_plan=plan, force_cached_fraction=0.0)
+    rereads_before = _counter("nr_csum_reread")
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(len(data))
+            res = sess.memcpy_ssd2ram(src, handle,
+                                      list(range(len(data) // PAGE_SIZE)),
+                                      PAGE_SIZE)
+            sess.memcpy_wait(res.dma_task_id, timeout=30.0)
+            assert bytes(buf.view()[:len(data)]) == data, \
+                "corrupt_once: repaired copy diverged"
+    finally:
+        src.close()
+    assert _counter("nr_csum_reread") > rereads_before, \
+        "corrupt_once: the flip was never detected/re-read"
+    return "corrupt_once"
+
+
+def scenario_rejoin(rng: random.Random, dirpath: str) -> str:
+    """Fail-stop then recovery: the member must walk healthy -> failed
+    during the task, then — via background canary probes alone — climb
+    failed -> rejoining -> healthy once the device answers again."""
+    from ..config import config
+    from ..engine import Session
+    from ..fault import HealthState
+    from .fake import FakeStripedNvmeSource, FaultPlan
+
+    config.set("io_retries", 1)
+    config.set("task_deadline_s", 30.0)
+    config.set("canary_interval_s", 0.05)
+    config.set("quarantine_s", 0.2)
+    config.set("rejoin_successes", 2)
+    config.set("rejoin_tokens_s", 1000.0)
+    victim = rng.choice([0, 2])
+    after = rng.randrange(2, 6)
+    # the dead window outlives the task's own read count (~35 with
+    # retries and mirror legs): recovery is canary-driven, not incidental
+    plan = FaultPlan(failstop_member=victim, failstop_after=after,
+                     rejoin_after=after + rng.randrange(45, 65))
+    paths = make_mirrored_members(dirpath, tag=f"rj{rng.randrange(1 << 16)}-")
+    src = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                fault_plan=plan, force_cached_fraction=0.0,
+                                mirror="paired")
+    canaries_before = _counter("nr_canary_probe")
+    rejoins_before = _counter("nr_member_rejoin")
+    try:
+        with Session() as sess:
+            got, total = read_all(sess, src)
+            assert got == expected_mirrored_stream(paths)[:total], \
+                "rejoin: degraded copy diverged from healthy stream"
+            # canary probes advance the plan's read count past
+            # rejoin_after, observe the recovery and warm the member back
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if sess._member_health.state(victim) is HealthState.HEALTHY:
+                    break
+                time.sleep(0.05)
+            assert sess._member_health.state(victim) is HealthState.HEALTHY, \
+                f"rejoin: member {victim} stuck in " \
+                f"{sess._member_health.state(victim)}"
+            steps = [(frm, to) for m, frm, to, _t
+                     in sess._member_health.transitions(victim)]
+            for edge in [("failed", "rejoining"), ("rejoining", "healthy")]:
+                assert edge in steps, \
+                    f"rejoin: member {victim} never took {edge}: {steps}"
+            assert_transitions_legal(sess, "rejoin")
+    finally:
+        src.close()
+    assert _counter("nr_canary_probe") > canaries_before, \
+        "rejoin: no canary probe ever ran"
+    assert _counter("nr_member_rejoin") > rejoins_before, \
+        "rejoin: warmup never completed"
+    return "rejoin"
+
+
+def scenario_native_degraded(rng: random.Random, dirpath: str) -> str:
+    """Native-path degraded striping: with a primary marked FAILED before
+    submit, the io_uring lanes must read its extents from the mirror fd
+    and still deliver the healthy stream."""
+    from ..config import config
+    from ..engine import Session, StripedSource
+
+    class _Direct(StripedSource):
+        def cached_fraction(self, offset, length):
+            return 0.0
+
+    config.set("task_deadline_s", 30.0)
+    paths = make_mirrored_members(dirpath, tag=f"nd{rng.randrange(1 << 16)}-")
+    src = _Direct(paths, stripe_chunk_size=STRIPE, mirror="paired")
+    mirrors_before = _counter("nr_mirror_read")
+    try:
+        with Session() as sess:
+            if sess._native is None:
+                return "native_skipped"
+            victim = rng.choice([0, 2])
+            sess._member_health.record_failure(victim, fatal=True)
+            got, total = read_all(sess, src)
+            assert got == expected_mirrored_stream(paths)[:total], \
+                "native_degraded: remapped copy diverged"
+            assert_transitions_legal(sess, "native_degraded")
+    finally:
+        src.close()
+    assert _counter("nr_mirror_read") > mirrors_before, \
+        "native_degraded: no request was remapped to the mirror fd"
+    return "native_degraded"
+
+
+SCENARIOS = (scenario_fail_stop, scenario_flaky, scenario_slow_hedge,
+             scenario_corrupt_once, scenario_rejoin,
+             scenario_native_degraded)
+
+
+def flaky_mirrored_round(rng: random.Random, dirpath: str) -> str:
+    """Entry point for the stress driver: one mirrored flaky round."""
+    return scenario_flaky(rng, dirpath)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def run_all(seed: int, rounds: int = 1, verbose: bool = True) -> dict:
+    from ..config import config
+    tally: dict = {}
+    for r in range(rounds):
+        for i, scenario in enumerate(SCENARIOS):
+            # integer-derived per-scenario seed: hash() of a str would
+            # change per process (PYTHONHASHSEED) and kill reproducibility
+            rng = random.Random(seed * 1_000_003 + r * 101 + i)
+            snap = config.snapshot()
+            with tempfile.TemporaryDirectory() as d:
+                t0 = time.monotonic()
+                try:
+                    tag = scenario(rng, d)
+                finally:
+                    config.restore(snap)
+                if verbose:
+                    print(f"  chaos[{r}] {scenario.__name__}: {tag} "
+                          f"({time.monotonic() - t0:.1f}s)", flush=True)
+            tally[tag] = tally.get(tag, 0) + 1
+    return tally
+
+
+def main(argv=None) -> int:
+    seed = int(os.environ.get("STROM_CHAOS_SEED", "1234"))
+    rounds = int(os.environ.get("STROM_CHAOS_ROUNDS", "1"))
+    t0 = time.monotonic()
+    tally = run_all(seed, rounds)
+    from ..stats import stats
+    c = stats.snapshot(reset_max=False).counters
+    print(f"chaos OK: {sum(tally.values())} scenarios in "
+          f"{time.monotonic() - t0:.1f}s (seed={seed}) — "
+          + ", ".join(f"{k}={v}" for k, v in sorted(tally.items()))
+          + f"; hedges won {c.get('nr_hedge_won', 0)}/"
+          f"{c.get('nr_hedge_issued', 0)}, "
+          f"mirror reads {c.get('nr_mirror_read', 0)}, "
+          f"canaries {c.get('nr_canary_probe', 0)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional, Set
 
-from ..api import StromError
+from ..api import ErrorClass, StromError
 from ..engine import PlainSource, StripedSource
 
 
@@ -67,6 +67,13 @@ class FaultPlan:
     * ``corrupt_offsets`` — persistent bit-flips (re-reads stay corrupt:
       exercises the latched CORRUPTION error), ``corrupt_once_offsets`` —
       torn reads that heal on re-read (each offset flips exactly once).
+    * ``failstop_member`` + ``failstop_after`` [+ ``rejoin_after``] —
+      deterministic fail-stop schedule (PR 6): once the global direct-read
+      count reaches ``failstop_after``, every read of that member (direct
+      *and* buffered — the device is gone) raises a PERSISTENT error,
+      driving the health machine to FAILED; from ``rejoin_after`` reads
+      onward the member answers again, so canary probes observe recovery
+      and walk it through REJOINING back to HEALTHY.
     """
 
     fail_offsets: Set[int] = field(default_factory=set)   # file_off -> EIO
@@ -78,8 +85,19 @@ class FaultPlan:
     slow_s: float = 0.0                                   # the extra latency
     corrupt_offsets: Set[int] = field(default_factory=set)  # flip a byte at offset
     corrupt_once_offsets: Set[int] = field(default_factory=set)  # flip once
+    failstop_member: Optional[int] = None   # member that hard-fails...
+    failstop_after: int = 0                 # ...once _count reaches this
+    rejoin_after: Optional[int] = None      # ...and heals at this count
     _count: int = 0
     _rng: object = field(default=None, repr=False)
+
+    def failstopped(self, member: Optional[int]) -> bool:
+        """Is *member* inside its fail-stop window right now?"""
+        return (self.failstop_member is not None
+                and member == self.failstop_member
+                and self._count >= self.failstop_after
+                and (self.rejoin_after is None
+                     or self._count < self.rejoin_after))
 
     def check(self, file_off: int, length: int,
               member: Optional[int] = None) -> None:
@@ -88,6 +106,10 @@ class FaultPlan:
             time.sleep(self.latency_s)
         if self.slow_s and member is not None and member == self.slow_member:
             time.sleep(self.slow_s)
+        if self.failstopped(member):
+            raise StromError(_errno.EIO,
+                             f"injected fail-stop of member {member}",
+                             error_class=ErrorClass.PERSISTENT)
         if self.fail_every_nth and self._count % self.fail_every_nth == 0:
             raise StromError(_errno.EIO, f"injected periodic fault #{self._count}")
         if self.fail_rate > 0.0:
@@ -97,11 +119,16 @@ class FaultPlan:
             if self._rng.random() < self.fail_rate:
                 raise StromError(_errno.EIO,
                                  f"injected random fault #{self._count}")
-        self.check_buffered(file_off, length)
+        self.check_buffered(file_off, length, member=member)
 
-    def check_buffered(self, file_off: int, length: int) -> None:
+    def check_buffered(self, file_off: int, length: int,
+                       member: Optional[int] = None) -> None:
         """The persistent tier only: consulted by the buffered fallback so
-        dead regions stay dead on every path."""
+        dead regions — and fail-stopped members — stay dead on every path."""
+        if self.failstopped(member):
+            raise StromError(_errno.EIO,
+                             f"injected fail-stop of member {member}",
+                             error_class=ErrorClass.PERSISTENT)
         for off in self.fail_offsets:
             if file_off <= off < file_off + length:
                 raise StromError(_errno.EIO, f"injected fault at {off}")
@@ -139,7 +166,7 @@ class FakeNvmeSource(PlainSource):
     def read_member_buffered(self, member: int, file_off: int, dest: memoryview) -> None:
         # the engine's degraded tier reads through here: persistent bad
         # regions must fail it too, transient/periodic plans must not
-        self.fault_plan.check_buffered(file_off, len(dest))
+        self.fault_plan.check_buffered(file_off, len(dest), member=member)
         super().read_member_buffered(member, file_off, dest)
 
     def cached_fraction(self, offset: int, length: int) -> float:
@@ -173,8 +200,10 @@ class FakeStripedNvmeSource(StripedSource):
     def __init__(self, paths, stripe_chunk_size: int, *,
                  fault_plan: Optional[FaultPlan] = None,
                  block_size: int = 512,
-                 force_cached_fraction: Optional[float] = None):
-        super().__init__(paths, stripe_chunk_size, block_size)
+                 force_cached_fraction: Optional[float] = None,
+                 mirror: Optional[str] = None):
+        super().__init__(paths, stripe_chunk_size, block_size,
+                         mirror=mirror)
         self.fault_plan = fault_plan or FaultPlan()
         self.force_cached_fraction = force_cached_fraction
 
@@ -184,7 +213,7 @@ class FakeStripedNvmeSource(StripedSource):
         self.fault_plan.apply_corruption(file_off, dest)
 
     def read_member_buffered(self, member: int, file_off: int, dest: memoryview) -> None:
-        self.fault_plan.check_buffered(file_off, len(dest))
+        self.fault_plan.check_buffered(file_off, len(dest), member=member)
         super().read_member_buffered(member, file_off, dest)
 
     def cached_fraction(self, offset: int, length: int) -> float:

@@ -79,19 +79,33 @@ def main(argv=None) -> int:
     seed = int(os.environ.get("STROM_STRESS_SEED", "1234"))
     rounds = int(os.environ.get("STROM_STRESS_ROUNDS", "40"))
     rng = random.Random(seed)
+    from ..config import config
     from .fake import make_test_file
     with tempfile.TemporaryDirectory() as d:
         path = os.path.join(d, "stress.bin")
         make_test_file(path, N_CHUNKS * CHUNK)
         t0 = time.monotonic()
-        tally = {"healed": 0, "latched": 0}
+        tally = {"healed": 0, "latched": 0, "mirrored": 0}
         for i in range(rounds):
+            if i % 4 == 3:
+                # every 4th round: a mirrored striped flaky schedule
+                # through the chaos harness (PR 6) so the stress sweep
+                # also exercises degraded striping + health transitions
+                from .chaos import flaky_mirrored_round
+                cfg_snap = config.snapshot()
+                try:
+                    flaky_mirrored_round(rng, d)
+                finally:
+                    config.restore(cfg_snap)
+                tally["mirrored"] += 1
+                continue
             tally[_one_round(rng, path, i)] += 1
     from ..stats import stats
     snap = stats.snapshot(reset_max=False).counters
     print(f"stress-faults OK: {rounds} rounds in "
           f"{time.monotonic() - t0:.1f}s (seed={seed}) — "
-          f"{tally['healed']} healed, {tally['latched']} latched; "
+          f"{tally['healed']} healed, {tally['latched']} latched, "
+          f"{tally['mirrored']} mirrored; "
           f"retries={snap.get('nr_io_retry', 0)} "
           f"fallbacks={snap.get('nr_io_fallback', 0)}")
     return 0

@@ -240,6 +240,14 @@ def main(argv=None) -> int:
             if occ_b:
                 print(f"mean queue occupancy (busy): "
                       f"{c.get('occ_integral_ns', 0) / occ_b:.2f}")
+            # hedged-read scoreboard (PR 6): issued vs won tells whether
+            # the latch is tight enough to matter; mirror reads count
+            # degraded-mode extents served at direct speed
+            if c.get("nr_hedge_issued") or c.get("nr_mirror_read"):
+                print(f"hedges: issued {c.get('nr_hedge_issued', 0)}  "
+                      f"won {c.get('nr_hedge_won', 0)}  "
+                      f"cancelled {c.get('nr_hedge_cancelled', 0)}  "
+                      f"mirror-reads {c.get('nr_mirror_read', 0)}")
         if args.verbose and snap.get("members"):
             # per-stripe-member breakdown (part_stat_add analog): a slow
             # member shows as an outlier avg-lat/p50 at similar req/byte
@@ -248,13 +256,18 @@ def main(argv=None) -> int:
             # stripe shows every member near its lane depth
             print("per-member:")
             print("  member   reqs        bytes   avg-lat  p50      p95    "
-                  "  occ  errs  retry  quar")
+                  "  occ  errs  retry  quar  state        in-state")
             for m, v in sorted(snap["members"].items(), key=lambda kv: int(kv[0])):
                 occ_b = v.get("occ_busy_ns", 0)
                 occ = (f"{v.get('occ_integral_ns', 0) / occ_b:5.1f}"
                        if occ_b else "   --")
+                # health-machine view (PR 6): the state column supersedes
+                # the old QUARANTINED flag but the flag is kept for scripts
+                st = v.get("state", "healthy")
+                st_s = v.get("state_s")
+                in_state = f"{st_s:8.1f}s" if st_s is not None else "       --"
                 health = f"{v.get('errors', 0):>5} {v.get('retries', 0):>6} " \
-                         f"{v.get('quarantines', 0):>5}" \
+                         f"{v.get('quarantines', 0):>5}  {st:<11} {in_state}" \
                          + ("  QUARANTINED" if v.get("quarantined") else "")
                 print(f"  {int(m):>6} {v['nreq']:>6} {v['bytes']:>12} "
                       f"  {show_avg(v['clk_ns'], v['nreq'])} "

@@ -1,0 +1,231 @@
+"""Member-survival tests (PR 6): paired-mirror geometry, degraded-mode
+striping across a mid-task fail-stop, canary-driven rejoin, the hedged-
+read tail gate, and the native mirror remap.  All hardware-free: faults
+come from FaultPlan schedules over the striped loopback fake; the native
+leg drives real files through the io_uring lanes.  The seeded chaos
+sweep itself runs as ``make chaos`` (testing/chaos.py)."""
+
+import errno
+import random
+import time
+
+import pytest
+
+from nvme_strom_tpu import Session, StromError, config, stats
+from nvme_strom_tpu.engine import StripedSource
+from nvme_strom_tpu.fault import ALLOWED_TRANSITIONS, HealthState
+from nvme_strom_tpu.stripe import StripeMap
+from nvme_strom_tpu.testing import FakeStripedNvmeSource, FaultPlan
+from nvme_strom_tpu.testing.chaos import (STRIPE, assert_transitions_legal,
+                                          expected_mirrored_stream,
+                                          make_mirrored_members, read_all)
+
+
+def _counter_delta(before, after, name):
+    return after.counters.get(name, 0) - before.counters.get(name, 0)
+
+
+def _mirrored_fake(tmp_path, plan, tag="m"):
+    paths = make_mirrored_members(str(tmp_path), tag=tag)
+    return paths, FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                        fault_plan=plan,
+                                        force_cached_fraction=0.0,
+                                        mirror="paired")
+
+
+# ---------------------------------------------------------------------------
+# paired-mirror geometry
+# ---------------------------------------------------------------------------
+
+def test_paired_map_geometry():
+    """Paired mirroring halves the address space: only even members are
+    addressable, a pair's depth is the smaller partner, and mirror_of is
+    the XOR-1 partner both ways."""
+    m = StripeMap([1 << 20, 1 << 20, 1 << 20, 768 << 10],
+                  chunk_size=64 << 10, mirror="paired")
+    # pair 0 keeps 1MB, pair 1 is clamped to its smaller partner's 768KB
+    assert m.total_size == (1 << 20) + (768 << 10)
+    assert m.mirror_of(0) == 1 and m.mirror_of(1) == 0
+    assert m.mirror_of(2) == 3 and m.mirror_of(3) == 2
+    assert m.mirror_of(7) is None
+    for ext in m.map_range(0, m.total_size):
+        assert ext.member % 2 == 0, "odd members must hold no address space"
+    plain = StripeMap([1 << 20] * 4, chunk_size=64 << 10)
+    assert plain.mirror_of(0) is None
+
+
+def test_paired_needs_even_member_count():
+    with pytest.raises(ValueError, match="even member"):
+        StripeMap([1 << 20] * 3, chunk_size=64 << 10, mirror="paired")
+
+
+def test_writable_paired_rejected(tmp_path):
+    """The mirror map is a read-path feature: a writable paired source
+    would desync the replicas, so it is refused outright."""
+    paths = make_mirrored_members(str(tmp_path))
+    with pytest.raises(StromError) as ei:
+        StripedSource(paths, stripe_chunk_size=STRIPE, writable=True,
+                      mirror="paired")
+    assert ei.value.errno == errno.EINVAL
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode striping (python pool path)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_failstop_serves_from_mirror_byte_identical(tmp_path):
+    """A member fail-stops mid-task: its extents are served from the
+    pair partner at direct speed, the copy stays byte-identical, and the
+    member lands in FAILED via legal transitions only."""
+    config.set("io_retries", 1)
+    config.set("canary_interval_s", 0.0)   # no background probes here
+    plan = FaultPlan(failstop_member=0, failstop_after=4)
+    paths, src = _mirrored_fake(tmp_path, plan)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            got, total = read_all(sess, src)
+            assert got == expected_mirrored_stream(paths)[:total]
+            # a straggler success from a read issued pre-fail-stop may
+            # have begun a (doomed) warmup, so REJOINING is also legal
+            assert sess._member_health.state(0) in (HealthState.FAILED,
+                                                    HealthState.REJOINING)
+            steps = [(f, t) for _m, f, t, _ts
+                     in sess._member_health.transitions(0)]
+            assert ("healthy", "failed") in steps
+            assert_transitions_legal(sess, "failstop")
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_mirror_read") > 0
+    assert _counter_delta(before, after, "nr_member_failed") >= 1
+
+
+@pytest.mark.chaos
+def test_canary_probes_rejoin_failed_member(tmp_path):
+    """After the device answers again, background canary probes alone
+    must walk the member failed -> rejoining -> healthy (token-bucket
+    warmup, no client traffic required)."""
+    config.set("io_retries", 1)
+    config.set("canary_interval_s", 0.05)
+    config.set("quarantine_s", 0.2)
+    config.set("rejoin_successes", 2)
+    config.set("rejoin_tokens_s", 1000.0)
+    # the dead window must outlive the task's own read count (~35 with
+    # retries and mirror legs) so recovery can only come from canaries
+    plan = FaultPlan(failstop_member=0, failstop_after=3, rejoin_after=60)
+    paths, src = _mirrored_fake(tmp_path, plan)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            got, total = read_all(sess, src)
+            assert got == expected_mirrored_stream(paths)[:total]
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and \
+                    sess._member_health.state(0) is not HealthState.HEALTHY:
+                time.sleep(0.05)
+            assert sess._member_health.state(0) is HealthState.HEALTHY
+            steps = [(f, t) for _m, f, t, _ts
+                     in sess._member_health.transitions(0)]
+            assert ("failed", "rejoining") in steps
+            assert ("rejoining", "healthy") in steps
+            assert_transitions_legal(sess, "rejoin")
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_canary_probe") > 0
+    assert _counter_delta(before, after, "nr_member_rejoin") >= 1
+
+
+# ---------------------------------------------------------------------------
+# hedged reads tame the tail (the ISSUE acceptance gate)
+# ---------------------------------------------------------------------------
+
+def _slow_member_wall(tmp_path, policy, tag):
+    """Wall-clock of a whole-source read with one member 150ms slow,
+    under the given hedge policy (serialized member lanes so the slow
+    member's cost is visible, not hidden by lane parallelism)."""
+    config.set("io_retries", 1)
+    config.set("member_queue_depth", 1)
+    config.set("task_deadline_s", 60.0)
+    config.set("hedge_policy", policy)
+    config.set("hedge_ms", 5.0)
+    plan = FaultPlan(slow_member=0, slow_s=0.15)
+    paths, src = _mirrored_fake(tmp_path, plan, tag=tag)
+    try:
+        with Session() as sess:
+            t0 = time.monotonic()
+            got, total = read_all(sess, src)
+            wall = time.monotonic() - t0
+            assert got == expected_mirrored_stream(paths)[:total]
+    finally:
+        src.close()
+    return wall
+
+
+@pytest.mark.chaos
+def test_hedge_p99_beats_off_on_slow_member(tmp_path):
+    """The tail gate: with a member serving every read 150ms slow,
+    ``hedge_policy=p99`` must finish the same copy materially faster
+    than ``off`` (the hedge leg reads the mirror at direct speed) and
+    must actually win hedges doing it."""
+    wall_off = _slow_member_wall(tmp_path, "off", tag="off-")
+    before = stats.snapshot(reset_max=False)
+    wall_hedged = _slow_member_wall(tmp_path, "p99", tag="p99-")
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_hedge_issued") > 0
+    assert _counter_delta(before, after, "nr_hedge_won") > 0
+    assert wall_hedged < wall_off * 0.6, \
+        f"hedged {wall_hedged:.2f}s vs off {wall_off:.2f}s: " \
+        "hedging failed to tame the slow member"
+
+
+# ---------------------------------------------------------------------------
+# native-path degraded striping
+# ---------------------------------------------------------------------------
+
+class _DirectStripe(StripedSource):
+    def cached_fraction(self, offset, length):
+        return 0.0
+
+
+@pytest.mark.chaos
+def test_native_lanes_remap_failed_member_to_mirror(tmp_path):
+    """With a primary FAILED before submit, the native io_uring lanes
+    must read its extents through the mirror partner's fd and still
+    deliver the healthy stream."""
+    paths = make_mirrored_members(str(tmp_path))
+    src = _DirectStripe(paths, stripe_chunk_size=STRIPE, mirror="paired")
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            if sess._native is None:
+                pytest.skip("native engine not active")
+            sess._member_health.record_failure(0, fatal=True)
+            got, total = read_all(sess, src)
+            assert got == expected_mirrored_stream(paths)[:total]
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_mirror_read") > 0
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos sweep (the make-chaos payload, one fast round)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_flaky_mirrored_round_heals(tmp_path):
+    """One seeded flaky round through the chaos harness's own driver:
+    randomized transient EIO over a paired set heals byte-identically."""
+    from nvme_strom_tpu.testing.chaos import flaky_mirrored_round
+    assert flaky_mirrored_round(random.Random(99), str(tmp_path)) == "flaky"
+
+
+def test_allowed_transitions_closed_over_states():
+    """Every edge endpoint is a real state and the log asserts against
+    the same set the machine enforces."""
+    states = set(HealthState)
+    for a, b in ALLOWED_TRANSITIONS:
+        assert a in states and b in states and a is not b

@@ -332,6 +332,92 @@ def test_member_quarantine_enters_and_routes_buffered(tmp_data_file):
 
 
 # ---------------------------------------------------------------------------
+# ladder ordering under hedging (PR 6)
+# ---------------------------------------------------------------------------
+
+class _FailFirstOnMember(FaultPlan):
+    """Exactly one direct read of *member* loses the race: it sleeps past
+    the hedge latch, then raises a transient EIO.  Every other read is
+    clean and fast."""
+
+    def __init__(self, member, delay_s):
+        super().__init__()
+        self._fail_member = member
+        self._delay_s = delay_s
+        self._seen = 0
+
+    def check(self, file_off, length, member=None):
+        if member == self._fail_member:
+            self._seen += 1
+            if self._seen == 1:
+                time.sleep(self._delay_s)
+                raise StromError(errno.EIO, "injected primary loss")
+        super().check(file_off, length, member=member)
+
+
+def _mirrored_striped(tmp_path, plan):
+    from nvme_strom_tpu.testing import FakeStripedNvmeSource
+    from nvme_strom_tpu.testing.chaos import (STRIPE,
+                                              make_mirrored_members)
+    paths = make_mirrored_members(str(tmp_path))
+    return paths, FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                        fault_plan=plan,
+                                        force_cached_fraction=0.0,
+                                        mirror="paired")
+
+
+def test_hedged_primary_failure_counts_once(tmp_path):
+    """A hedged chunk whose primary fails after the hedge already won
+    must take exactly ONE health debit: with quarantine_after=2 and a
+    single failing read, no interleaving can reach the threshold unless
+    the chunk double-counts."""
+    from nvme_strom_tpu.fault import HealthState
+    from nvme_strom_tpu.testing.chaos import (expected_mirrored_stream,
+                                              read_all)
+    config.set("io_retries", 0)
+    config.set("quarantine_after", 2)
+    config.set("quarantine_s", 60.0)
+    config.set("hedge_policy", "fixed")
+    config.set("hedge_ms", 2.0)
+    plan = _FailFirstOnMember(0, delay_s=0.05)
+    paths, src = _mirrored_striped(tmp_path, plan)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            got, total = read_all(sess, src)
+            assert got == expected_mirrored_stream(paths)[:total]
+            assert sess._member_health.state(0) is not HealthState.QUARANTINED
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_hedge_won") >= 1
+    assert _counter_delta(before, after, "nr_member_quarantine") == 0
+
+
+def test_watchdog_fires_once_with_both_legs_in_flight(tmp_path):
+    """Deadline expiry while a hedged chunk has BOTH legs still in
+    flight: the watchdog latches ETIMEDOUT exactly once — the racing
+    legs must not each trip it."""
+    from nvme_strom_tpu.testing.chaos import read_all
+    config.set("io_retries", 0)
+    config.set("task_deadline_s", 0.25)
+    config.set("hedge_policy", "fixed")
+    config.set("hedge_ms", 2.0)
+    plan = FaultPlan(latency_s=0.8)   # both legs sleep well past deadline
+    paths, src = _mirrored_striped(tmp_path, plan)
+    before = stats.snapshot(reset_max=False)
+    try:
+        with Session() as sess:
+            with pytest.raises(StromError) as ei:
+                read_all(sess, src, timeout=30.0)
+            assert ei.value.errno == errno.ETIMEDOUT
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False)
+    assert _counter_delta(before, after, "nr_task_timeout") == 1
+
+
+# ---------------------------------------------------------------------------
 # randomized stress (short CI slice of `make stress-faults`)
 # ---------------------------------------------------------------------------
 
