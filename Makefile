@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe probe-loop clean
+.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe trace-gate probe-loop clean
 
 all: native
 
@@ -75,9 +75,17 @@ bench-stripe:
 	  JAX_PLATFORMS=cpu python bench.py --stripe-scaling
 	@echo "bench-stripe ok"
 
-# The everyday gate: tier-1 tests plus the perf smokes and the seeded
-# member-survival schedules.
-check: bench-smoke bench-stripe chaos
+# Trace-overhead gate (ISSUE 7): the bench-smoke workload under
+# trace_policy=sampled must ride within 3% of off (A/B interleaved
+# medians) — the production-safety contract for always-on sampled
+# tracing.  Override STROM_TRACE_GATE_RUNS / STROM_TRACE_GATE_PCT.
+trace-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.trace_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m trace
+
+# The everyday gate: tier-1 tests plus the perf smokes, the seeded
+# member-survival schedules, and the trace-overhead gate.
+check: bench-smoke bench-stripe chaos trace-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

@@ -236,6 +236,13 @@ struct RingCtx {
   std::condition_variable q_cv;
   std::deque<ReqCtx*> q;
   std::vector<std::thread> workers;
+  // flight-recorder event ring (API v3): one event per completed request,
+  // recorded on this lane's completion path only when tracing is on —
+  // bounded drop-oldest, drained by nstpu_engine_trace_drain.  Its own
+  // mutex: never nests with sq_m/win_m/q_m (record happens after the
+  // window slot is still held but touches no other lock).
+  std::mutex tr_m;
+  std::deque<nstpu_trace_event> tr;
 };
 
 // ---------------------------------------------------------------------------
@@ -252,6 +259,13 @@ struct Engine {
   Slot slots[kTaskSlots];
   std::atomic<int64_t> next_task{1};
   std::atomic<bool> stopping{false};
+
+  // flight recorder (API v3): off by default; when off the completion
+  // path pays exactly one relaxed load.  trace_seq_ is engine-global so
+  // drained events interleave with a total order and ring drops show as
+  // sequence gaps.
+  std::atomic<int> trace_on_{0};
+  std::atomic<uint32_t> trace_seq_{0};
 
   // queue-occupancy integral: the interval ending at each in-flight
   // transition is accounted against the OLD level, so mean occupancy
@@ -312,6 +326,24 @@ struct Engine {
     out2[0] = m_occ_integral[member];
     out2[1] = m_occ_busy[member];
     return 0;
+  }
+
+  int trace_set(int enable) {
+    return trace_on_.exchange(enable ? 1 : 0, std::memory_order_relaxed);
+  }
+
+  int trace_drain(nstpu_trace_event* out, int32_t cap) {
+    if (!out || cap < 0) return -EINVAL;
+    int n = 0;
+    for (auto* rx : rings) {
+      std::lock_guard<std::mutex> lk(rx->tr_m);
+      while (n < cap && !rx->tr.empty()) {
+        out[n++] = rx->tr.front();
+        rx->tr.pop_front();
+      }
+      if (n >= cap) break;
+    }
+    return n;
   }
 
   // one lane per (member % nlanes), BOTH backends — see RingCtx
@@ -535,9 +567,32 @@ struct Engine {
 
   // ---- request completion (shared by both backends) ----------------------
 
+  // record one flight-recorder event for a finishing request: the
+  // measured device window [t_start, now] plus the ORIGINAL extent
+  // (file_off advanced on short-read continuations; walk it back by the
+  // bytes already consumed).  Bounded drop-oldest per lane.
+  void trace_record(ReqCtx* rc, uint64_t complete_ns, int err) {
+    nstpu_trace_event ev;
+    ev.submit_ns = rc->t_start;
+    ev.complete_ns = complete_ns;
+    ev.file_off = rc->file_off - (rc->orig_len - rc->remaining);
+    ev.len = rc->orig_len;
+    ev.member = rc->member;
+    ev.lane = rc->ring_idx;
+    ev.result = err ? -err : 0;
+    ev.seq = trace_seq_.fetch_add(1, std::memory_order_relaxed);
+    RingCtx& rx = ring_of(rc);
+    std::lock_guard<std::mutex> lk(rx.tr_m);
+    if (rx.tr.size() >= NSTPU_TRACE_RING_EVENTS) rx.tr.pop_front();
+    rx.tr.push_back(ev);
+  }
+
   void finish_req(ReqCtx* rc, int err) {
     // per-member accounting at completion: requests, bytes, busy ns
-    uint64_t service_ns = now_ns() - rc->t_start;
+    uint64_t now = now_ns();
+    if (trace_on_.load(std::memory_order_relaxed))
+      trace_record(rc, now, err);
+    uint64_t service_ns = now - rc->t_start;
     member_ctr[rc->member][0].fetch_add(1, std::memory_order_relaxed);
     member_ctr[rc->member][1].fetch_add(rc->orig_len,
                                         std::memory_order_relaxed);
@@ -1080,7 +1135,7 @@ const char* nstpu_signature(void) {
 #define NSTPU_BUILD_TS __DATE__ " " __TIME__
 #endif
   return "strom_tpu native engine api " /* api version stringized below */
-         "v2, built " NSTPU_BUILD_TS
+         "v3, built " NSTPU_BUILD_TS
 #ifdef __clang__
          ", clang"
 #elif defined(__GNUC__)
@@ -1217,6 +1272,19 @@ int nstpu_engine_member_occ(uint64_t engine, int32_t member, uint64_t* out2) {
   Engine* e = lookup(engine);
   if (!e) return -ENOENT;
   return e->member_occ(member, out2);
+}
+
+int nstpu_engine_trace(uint64_t engine, int enable) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  return e->trace_set(enable);
+}
+
+int nstpu_engine_trace_drain(uint64_t engine, nstpu_trace_event* out,
+                             int32_t cap) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  return e->trace_drain(out, cap);
 }
 
 }  // extern "C"

@@ -27,7 +27,7 @@
 extern "C" {
 #endif
 
-#define NSTPU_API_VERSION 2
+#define NSTPU_API_VERSION 3
 
 /* backends */
 #define NSTPU_BACKEND_AUTO       0
@@ -203,6 +203,39 @@ int      nstpu_engine_member_occ(uint64_t engine, int32_t member,
  * their destination is not inside any registered region. */
 int      nstpu_buf_register(uint64_t engine, void* base, uint64_t len);
 int      nstpu_buf_unregister(uint64_t engine, int32_t slot);
+
+/* -- flight-recorder event ring (API v3) --------------------------------
+ * When tracing is enabled each lane records one event per completed
+ * request — the measured device window (submit->last-completion, the same
+ * CLOCK_MONOTONIC ns domain as Python's time.monotonic_ns()) plus its
+ * extent and attribution.  Rings are bounded (drop-oldest) and touched
+ * only under the lane's completion path; when tracing is off the hot path
+ * pays exactly one relaxed atomic load per completion. */
+#define NSTPU_TRACE_RING_EVENTS 4096
+
+typedef struct nstpu_trace_event {
+  uint64_t submit_ns;    /* CLOCK_MONOTONIC at request submission */
+  uint64_t complete_ns;  /* CLOCK_MONOTONIC at final completion */
+  uint64_t file_off;     /* original extent (pre-continuation) */
+  uint64_t len;          /* original request length */
+  uint32_t member;       /* stripe member attribution */
+  uint32_t lane;         /* lane (queue pair) index */
+  int32_t  result;       /* 0 or -errno latched for the request */
+  uint32_t seq;          /* engine-global sequence (drop detection) */
+} nstpu_trace_event;
+
+/* Enable/disable event recording.  Returns previous state (0/1) or
+ * -ENOENT for a bad handle.  Off is the default; enabling mid-flight is
+ * safe (in-flight requests complete with recording per the flag at their
+ * completion time). */
+int      nstpu_engine_trace(uint64_t engine, int enable);
+
+/* Drain up to cap recorded events (all lanes, oldest first per lane) into
+ * out and clear them from the rings.  Returns events written, or -errno.
+ * Callers poll this from the completion/await path; an undrained full
+ * ring drops its oldest events (seq gaps reveal the loss). */
+int      nstpu_engine_trace_drain(uint64_t engine, nstpu_trace_event* out,
+                                  int32_t cap);
 
 #ifdef __cplusplus
 }

@@ -62,6 +62,20 @@ class _Req(ctypes.Structure):
                 ("dest_off", ctypes.c_uint64)]
 
 
+class _TraceEvent(ctypes.Structure):
+    # must match nstpu_trace_event in csrc/strom_tpu.h (API v3)
+    _fields_ = [("submit_ns", ctypes.c_uint64),
+                ("complete_ns", ctypes.c_uint64),
+                ("file_off", ctypes.c_uint64), ("len", ctypes.c_uint64),
+                ("member", ctypes.c_uint32), ("lane", ctypes.c_uint32),
+                ("result", ctypes.c_int32), ("seq", ctypes.c_uint32)]
+
+
+#: drain batch size — matches NSTPU_TRACE_RING_EVENTS so one call can
+#: empty a full lane ring
+TRACE_RING_EVENTS = 4096
+
+
 _lib = None
 _lib_lock = threading.Lock()
 _load_failed = False
@@ -141,6 +155,12 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.nstpu_engine_member_occ.argtypes = [
                 ctypes.c_uint64, ctypes.c_int32,
                 ctypes.POINTER(ctypes.c_uint64)]
+        except AttributeError:  # pragma: no cover - older .so
+            pass
+        try:  # API v3: flight-recorder event ring
+            lib.nstpu_engine_trace.argtypes = [ctypes.c_uint64, ctypes.c_int]
+            lib.nstpu_engine_trace_drain.argtypes = [
+                ctypes.c_uint64, ctypes.POINTER(_TraceEvent), ctypes.c_int32]
         except AttributeError:  # pragma: no cover - older .so
             pass
         _lib = lib
@@ -378,6 +398,29 @@ class NativeEngine:
                     out[m] = (cur[0] - prev[0], cur[1] - prev[1])
                     self._prev_member_occ[m] = cur
             return out
+
+    def trace_enable(self, on: bool = True) -> bool:
+        """Turn the native flight-recorder ring on/off.  Returns the
+        PREVIOUS state; False also covers an older .so without the export
+        (callers lose only native spans, never correctness)."""
+        if not hasattr(self._lib, "nstpu_engine_trace"):
+            return False
+        return self._lib.nstpu_engine_trace(self._h, 1 if on else 0) > 0
+
+    def trace_drain(self, cap: int = TRACE_RING_EVENTS) -> List[Dict[str, int]]:
+        """Drain recorded device events (oldest first per lane); [] on an
+        older .so.  Each dict carries the measured submit->complete window
+        in CLOCK_MONOTONIC ns — the same domain as time.monotonic_ns()."""
+        if not hasattr(self._lib, "nstpu_engine_trace_drain") or not self._h:
+            return []
+        out = (_TraceEvent * cap)()
+        n = self._lib.nstpu_engine_trace_drain(self._h, out, cap)
+        if n <= 0:
+            return []
+        return [{"submit_ns": e.submit_ns, "complete_ns": e.complete_ns,
+                 "file_off": e.file_off, "len": e.len, "member": e.member,
+                 "lane": e.lane, "result": e.result, "seq": e.seq}
+                for e in out[:min(n, cap)]]
 
     def member_stats_delta(self, members: Sequence[int]) -> Dict[int, Tuple[int, int, int]]:
         """Per-member (nreq, bytes, ns) deltas since the previous call,

@@ -236,6 +236,18 @@ STAT_FIELDS: Tuple[str, ...] = (
     "nr_hedge_cancelled",     # hedge legs discarded after the primary won
     "nr_mirror_read",         # extents served from a member's mirror at
     #                           direct speed (degraded-mode striping)
+    # write-amplification surface (PR 7): bytes the pipeline TOUCHED
+    # beyond the payload it delivered.  The ROADMAP item 5 gate metric is
+    # the derived ratio (payload + these) / payload — "bytes touched per
+    # byte delivered", 1.0 = the reference's zero-copy ideal
+    # (stats.bytes_touched_ratio; tpu_stat -v and the Prometheus render
+    # both surface it).
+    "bytes_staging_copy",     # staged bytes copied pinned-host -> device
+    #                           (the hop GPUDirect avoided; every staged
+    #                           payload byte crosses it once today)
+    "bytes_verify_reread",    # bytes re-read healing checksum mismatches
+    "bytes_hedge_dup",        # duplicate bytes a hedge race read twice
+    #                           (the losing leg's extent length)
     # queue-occupancy integral (PR 4 saturation work): occ_integral_ns
     # accumulates sum(in_flight * dt) and occ_busy_ns the elapsed ns with
     # in_flight > 0, so mean queue occupancy over an interval is

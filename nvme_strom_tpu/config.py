@@ -119,6 +119,11 @@ def _check_mirror(val: str, _cfg: "Config") -> None:
         raise ConfigError(f"mirror must be none|paired, got {val!r}")
 
 
+def _check_trace_policy(val: str, _cfg: "Config") -> None:
+    if val not in ("off", "sampled", "all"):
+        raise ConfigError(f"trace_policy must be off|sampled|all, got {val!r}")
+
+
 def _check_coalesce_limit(val: int, cfg: "Config") -> None:
     # 0 = coalescing off; otherwise the merge window must cover at least
     # one dma_max_size request or planning could emit nothing mergeable
@@ -375,6 +380,24 @@ class Config:
                      "(kmod/nvme_strom.c:1639-1663 analog)"))
         reg(Var("cache_threshold", 0.5, "float", minval=0.0, maxval=1.0,
                 help="cached-page fraction above which a chunk takes the write-back path"))
+        # flight recorder + end-to-end task tracing (PR 7)
+        reg(Var("trace_policy", "off", "str",
+                help="per-task span tracing into the flight recorder: "
+                     "'off' costs one branch per event site and records "
+                     "nothing, 'sampled' traces 1-in-N tasks (N from "
+                     "trace_sample_rate; the production setting — "
+                     "overhead gated <=3% by `make trace-gate`), 'all' "
+                     "traces every task (debugging/chaos).  Read at "
+                     "Session construction (trace.recorder.configure())",
+                validate=_check_trace_policy))
+        reg(Var("trace_sample_rate", 0.01, "float", minval=0.0, maxval=1.0,
+                help="fraction of tasks traced under trace_policy="
+                     "sampled (0.01 = every 100th task, deterministic "
+                     "1-in-round(1/rate) selection so runs reproduce)"))
+        reg(Var("trace_ring_events", 8192, "int", minval=256, maxval=1 << 20,
+                help="flight-recorder capacity per thread (bounded ring; "
+                     "oldest events overwrite, the dump reports the "
+                     "overwrite count)"))
 
     # -- layered loading ---------------------------------------------------
     def _load_file(self) -> None:

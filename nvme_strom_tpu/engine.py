@@ -49,6 +49,7 @@ from .fault import HealthState, MemberHealthMachine, RetryPolicy
 from .log import pr_info, pr_warn
 from .eligibility import probe_backing
 from .stats import stats
+from .trace import recorder as _trace
 from . import numa as _numa
 
 #: live sessions, for the stat exporter's pre-publish fold (weak: the
@@ -1057,7 +1058,7 @@ _N_TASK_SLOTS = 512  # reference uses 512 hash slots (kmod/nvme_strom.c:639-644)
 class DmaTask:
     __slots__ = ("task_id", "state", "errno_", "errmsg", "pending", "frozen",
                  "result", "t_submit", "buf_handle", "deadline", "expired",
-                 "verify_src", "verify_dest", "verify_reqs")
+                 "verify_src", "verify_dest", "verify_reqs", "trace_id")
 
     def __init__(self, task_id: int, deadline_s: float = 0.0):
         self.task_id = task_id
@@ -1080,6 +1081,8 @@ class DmaTask:
         self.deadline = (time.monotonic() + deadline_s) if deadline_s > 0 \
             else 0.0
         self.expired = False   # set by the watchdog; chunks check and bail
+        self.trace_id = 0      # nonzero when the flight recorder sampled
+        #                        this task (trace.recorder.task_begin)
 
 
 class Session:
@@ -1107,6 +1110,9 @@ class Session:
         stats.default_export_start()
         _live_sessions.add(self)
         stats.add_export_hook(_fold_live_native_stats)
+        # flight recorder (PR 7): trace_policy is read here, once — event
+        # sites then cost one `_trace.active` branch when tracing is off
+        _trace.configure()
         self._slots: List[Dict[int, DmaTask]] = [dict() for _ in range(_N_TASK_SLOTS)]
         self._slot_cv = [threading.Condition() for _ in range(_N_TASK_SLOTS)]
         self._id_lock = threading.Lock()
@@ -1205,6 +1211,10 @@ class Session:
                         "falling back to python path", want)
         self.backend_name = (self._native.backend_name if self._native
                              else "python")
+        if _trace.active and self._native is not None:
+            # per-lane native event ring: device submit->complete windows
+            # are MEASURED by the engine and drained into the recorder
+            self._native.trace_enable(True)
         pr_info("session open: backend=%s workers=%d",
                 self.backend_name, nworkers)
 
@@ -1327,6 +1337,8 @@ class Session:
             tid = self._next_task
             self._next_task += 1
         task = DmaTask(tid, deadline_s=float(config.get("task_deadline_s")))
+        if _trace.active:
+            task.trace_id = _trace.task_begin(tid)
         s = self._slot_of(tid)
         with self._slot_cv[s]:
             self._slots[s][tid] = task
@@ -1358,6 +1370,10 @@ class Session:
                                 f"{config.get('task_deadline_s')}s deadline "
                                 f"({task.pending} chunks outstanding)")
                             stats.add("nr_task_timeout")
+                            if _trace.active and task.trace_id:
+                                _trace.instant(
+                                    "task_timeout", tid=task.trace_id,
+                                    args={"pending": task.pending})
                         # latch FAILED now (pending chunks drain later and
                         # cannot flip it back: errno_ is already set)
                         task.state = DmaTaskState.FAILED
@@ -1435,6 +1451,10 @@ class Session:
                 stats.count_clock("ssd2dev", time.monotonic_ns() - task.t_submit)
                 self._slot_cv[s].notify_all()
         if latched is not None:
+            if _trace.active and task.trace_id:
+                _trace.instant("task_failed", tid=task.trace_id,
+                               args={"errno": latched.errno,
+                                     "error": str(latched)[:160]})
             # outside the lock: a slow stderr must not stall completions
             pr_warn("dma task %d latched error: %s", task.task_id, latched)
         if done and task.buf_handle is not None:
@@ -1468,7 +1488,15 @@ class Session:
                 if task.state == DmaTaskState.RUNNING:
                     stats.add("nr_wrong_wakeup")
         stats.count_clock("ioctl_memcpy_wait", time.monotonic_ns() - t0)
+        if _trace.active and task.trace_id:
+            _trace.span("wait", t0, time.monotonic_ns(), tid=task.trace_id,
+                        args=({"errno": task.errno_} if task.errno_ else None))
         if task.errno_:
+            if _trace.active:
+                # the flight-recorder moment: dump what the engine did in
+                # the window before this task latched (bounded per process)
+                _trace.dump_on_failure(
+                    f"task {task_id} errno {task.errno_}")
             raise StromError(task.errno_, task.errmsg or "async DMA failed")
         if task.verify_reqs:
             # zero-copy landing: the native engine read straight into the
@@ -1517,6 +1545,10 @@ class Session:
             raise StromError(_errno.EINVAL, "no chunks")
         dest = self._get_buffer(buf_handle, need=dest_offset + n * chunk_size)
         task = self._create_task()
+        if _trace.active and task.trace_id:
+            _trace.instant("submit", tid=task.trace_id, ts_ns=t0,
+                           length=n * chunk_size,
+                           args={"task": task.task_id, "chunks": n})
         try:
             # --- cache arbitration (write-back vs direct) -----------------
             threshold = config.get("cache_threshold")
@@ -1599,10 +1631,16 @@ class Session:
                             mirror_remap[m] = mir
             native_failed = False
             for w in range(0, len(entries), window):
+                tp0 = time.monotonic_ns()
                 with stats.stage("setup_prps"):
                     reqs = plan_requests(source, entries[w:w + window],
                                          chunk_size, dest_offset,
                                          coalesce_limit=climit or None)
+                if _trace.active and task.trace_id:
+                    _trace.span("plan", tp0, time.monotonic_ns(),
+                                tid=task.trace_id,
+                                args={"window": w // window,
+                                      "requests": len(reqs)})
                 if not use_native or native_failed:
                     self._submit_pool_requests(task, source, reqs, dest)
                     continue
@@ -1635,6 +1673,12 @@ class Session:
                         m_eff = mirror_remap.get(r.member, r.member)
                         if m_eff != r.member:
                             stats.add("nr_mirror_read")
+                            if _trace.active and task.trace_id:
+                                _trace.instant(
+                                    "mirror_read", tid=task.trace_id,
+                                    member=r.member, offset=r.file_off,
+                                    length=r.length,
+                                    args={"mirror": m_eff})
                         foff = r.file_off
                         for dseg, lseg in r.dest_segs:
                             native_reqs.append((fds[m_eff], foff, lseg,
@@ -1646,6 +1690,12 @@ class Session:
                         m_eff = mirror_remap.get(r.member, r.member)
                         if m_eff != r.member:
                             stats.add("nr_mirror_read")
+                            if _trace.active and task.trace_id:
+                                _trace.instant(
+                                    "mirror_read", tid=task.trace_id,
+                                    member=r.member, offset=r.file_off,
+                                    length=r.length,
+                                    args={"mirror": m_eff})
                         native_reqs.append((fds[m_eff], r.file_off,
                                             r.length, r.dest_off))
                         native_members.append(m_eff)
@@ -1662,6 +1712,12 @@ class Session:
                     nat = self._native
                     nid = nat.submit(addr, native_reqs,
                                      members=native_members)
+                    if _trace.active and task.trace_id:
+                        _trace.instant(
+                            "native_submit", tid=task.trace_id,
+                            length=sum(q[2] for q in native_reqs),
+                            args={"requests": len(native_reqs),
+                                  "batch": nid})
                     self._task_get(task)
                     try:
                         self._pool.submit(self._await_native, task, nat, nid)
@@ -1698,7 +1754,12 @@ class Session:
                 length = min(chunk_size, source.size - base)
                 target = wb_buffer if wb_buffer is not None else dest
                 off = (dest_offset if wb_buffer is None else 0) + slot * chunk_size
+                tw0 = time.monotonic_ns()
                 source.read_buffered(base, target[off:off + length])
+                if _trace.active and task.trace_id:
+                    _trace.span("writeback", tw0, time.monotonic_ns(),
+                                tid=task.trace_id, offset=base,
+                                length=length)
         except BaseException:
             self._task_put(task, StromError(_errno.ECANCELED, "submit aborted"))
             # reference waits out in-flight DMA on submit error (:1781-1784)
@@ -1910,6 +1971,15 @@ class Session:
         finally:
             elapsed = time.monotonic_ns() - t0
             stats.member_add(r.member, r.length, elapsed)
+            if _trace.active and task.trace_id:
+                eargs = {}
+                if r.buffered:
+                    eargs["buffered"] = True
+                if err is not None:
+                    eargs["errno"] = err.errno
+                _trace.span("extent", t0, t0 + elapsed, tid=task.trace_id,
+                            member=r.member, offset=r.file_off,
+                            length=r.length, args=eargs or None)
             if not r.buffered:
                 stats.observe_latency(elapsed)
                 if err is None:
@@ -1988,6 +2058,13 @@ class Session:
                 stats.member_error(mirror)
                 return False
             stats.add("nr_mirror_read")
+            if _trace.active and task.trace_id:
+                # attributed to the member being covered FOR, so the
+                # degraded read shows on the failing member's track
+                _trace.span("mirror_read", tm, time.monotonic_ns(),
+                            tid=task.trace_id, member=r.member,
+                            offset=r.file_off, length=r.length,
+                            args={"mirror": mirror})
             health.record_success(mirror)
             health.observe_latency(mirror, time.monotonic_ns() - tm)
             return True
@@ -1997,6 +2074,10 @@ class Session:
                 and not health.allow_direct(r.member):
             # routed away (QUARANTINED/FAILED, or REJOINING beyond its
             # warmup tokens): mirror at direct speed first, buffered next
+            if _trace.active and task.trace_id:
+                _trace.instant("route_away", tid=task.trace_id,
+                               member=r.member, offset=r.file_off,
+                               length=r.length)
             if _try_mirror():
                 done = True
             elif fallback_ok:
@@ -2033,6 +2114,12 @@ class Session:
                 if attempt < self._retry.attempts and not task.errno_:
                     stats.add("nr_io_retry")
                     stats.member_error(r.member, retried=True)
+                    if _trace.active and task.trace_id:
+                        _trace.instant("retry", tid=task.trace_id,
+                                       member=r.member, offset=r.file_off,
+                                       length=r.length,
+                                       args={"attempt": attempt + 1,
+                                             "errno": se.errno})
                     self._retry.sleep(attempt, self._retry_rng)
                     attempt += 1
                     continue
@@ -2046,6 +2133,10 @@ class Session:
                     # buffered path (the reference's page-cache
                     # arbitration, reused as an error path)
                     stats.add("nr_io_fallback")
+                    if _trace.active and task.trace_id:
+                        _trace.instant("fallback_buffered",
+                                       tid=task.trace_id, member=r.member,
+                                       offset=r.file_off, length=r.length)
                     _buffered()
                     break
                 raise se
@@ -2094,6 +2185,19 @@ class Session:
                     if state["winner"] is not None:
                         return
                 stats.add("nr_hedge_issued")
+                # the race reads this extent twice — one leg's bytes are
+                # pure overhead whoever wins (bytes-touched gate metric)
+                stats.add("bytes_hedge_dup", r.length)
+                th0 = time.monotonic_ns()
+                if _trace.active and task.trace_id:
+                    # hedge events ride the PRIMARY member's track: the
+                    # race is a fact about the slow/failing member, the
+                    # serving leg is an attribute
+                    _trace.instant("hedge_issued", tid=task.trace_id,
+                                   member=r.member,
+                                   offset=r.file_off, length=r.length,
+                                   args={"leg": f"mirror:{mirror}"
+                                         if use_mirror else "buffered"})
                 # page-aligned scratch: the direct leg is an O_DIRECT
                 # pread and a heap bytearray would EINVAL it
                 scratch = mmap.mmap(-1, max(r.length, 1))
@@ -2107,14 +2211,30 @@ class Session:
                     if use_mirror:
                         health.record_failure(mirror)
                     stats.add("nr_hedge_cancelled")
+                    if _trace.active and task.trace_id:
+                        _trace.instant("hedge_cancelled",
+                                       tid=task.trace_id, member=r.member,
+                                       offset=r.file_off, length=r.length,
+                                       args={"reason": "leg_failed"})
                     return
                 if use_mirror:
                     health.record_success(mirror)
                     stats.add("nr_mirror_read")
                 if _finish("hedge", scratch):
                     stats.add("nr_hedge_won")
+                    if _trace.active and task.trace_id:
+                        _trace.span("hedge_won", th0, time.monotonic_ns(),
+                                    tid=task.trace_id, member=r.member,
+                                    offset=r.file_off, length=r.length,
+                                    args={"leg": f"mirror:{mirror}"
+                                          if use_mirror else "buffered"})
                 else:
                     stats.add("nr_hedge_cancelled")
+                    if _trace.active and task.trace_id:
+                        _trace.instant("hedge_cancelled",
+                                       tid=task.trace_id, member=r.member,
+                                       offset=r.file_off, length=r.length,
+                                       args={"reason": "primary_won"})
             finally:
                 hedge_settled.set()
 
@@ -2209,6 +2329,10 @@ class Session:
         rereads = int(config.get("checksum_retries"))
         while bad:
             stats.add("nr_csum_fail", len(bad))
+            if _trace.active:
+                _trace.instant("csum_fail", member=r.member,
+                               offset=r.file_off, length=r.length,
+                               args={"bad_pages": len(bad)})
             if rereads <= 0:
                 first = r.file_off + bad[0] * PAGE_SIZE
                 raise StromError(
@@ -2217,6 +2341,7 @@ class Session:
                     f"({len(bad)} bad page(s), re-reads exhausted)")
             rereads -= 1
             stats.add("nr_csum_reread", len(bad))
+            stats.add("bytes_verify_reread", len(bad) * PAGE_SIZE)
             for p in bad:
                 off = p * PAGE_SIZE
                 source.read_member_direct(
@@ -2252,7 +2377,30 @@ class Session:
             except BaseException as e:  # pragma: no cover
                 err = StromError(_errno.EIO, f"{type(e).__name__}: {e}")
                 break
+        if _trace.active:
+            # the reaper just saw this batch complete: pull the engine's
+            # per-lane event ring so the MEASURED device windows land in
+            # the recorder close to their completion
+            self._drain_native_trace(eng)
         self._task_put(task, err)
+
+    def _drain_native_trace(self, eng=None) -> int:
+        """Drain the native engine's per-lane trace ring into the flight
+        recorder (device submit->complete windows, monotonic ns — same
+        clock as the Python spans).  No-op on older .so builds."""
+        eng = eng if eng is not None else self._native
+        if eng is None:
+            return 0
+        try:
+            evs = eng.trace_drain()
+        except Exception:   # noqa: BLE001 — observability, not control
+            return 0
+        for ev in evs:
+            _trace.native_event(ev["submit_ns"], ev["complete_ns"],
+                                member=ev["member"], lane=ev["lane"],
+                                offset=ev["file_off"], length=ev["len"],
+                                result=ev["result"])
+        return len(evs)
 
     def _adaptive_cap(self, floor: int, limit: int, member: int = 0) -> int:
         """Current effective coalescing cap from *member*'s adaptive sizer
@@ -2322,6 +2470,8 @@ class Session:
                                          backing, cb)
             old, self._native = self._native, eng
         self._old_engines.append(old)
+        if _trace.active:
+            eng.trace_enable(True)
         self.backend_name = eng.backend_name
         pr_info("engine scaled out: %d lane(s) for %d stripe members "
                 "(backend=%s depth=%d)", eng.nlanes(), nmem,
@@ -2528,6 +2678,8 @@ class Session:
                 pass
         if self._native is not None:
             self._native.reap(timeout_ms=int(timeout * 1000))
+            if _trace.active:
+                self._drain_native_trace()
             try:
                 self._fold_native_stats()
             except StromError:
@@ -2539,6 +2691,8 @@ class Session:
         for old in self._old_engines:
             try:
                 old.reap(timeout_ms=2000)
+                if _trace.active:
+                    self._drain_native_trace(old)
                 self._fold_native_stats(old)
                 old.close()
             except Exception:

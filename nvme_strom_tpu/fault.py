@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .config import config
 from .stats import LAT_HIST_BUCKETS, hist_percentiles, stats
+from .trace import recorder as _trace
 
 __all__ = ["RetryPolicy", "HealthState", "MemberHealthMachine",
            "MemberHealth"]
@@ -183,6 +184,9 @@ class MemberHealthMachine:
         rec.state = new
         rec.since = now
         stats.member_state(member, new.value)
+        if _trace.active:
+            _trace.instant("health", member=member,
+                           args={"from": old.value, "to": new.value})
 
     def _expire(self, member: int, rec: _Member, now: float) -> None:
         """QUARANTINED -> REJOINING once the hold lapses (the PR 1 cliff

@@ -35,6 +35,7 @@ from ..api import MemCopyResult, StromError
 from ..config import config
 from ..engine import Session, Source
 from ..stats import stats
+from ..trace import recorder as _tr
 from .registry import HbmRegistry, registry as global_registry
 
 __all__ = ["StagingPipeline", "load_file_to_device", "AdaptiveH2DDepth"]
@@ -330,6 +331,9 @@ class StagingPipeline:
                 out_ids[out_pos:out_pos + len(batch)] = res.chunk_ids
                 nr_ssd += res.nr_ssd2dev
                 nr_ram += res.nr_ram2dev
+                # the pinned-host hop re-touches every delivered byte (the
+                # cost GPUDirect avoided) — feed the bytes-touched ratio
+                stats.add("bytes_staging_copy", nbytes)
                 # staged batch -> device (async H2D), landed with an async
                 # donated update; nothing here blocks
                 t0 = time.monotonic_ns()
@@ -343,7 +347,18 @@ class StagingPipeline:
                 # the DMA to HBM finishes; on CPU the chunk is an owned
                 # copy, so this stays safe)
                 self._barriers[bufidx] = fence
-                stats.count_clock("debug3", time.monotonic_ns() - t0)
+                now = time.monotonic_ns()
+                stats.count_clock("debug3", now - t0)
+                if _tr.active:
+                    trid = _tr.traced_id(task_id)
+                    if trid:
+                        _tr.span("staging_retire", t0, now, tid=trid,
+                                 length=nbytes,
+                                 args={"batch_chunks": len(batch),
+                                       "buffer": bufidx,
+                                       "ssd2dev": res.nr_ssd2dev,
+                                       "ram2dev": res.nr_ram2dev})
+                    _tr.task_end(task_id)
 
             def retire_one() -> None:
                 # fan-in from the member lanes (PR 5): retire the FIRST
@@ -440,6 +455,7 @@ class StagingPipeline:
                     f"{foff} ({len(bad)} bad page(s), re-reads exhausted)")
             rereads -= 1
             stats.add("nr_csum_reread", len(bad))
+            stats.add("bytes_verify_reread", len(bad) * PAGE_SIZE)
             for p in bad:
                 boff = p * PAGE_SIZE
                 foff = (chunk_ids[boff // chunk_size] * chunk_size

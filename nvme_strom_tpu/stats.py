@@ -26,7 +26,7 @@ from .config import config
 
 __all__ = ["StatRegistry", "stats", "DEFAULT_STAT_EXPORT",
            "STAT_EXPORT_DIR", "pid_export_path", "list_exports",
-           "LAT_HIST_BUCKETS", "hist_percentiles"]
+           "LAT_HIST_BUCKETS", "hist_percentiles", "bytes_touched_ratio"]
 
 #: per-request service-latency histogram: log2-ns buckets (bucket b covers
 #: [2^b, 2^(b+1)) ns), enough for 1ns..584y.  Matches the native engine's
@@ -58,6 +58,24 @@ def hist_percentiles(hist, qs=(0.50, 0.95, 0.99)):
                 break
         out.append(val)
     return out
+
+def bytes_touched_ratio(counters: dict):
+    """Bytes touched per byte delivered (ROADMAP item 5 gate metric).
+
+    ``(payload + staging copies + verify re-reads + hedge duplicate legs)
+    / payload`` — 1.0 means every byte moved exactly once (the
+    reference's peer-to-peer ideal); today's staging pipeline sits near
+    2.0 because each staged byte crosses the pinned-host→device hop.
+    Returns None until any payload bytes have been delivered."""
+    delivered = counters.get("total_dma_length", 0)
+    if delivered <= 0:
+        return None
+    touched = (delivered
+               + counters.get("bytes_staging_copy", 0)
+               + counters.get("bytes_verify_reread", 0)
+               + counters.get("bytes_hedge_dup", 0))
+    return touched / delivered
+
 
 #: cross-process observability: the reference exposes counters through
 #: /proc/nvme-strom readable by nvme_stat from any process; here an exporter
@@ -295,10 +313,17 @@ class StatRegistry:
         finally:
             self.count_clock(name, time.monotonic_ns() - t0)
 
-    def snapshot(self, *, debug: bool = False, reset_max: bool = True) -> StatInfo:
-        """STAT_INFO: consistent snapshot; ``max_dma_count`` is read-and-reset
-        to the current in-flight count, as the reference does
-        (kmod/nvme_strom.c:2087)."""
+    def snapshot(self, *, debug: bool = False, reset_max: bool = False) -> StatInfo:
+        """STAT_INFO: consistent snapshot.
+
+        ``reset_max=True`` additionally reads-and-resets ``max_dma_count``
+        to the current in-flight count, as the reference does on each
+        STAT_INFO (kmod/nvme_strom.c:2087) — but ONLY the exporter passes
+        it (the single resetter, :meth:`export`).  With multiple attached
+        readers the reference semantics race: two concurrent
+        read-and-resets make one watcher report a too-low high-water
+        mark, so plain reads (stat_info, tools, tests) observe without
+        consuming and the gauge covers the export interval."""
         with self._lock:
             counters = dict(self._c)
             if reset_max:
@@ -394,7 +419,10 @@ class StatRegistry:
                 fn()
             except Exception:   # noqa: BLE001 — publish must not die
                 pass
-        snap = self.snapshot(debug=True, reset_max=False)
+        # the exporter is the SINGLE resetter of the max_dma_count
+        # high-water mark: every reader sees the same per-interval peak
+        # instead of racing concurrent read-and-resets
+        snap = self.snapshot(debug=True, reset_max=True)
         payload = {"timestamp_ns": snap.timestamp_ns, "pid": os.getpid(),
                    "version": snap.version, "counters": snap.counters,
                    "members": self.member_snapshot(),

@@ -103,20 +103,38 @@ def _counter(name: str) -> int:
 # ---------------------------------------------------------------------------
 
 def scenario_fail_stop(rng: random.Random, dirpath: str) -> str:
-    """A mirrored member fail-stops mid-task: the copy must complete
-    byte-identical with the dead member's extents served by its mirror,
-    and the member must land in FAILED."""
+    """A mirrored member turns slow, loses a hedge race or two, then
+    fail-stops mid-task: the copy must complete byte-identical with the
+    dead member's extents served by its mirror, the member must land in
+    FAILED — and the flight recorder (forced to ``trace_policy=all`` for
+    this scenario) must produce a Perfetto-loadable dump showing the
+    hedge race and the mirror fallback on the victim's track."""
     from ..config import config
     from ..engine import Session
     from ..fault import HealthState
+    from ..trace import recorder, validate_chrome_trace
     from .fake import FakeStripedNvmeSource, FaultPlan
 
     config.set("io_retries", 1)
     config.set("task_deadline_s", 30.0)
     config.set("canary_interval_s", 0.0)   # no probes: FAILED must hold
+    config.set("hedge_policy", "fixed")
+    config.set("hedge_ms", 5.0)
+    # one-at-a-time member lane: deep lanes would put every extent in
+    # flight before the health machine flips, serving the whole stream
+    # by winning hedges — serialized, the fail-stop bites mid-stream and
+    # the post-failure extents walk the route-away/mirror rung
+    config.set("member_queue_depth", 1)
+    config.set("trace_policy", "all")
+    recorder.configure()
+    recorder.clear()
     victim = rng.choice([0, 2])
+    # slow before dead: the victim's pre-fail-stop reads each lose a
+    # 5ms hedge race, so the dump carries hedge spans AND mirror
+    # fallbacks in causal order on one track
     plan = FaultPlan(failstop_member=victim,
-                     failstop_after=rng.randrange(2, 8))
+                     failstop_after=rng.randrange(2, 8),
+                     slow_member=victim, slow_s=0.05)
     paths = make_mirrored_members(dirpath, tag=f"fs{rng.randrange(1 << 16)}-")
     src = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
                                 fault_plan=plan, force_cached_fraction=0.0,
@@ -136,6 +154,20 @@ def scenario_fail_stop(rng: random.Random, dirpath: str) -> str:
             assert_transitions_legal(sess, "fail_stop")
     finally:
         src.close()
+        doc = recorder.chrome_trace("chaos fail_stop")
+        dump_path = recorder.dump(os.path.join(dirpath, "fail_stop.json"),
+                                  reason="chaos fail_stop")
+        config.set("trace_policy", "off")
+        recorder.configure()
+        recorder.clear()
+    errs = validate_chrome_trace(doc)
+    assert not errs, f"fail_stop: trace dump fails schema check: {errs[:5]}"
+    names = {(e.get("name"), e.get("tid")) for e in doc["traceEvents"]}
+    vt = 100 + victim
+    assert ("hedge_issued", vt) in names or ("hedge_won", vt) in names, \
+        f"fail_stop: no hedge event on victim track (dump: {dump_path})"
+    assert ("mirror_read", vt) in names, \
+        f"fail_stop: no mirror_read on victim track (dump: {dump_path})"
     assert _counter("nr_mirror_read") > mirrors_before, \
         "fail_stop: no extent was served from the mirror"
     return "fail_stop"

@@ -177,7 +177,14 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="one-shot machine-readable snapshot (counters + "
                          "per-member breakdown) for scripts/monitoring")
+    ap.add_argument("--trace", action="store_true", dest="trace",
+                    help="list flight-recorder dumps (newest first) with "
+                         "a per-file summary; open them with strom_trace "
+                         "or Perfetto")
     args = ap.parse_args(argv)
+    if args.trace:
+        from .strom_trace import list_cmd
+        return list_cmd()
     if args.as_json and args.interval is not None:
         ap.error("--json is one-shot; drop the interval")
     if args.list:
@@ -248,6 +255,17 @@ def main(argv=None) -> int:
                       f"won {c.get('nr_hedge_won', 0)}  "
                       f"cancelled {c.get('nr_hedge_cancelled', 0)}  "
                       f"mirror-reads {c.get('nr_mirror_read', 0)}")
+            # write-amplification of the recovery/staging stack: every
+            # byte the pipeline touched (staging hop + verify re-reads +
+            # duplicated hedge legs) over every byte delivered — 1.0 is
+            # the direct-path floor, the paper's zero-copy ideal
+            from ..stats import bytes_touched_ratio
+            ratio = bytes_touched_ratio(c)
+            if ratio is not None:
+                print(f"bytes touched/delivered: {ratio:.3f}  "
+                      f"(staging {c.get('bytes_staging_copy', 0)}  "
+                      f"verify {c.get('bytes_verify_reread', 0)}  "
+                      f"hedge-dup {c.get('bytes_hedge_dup', 0)})")
         if args.verbose and snap.get("members"):
             # per-stripe-member breakdown (part_stat_add analog): a slow
             # member shows as an outlier avg-lat/p50 at similar req/byte

@@ -42,8 +42,13 @@ def tmp_data_file(tmp_path):
 @pytest.fixture(autouse=True)
 def _reset_config():
     """Isolate config mutations between tests (atomic restore: per-key
-    set() can trip cross-variable invariants depending on key order)."""
+    set() can trip cross-variable invariants depending on key order).
+    The flight recorder caches trace_policy at configure() time, so it is
+    re-synced and cleared alongside the restore."""
     from nvme_strom_tpu.config import config
+    from nvme_strom_tpu.trace import recorder
     snap = config.snapshot()
     yield
     config.restore(snap)
+    recorder.configure()
+    recorder.clear()
