@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe trace-gate probe-loop clean
+.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe trace-gate landing-gate probe-loop clean
 
 all: native
 
@@ -83,9 +83,19 @@ trace-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.trace_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m trace
 
+# Zero-copy landing gate (ISSUE 8): on the direct-eligible synthetic
+# config the pipeline must deliver bytes_touched_per_byte_delivered
+# <= 1.05 (the staging hop's second touch is gone), and landing=direct
+# must stay byte-identical to landing=staged down the fault ladder
+# (transient fail-stop, corrupt-once re-read, hedged legs).  Override
+# STROM_LANDING_GATE_RATIO to widen.
+landing-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.landing_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_landing.py -q -m landing
+
 # The everyday gate: tier-1 tests plus the perf smokes, the seeded
-# member-survival schedules, and the trace-overhead gate.
-check: bench-smoke bench-stripe chaos trace-gate
+# member-survival schedules, and the trace-overhead and landing gates.
+check: bench-smoke bench-stripe chaos trace-gate landing-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

@@ -51,6 +51,13 @@ a deterministic latency-bound "synthetic" curve that isolates the lane
 scale-out from the disk — journals the result to STRIPE_SCALING.jsonl
 and prints one JSON line.  ``make bench-stripe`` runs the 2-member
 synthetic smoke and gates on its ratio (BENCH_STRIPE_MIN_RATIO).
+
+Zero-copy landing A/B (ISSUE 8): ``python bench.py --landing`` runs the
+same pipeline load under ``landing=direct`` (engine reads land in the
+owned buffer the device array aliases) and ``landing=staged`` (the
+staging-ring hop), alternating modes across rounds, and prints one JSON
+line with both medians, the speedup, and each path's measured
+bytes-touched-per-byte-delivered ratio (direct ≈ 1.0, staged ≈ 2.0).
 """
 
 import fcntl
@@ -658,6 +665,92 @@ print("ROW=" + json.dumps(row))
 """
 
 
+_LANDING_CODE = """
+import json, os, statistics, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from nvme_strom_tpu import Session, config, stats
+from nvme_strom_tpu.engine import PlainSource
+from nvme_strom_tpu.hbm import HbmRegistry, StagingPipeline
+from nvme_strom_tpu.stats import bytes_touched_ratio
+
+path = os.environ["LANDING_BENCH_FILE"]
+rounds = int(os.environ.get("LANDING_BENCH_ROUNDS", "3"))
+chunk = 1 << 20
+size = os.path.getsize(path)
+# a freshly written bench file is fully page-cached; arbitration would
+# route every chunk write-back and the A/B would measure memcpy, not the
+# landing paths
+config.set("cache_arbitration", False)
+
+
+def run(mode):
+    config.set("landing", mode)
+    reg = HbmRegistry()
+    with PlainSource(path) as src, Session() as sess:
+        h = reg.map_device_memory(size)
+        try:
+            t0 = time.monotonic()
+            with StagingPipeline(sess, hbm_registry=reg) as pipe:
+                res = pipe.memcpy_ssd2dev(src, h,
+                                          list(range(size // chunk)), chunk)
+            reg.get(h).array.block_until_ready()
+            dt = time.monotonic() - t0
+            assert res.landing == mode, res.landing
+        finally:
+            reg.unmap(h)
+    return size / dt / (1 << 30)
+
+
+runs = {"direct": [], "staged": []}
+ratios = {"direct": [], "staged": []}
+for r in range(rounds):
+    order = ["direct", "staged"] if r % 2 == 0 else ["staged", "direct"]
+    for mode in order:
+        b = dict(stats.snapshot(reset_max=False).counters)
+        gbps = run(mode)
+        a = dict(stats.snapshot(reset_max=False).counters)
+        runs[mode].append(gbps)
+        rt = bytes_touched_ratio({k: a.get(k, 0) - b.get(k, 0) for k in a})
+        if rt is not None:
+            ratios[mode].append(rt)
+
+row = {m: round(statistics.median(v), 3) for m, v in runs.items()}
+row["speedup"] = (round(row["direct"] / row["staged"], 3)
+                  if row["staged"] else None)
+for m, v in ratios.items():
+    if v:
+        row["bytes_touched_" + m] = round(statistics.median(v), 3)
+print("ROW=" + json.dumps(row))
+"""
+
+
+def _landing_ab() -> int:
+    """``bench.py --landing``: A/B the zero-copy landing against the
+    staged ring on the CPU engine (same file, same chunking, alternating
+    rounds) and print one JSON line with medians + bytes-touched ratios."""
+    smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
+    size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
+    path = os.environ.get("BENCH_FILE",
+                          f"/tmp/strom_tpu_landing_{size_mb}.bin")
+    _lock = hold_bench_lock("bench.py --landing")
+    _ensure_file(path, size_mb << 20)
+    env = _env()
+    env["LANDING_BENCH_FILE"] = path
+    env.setdefault("LANDING_BENCH_ROUNDS", "1" if smoke else "3")
+    out = subprocess.run([sys.executable, "-c", _LANDING_CODE],
+                         capture_output=True, text=True, cwd=REPO, env=env,
+                         timeout=1800)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise RuntimeError("landing A/B run failed")
+    m = re.search(r"ROW=(\{.*\})", out.stdout)
+    row = {"metric": "landing_ab_GBps", "unit": "GB/s",
+           **json.loads(m.group(1))}
+    print(json.dumps(row))
+    return 0
+
+
 def _stripe_scaling() -> int:
     """``bench.py --stripe-scaling``: measure the member-lane scale-out
     curve (GB/s at 1/2/4 members + efficiency), journal it to
@@ -829,6 +922,8 @@ def main() -> int:
         return _probe_loop()
     if "--stripe-scaling" in sys.argv[1:]:
         return _stripe_scaling()
+    if "--landing" in sys.argv[1:]:
+        return _landing_ab()
     smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")

@@ -177,6 +177,10 @@ class MemCopyResult:
     nr_ssd2dev: int
     nr_ram2dev: int
     chunk_ids: List[int]
+    # landing path this command took ("direct" zero-copy into the owned
+    # destination buffer, "staged" through the pinned ring); empty for
+    # raw engine commands where the question does not arise
+    landing: str = ""
 
     def __post_init__(self) -> None:
         # conservation invariant the reference asserts (kmod/nvme_strom.c:1708)
@@ -248,6 +252,20 @@ STAT_FIELDS: Tuple[str, ...] = (
     "bytes_verify_reread",    # bytes re-read healing checksum mismatches
     "bytes_hedge_dup",        # duplicate bytes a hedge race read twice
     #                           (the losing leg's extent length)
+    # zero-copy landing (ISSUE 8): plan-time routing of each pipeline
+    # command between direct-to-destination and the staged ring
+    "nr_landing_direct",      # commands landed straight in an owned
+    #                           LandingBuffer the device array aliases
+    #                           (no staging hop: ratio floor ~1.0)
+    "nr_landing_staged",      # commands routed through the staging ring
+    #                           (chosen or fallen back)
+    "nr_landing_fallback",    # commands that wanted direct but fell back
+    "nr_landing_fallback_alignment",  # ...dest_offset/total does not
+    #                                   cover the destination exactly
+    "nr_landing_fallback_dtype",      # ...chunk/tail geometry or array
+    #                                   shape not dtype-compatible
+    "nr_landing_fallback_backend",    # ...backend cannot alias host
+    #                                   memory (no zero-copy device_put)
     # queue-occupancy integral (PR 4 saturation work): occ_integral_ns
     # accumulates sum(in_flight * dt) and occ_busy_ns the elapsed ns with
     # in_flight > 0, so mean queue occupancy over an interval is

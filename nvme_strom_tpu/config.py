@@ -97,6 +97,11 @@ def _check_h2d_path(val: str, _cfg: "Config") -> None:
                           f"got {val!r}")
 
 
+def _check_landing(val: str, _cfg: "Config") -> None:
+    if val not in ("auto", "direct", "staged"):
+        raise ConfigError(f"landing must be auto|direct|staged, got {val!r}")
+
+
 def _check_numa_policy(val: str, _cfg: "Config") -> None:
     if val in ("auto", "off"):
         return
@@ -242,6 +247,18 @@ class Config:
                      "re-measurable via bench_matrix h2d_pinned_peak "
                      "vs h2d_peak",
                 validate=_check_h2d_path))
+        reg(Var("landing", "auto", "str",
+                help="destination landing for pipeline commands: "
+                     "'direct' demands the zero-copy path (engine reads "
+                     "land in an owned page-aligned LandingBuffer the "
+                     "device array then ALIASES — no staging hop; "
+                     "ineligible commands fall back staged with a "
+                     "warning), 'staged' forces the pinned staging "
+                     "ring, 'auto' picks direct whenever alignment, "
+                     "dtype and backend allow (per-command choice "
+                     "recorded in stats nr_landing_* and the flight "
+                     "recorder's landing spans)",
+                validate=_check_landing))
         reg(Var("backend_fence_timeout", 60.0, "float", minval=0.0,
                 help="seconds a device fence (block_until_ready) may "
                      "block before the backend is declared LOST and "

@@ -1543,7 +1543,15 @@ class Session:
         n = len(chunk_ids)
         if n == 0:
             raise StromError(_errno.EINVAL, "no chunks")
-        dest = self._get_buffer(buf_handle, need=dest_offset + n * chunk_size)
+        # exact-size destinations (zero-copy landing, tail slots): a
+        # single-chunk task only needs the chunk's TRUE length, which may
+        # be a partial tail shorter than chunk_size
+        need = dest_offset + n * chunk_size
+        if n == 1:
+            tail = min(chunk_size, source.size - chunk_ids[0] * chunk_size)
+            if tail > 0:
+                need = dest_offset + tail
+        dest = self._get_buffer(buf_handle, need=need)
         task = self._create_task()
         if _trace.active and task.trace_id:
             _trace.instant("submit", tid=task.trace_id, ts_ns=t0,

@@ -1,5 +1,5 @@
-from .registry import HbmBuffer, HbmRegistry, registry
-from .staging import StagingPipeline, load_file_to_device
+from .registry import HbmBuffer, HbmRegistry, LandingBuffer, registry
+from .staging import StagingPipeline, load_file_to_device, plan_landing
 
-__all__ = ["HbmBuffer", "HbmRegistry", "registry", "StagingPipeline",
-           "load_file_to_device"]
+__all__ = ["HbmBuffer", "HbmRegistry", "LandingBuffer", "registry",
+           "StagingPipeline", "load_file_to_device", "plan_landing"]

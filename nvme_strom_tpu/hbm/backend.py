@@ -37,7 +37,23 @@ from ..api import StromError
 from ..config import config
 from ..log import pr_warn
 
-__all__ = ["BackendMonitor", "monitor"]
+__all__ = ["BackendMonitor", "monitor", "aliased_device_put"]
+
+
+def aliased_device_put(host, devlike):
+    """``device_put`` that MAY alias *host* — the zero-copy landing leg.
+
+    The staging ring must never alias its reusable slots (the next SSD
+    DMA would overwrite live device state; ``staging.owned_if_cpu``
+    copies first).  A :class:`~.registry.LandingBuffer` is the opposite
+    case: the buffer is OWNED by the destination for the array's whole
+    lifetime, so the CPU backend's zero-copy of a page-aligned view is
+    exactly the reference's BAR1 behaviour (`kmod/pmemmap.c`) — the
+    landed bytes ARE the device array, nothing is touched twice.
+    Accelerator backends copy host→HBM here like everywhere else; the
+    landing planner routes those staged instead."""
+    import jax
+    return jax.device_put(host, devlike)
 
 
 class BackendMonitor:

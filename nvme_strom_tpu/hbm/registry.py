@@ -28,11 +28,66 @@ import numpy as np
 
 from ..api import BufferInfo, StromError
 
-__all__ = ["HbmBuffer", "HbmRegistry", "registry"]
+__all__ = ["HbmBuffer", "HbmRegistry", "LandingBuffer", "registry"]
 
 # TPU page granularity reported in INFO; purely informational here (the
 # reference decodes 4K/64K/128K GPU page sizes, kmod/pmemmap.c:264-282)
 _DEVICE_PAGE = 4096
+
+
+class LandingBuffer:
+    """Owned, page-aligned destination buffer for zero-copy landing.
+
+    The ownership split the staging ring cannot express (LMB's buffer-
+    ownership motivation, PAPERS.md arXiv:2406.02039): the ring's slots
+    are REUSED, so its bytes must be copied off before the next SSD DMA;
+    a LandingBuffer belongs to exactly one destination, so the engine's
+    O_DIRECT/io_uring reads land here and the device array is an ALIAS
+    of this memory — the TPU analog of the reference mapping BAR1 pages
+    into the SSD's PRP lists (`kmod/pmemmap.c`).
+
+    Allocation rides the session's DmaBuffer machinery, so the buffer is
+    pinned, registered as an io_uring fixed buffer, and — because fixed
+    registrations are carried per DmaBuffer — RE-registered on the new
+    engine whenever a lane rebuild swaps engines mid-task.  ``release()``
+    detaches it from the session; the underlying mmap defers its munmap
+    until the last adopting array drops its buffer-protocol reference
+    (``DmaBuffer.close`` tolerates ``BufferError`` for exactly this), so
+    an :class:`HbmBuffer` holding an adopted alias keeps the memory
+    alive for as long as the array is reachable."""
+
+    def __init__(self, session, nbytes: int):
+        if nbytes <= 0:
+            raise StromError(_errno.EINVAL,
+                             "landing buffer size must be positive")
+        self.nbytes = int(nbytes)
+        self._session = session
+        self.handle, self._dma = session.alloc_dma_buffer(self.nbytes)
+        self._released = False
+
+    def view(self) -> memoryview:
+        return self._dma.view()[:self.nbytes]
+
+    def adopt_array(self, dtype, device) -> jax.Array:
+        """The landed bytes as a device array ALIASING this buffer where
+        the backend zero-copies (CPU), else as a device copy."""
+        from .backend import aliased_device_put
+        host = np.frombuffer(self.view(), dtype=dtype)
+        return aliased_device_put(host, device)
+
+    def release(self) -> None:
+        """Unmap from the session and drop the pinned mapping.  Safe to
+        call while adopted arrays are alive: fixed-buffer unregistration
+        and munlock run now; the munmap itself defers to the arrays'
+        refcount.  Idempotent."""
+        if self._released:
+            return
+        self._released = True
+        try:
+            self._session.unmap_buffer(self.handle)
+        except StromError:
+            pass        # session already closed / handle already gone
+        self._dma.close()
 
 
 class HbmBuffer:
@@ -49,6 +104,9 @@ class HbmBuffer:
         # polling (same CV drain Session.unmap_buffer uses in engine.py).
         self._drained = threading.Condition(self._lock)
         self._revoked = False
+        # LandingBuffer the current array aliases (zero-copy landing);
+        # owned by this holder once adopted, released on unmap/revoke
+        self._landing: Optional[LandingBuffer] = None
 
     @property
     def array(self) -> jax.Array:
@@ -58,11 +116,33 @@ class HbmBuffer:
             return self._array
 
     def swap(self, new_array: jax.Array) -> None:
-        """Install the successor array produced by a donated update."""
+        """Install the successor array produced by a donated update.
+        An attached LandingBuffer stays attached: a donated update of an
+        aliasing array may reuse the very same memory, so ownership only
+        transfers at :meth:`adopt` / unmap / revoke boundaries."""
         with self._lock:
             if self._revoked:
                 raise StromError(_errno.ENODEV, f"buffer {self.handle} revoked")
             self._array = new_array
+
+    def adopt(self, new_array: jax.Array, landing: "LandingBuffer") -> None:
+        """Install a directly-landed successor array together with the
+        LandingBuffer it aliases.  The holder owns *landing* from here
+        on; a previously adopted buffer is released (its memory survives
+        as long as arrays still alias it)."""
+        with self._lock:
+            if self._revoked:
+                raise StromError(_errno.ENODEV, f"buffer {self.handle} revoked")
+            prev, self._landing = self._landing, landing
+            self._array = new_array
+        if prev is not None:
+            prev.release()
+
+    def _release_landing(self) -> None:
+        with self._lock:
+            landing, self._landing = self._landing, None
+        if landing is not None:
+            landing.release()
 
     @property
     def nbytes(self) -> int:
@@ -142,6 +222,7 @@ class HbmRegistry:
         if already:   # outside buf._lock: registry lock nests self->buf
             with self._lock:
                 self._buffers.pop(handle, None)
+            buf._release_landing()
             return
         with buf._lock:
             # standard CV idiom: re-test the predicate after every wake,
@@ -158,6 +239,7 @@ class HbmRegistry:
             buf._revoked = True
         with self._lock:
             self._buffers.pop(handle, None)
+        buf._release_landing()
 
     def revoke_all(self, why: str) -> int:
         """Backend-loss revocation (VERDICT r3 #5): mark every registered
@@ -178,6 +260,12 @@ class HbmRegistry:
                     buf.revoke_reason = why
                     n += 1
                 buf._drained.notify_all()
+            try:
+                # the alias is dead with the array (ENODEV on access);
+                # unpin its memory now rather than waiting for unmap
+                buf._release_landing()
+            except Exception:  # noqa: BLE001 - loss path must not throw
+                pass
         return n
 
     # -- LIST / INFO -------------------------------------------------------

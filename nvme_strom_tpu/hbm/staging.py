@@ -34,11 +34,13 @@ import numpy as np
 from ..api import MemCopyResult, StromError
 from ..config import config
 from ..engine import Session, Source
+from ..log import pr_warn
 from ..stats import stats
 from ..trace import recorder as _tr
-from .registry import HbmRegistry, registry as global_registry
+from .registry import HbmRegistry, LandingBuffer, registry as global_registry
 
-__all__ = ["StagingPipeline", "load_file_to_device", "AdaptiveH2DDepth"]
+__all__ = ["StagingPipeline", "load_file_to_device", "AdaptiveH2DDepth",
+           "plan_landing"]
 
 
 class AdaptiveH2DDepth:
@@ -246,8 +248,72 @@ def _land(hbm, dev_chunk, elem_start: int, grid_elems: int):
                         f"device buffer to a multiple of the staging batch")
 
 
+def plan_landing(hbm, chunk_ids: Sequence[int], chunk_size: int,
+                 dest_offset: int, device_dtype, tail_len: int):
+    """Plan-time landing routing for one pipeline command (ISSUE 8).
+
+    Returns ``(mode, reason)``: *mode* is ``"direct"`` or ``"staged"``;
+    *reason* names the fallback cause (``"alignment"`` | ``"dtype"`` |
+    ``"backend"``) when the configuration allowed direct but the command
+    is ineligible, else ``None``.
+
+    Direct landing REPLACES the destination array with an alias of the
+    landed buffer, so the command must cover the destination exactly
+    (offset 0, total == nbytes), the geometry must be expressible in the
+    device dtype, and the backend must zero-copy page-aligned host views
+    (CPU today).  Accelerators pay a host→HBM copy either way, and the
+    staged ring overlaps that copy with in-flight SSD DMA — falling back
+    there is the fast path, not a compromise."""
+    how = config.get("landing")
+    if how == "staged":
+        return "staged", None
+    arr = hbm.array
+    dev = list(arr.devices())[0]
+    if dev.platform != "cpu":
+        return "staged", "backend"
+    itemsize = np.dtype(device_dtype).itemsize
+    if (arr.ndim != 1 or arr.dtype != np.dtype(device_dtype)
+            or chunk_size % itemsize or tail_len % itemsize):
+        return "staged", "dtype"
+    total = (len(chunk_ids) - 1) * chunk_size + tail_len
+    if dest_offset != 0 or total != arr.nbytes:
+        return "staged", "alignment"
+    return "direct", None
+
+
+def _trace_landing(source: Source, chunk_ids: Sequence[int], chunk_size: int,
+                   nbytes: int, path: str, t0: int, t1: int,
+                   trid: int) -> None:
+    """One 'landing' span per member extent of the command's chunks, so
+    Perfetto member tracks show direct-vs-staged routing per extent
+    (events carrying member >= 0 render on the member track)."""
+    left = nbytes
+    for cid in chunk_ids:
+        length = min(chunk_size, left)
+        left -= length
+        if length <= 0:
+            break
+        try:
+            extents = source.extents(cid * chunk_size, length)
+        except (StromError, NotImplementedError):
+            extents = None
+        if not extents:
+            _tr.span("landing", t0, t1, tid=trid, member=0,
+                     offset=cid * chunk_size, length=length,
+                     args={"path": path})
+            continue
+        for e in extents:
+            _tr.span("landing", t0, t1, tid=trid, member=e.member,
+                     offset=e.file_off, length=e.length,
+                     args={"path": path})
+
+
 class StagingPipeline:
-    """Overlapped SSD→HBM chunk mover (MEMCPY_SSD2GPU analog, full path)."""
+    """Overlapped SSD→HBM chunk mover (MEMCPY_SSD2GPU analog, full path).
+
+    Since ISSUE 8 this is the FALLBACK tier: eligible commands land
+    zero-copy in an owned :class:`LandingBuffer` (``_memcpy_direct``)
+    and never touch the ring; everything else stages here."""
 
     def __init__(self, session: Session, *, n_buffers: Optional[int] = None,
                  staging_bytes: Optional[int] = None,
@@ -281,23 +347,58 @@ class StagingPipeline:
                                  f"buffer {self.staging_bytes}")
         if not chunk_ids:
             raise StromError(22, "no chunks")
-        # every chunk must be full: staging slots are chunk_size-strided, so a
-        # partial chunk mid-batch would leave a hole in the device layout
-        # (the reference reads uniform BLCKSZ blocks for the same reason);
-        # callers stream a file tail with a separate command
-        for cid in chunk_ids:
+        # chunks must be full except a single trailing partial: staging
+        # slots are chunk_size-strided, so a partial chunk mid-batch would
+        # leave a hole in the device layout (the reference reads uniform
+        # BLCKSZ blocks for the same reason).  A non-multiple file TAIL is
+        # legal (ISSUE 8): it lands a partial slot — submitted as its own
+        # single-chunk command, so cache arbitration can never reorder it
+        # off the final device slot
+        tail_len = chunk_size
+        last = len(chunk_ids) - 1
+        for pos, cid in enumerate(chunk_ids):
+            if cid * chunk_size >= source.size:
+                raise StromError(22, f"chunk {cid} beyond EOF (source size "
+                                     f"{source.size})")
             if (cid + 1) * chunk_size > source.size:
-                raise StromError(22, f"chunk {cid} is partial (source size "
-                                     f"{source.size}); stream tails separately")
+                if pos != last:
+                    raise StromError(22, f"chunk {cid} is partial (source "
+                                         f"size {source.size}) but not last; "
+                                         f"only the final slot may be partial")
+                tail_len = source.size - cid * chunk_size
         hbm = self.registry.acquire(hbm_handle)
         try:
-            per_batch = self.staging_bytes // chunk_size
-            batches = [list(chunk_ids[i:i + per_batch])
-                       for i in range(0, len(chunk_ids), per_batch)]
             itemsize = np.dtype(device_dtype).itemsize
-            grid_elems = per_batch * chunk_size // itemsize
             if dest_offset % itemsize:
                 raise StromError(22, "dest_offset not aligned to device dtype")
+            if tail_len % itemsize:
+                raise StromError(22, f"partial tail ({tail_len} bytes) not a "
+                                     f"multiple of device dtype itemsize "
+                                     f"{itemsize}")
+            # -- plan-time landing decision (ISSUE 8) ----------------------
+            mode, why = plan_landing(hbm, chunk_ids, chunk_size, dest_offset,
+                                     device_dtype, tail_len)
+            if mode == "direct":
+                stats.add("nr_landing_direct")
+                return self._memcpy_direct(source, hbm, list(chunk_ids),
+                                           chunk_size, tail_len, device_dtype)
+            stats.add("nr_landing_staged")
+            if why is not None:
+                stats.add("nr_landing_fallback")
+                stats.add(f"nr_landing_fallback_{why}")
+                if _tr.active:
+                    _tr.instant("landing_fallback", args={"reason": why})
+                if config.get("landing") == "direct":
+                    pr_warn("landing=direct but command ineligible (%s); "
+                            "falling back to the staged ring", why)
+            per_batch = self.staging_bytes // chunk_size
+            full_ids = (list(chunk_ids) if tail_len == chunk_size
+                        else list(chunk_ids[:-1]))
+            batches = [full_ids[i:i + per_batch]
+                       for i in range(0, len(full_ids), per_batch)]
+            if tail_len != chunk_size:
+                batches.append([chunk_ids[-1]])
+            grid_elems = per_batch * chunk_size // itemsize
 
             # (bufidx, engine_task_id, batch, dev_elem_start, nbytes, out_pos)
             inflight = []
@@ -308,7 +409,9 @@ class StagingPipeline:
             nr_ssd = nr_ram = 0
             elem_cursor = dest_offset // itemsize
             chunk_cursor = 0
-            total_bytes_needed = dest_offset + len(chunk_ids) * chunk_size
+            total_bytes_needed = (dest_offset
+                                  + (len(chunk_ids) - 1) * chunk_size
+                                  + tail_len)
             if total_bytes_needed > hbm.nbytes:
                 raise StromError(34, f"device buffer too small: need "
                                      f"{total_bytes_needed} > {hbm.nbytes}")
@@ -358,6 +461,8 @@ class StagingPipeline:
                                        "buffer": bufidx,
                                        "ssd2dev": res.nr_ssd2dev,
                                        "ram2dev": res.nr_ram2dev})
+                        _trace_landing(source, res.chunk_ids, chunk_size,
+                                       nbytes, "staged", t0, now, trid)
                     _tr.task_end(task_id)
 
             def retire_one() -> None:
@@ -384,7 +489,7 @@ class StagingPipeline:
                 retire(inflight.pop(0))
 
             try:
-                for batch in batches:
+                for bi, batch in enumerate(batches):
                     # if every staging buffer is in flight, retire a
                     # completed batch first
                     if len(inflight) >= self.n_buffers:
@@ -402,6 +507,8 @@ class StagingPipeline:
                         self._barriers[bufidx] = None
                     handle, _ = self._bufs[bufidx]
                     nbytes = len(batch) * chunk_size
+                    if tail_len != chunk_size and bi == len(batches) - 1:
+                        nbytes = tail_len     # the partial-tail slot
                     task = self.session.memcpy_ssd2ram(source, handle,
                                                        batch, chunk_size)
                     inflight.append((bufidx, task.dma_task_id, batch,
@@ -424,9 +531,106 @@ class StagingPipeline:
                 raise
             return MemCopyResult(dma_task_id=0, nr_chunks=len(out_ids),
                                  nr_ssd2dev=nr_ssd, nr_ram2dev=nr_ram,
-                                 chunk_ids=out_ids)
+                                 chunk_ids=out_ids, landing="staged")
         finally:
             self.registry.release(hbm)
+
+    def _memcpy_direct(self, source: Source, hbm, chunk_ids: List[int],
+                       chunk_size: int, tail_len: int,
+                       device_dtype) -> MemCopyResult:
+        """Zero-copy landing (ISSUE 8): the engine's O_DIRECT/io_uring
+        reads land straight in an owned :class:`LandingBuffer` and the
+        device array becomes an ALIAS of it — no staging hop, every
+        delivered byte touched once (``bytes_touched_per_byte_delivered``
+        → ~1.0, the reference's BAR1 contract, `kmod/pmemmap.c`).
+
+        The full chunks ride ONE engine command (window-pipelined across
+        the member lanes, verified at wait time against the landed buffer
+        itself); a partial tail rides its own single-chunk command pinned
+        to the final slot.  Write-back (page-cache) chunks get the same
+        post-landing verify pass the staging ring applies, because the
+        engine's wait-time verify only covers the direct legs."""
+        n = len(chunk_ids)
+        total = (n - 1) * chunk_size + tail_len
+        t0 = time.monotonic_ns()
+        landing = LandingBuffer(self.session, total)
+        verify = bool(config.get("checksum_verify"))
+        adopted = False
+        tasks = []                    # (task_id, region_off, region_len)
+        unwaited: List[int] = []
+        try:
+            full = chunk_ids if tail_len == chunk_size else chunk_ids[:-1]
+            if full:
+                sub = self.session.memcpy_ssd2ram(source, landing.handle,
+                                                  full, chunk_size)
+                tasks.append((sub.dma_task_id, 0, len(full) * chunk_size))
+                unwaited.append(sub.dma_task_id)
+            if tail_len != chunk_size:
+                sub = self.session.memcpy_ssd2ram(
+                    source, landing.handle, [chunk_ids[-1]], chunk_size,
+                    dest_offset=(n - 1) * chunk_size)
+                tasks.append((sub.dma_task_id, (n - 1) * chunk_size,
+                              tail_len))
+                unwaited.append(sub.dma_task_id)
+            waited = []               # (result, region_off, region_len, id)
+            first_err: Optional[BaseException] = None
+            for task_id, region, rlen in tasks:
+                unwaited.remove(task_id)   # wait reaps, success or failure
+                try:
+                    res = self.session.memcpy_wait(task_id)
+                except StromError as e:
+                    if first_err is None:
+                        first_err = e
+                    continue
+                waited.append((res, region, rlen, task_id))
+            if first_err is not None:
+                raise first_err
+            out_ids: List[int] = []
+            nr_ssd = nr_ram = 0
+            view = landing.view()
+            for res, region, rlen, _tid in waited:
+                if verify and res.nr_ram2dev:
+                    # write-back chunks sit tail-packed in their region
+                    # (the per-command positional contract)
+                    self._verify_staged(
+                        source, res.chunk_ids[res.nr_ssd2dev:], chunk_size,
+                        view[region + res.nr_ssd2dev * chunk_size:
+                             region + rlen])
+                out_ids.extend(res.chunk_ids)
+                nr_ssd += res.nr_ssd2dev
+                nr_ram += res.nr_ram2dev
+            dev = list(hbm.array.devices())[0]
+            arr = landing.adopt_array(device_dtype, dev)
+            # the adopted alias must be real before it becomes device
+            # state: a wedged backend latches loss HERE with ENODEV —
+            # the same detection point the staged path gets per H2D fence
+            bounded_fence(arr, "landing-adopt")
+            hbm.adopt(arr, landing)
+            adopted = True
+            now = time.monotonic_ns()
+            if _tr.active:
+                for res, region, rlen, task_id in waited:
+                    trid = _tr.traced_id(task_id)
+                    if trid:
+                        _trace_landing(source, res.chunk_ids, chunk_size,
+                                       rlen, "direct", t0, now, trid)
+                    _tr.task_end(task_id)
+            return MemCopyResult(dma_task_id=0, nr_chunks=n,
+                                 nr_ssd2dev=nr_ssd, nr_ram2dev=nr_ram,
+                                 chunk_ids=out_ids, landing="direct")
+        except BaseException:
+            # first-error latch + retention discipline (the staged path's
+            # except clause, kmod/nvme_strom.c:770-776): reap what is
+            # still in flight, bounded, before surfacing the error
+            for task_id in unwaited:
+                try:
+                    self.session.memcpy_wait(task_id, timeout=5.0)
+                except StromError:
+                    pass
+            raise
+        finally:
+            if not adopted:
+                landing.release()
 
     def _verify_staged(self, source: Source, chunk_ids: Sequence[int],
                        chunk_size: int, view: memoryview) -> None:
@@ -520,31 +724,13 @@ def load_file_to_device(source: Source, *, chunk_size: Optional[int] = None,
     try:
         handle = reg.map_device_memory(n_elems, dtype=dtype, device=device)
         try:
-            n_full = source.size // chunk_size
-            tail = source.size - n_full * chunk_size
+            n_chunks = (source.size + chunk_size - 1) // chunk_size
             with StagingPipeline(sess, staging_bytes=staging_bytes,
                                  hbm_registry=reg) as pipe:
-                if n_full:
-                    pipe.memcpy_ssd2dev(source, handle, list(range(n_full)),
-                                        chunk_size, device_dtype=dtype)
-            if tail:
-                # file tail: one pinned-buffer hop outside the chunk grid
-                thandle, tbuf = sess.alloc_dma_buffer(max(tail, 4096))
-                try:
-                    source.read_buffered(n_full * chunk_size,
-                                         tbuf.view()[:tail])
-                    hbm = reg.acquire(handle)
-                    try:
-                        tdev = list(hbm.array.devices())[0]
-                        host = np.frombuffer(tbuf.view()[:tail], dtype=dtype)
-                        dev = safe_device_put(host, tdev)
-                        _land(hbm, dev, n_full * chunk_size // itemsize,
-                              chunk_size // itemsize)
-                    finally:
-                        reg.release(hbm)
-                finally:
-                    sess.unmap_buffer(thandle)
-                    tbuf.close()
+                # a non-multiple file tail rides the pipeline as a partial
+                # final chunk (ISSUE 8) — no separate pinned hop
+                pipe.memcpy_ssd2dev(source, handle, list(range(n_chunks)),
+                                    chunk_size, device_dtype=dtype)
             arr = reg.get(handle).array
             arr.block_until_ready()
             return arr

@@ -1,0 +1,208 @@
+"""Zero-copy landing gate (ISSUE 8).
+
+Holds the tentpole's two contracts on the synthetic direct-eligible
+config:
+
+* **Ratio** — with ``landing=direct`` the pipeline must deliver the
+  payload touching at most 1.05 bytes per byte delivered
+  (``stats.bytes_touched_ratio`` over the run's counter delta): the
+  engine's reads land in the owned LandingBuffer the device array
+  aliases, so the staging hop's second touch is gone.
+* **Identity** — ``landing=direct`` and ``landing=staged`` must produce
+  byte-identical device contents, on the clean path AND down the fault
+  ladder: transient fail-stop reads healed by the retry tier, a
+  corrupt-once torn read healed by the checksum re-read tier, and
+  hedged legs racing a slow member on a mirrored stripe.
+
+Runs in `make landing-gate` (wired into `make check`).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+RATIO_LIMIT = float(os.environ.get("STROM_LANDING_GATE_RATIO", "1.05"))
+
+
+def _load(mode: str, source, nbytes: int, chunk: int) -> bytes:
+    """One full pipeline load under the given landing mode; returns the
+    device array's bytes."""
+    from ..config import config
+    from ..engine import Session
+    from ..hbm import HbmRegistry, StagingPipeline
+
+    config.set("landing", mode)
+    reg = HbmRegistry()
+    with Session() as sess:
+        handle = reg.map_device_memory(nbytes)
+        try:
+            with StagingPipeline(sess, hbm_registry=reg) as pipe:
+                res = pipe.memcpy_ssd2dev(
+                    source, handle,
+                    list(range((nbytes + chunk - 1) // chunk)), chunk)
+            assert res.landing == ("direct" if mode == "direct"
+                                   else "staged"), \
+                f"landing={mode} but command took {res.landing!r}"
+            got = np.asarray(reg.get(handle).array).tobytes()
+        finally:
+            reg.unmap(handle)
+    return got
+
+
+def _leg_ratio_and_identity(dirpath: str) -> None:
+    """Clean path: direct ratio <= RATIO_LIMIT, byte-identical to staged."""
+    from ..config import config
+    from ..engine import PlainSource
+    from ..stats import bytes_touched_ratio, stats
+    from . import make_test_file
+
+    size, chunk = 16 << 20, 1 << 20
+    path = os.path.join(dirpath, "landing.bin")
+    make_test_file(path, size)
+    # the freshly written file is fully page-cached; arbitration would
+    # route every chunk write-back and no DMA would move — the gate
+    # measures the DIRECT read path, so force it
+    config.set("cache_arbitration", False)
+    with PlainSource(path) as src:
+        staged = _load("staged", src, size, chunk)
+    before = stats.snapshot(reset_max=False).counters
+    with PlainSource(path) as src:
+        direct = _load("direct", src, size, chunk)
+    after = stats.snapshot(reset_max=False).counters
+    delta = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+    assert direct == staged, "direct vs staged bytes diverge (clean path)"
+    assert delta.get("nr_landing_direct", 0) >= 1, \
+        f"eligible command did not land direct: {delta}"
+    assert delta.get("nr_landing_fallback", 0) == 0, \
+        f"eligible command fell back: {delta}"
+    ratio = bytes_touched_ratio(delta)
+    assert ratio is not None, "no DMA bytes moved in the direct leg"
+    assert ratio <= RATIO_LIMIT, \
+        f"bytes touched per byte delivered {ratio:.4f} > {RATIO_LIMIT}"
+    print(f"landing-gate ratio leg ok: {ratio:.4f} <= {RATIO_LIMIT} "
+          f"({size >> 20}MB, direct {delta.get('nr_landing_direct', 0)})")
+
+
+def _leg_transient_faults(dirpath: str) -> None:
+    """Fail-stop ladder: every 3rd direct read EIOs (transient); retries
+    heal it identically on both landing paths."""
+    from . import FakeStripedNvmeSource, FaultPlan, make_test_file
+
+    nmem, msize, chunk = 2, 2 << 20, 256 << 10
+    paths = []
+    for m in range(nmem):
+        p = os.path.join(dirpath, f"tm{m}.bin")
+        make_test_file(p, msize, seed=m)
+        paths.append(p)
+    total = nmem * msize
+
+    def fresh():
+        return FakeStripedNvmeSource(
+            paths, stripe_chunk_size=chunk,
+            fault_plan=FaultPlan(fail_every_nth=3),
+            force_cached_fraction=0.0)
+
+    src = fresh()
+    try:
+        staged = _load("staged", src, total, chunk)
+    finally:
+        src.close()
+    src = fresh()
+    try:
+        direct = _load("direct", src, total, chunk)
+    finally:
+        src.close()
+    assert direct == staged, "direct vs staged diverge under transient EIO"
+    print("landing-gate fault leg ok: transient fail-stop heals "
+          "byte-identically")
+
+
+def _leg_corrupt_once(dirpath: str) -> None:
+    """A torn read (flips once, heals on re-read): the checksum re-read
+    tier must repair it on both landing paths."""
+    from ..config import config
+    from ..scan.heap import PAGE_SIZE, HeapSchema, build_heap_file
+    from .fake import FakeNvmeSource, FaultPlan
+
+    config.set("checksum_verify", True)
+    schema = HeapSchema(n_cols=2, visibility=False)
+    n = schema.tuples_per_page * 8
+    path = os.path.join(dirpath, "co.heap")
+    build_heap_file(path, [np.arange(n, dtype=np.int32),
+                           (n - np.arange(n)).astype(np.int32)], schema)
+    size = os.path.getsize(path)
+
+    def load(mode):
+        src = FakeNvmeSource(
+            path,
+            fault_plan=FaultPlan(corrupt_once_offsets={2 * PAGE_SIZE + 99}),
+            force_cached_fraction=0.0)
+        try:
+            return _load(mode, src, size, PAGE_SIZE)
+        finally:
+            src.close()
+
+    with open(path, "rb") as f:
+        want = f.read()
+    staged, direct = load("staged"), load("direct")
+    config.set("checksum_verify", False)
+    assert staged == want, "staged corrupt-once repair diverged from disk"
+    assert direct == want, "direct corrupt-once repair diverged from disk"
+    print("landing-gate corrupt leg ok: torn read healed on both paths")
+
+
+def _leg_hedged(dirpath: str) -> None:
+    """Hedged legs racing a slow member on a mirrored stripe deliver the
+    same bytes on both landing paths."""
+    from ..config import config
+    from . import FakeStripedNvmeSource, FaultPlan
+    from .chaos import make_mirrored_members
+
+    chunk = 128 << 10
+    paths = make_mirrored_members(dirpath, n_pairs=1, size=1 << 20,
+                                  tag="hm")
+    config.set("hedge_policy", "fixed")
+    config.set("hedge_ms", 2.0)
+
+    def load(mode):
+        src = FakeStripedNvmeSource(
+            paths, stripe_chunk_size=chunk,
+            fault_plan=FaultPlan(slow_member=1, slow_s=0.02),
+            force_cached_fraction=0.0, mirror="paired")
+        try:
+            return _load(mode, src, src.size, chunk)
+        finally:
+            src.close()
+
+    staged, direct = load("staged"), load("direct")
+    config.set("hedge_policy", "off")
+    assert direct == staged, "direct vs staged diverge under hedged reads"
+    print("landing-gate hedge leg ok: hedged legs byte-identical")
+
+
+def main() -> int:
+    from ..config import config
+
+    snap = config.snapshot()
+    try:
+        with tempfile.TemporaryDirectory(prefix="strom_landing_") as d:
+            _leg_ratio_and_identity(d)
+            _leg_transient_faults(d)
+            _leg_corrupt_once(d)
+            _leg_hedged(d)
+    except AssertionError as e:
+        print(f"landing-gate FAIL: {e}")
+        return 1
+    finally:
+        config.restore(snap)
+    print("landing-gate ok: ratio within bound, fault ladder "
+          "byte-identical direct vs staged")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -255,6 +255,17 @@ def main(argv=None) -> int:
                       f"won {c.get('nr_hedge_won', 0)}  "
                       f"cancelled {c.get('nr_hedge_cancelled', 0)}  "
                       f"mirror-reads {c.get('nr_mirror_read', 0)}")
+            # zero-copy landing scoreboard (ISSUE 8): how many pipeline
+            # commands landed direct vs staged, and what blocked the
+            # direct tier when it was wanted
+            if (c.get("nr_landing_direct") or c.get("nr_landing_staged")
+                    or c.get("nr_landing_fallback")):
+                print(f"landing: direct {c.get('nr_landing_direct', 0)}  "
+                      f"staged {c.get('nr_landing_staged', 0)}  "
+                      f"fallback {c.get('nr_landing_fallback', 0)} "
+                      f"(align {c.get('nr_landing_fallback_alignment', 0)} "
+                      f"dtype {c.get('nr_landing_fallback_dtype', 0)} "
+                      f"backend {c.get('nr_landing_fallback_backend', 0)})")
             # write-amplification of the recovery/staging stack: every
             # byte the pipeline touched (staging hop + verify re-reads +
             # duplicated hedge legs) over every byte delivered — 1.0 is

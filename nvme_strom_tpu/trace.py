@@ -470,11 +470,27 @@ def render_prometheus(payload: dict) -> str:
         out.append(f"{name}{labels} {value}")
 
     for k in sorted(counters):
-        if "debug" in k:
-            continue
+        if "debug" in k or k.startswith("nr_landing_"):
+            continue    # landing counters render as labeled series below
         mtype = "gauge" if k in _PROM_GAUGES else "counter"
         emit(_prom_name(k if k in _PROM_GAUGES else k + "_total"),
              mtype, counters[k])
+    # landing-path attribution (ISSUE 8): one series per path / per
+    # fallback reason, so dashboards can plot direct-vs-staged routing
+    # and what is blocking the zero-copy tier
+    paths = [(p, counters.get(f"nr_landing_{p}", 0))
+             for p in ("direct", "staged")]
+    if any(v for _, v in paths):
+        out.append("# TYPE strom_tpu_landing_total counter")
+        for p, v in paths:
+            out.append(f'strom_tpu_landing_total{{path="{p}"}} {v}')
+    reasons = [(r, counters.get(f"nr_landing_fallback_{r}", 0))
+               for r in ("alignment", "dtype", "backend")]
+    if counters.get("nr_landing_fallback", 0) or any(v for _, v in reasons):
+        out.append("# TYPE strom_tpu_landing_fallback_total counter")
+        for r, v in reasons:
+            out.append(
+                f'strom_tpu_landing_fallback_total{{reason="{r}"}} {v}')
     ratio = bytes_touched_ratio(counters)
     if ratio is not None:
         emit("strom_tpu_bytes_touched_per_byte_delivered", "gauge",

@@ -105,15 +105,32 @@ def test_pipeline_out_of_order_and_offset(tmp_path, reg):
         reg.unmap(h)
 
 
-def test_pipeline_rejects_partial_chunk(tmp_path, reg):
+def test_pipeline_partial_chunk_only_last(tmp_path, reg):
+    """ISSUE 8 relaxed the full-chunk constraint: a partial chunk is
+    legal ONLY in the final slot (it stages/lands a partial slot); a
+    partial chunk anywhere else would hole the device layout and still
+    raises EINVAL, as does a chunk entirely beyond EOF."""
     path = str(tmp_path / "p3.bin")
-    make_test_file(path, CHUNK + 512)
+    size = CHUNK + 512
+    make_test_file(path, size)
     with PlainSource(path) as src, Session() as sess:
         h = reg.map_device_memory(4 * CHUNK)
         with StagingPipeline(sess, staging_bytes=2 * CHUNK, hbm_registry=reg) as pipe:
+            # partial chunk 1 NOT in the final slot: rejected
             with pytest.raises(StromError) as ei:
-                pipe.memcpy_ssd2dev(src, h, [0, 1], CHUNK)
+                pipe.memcpy_ssd2dev(src, h, [1, 0], CHUNK)
             assert ei.value.errno == errno.EINVAL
+            # chunk beyond EOF: rejected
+            with pytest.raises(StromError) as ei:
+                pipe.memcpy_ssd2dev(src, h, [0, 2], CHUNK)
+            assert ei.value.errno == errno.EINVAL
+            # partial chunk in the final slot: stages a partial slot
+            res = pipe.memcpy_ssd2dev(src, h, [0, 1], CHUNK)
+        assert res.nr_chunks == 2
+        arr = np.asarray(reg.get(h).array)
+        assert arr[:CHUNK].tobytes() == expected_bytes(0, CHUNK)
+        assert arr[CHUNK:size].tobytes() == expected_bytes(CHUNK, 512)
+        assert not arr[size:].any()   # beyond the tail stays zero
         reg.unmap(h)
 
 

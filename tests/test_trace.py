@@ -18,8 +18,8 @@ from nvme_strom_tpu.testing import FakeStripedNvmeSource, FaultPlan
 from nvme_strom_tpu.testing.chaos import (STRIPE, expected_mirrored_stream,
                                           make_mirrored_members, read_all)
 from nvme_strom_tpu.trace import (recorder, validate_chrome_trace,
-                                  _MEMBER, _LANE, _LEN, _NAME, _OFF, _TID,
-                                  _TS)
+                                  _ARGS, _MEMBER, _LANE, _LEN, _NAME, _OFF,
+                                  _TID, _TS)
 
 pytestmark = pytest.mark.trace
 
@@ -143,6 +143,54 @@ def test_chrome_trace_schema_and_flow_arrows(tmp_path):
     path = recorder.dump(str(tmp_path / "dump.json"), reason="schema test")
     with open(path) as f:
         assert validate_chrome_trace(json.load(f)) == []
+
+
+@pytest.mark.landing
+def test_landing_spans_attribute_the_path_taken(tmp_path):
+    """Every pipeline command leaves 'landing' spans naming the path it
+    took (direct vs staged) with member attribution, an ineligible
+    command under landing=direct leaves the fallback-reason instant, and
+    the export still passes the schema check (ISSUE 8)."""
+    from nvme_strom_tpu.engine import PlainSource
+    from nvme_strom_tpu.hbm import HbmRegistry, StagingPipeline
+    from nvme_strom_tpu.testing import make_test_file
+
+    _tracing("all")
+    size, chunk = 1 << 20, 256 << 10
+    path = str(tmp_path / "land.bin")
+    make_test_file(path, size)
+    reg = HbmRegistry()
+    with Session() as sess, PlainSource(path) as src:
+        for mode, nbytes in (("direct", size), ("staged", size + chunk)):
+            # the oversized destination is ineligible (alignment) and
+            # must fall back — under landing=direct, with the instant
+            config.set("landing", "direct")
+            handle = reg.map_device_memory(nbytes)
+            try:
+                with StagingPipeline(sess, hbm_registry=reg) as pipe:
+                    res = pipe.memcpy_ssd2dev(src, handle,
+                                              list(range(size // chunk)),
+                                              chunk)
+                assert res.landing == mode
+            finally:
+                reg.unmap(handle)
+
+    events = recorder.snapshot_events()
+    landing = [e for e in events if e[_NAME] == "landing"]
+    routed = {e[_ARGS].get("path") for e in landing}
+    assert routed == {"direct", "staged"}, \
+        f"landing spans missing a path: {routed}"
+    assert all(e[_MEMBER] >= 0 for e in landing), \
+        "landing span without member attribution"
+    for p in ("direct", "staged"):
+        moved = sum(e[_LEN] for e in landing if e[_ARGS].get("path") == p)
+        assert moved == size, \
+            f"{p} landing spans cover {moved} bytes, task moved {size}"
+    falls = [e for e in events if e[_NAME] == "landing_fallback"]
+    assert [e[_ARGS].get("reason") for e in falls] == ["alignment"]
+
+    doc = recorder.chrome_trace("landing spans")
+    assert validate_chrome_trace(doc) == []
 
 
 def test_validator_rejects_malformed_documents():
