@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe trace-gate landing-gate probe-loop clean
+.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe trace-gate landing-gate cache-gate probe-loop clean
 
 all: native
 
@@ -93,9 +93,19 @@ landing-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.landing_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_landing.py -q -m landing
 
+# Residency-tier gate (ISSUE 9): on the latency-injected synthetic a
+# hot rescan must beat the cold scan >= 2x (every chunk served from the
+# owned pinned-RAM tier, no engine submission), results must stay
+# byte-identical under eviction pressure, and a write-back-invalidated
+# extent must never be served stale.  Override STROM_CACHE_GATE_RATIO.
+cache-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.cache_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q -m cache
+
 # The everyday gate: tier-1 tests plus the perf smokes, the seeded
-# member-survival schedules, and the trace-overhead and landing gates.
-check: bench-smoke bench-stripe chaos trace-gate landing-gate
+# member-survival schedules, and the trace-overhead, landing and cache
+# gates.
+check: bench-smoke bench-stripe chaos trace-gate landing-gate cache-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

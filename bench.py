@@ -58,6 +58,15 @@ owned buffer the device array aliases) and ``landing=staged`` (the
 staging-ring hop), alternating modes across rounds, and prints one JSON
 line with both medians, the speedup, and each path's measured
 bytes-touched-per-byte-delivered ratio (direct ≈ 1.0, staged ≈ 2.0).
+
+Residency-tier A/B (ISSUE 9): ``python bench.py --cache`` interleaves a
+cold scan (tier cleared, every chunk submitted and filled) with a hot
+rescan (every chunk served from the owned pinned-RAM tier by memcpy, no
+engine submission) on the same file, journals the medians to
+CACHE_AB.jsonl and prints one JSON line with both numbers, the speedup
+and the measured hit ratio.  The deterministic latency-bound gate on
+this path is ``make cache-gate``; this bench records the real-file
+numbers for the trend journal.
 """
 
 import fcntl
@@ -725,6 +734,91 @@ print("ROW=" + json.dumps(row))
 """
 
 
+_CACHE_CODE = """
+import json, os, statistics, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from nvme_strom_tpu import Session, config, stats
+from nvme_strom_tpu.cache import residency_cache
+from nvme_strom_tpu.engine import PlainSource
+
+path = os.environ["CACHE_BENCH_FILE"]
+rounds = int(os.environ.get("CACHE_BENCH_ROUNDS", "3"))
+chunk = 1 << 20
+size = os.path.getsize(path)
+# the tier must hold the whole table so the hot pass is all hits; and a
+# freshly written bench file is fully page-cached, so arbitration would
+# route every cold chunk write-back and the A/B would compare memcpy
+# against memcpy+probe instead of the submission path against the tier
+config.set("cache_bytes", size + (8 << 20))
+config.set("cache_arbitration", False)
+ids = list(range(size // chunk))
+
+
+def run(sess, handle, buf):
+    t0 = time.monotonic()
+    res = sess.memcpy_ssd2ram(src, handle, ids, chunk)
+    sess.memcpy_wait(res.dma_task_id, timeout=300.0)
+    return size / (time.monotonic() - t0) / (1 << 30)
+
+
+runs = {"cold": [], "hot": []}
+hits = misses = 0
+with PlainSource(path) as src, Session() as sess:
+    handle, buf = sess.alloc_dma_buffer(size)
+    try:
+        for r in range(rounds):
+            residency_cache.clear()          # cold: tier empty, all fills
+            runs["cold"].append(run(sess, handle, buf))
+            b = dict(stats.snapshot(reset_max=False).counters)
+            runs["hot"].append(run(sess, handle, buf))
+            a = dict(stats.snapshot(reset_max=False).counters)
+            hits += a.get("nr_cache_hit", 0) - b.get("nr_cache_hit", 0)
+            misses += a.get("nr_cache_miss", 0) - b.get("nr_cache_miss", 0)
+    finally:
+        sess.unmap_buffer(handle)
+
+row = {m: round(statistics.median(v), 3) for m, v in runs.items()}
+row["speedup"] = (round(row["hot"] / row["cold"], 3)
+                  if row["cold"] else None)
+row["hit_ratio"] = round(hits / (hits + misses), 4) if hits + misses else 0.0
+row["resident_mb"] = round(residency_cache.resident_bytes() / (1 << 20), 1)
+print("ROW=" + json.dumps(row))
+"""
+
+
+def _cache_ab() -> int:
+    """``bench.py --cache``: interleaved cold-vs-hot A/B of the
+    cross-query residency tier on a real file (same chunking, tier
+    cleared before every cold pass), journaled to CACHE_AB.jsonl."""
+    smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
+    size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
+    path = os.environ.get("BENCH_FILE",
+                          f"/tmp/strom_tpu_cache_{size_mb}.bin")
+    _lock = hold_bench_lock("bench.py --cache")
+    _ensure_file(path, size_mb << 20)
+    env = _env()
+    env["CACHE_BENCH_FILE"] = path
+    env.setdefault("CACHE_BENCH_ROUNDS", "1" if smoke else "3")
+    out = subprocess.run([sys.executable, "-c", _CACHE_CODE],
+                         capture_output=True, text=True, cwd=REPO, env=env,
+                         timeout=1800)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise RuntimeError("cache A/B run failed")
+    m = re.search(r"ROW=(\{.*\})", out.stdout)
+    row = {"metric": "cache_ab_GBps", "unit": "GB/s",
+           **json.loads(m.group(1))}
+    entry = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **row}
+    try:
+        with open(os.path.join(REPO, "CACHE_AB.jsonl"), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        sys.stderr.write(f"bench: could not journal cache A/B: {e}\n")
+    print(json.dumps(row))
+    return 0
+
+
 def _landing_ab() -> int:
     """``bench.py --landing``: A/B the zero-copy landing against the
     staged ring on the CPU engine (same file, same chunking, alternating
@@ -924,6 +1018,8 @@ def main() -> int:
         return _stripe_scaling()
     if "--landing" in sys.argv[1:]:
         return _landing_ab()
+    if "--cache" in sys.argv[1:]:
+        return _cache_ab()
     smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")

@@ -274,6 +274,18 @@ STAT_FIELDS: Tuple[str, ...] = (
     # boundaries instead of draining at each wait.
     "occ_integral_ns",
     "occ_busy_ns",
+    # cross-query residency tier (ISSUE 9): the owned pinned-RAM extent
+    # cache in cache.py — hits are chunks served straight from slabs
+    # (no submission, no mincore probe), fills are miss extents
+    # installed at wait time after the fault ladder healed them
+    "nr_cache_hit",           # chunks served from resident slabs
+    "nr_cache_miss",          # chunks that went to the engine instead
+    "nr_cache_fill",          # extents installed into slabs
+    "nr_cache_evict",         # extents ARC-evicted to make room
+    "nr_cache_invalidate",    # extents dropped by write-back/checkpoint
+    #                           coherency
+    "bytes_cache_hit",        # payload bytes served from the tier
+    "cache_resident_bytes",   # gauge: bytes currently resident
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",
@@ -300,7 +312,8 @@ class StatInfo:
     def delta(new: "StatInfo", old: "StatInfo") -> "StatInfo":
         d = {k: new.counters.get(k, 0) - old.counters.get(k, 0) for k in new.counters}
         # gauges are point-in-time, not deltas
-        for g in ("cur_dma_count", "max_dma_count", "h2d_depth_reached"):
+        for g in ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
+                  "cache_resident_bytes"):
             if g in new.counters:
                 d[g] = new.counters[g]
         return StatInfo(version=new.version, has_debug=new.has_debug,

@@ -1,0 +1,410 @@
+"""Cross-query residency cache (ISSUE 9).
+
+An owned capacity tier between SSD and HBM: page-aligned pinned-host-RAM
+slabs keyed by ``(source id, extent)``, sized by ``config.cache_bytes``
+and evicted with byte-weighted ARC so one streaming scan cannot flush
+the hot set.  The engine consults it at plan time — hits are served by
+memcpy straight into the destination (no submission, no mincore probe),
+misses are filled *into* slabs at wait time, after the fault ladder
+(retry/hedge/mirror/checksum) has healed the bytes, so a quarantined
+member still populates the cache through its surviving legs.
+
+Design notes:
+
+* **Keying** — a source's identity is the tuple of its members' real
+  paths (``source_key``); an extent is ``(base, length)`` on the
+  source's logical byte space.  Lookups are exact-extent: the engine
+  reads on a fixed chunk grid per task, so fills and hits agree.
+* **ARC** — ``t1`` holds once-touched extents, ``t2`` twice-or-more;
+  ghosts ``b1``/``b2`` remember recently evicted keys (lengths only)
+  and steer the adaptive target ``p`` (bytes granted to recency).
+  ``p`` starts at 0, so scan-once traffic evicts itself first.
+* **Leases** — a hit returns a refcounted :class:`CacheLease`; eviction
+  skips pinned entries and invalidation marks them stale instead of
+  freeing, so a task mid-copy never reads a recycled slab.  Stale
+  entries are never served and are freed at the last release.
+* **Coherency** — the engine's write path and the checkpoint savers
+  call :meth:`invalidate_extents` / :meth:`invalidate_paths`.  A write
+  through a *different* framing of a shared file (e.g. a PlainSource
+  over one member of a stripe) drops every entry touching that file,
+  because offsets do not map 1:1 across framings.
+
+The module-global ``residency_cache`` follows the flight recorder's
+one-branch-when-off contract: ``configure()`` reads ``cache_bytes``
+once and the hot paths check the plain ``active`` attribute.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional, Sequence, Tuple
+
+from .config import config
+from .stats import stats
+from .trace import recorder as _trace
+
+__all__ = ["ResidencyCache", "CacheLease", "residency_cache"]
+
+_libc = None
+try:  # pragma: no cover - platform probe
+    _libc = ctypes.CDLL(None, use_errno=True)
+except OSError:  # pragma: no cover
+    _libc = None
+
+
+class _Entry:
+    __slots__ = ("key", "mm", "view", "length", "refs", "stale")
+
+    def __init__(self, key, mm, length: int) -> None:
+        self.key = key
+        self.mm = mm
+        self.view = memoryview(mm)
+        self.length = length
+        self.refs = 0
+        self.stale = False
+
+    def free(self) -> None:
+        try:
+            self.view.release()
+        except BufferError:  # pragma: no cover - defensive
+            pass
+        try:
+            self.mm.close()
+        except BufferError:  # pragma: no cover - mlock address export
+            pass
+
+
+class CacheLease:
+    """Refcounted pin on a resident slab.
+
+    Taken under the cache lock by :meth:`ResidencyCache.lookup`; the
+    holder copies out with :meth:`copy_into` and must :meth:`release`
+    (eviction skips the entry and invalidation only marks it stale
+    while the lease is live).
+    """
+
+    __slots__ = ("_cache", "_entry", "_released")
+
+    def __init__(self, cache: "ResidencyCache", entry: _Entry) -> None:
+        self._cache = cache
+        self._entry = entry
+        self._released = False
+
+    @property
+    def length(self) -> int:
+        return self._entry.length
+
+    @property
+    def stale(self) -> bool:
+        return self._entry.stale
+
+    def copy_into(self, dest) -> bool:
+        """Copy the slab into *dest* (a writable buffer no longer than
+        the extent).  Returns False — and copies nothing — when the
+        entry was invalidated after the lookup; the caller re-reads."""
+        e = self._entry
+        if e.stale:
+            return False
+        n = len(dest)
+        dest[:] = e.view[:n]
+        return not e.stale
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._cache._release(self._entry)
+
+
+class ResidencyCache:
+    """Byte-weighted ARC over pinned anonymous slabs."""
+
+    def __init__(self) -> None:
+        self.active = False
+        self._lock = threading.Lock()
+        self._cap = 0
+        self._p = 0  # adaptive target for t1 (recency), in bytes
+        self._bytes = 0
+        self._t1: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._t2: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._b1: "OrderedDict[tuple, int]" = OrderedDict()
+        self._b2: "OrderedDict[tuple, int]" = OrderedDict()
+        self._b1_bytes = 0
+        self._b2_bytes = 0
+
+    # -- configuration ------------------------------------------------
+
+    def configure(self) -> None:
+        """Re-read ``cache_bytes``; 0 disables the tier and frees it."""
+        cap = int(config.get("cache_bytes"))
+        with self._lock:
+            self._cap = cap
+            self.active = cap > 0
+            if not self.active:
+                self._clear_locked()
+            else:
+                while self._bytes > cap and self._evict_one(False):
+                    pass
+                self._p = min(self._p, cap)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._clear_locked()
+
+    def _clear_locked(self) -> None:
+        for od in (self._t1, self._t2):
+            for e in od.values():
+                if e.refs:
+                    e.stale = True  # freed at last release
+                else:
+                    e.free()
+            od.clear()
+        self._b1.clear()
+        self._b2.clear()
+        self._b1_bytes = self._b2_bytes = 0
+        self._bytes = 0
+        self._p = 0
+        stats.gauge_set("cache_resident_bytes", 0)
+
+    # -- identity -----------------------------------------------------
+
+    @staticmethod
+    def source_key(source) -> tuple:
+        """Stable identity for a source: the tuple of its members' real
+        paths (works for plain, segmented and striped sources, and the
+        loopback fakes, which subclass them)."""
+        members = getattr(source, "members", None)
+        if members:
+            try:
+                return tuple(os.path.realpath(m.path) for m in members)
+            except AttributeError:
+                pass
+        path = getattr(source, "path", None)
+        if isinstance(path, str):
+            return (os.path.realpath(path),)
+        return ("<anon:%d>" % id(source),)
+
+    # -- read side ----------------------------------------------------
+
+    def lookup(self, skey: tuple, base: int,
+               length: int) -> Optional[CacheLease]:
+        """Return a pinned lease on the extent, or None on a miss.
+        Bumps ARC recency/frequency state on the hit."""
+        if not self.active:
+            return None
+        key = (skey, base, length)
+        with self._lock:
+            e = self._t1.pop(key, None)
+            if e is not None:
+                self._t2[key] = e  # second touch: promote to frequency
+            else:
+                e = self._t2.get(key)
+                if e is not None:
+                    self._t2.move_to_end(key)
+            if e is None or e.stale:
+                return None
+            e.refs += 1
+            return CacheLease(self, e)
+
+    def _release(self, e: _Entry) -> None:
+        with self._lock:
+            e.refs -= 1
+            if e.refs <= 0 and e.stale:
+                # dropped from the lists while pinned; free it now
+                e.free()
+
+    # -- fill side ----------------------------------------------------
+
+    def fill(self, skey: tuple, base: int, length: int, data) -> bool:
+        """Install healed bytes for an extent.  Returns True when the
+        extent is now resident (skipped when the tier is off, the
+        extent exceeds capacity, or every candidate victim is pinned)."""
+        if not self.active or length <= 0:
+            return False
+        key = (skey, base, length)
+        with self._lock:
+            cap = self._cap
+            if length > cap:
+                return False
+            e = self._t1.get(key) or self._t2.get(key)
+            if e is not None:
+                # already resident (a racing task filled it); refresh
+                # the bytes unless a reader is mid-copy on the slab
+                if not e.refs:
+                    e.view[:length] = data
+                return True
+            # ghost hits steer the recency/frequency balance
+            in_b1 = key in self._b1
+            in_b2 = key in self._b2
+            if in_b1:
+                self._b1_bytes -= self._b1.pop(key)
+                self._p = min(cap, self._p + length)
+            elif in_b2:
+                self._b2_bytes -= self._b2.pop(key)
+                self._p = max(0, self._p - length)
+            while self._bytes + length > cap:
+                if not self._evict_one(in_b2):
+                    return False  # everything evictable is pinned
+            try:
+                mm = mmap.mmap(-1, length)
+            except (OSError, ValueError):  # pragma: no cover
+                return False
+            self._mlock(mm, length)
+            e = _Entry(key, mm, length)
+            e.view[:length] = data
+            if in_b1 or in_b2:
+                self._t2[key] = e
+            else:
+                self._t1[key] = e
+            self._bytes += length
+            stats.add("nr_cache_fill")
+            stats.gauge_set("cache_resident_bytes", self._bytes)
+        # (the engine emits the `cache_fill` span with the task's trace
+        # id; evict/invalidate have no task context and instant here)
+        return True
+
+    @staticmethod
+    def _mlock(mm, length: int) -> None:
+        """Best-effort pin; harmless to fail under RLIMIT_MEMLOCK."""
+        if _libc is None:
+            return
+        try:
+            buf = (ctypes.c_char * length).from_buffer(mm)
+            _libc.mlock(ctypes.addressof(buf), ctypes.c_size_t(length))
+        except Exception:  # pragma: no cover - best effort only
+            pass
+        finally:
+            try:
+                del buf
+            except UnboundLocalError:
+                pass
+
+    def _evict_one(self, prefer_t2: bool) -> bool:
+        """ARC REPLACE: evict one unpinned LRU entry, ghosting its key.
+        Returns False when nothing is evictable (all pinned/empty)."""
+        from_t1 = bool(self._t1) and (
+            self._t1_bytes() > self._p
+            or (prefer_t2 and self._t1_bytes() == self._p))
+        for od, ghost in ((self._t1, self._b1), (self._t2, self._b2)) \
+                if from_t1 else ((self._t2, self._b2), (self._t1, self._b1)):
+            for key, e in od.items():  # LRU first
+                if e.refs:
+                    continue
+                del od[key]
+                e.free()
+                self._bytes -= e.length
+                ghost[key] = e.length
+                if ghost is self._b1:
+                    self._b1_bytes += e.length
+                else:
+                    self._b2_bytes += e.length
+                self._trim_ghosts()
+                stats.add("nr_cache_evict")
+                stats.gauge_set("cache_resident_bytes", self._bytes)
+                if _trace.active:
+                    _trace.instant("cache_evict", offset=e.key[1],
+                                   length=e.length)
+                return True
+        return False
+
+    def _t1_bytes(self) -> int:
+        return sum(e.length for e in self._t1.values())
+
+    def _trim_ghosts(self) -> None:
+        while self._b1_bytes > self._cap and self._b1:
+            _, ln = self._b1.popitem(last=False)
+            self._b1_bytes -= ln
+        while self._b2_bytes > self._cap and self._b2:
+            _, ln = self._b2.popitem(last=False)
+            self._b2_bytes -= ln
+
+    # -- coherency ----------------------------------------------------
+
+    def invalidate_extents(self, skey: tuple,
+                           extents: Sequence[Tuple[int, int]]) -> int:
+        """Drop every resident extent the write touches.  Same-key
+        entries are matched by byte overlap; entries under a different
+        key that shares a file are dropped wholesale (offsets do not
+        map across framings).  Returns the number dropped."""
+        if not self.active:
+            return 0
+        pathset = set(skey)
+        dropped = 0
+        with self._lock:
+            for od in (self._t1, self._t2):
+                for key in list(od):
+                    ks, kb, kl = key
+                    if ks == skey:
+                        if not any(kb < b + l and b < kb + kl
+                                   for b, l in extents):
+                            continue
+                    elif not (pathset & set(ks)):
+                        continue
+                    self._drop_locked(od, key)
+                    dropped += 1
+        self._note_invalidated(dropped, extents)
+        return dropped
+
+    def invalidate_paths(self, paths: Sequence[str]) -> int:
+        """Drop every resident extent over any of *paths* (used by the
+        checkpoint savers after an atomic rename installs new bytes)."""
+        if not self.active:
+            return 0
+        want = {os.path.realpath(p) for p in paths}
+        dropped = 0
+        with self._lock:
+            for od in (self._t1, self._t2):
+                for key in list(od):
+                    if want & set(key[0]):
+                        self._drop_locked(od, key)
+                        dropped += 1
+        self._note_invalidated(dropped, [])
+        return dropped
+
+    def _drop_locked(self, od, key) -> None:
+        e = od.pop(key)
+        self._bytes -= e.length
+        if e.refs:
+            e.stale = True  # pinned: freed at the last lease release
+        else:
+            e.free()
+        stats.gauge_set("cache_resident_bytes", self._bytes)
+
+    def _note_invalidated(self, dropped: int, extents) -> None:
+        if not dropped:
+            return
+        stats.add("nr_cache_invalidate", dropped)
+        if _trace.active:
+            off = extents[0][0] if extents else -1
+            _trace.instant("cache_invalidate", offset=off, length=dropped)
+
+    # -- introspection ------------------------------------------------
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def resident_fraction(self, paths: Sequence[str],
+                          total_bytes: int) -> float:
+        """Fraction of a table's bytes currently resident — the
+        planner's expected hit ratio for a scan over *paths*."""
+        if not self.active or total_bytes <= 0 or not paths:
+            return 0.0
+        want = {os.path.realpath(p) for p in paths if isinstance(p, str)}
+        if not want:
+            return 0.0
+        got = 0
+        with self._lock:
+            for od in (self._t1, self._t2):
+                for (ks, _b, ln), e in od.items():
+                    if not e.stale and (want & set(ks)):
+                        got += ln
+        return min(1.0, got / float(total_bytes))
+
+
+#: process-wide tier; ``configure()`` is called at Session construction
+#: and by tests after flipping ``cache_bytes``
+residency_cache = ResidencyCache()

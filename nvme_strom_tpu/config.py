@@ -397,6 +397,15 @@ class Config:
                      "(kmod/nvme_strom.c:1639-1663 analog)"))
         reg(Var("cache_threshold", 0.5, "float", minval=0.0, maxval=1.0,
                 help="cached-page fraction above which a chunk takes the write-back path"))
+        reg(Var("cache_bytes", 0, "size", minval=0,
+                help="capacity of the owned cross-query residency tier "
+                     "(pinned-host-RAM extent slabs with ARC eviction, "
+                     "cache.residency_cache): hits are served by memcpy "
+                     "with no engine submission and no mincore probe, "
+                     "misses fill slabs at wait time after the fault "
+                     "ladder heals them.  0 (default) disables the tier "
+                     "entirely — one branch per task.  Read at Session "
+                     "construction (residency_cache.configure())"))
         # flight recorder + end-to-end task tracing (PR 7)
         reg(Var("trace_policy", "off", "str",
                 help="per-task span tracing into the flight recorder: "

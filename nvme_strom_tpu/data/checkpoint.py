@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..api import StromError
+from ..cache import residency_cache
 from ..engine import Session, open_source, read_chunk_ids
 from ..hbm.staging import default_device, safe_device_put
 
@@ -158,6 +159,9 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
             _save_leaves_direct(tmp, entries, flat, header_len,
                                 session, staging_bytes)
         os.replace(tmp, path)
+        # the rename just installed new bytes under the old identity:
+        # drop any residency-tier extents over this path (ISSUE 9)
+        residency_cache.invalidate_paths([path])
         try:
             dirfd = os.open(directory, os.O_RDONLY)
             try:
@@ -370,6 +374,9 @@ def save_checkpoint_sharded(path: str, tree: Any) -> Dict:
             except OSError:
                 pass
         barrier("installed")
+        # every process drops its own residency-tier extents over the
+        # freshly installed bytes (the cache is process-local)
+        residency_cache.invalidate_paths([path])
     except BaseException:
         if pid0:
             try:

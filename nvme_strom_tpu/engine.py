@@ -50,6 +50,7 @@ from .log import pr_info, pr_warn
 from .eligibility import probe_backing
 from .stats import stats
 from .trace import recorder as _trace
+from .cache import residency_cache as _rcache
 from . import numa as _numa
 
 #: live sessions, for the stat exporter's pre-publish fold (weak: the
@@ -396,6 +397,17 @@ class _FileMember:
             self._mm_addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
         return self._mm
 
+    def _mincore_scratch(self, npages: int):
+        """Grow-and-return the member's shared mincore(2) residency
+        vector, sized for at least *npages* entries.  Arbitration probes
+        every chunk of every read: one scratch per member instead of an
+        allocation per call — callers consume the result before the next
+        probe on this member, and only the first npages entries are live."""
+        if npages > self._mincore_cap:
+            self._mincore_cap = max(npages, self._mincore_cap * 2, 256)
+            self._mincore_buf = (ctypes.c_ubyte * self._mincore_cap)()
+        return self._mincore_buf
+
     def _mincore_vec(self, offset: int, length: int):
         """(residency bytevec, start, npages) for the page-aligned range."""
         mm = self.mm()
@@ -404,13 +416,7 @@ class _FileMember:
         start = offset & ~(PAGE_SIZE - 1)
         end = min((offset + length + PAGE_SIZE - 1) & ~(PAGE_SIZE - 1), self.size)
         npages = max((end - start + PAGE_SIZE - 1) // PAGE_SIZE, 1)
-        # arbitration probes every chunk of every read: reuse one scratch
-        # vector per member instead of allocating npages bytes per call
-        # (callers consume the result before the next probe on this member)
-        if npages > self._mincore_cap:
-            self._mincore_cap = max(npages, self._mincore_cap * 2, 256)
-            self._mincore_buf = (ctypes.c_ubyte * self._mincore_cap)()
-        vec = self._mincore_buf
+        vec = self._mincore_scratch(npages)
         rc = _libc.mincore(ctypes.c_void_p(self._mm_addr + start),
                            ctypes.c_size_t(end - start), vec)
         if rc != 0:
@@ -439,14 +445,12 @@ class _FileMember:
         lo = min(o for o, _ in spans) & ~(PAGE_SIZE - 1)
         end = min(max(o + l for o, l in spans), self.size)
         npages = max((end - lo + PAGE_SIZE - 1) // PAGE_SIZE, 1)
-        if npages > self._mincore_cap:
-            self._mincore_cap = max(npages, self._mincore_cap * 2, 256)
-            self._mincore_buf = (ctypes.c_ubyte * self._mincore_cap)()
+        vec = self._mincore_scratch(npages)
         rc = _libc.mincore(ctypes.c_void_p(self._mm_addr + lo),
-                           ctypes.c_size_t(end - lo), self._mincore_buf)
+                           ctypes.c_size_t(end - lo), vec)
         if rc != 0:
             return [(0.0, False)] * len(spans)
-        raw = ctypes.string_at(self._mincore_buf, npages).translate(_MINCORE_LSB)
+        raw = ctypes.string_at(vec, npages).translate(_MINCORE_LSB)
         out = []
         for o, l in spans:
             p0 = ((o & ~(PAGE_SIZE - 1)) - lo) // PAGE_SIZE
@@ -1058,7 +1062,8 @@ _N_TASK_SLOTS = 512  # reference uses 512 hash slots (kmod/nvme_strom.c:639-644)
 class DmaTask:
     __slots__ = ("task_id", "state", "errno_", "errmsg", "pending", "frozen",
                  "result", "t_submit", "buf_handle", "deadline", "expired",
-                 "verify_src", "verify_dest", "verify_reqs", "trace_id")
+                 "verify_src", "verify_dest", "verify_reqs", "trace_id",
+                 "cache_fill", "cache_invalidate")
 
     def __init__(self, task_id: int, deadline_s: float = 0.0):
         self.task_id = task_id
@@ -1083,6 +1088,11 @@ class DmaTask:
         self.expired = False   # set by the watchdog; chunks check and bail
         self.trace_id = 0      # nonzero when the flight recorder sampled
         #                        this task (trace.recorder.task_begin)
+        # residency-cache work deferred to wait time (ISSUE 9): miss
+        # extents to install from the healed destination, and written
+        # extents to re-invalidate once the write has retired
+        self.cache_fill: Optional[tuple] = None
+        self.cache_invalidate: Optional[tuple] = None
 
 
 class Session:
@@ -1113,6 +1123,9 @@ class Session:
         # flight recorder (PR 7): trace_policy is read here, once — event
         # sites then cost one `_trace.active` branch when tracing is off
         _trace.configure()
+        # residency cache (ISSUE 9): same contract — cache_bytes is read
+        # here and hit/miss sites cost one `_rcache.active` branch when off
+        _rcache.configure()
         self._slots: List[Dict[int, DmaTask]] = [dict() for _ in range(_N_TASK_SLOTS)]
         self._slot_cv = [threading.Condition() for _ in range(_N_TASK_SLOTS)]
         self._id_lock = threading.Lock()
@@ -1508,6 +1521,29 @@ class Session:
             for r in task.verify_reqs:
                 self._verify_request_checksums(task.verify_src, r,
                                                task.verify_dest)
+        if task.cache_fill is not None:
+            # residency-cache fills run HERE, on the retired task: the
+            # destination bytes have been healed by the full fault
+            # ladder (retry/hedge/mirror/checksum re-read), so a
+            # degraded member still populates the tier via its
+            # surviving legs — and a latched failure never fills
+            skey, fills, fdest = task.cache_fill
+            task.cache_fill = None
+            for base, length, doff in fills:
+                tf0 = time.monotonic_ns()
+                if _rcache.fill(skey, base, length,
+                                fdest[doff:doff + length]) \
+                        and _trace.active and task.trace_id:
+                    _trace.span("cache_fill", tf0, time.monotonic_ns(),
+                                tid=task.trace_id, offset=base,
+                                length=length)
+        if task.cache_invalidate is not None:
+            # re-run the write path's invalidation after the write has
+            # retired: a racing read may have re-filled a written extent
+            # from pre-write bytes between submit and completion
+            skey, extents = task.cache_invalidate
+            task.cache_invalidate = None
+            _rcache.invalidate_extents(skey, extents)
         assert task.result is not None
         return task.result
 
@@ -1557,35 +1593,62 @@ class Session:
             _trace.instant("submit", tid=task.trace_id, ts_ns=t0,
                            length=n * chunk_size,
                            args={"task": task.task_id, "chunks": n})
+        cache_hits: List[tuple] = []  # (cid, base, length, lease)
         try:
-            # --- cache arbitration (write-back vs direct) -----------------
-            threshold = config.get("cache_threshold")
-            arbitrate = config.get("cache_arbitration")
-            direct_ids: List[int] = []
-            wb_ids: List[int] = []
-            spans: List[Tuple[int, int]] = []
+            spans_all: List[Tuple[int, int]] = []
             for cid in chunk_ids:
                 base = cid * chunk_size
                 length = min(chunk_size, source.size - base)
                 if length <= 0:
                     raise StromError(_errno.EINVAL, f"chunk {cid} beyond EOF")
-                spans.append((base, length))
-            if arbitrate:
+                spans_all.append((base, length))
+            # --- residency-tier split (ISSUE 9) ---------------------------
+            # hits take a pinned lease and are served by memcpy below —
+            # no submission, no mincore probe; only the misses go on to
+            # page-cache arbitration and the member lanes
+            skey = None
+            miss_ids, spans = chunk_ids, spans_all
+            if _rcache.active:
+                skey = _rcache.source_key(source)
+                miss_ids, spans = [], []
+                for cid, (base, length) in zip(chunk_ids, spans_all):
+                    lease = _rcache.lookup(skey, base, length)
+                    if lease is not None:
+                        cache_hits.append((cid, base, length, lease))
+                    else:
+                        miss_ids.append(cid)
+                        spans.append((base, length))
+                if cache_hits:
+                    stats.add("nr_cache_hit", len(cache_hits))
+                    stats.add("bytes_cache_hit",
+                              sum(h[2] for h in cache_hits))
+                if miss_ids:
+                    stats.add("nr_cache_miss", len(miss_ids))
+
+            # --- cache arbitration (write-back vs direct) -----------------
+            threshold = config.get("cache_threshold")
+            arbitrate = config.get("cache_arbitration")
+            direct_ids: List[int] = []
+            wb_ids: List[int] = []
+            if arbitrate and miss_ids:
                 # one batched residency probe for the whole task (real file
                 # sources fold it into a single mincore scan); hot/dirty
                 # data is decisive, not weighted: the reference scores one
                 # dirty page at threshold+1 (:1643), because a direct read
                 # of a dirty range either stalls on a forced flush or reads
                 # stale blocks
-                for cid, (cached, hot) in zip(chunk_ids,
+                for cid, (cached, hot) in zip(miss_ids,
                                               source.residency(spans)):
                     if hot > 0.0 or cached > threshold:
                         wb_ids.append(cid)
                     else:
                         direct_ids.append(cid)
             else:
-                direct_ids = list(chunk_ids)
-            new_order = direct_ids + wb_ids
+                direct_ids = list(miss_ids)
+            # hits tail-pack after the write-back slots so the result's
+            # RAM-sourced region stays one contiguous tail
+            # (MemCopyResult contract: ssd chunks first)
+            new_order = direct_ids + wb_ids + [h[0] for h in cache_hits]
             nr_ssd = len(direct_ids)
 
             # --- plan + submit direct requests (sliding window) -----------
@@ -1768,7 +1831,47 @@ class Session:
                     _trace.span("writeback", tw0, time.monotonic_ns(),
                                 tid=task.trace_id, offset=base,
                                 length=length)
+
+            # --- residency-tier hit serving (tail-packed after the
+            #     write-back slots): memcpy out of the pinned slab, no
+            #     submission — a fully-resident task reaches here with
+            #     nothing submitted at all
+            j = 0
+            while cache_hits:
+                cid, base, length, lease = cache_hits.pop(0)
+                slot = nr_ssd + len(wb_ids) + j
+                j += 1
+                target = wb_buffer if wb_buffer is not None else dest
+                off = (dest_offset if wb_buffer is None else 0) \
+                    + slot * chunk_size
+                th0 = time.monotonic_ns()
+                try:
+                    if not lease.copy_into(target[off:off + length]):
+                        # invalidated between lookup and serve: the
+                        # write that staled the slab wins — read fresh
+                        source.read_buffered(base,
+                                             target[off:off + length])
+                finally:
+                    lease.release()
+                if _trace.active and task.trace_id:
+                    _trace.span("cache_hit", th0, time.monotonic_ns(),
+                                tid=task.trace_id, offset=base,
+                                length=length)
+
+            # --- record the miss fills, consumed at wait time once the
+            #     fault ladder has healed the destination bytes (direct
+            #     chunks land in `dest` even when wb_buffer is given)
+            if skey is not None and direct_ids:
+                fills = []
+                for i, cid in enumerate(direct_ids):
+                    base = cid * chunk_size
+                    fills.append((base,
+                                  min(chunk_size, source.size - base),
+                                  dest_offset + i * chunk_size))
+                task.cache_fill = (skey, fills, dest)
         except BaseException:
+            while cache_hits:  # leases not yet served: unpin them
+                cache_hits.pop()[3].release()
             self._task_put(task, StromError(_errno.ECANCELED, "submit aborted"))
             # reference waits out in-flight DMA on submit error (:1781-1784)
             try:
@@ -1819,6 +1922,16 @@ class Session:
         src = self._get_buffer(buf_handle, need=src_offset + n * chunk_size)
         task = self._create_task()
         try:
+            if _rcache.active:
+                # write-back coherency (ISSUE 9): drop resident extents
+                # the write touches before any byte moves, and again at
+                # wait time (task.cache_invalidate) in case a racing
+                # read re-filled from pre-write bytes mid-flight
+                wkey = _rcache.source_key(sink)
+                extents = [(cid * chunk_size, chunk_size)
+                           for cid in chunk_ids]
+                _rcache.invalidate_extents(wkey, extents)
+                task.cache_invalidate = (wkey, extents)
             with stats.stage("setup_prps"):
                 reqs = plan_requests(sink, [(cid, i) for i, cid in enumerate(chunk_ids)],
                                      chunk_size, src_offset)

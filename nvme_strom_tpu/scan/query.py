@@ -201,13 +201,16 @@ class QueryPlan:
     reason: str
     join_strategy: Optional[str] = None  # broadcast | partitioned(N)
     workers: int = 0       # parallel worker processes (0 = serial)
+    cache_hit_ratio: float = 0.0  # expected residency-tier hit fraction
 
     def __str__(self) -> str:
         par = f", workers={self.workers}" if self.workers else ""
+        cache = (f"  cache-resident: ~{self.cache_hit_ratio:.0%}"
+                 if self.cache_hit_ratio > 0 else "")
         return (f"{self.operator} scan  [{self.access_path} path, "
                 f"{self.kernel} kernel, {self.mode}{par}]\n"
                 f"  pages: {self.n_pages}  cost: direct={self.cost_direct:.0f} "
-                f"vfs={self.cost_vfs:.0f}\n"
+                f"vfs={self.cost_vfs:.0f}{cache}\n"
                 f"  {self.reason}")
 
 
@@ -1602,11 +1605,31 @@ class Query:
             else:
                 reason = "table below the direct-scan threshold " \
                          "(page cache wins for small tables); " + why
+        # cache-aware planning (ISSUE 9): report the residency tier's
+        # expected hit ratio for this table — at 1.0 the scan is served
+        # entirely from pinned slabs and skips engine submission
+        from ..cache import residency_cache
+        ratio = 0.0
+        if residency_cache.active and size:
+            if isinstance(self.source, (list, tuple)):
+                cpaths = list(self.source)
+            elif path is not None:
+                cpaths = [path]
+            else:
+                cpaths = []
+            ratio = residency_cache.resident_fraction(cpaths, size)
+        if ratio >= 1.0:
+            reason += ("; fully cache-resident: served from the "
+                       "residency tier, engine submission skipped")
+        elif ratio > 0:
+            reason += (f"; residency tier holds ~{ratio:.0%} of the "
+                       f"table (memcpy hits, no mincore probe)")
         return QueryPlan(operator=self._op,
                          access_path="direct" if direct else "vfs",
                          kernel=kernel, mode=mode, n_pages=n_pages,
                          cost_direct=cd.total, cost_vfs=cv.total,
-                         reason=reason)
+                         reason=reason,
+                         cache_hit_ratio=round(ratio, 4))
 
     # -- compute builders ---------------------------------------------------
     def _build_fn(self, kernel: str):

@@ -450,7 +450,8 @@ class StatRegistry:
         not clobber it — callers combine gauges at snapshot time instead."""
         with self._lock:
             for k, v in native_counters.items():
-                if k in self._c and k not in ("cur_dma_count", "max_dma_count"):
+                if k in self._c and k not in ("cur_dma_count", "max_dma_count",
+                                              "cache_resident_bytes"):
                     self._c[k] += v
 
 

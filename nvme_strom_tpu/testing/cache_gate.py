@@ -1,0 +1,194 @@
+"""Residency-tier gate (ISSUE 9, ``make cache-gate``).
+
+Holds the tentpole's three contracts on deterministic synthetics:
+
+* **Speedup** — with per-request latency injected into the loopback
+  fake, a hot rescan (every chunk served from the owned pinned-RAM
+  tier, no engine submission) must beat the cold scan by at least
+  ``STROM_CACHE_GATE_RATIO`` (default 2x).  The cold pass pays the
+  injected device latency per chunk; the hot pass is pure memcpy, so
+  the ratio is latency-bound and reproduces on any machine.
+* **Eviction identity** — with capacity far below the table, both
+  passes churn the ARC lists constantly and must stay byte-identical
+  to the deterministic pattern.
+* **Write-back coherency** — an extent dirtied through
+  ``memcpy_ram2ssd`` is dropped from the tier and the next read
+  returns the new bytes, never the stale slab.
+
+Runs in `make cache-gate` (wired into `make check`).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+RATIO_LIMIT = float(os.environ.get("STROM_CACHE_GATE_RATIO", "2.0"))
+ROUNDS = int(os.environ.get("STROM_CACHE_GATE_ROUNDS", "3"))
+
+CHUNK = 64 << 10
+
+
+def _read_pass(sess, src, nchunks: int) -> bytes:
+    handle, buf = sess.alloc_dma_buffer(nchunks * CHUNK)
+    try:
+        res = sess.memcpy_ssd2ram(src, handle,
+                                  list(range(nchunks)), CHUNK)
+        sess.memcpy_wait(res.dma_task_id, timeout=120.0)
+        return bytes(buf.view()[:nchunks * CHUNK])
+    finally:
+        sess.unmap_buffer(handle)
+
+
+def _leg_speedup(dirpath: str) -> None:
+    """Hot rescan >= RATIO_LIMIT x cold on the latency-injected fake."""
+    import statistics
+
+    from ..cache import residency_cache
+    from ..config import config
+    from ..engine import Session
+    from . import FakeNvmeSource, FaultPlan, make_test_file
+    from .fake import expected_bytes
+
+    nchunks, lat = 24, 0.002
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, "speed.bin")
+    make_test_file(path, size)
+    config.set("cache_bytes", 64 << 20)
+    config.set("cache_arbitration", False)
+    config.set("dma_max_size", CHUNK)   # one injected latency per chunk
+    src = FakeNvmeSource(path, fault_plan=FaultPlan(latency_s=lat),
+                         force_cached_fraction=0.0)
+    cold, hot = [], []
+    try:
+        with Session() as sess:
+            for r in range(ROUNDS):
+                residency_cache.clear()
+                t0 = time.perf_counter()
+                got_cold = _read_pass(sess, src, nchunks)
+                cold.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                got_hot = _read_pass(sess, src, nchunks)
+                hot.append(time.perf_counter() - t0)
+                assert got_cold == expected_bytes(0, size), \
+                    f"cold pass bytes diverged (round {r})"
+                assert got_hot == expected_bytes(0, size), \
+                    f"hot pass bytes diverged (round {r})"
+    finally:
+        src.close()
+    c, h = statistics.median(cold), statistics.median(hot)
+    ratio = c / h if h > 0 else float("inf")
+    assert ratio >= RATIO_LIMIT, \
+        f"hot rescan only {ratio:.2f}x cold (limit {RATIO_LIMIT}x; " \
+        f"cold {c * 1e3:.1f}ms hot {h * 1e3:.1f}ms)"
+    print(f"cache-gate speedup leg ok: hot {ratio:.1f}x cold "
+          f"(cold {c * 1e3:.1f}ms, hot {h * 1e3:.1f}ms, "
+          f"{ROUNDS} interleaved rounds)")
+
+
+def _leg_eviction_identity(dirpath: str) -> None:
+    """Capacity 1/4 of the table: constant ARC churn, bytes identical."""
+    from ..cache import residency_cache
+    from ..config import config
+    from ..engine import Session
+    from ..stats import stats
+    from . import FakeNvmeSource, make_test_file
+    from .fake import expected_bytes
+
+    nchunks = 16
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, "evict.bin")
+    make_test_file(path, size)
+    config.set("cache_bytes", 4 * CHUNK)
+    config.set("cache_arbitration", False)
+    config.set("dma_max_size", CHUNK)
+    src = FakeNvmeSource(path, force_cached_fraction=0.0)
+    before = stats.snapshot(reset_max=False).counters
+    try:
+        with Session() as sess:
+            for r in range(3):
+                got = _read_pass(sess, src, nchunks)
+                assert got == expected_bytes(0, size), \
+                    f"bytes diverged under eviction pressure (pass {r})"
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False).counters
+    evicted = after.get("nr_cache_evict", 0) - before.get("nr_cache_evict", 0)
+    assert evicted > 0, "eviction leg never evicted (capacity not binding?)"
+    resident = residency_cache.resident_bytes()
+    assert resident <= 4 * CHUNK, \
+        f"resident {resident} exceeds capacity {4 * CHUNK}"
+    print(f"cache-gate eviction leg ok: {evicted} evictions, "
+          f"bytes identical, resident {resident} <= cap")
+
+
+def _leg_writeback_invalidation(dirpath: str) -> None:
+    """A dirtied extent is never served stale after memcpy_ram2ssd."""
+    from ..config import config
+    from ..engine import Session, open_source
+    from ..stats import stats
+    from . import make_test_file
+    from .fake import expected_bytes
+
+    nchunks = 8
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, "wb.bin")
+    make_test_file(path, size)
+    config.set("cache_bytes", 64 << 20)
+    config.set("cache_arbitration", False)
+    config.set("dma_max_size", CHUNK)
+    new0 = bytes(range(256))[::-1] * (CHUNK // 256)
+    before = stats.snapshot(reset_max=False).counters
+    with Session() as sess:
+        with open_source(path) as src:
+            got = _read_pass(sess, src, nchunks)  # warm the tier
+        assert got == expected_bytes(0, size)
+        handle, buf = sess.alloc_dma_buffer(CHUNK)
+        try:
+            buf.view()[:CHUNK] = new0
+            with open_source(path, writable=True) as sink:
+                res = sess.memcpy_ram2ssd(sink, handle, [0], CHUNK)
+                sess.memcpy_wait(res.dma_task_id)
+                sink.sync()
+        finally:
+            sess.unmap_buffer(handle)
+        with open_source(path) as src:
+            got = _read_pass(sess, src, nchunks)
+    after = stats.snapshot(reset_max=False).counters
+    inval = (after.get("nr_cache_invalidate", 0)
+             - before.get("nr_cache_invalidate", 0))
+    assert got[:CHUNK] == new0, \
+        "write-back-invalidated extent was served stale"
+    assert got[CHUNK:] == expected_bytes(CHUNK, size - CHUNK), \
+        "untouched extents diverged after the write"
+    assert inval > 0, "write-back dropped nothing from the tier"
+    print(f"cache-gate write-back leg ok: {inval} invalidation(s), "
+          f"fresh bytes served")
+
+
+def main() -> int:
+    from ..cache import residency_cache
+    from ..config import config
+
+    snap = config.snapshot()
+    try:
+        with tempfile.TemporaryDirectory(prefix="strom_cache_") as d:
+            _leg_speedup(d)
+            _leg_eviction_identity(d)
+            _leg_writeback_invalidation(d)
+    except AssertionError as e:
+        print(f"cache-gate FAIL: {e}")
+        return 1
+    finally:
+        config.restore(snap)
+        residency_cache.clear()
+        residency_cache.configure()
+    print("cache-gate ok: hot rescan beats cold, identity holds under "
+          "eviction pressure, write-back never serves stale")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

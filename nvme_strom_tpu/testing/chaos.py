@@ -374,9 +374,109 @@ def scenario_native_degraded(rng: random.Random, dirpath: str) -> str:
     return "native_degraded"
 
 
+def scenario_cache_churn(rng: random.Random, dirpath: str) -> str:
+    """Seeded residency-tier churn racing a fail-stop (ISSUE 9): with
+    capacity far below the table, repeated whole-stream reads fill and
+    evict constantly while a mirrored member fail-stops mid-schedule and
+    a write-back invalidation lands between passes.  Every pass must
+    stay byte-identical to the healthy stream, and the trace dump must
+    be schema-valid with fill -> evict -> refill in causal order on at
+    least one extent."""
+    from ..cache import residency_cache
+    from ..config import config
+    from ..engine import Session, open_source
+    from ..trace import recorder, validate_chrome_trace
+    from .fake import FakeStripedNvmeSource, FaultPlan
+
+    config.set("io_retries", 2)
+    config.set("task_deadline_s", 30.0)
+    config.set("cache_arbitration", False)
+    # 3 chunks of capacity under an 8-chunk logical stream: every pass
+    # churns the ARC lists end to end
+    config.set("cache_bytes", 3 * CHUNK)
+    config.set("dma_max_size", CHUNK)
+    config.set("trace_policy", "all")
+    recorder.configure()
+    recorder.clear()
+    residency_cache.clear()
+    victim = rng.choice([0, 2])
+    plan = FaultPlan(failstop_member=victim,
+                     failstop_after=rng.randrange(4, 12))
+    paths = make_mirrored_members(dirpath, tag=f"cc{rng.randrange(1 << 16)}-")
+    src = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                fault_plan=plan, force_cached_fraction=0.0,
+                                mirror="paired")
+    fills0, evicts0 = _counter("nr_cache_fill"), _counter("nr_cache_evict")
+    inval0 = _counter("nr_cache_invalidate")
+    want = expected_mirrored_stream(paths)
+    try:
+        with Session() as sess:
+            for rnd in range(3):
+                got, total = read_all(sess, src)
+                assert got == want[:total], \
+                    f"cache_churn: pass {rnd} diverged from healthy stream"
+                if rnd == 1:
+                    # write-back invalidation racing the churn: identical
+                    # bytes through a different framing of a shared
+                    # member file, so the stream is unchanged but the
+                    # tier must conservatively drop its extents
+                    wpath = paths[victim + 1]  # the survivor mirror
+                    with open(wpath, "rb") as f:
+                        head = f.read(CHUNK)
+                    handle, buf = sess.alloc_dma_buffer(CHUNK)
+                    try:
+                        buf.view()[:CHUNK] = head
+                        with open_source(wpath, writable=True) as sink:
+                            res = sess.memcpy_ram2ssd(sink, handle, [0],
+                                                      CHUNK)
+                            sess.memcpy_wait(res.dma_task_id)
+                            sink.sync()
+                    finally:
+                        sess.unmap_buffer(handle)
+    finally:
+        src.close()
+        doc = recorder.chrome_trace("chaos cache_churn")
+        dump_path = recorder.dump(
+            os.path.join(dirpath, "cache_churn.json"),
+            reason="chaos cache_churn")
+        config.set("trace_policy", "off")
+        recorder.configure()
+        recorder.clear()
+        config.set("cache_bytes", 0)
+        residency_cache.configure()
+    assert _counter("nr_cache_fill") > fills0, "cache_churn: no fills"
+    assert _counter("nr_cache_evict") > evicts0, "cache_churn: no evictions"
+    assert _counter("nr_cache_invalidate") > inval0, \
+        "cache_churn: the write-back dropped nothing"
+    errs = validate_chrome_trace(doc)
+    assert not errs, \
+        f"cache_churn: trace dump fails schema check: {errs[:5]}"
+    # causal fill -> evict -> refill on at least one extent
+    by_off: dict = {}
+    for ev in doc["traceEvents"]:
+        nm = ev.get("name")
+        if nm in ("cache_fill", "cache_evict"):
+            off = ev.get("args", {}).get("offset")
+            if off is not None:
+                by_off.setdefault(off, []).append((ev["ts"], nm))
+    cycled = 0
+    for off, evs in by_off.items():
+        evs.sort()
+        names = [n for _, n in evs]
+        for i in range(len(names) - 2):
+            if names[i] == "cache_fill" and names[i + 1] == "cache_evict" \
+                    and names[i + 2] == "cache_fill":
+                cycled += 1
+                break
+    assert cycled > 0, \
+        f"cache_churn: no extent shows fill->evict->refill " \
+        f"(dump: {dump_path})"
+    return "cache_churn"
+
+
 SCENARIOS = (scenario_fail_stop, scenario_flaky, scenario_slow_hedge,
              scenario_corrupt_once, scenario_rejoin,
-             scenario_native_degraded)
+             scenario_native_degraded, scenario_cache_churn)
 
 
 def flaky_mirrored_round(rng: random.Random, dirpath: str) -> str:

@@ -266,6 +266,22 @@ def main(argv=None) -> int:
                       f"(align {c.get('nr_landing_fallback_alignment', 0)} "
                       f"dtype {c.get('nr_landing_fallback_dtype', 0)} "
                       f"backend {c.get('nr_landing_fallback_backend', 0)})")
+            # residency-tier scoreboard (ISSUE 9): cross-query hit ratio
+            # plus churn (fills/evictions/invalidations) against the
+            # resident-bytes gauge — a hot working set shows a high hit
+            # ratio with evictions near zero
+            if (c.get("nr_cache_hit") or c.get("nr_cache_miss")
+                    or c.get("nr_cache_fill")):
+                lookups = c.get("nr_cache_hit", 0) + c.get("nr_cache_miss", 0)
+                hr = c.get("nr_cache_hit", 0) / lookups if lookups else 0.0
+                print(f"cache: hit {c.get('nr_cache_hit', 0)}  "
+                      f"miss {c.get('nr_cache_miss', 0)}  "
+                      f"({hr:.0%} hit)  "
+                      f"fill {c.get('nr_cache_fill', 0)}  "
+                      f"evict {c.get('nr_cache_evict', 0)}  "
+                      f"invalidate {c.get('nr_cache_invalidate', 0)}  "
+                      f"resident "
+                      f"{c.get('cache_resident_bytes', 0) / 1048576:.1f}MB")
             # write-amplification of the recovery/staging stack: every
             # byte the pipeline touched (staging hop + verify re-reads +
             # duplicated hedge legs) over every byte delivered — 1.0 is

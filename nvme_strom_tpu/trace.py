@@ -409,6 +409,8 @@ def summarize_chrome_trace(doc: dict) -> str:
     events = doc.get("traceEvents", [])
     names: Dict[int, str] = {}
     per_track: Dict[int, List[int]] = {}
+    cache_ops = {"cache_hit": 0, "cache_fill": 0, "cache_evict": 0,
+                 "cache_invalidate": 0}
     t0 = t1 = None
     tasks = set()
     for ev in events:
@@ -427,6 +429,9 @@ def summarize_chrome_trace(doc: dict) -> str:
             continue
         if ph in ("f", "t"):
             continue
+        nm = ev.get("name")
+        if nm in cache_ops:
+            cache_ops[nm] += 1
         row = per_track.setdefault(ev.get("tid", -1), [0, 0])
         row[0 if ph == "X" else 1] += 1
     lines = []
@@ -436,6 +441,9 @@ def summarize_chrome_trace(doc: dict) -> str:
     other = doc.get("otherData", {})
     if other.get("dropped_events"):
         lines.append(f"ring overwrote {other['dropped_events']} event(s)")
+    if any(cache_ops.values()):
+        lines.append("cache: " + "  ".join(
+            f"{k.split('_', 1)[1]} {v}" for k, v in cache_ops.items()))
     for tid in sorted(per_track):
         spans, insts = per_track[tid]
         lines.append(f"  {names.get(tid, f'tid {tid}'):<12} "
@@ -450,7 +458,7 @@ def _prom_name(counter: str) -> str:
 
 
 _PROM_GAUGES = ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
-                "occ_integral_ns", "occ_busy_ns")
+                "occ_integral_ns", "occ_busy_ns", "cache_resident_bytes")
 
 
 def render_prometheus(payload: dict) -> str:
@@ -470,8 +478,9 @@ def render_prometheus(payload: dict) -> str:
         out.append(f"{name}{labels} {value}")
 
     for k in sorted(counters):
-        if "debug" in k or k.startswith("nr_landing_"):
-            continue    # landing counters render as labeled series below
+        if "debug" in k or k.startswith("nr_landing_") \
+                or k.startswith("nr_cache_"):
+            continue    # landing/cache counters render as labeled series
         mtype = "gauge" if k in _PROM_GAUGES else "counter"
         emit(_prom_name(k if k in _PROM_GAUGES else k + "_total"),
              mtype, counters[k])
@@ -491,6 +500,14 @@ def render_prometheus(payload: dict) -> str:
         for r, v in reasons:
             out.append(
                 f'strom_tpu_landing_fallback_total{{reason="{r}"}} {v}')
+    # residency-tier attribution (ISSUE 9): one series per cache op, so
+    # dashboards can plot hit ratio and churn against resident bytes
+    ops = [(op, counters.get(f"nr_cache_{op}", 0))
+           for op in ("hit", "miss", "fill", "evict", "invalidate")]
+    if any(v for _, v in ops):
+        out.append("# TYPE strom_tpu_cache_ops_total counter")
+        for op, v in ops:
+            out.append(f'strom_tpu_cache_ops_total{{op="{op}"}} {v}')
     ratio = bytes_touched_ratio(counters)
     if ratio is not None:
         emit("strom_tpu_bytes_touched_per_byte_delivered", "gauge",

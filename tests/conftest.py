@@ -44,7 +44,10 @@ def _reset_config():
     """Isolate config mutations between tests (atomic restore: per-key
     set() can trip cross-variable invariants depending on key order).
     The flight recorder caches trace_policy at configure() time, so it is
-    re-synced and cleared alongside the restore."""
+    re-synced and cleared alongside the restore; the residency cache
+    caches cache_bytes the same way and also holds cross-test slabs, so
+    it is emptied and re-synced too (cache_bytes defaults to 0 = off)."""
+    from nvme_strom_tpu.cache import residency_cache
     from nvme_strom_tpu.config import config
     from nvme_strom_tpu.trace import recorder
     snap = config.snapshot()
@@ -52,3 +55,5 @@ def _reset_config():
     config.restore(snap)
     recorder.configure()
     recorder.clear()
+    residency_cache.clear()
+    residency_cache.configure()
