@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe trace-gate landing-gate cache-gate probe-loop clean
+.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe trace-gate landing-gate cache-gate probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -102,10 +102,39 @@ cache-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.cache_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q -m cache
 
-# The everyday gate: tier-1 tests plus the perf smokes, the seeded
-# member-survival schedules, and the trace-overhead, landing and cache
-# gates.
-check: bench-smoke bench-stripe chaos trace-gate landing-gate cache-gate
+# stromlint (ISSUE 10): the project-invariant static checker — lock
+# discipline, buffer lifetimes, native-ABI drift against csrc/strom_tpu.h,
+# stats/trace surface completeness, config hygiene.  Zero unsuppressed
+# findings and zero stale baseline entries or the gate fails; the
+# analyzer's own test suite (the `lint` marker) rides along.
+lint-strom:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.analysis
+	JAX_PLATFORMS=cpu python -m pytest tests/test_stromlint.py -q -m lint
+
+# ASan/UBSan gate for the native engine (ISSUE 10 satellite): build
+# strom_engine.cc + stress_test.cc under address+UB sanitizers and run
+# the full concurrency stress; any report aborts the binary and fails
+# the target.  The TSan variant of the same stress is part of `make
+# test` (stress_test_tsan, with a skip when TSAN cannot start in the
+# runtime).
+sanitize:
+	$(MAKE) -C csrc sanitize
+	@test -f $(STRESS_FILE) || dd if=/dev/urandom of=$(STRESS_FILE) bs=1M count=8 status=none
+	csrc/stress_test_asan $(STRESS_FILE) 8 20
+	@echo "sanitize ok (ASan/UBSan clean)"
+
+# Fast variant riding in `make check`: same sanitized binary, short pass.
+sanitize-smoke:
+	$(MAKE) -C csrc sanitize
+	@test -f $(STRESS_FILE) || dd if=/dev/urandom of=$(STRESS_FILE) bs=1M count=8 status=none
+	csrc/stress_test_asan $(STRESS_FILE) 2 4
+	@echo "sanitize-smoke ok"
+
+# The everyday gate: static analysis first (cheapest, fails fastest),
+# then tier-1 tests plus the perf smokes, the seeded member-survival
+# schedules, the trace-overhead, landing and cache gates, and the
+# short sanitizer pass.
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos trace-gate landing-gate cache-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

@@ -25,6 +25,12 @@ _CSRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "csrc")
 BACKEND_AUTO, BACKEND_IO_URING, BACKEND_THREADPOOL = 0, 1, 2
 _BACKEND_NAMES = {BACKEND_IO_URING: "io_uring", BACKEND_THREADPOOL: "threadpool"}
 
+#: NSTPU_API_VERSION — the header contract these bindings mirror.  A
+#: loaded .so reporting a different nstpu_engine_version() is a stale
+#: build (strom_check diagnoses this at startup; stromlint's abi.drift
+#: rule keeps the constant itself honest against csrc/strom_tpu.h).
+API_VERSION = 3
+
 # counter order must match enum NSTPU_CTR_* in csrc/strom_tpu.h
 NATIVE_COUNTERS = (
     "nr_submit_dma", "clk_submit_dma",
@@ -169,6 +175,18 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def native_api_version() -> Optional[int]:
+    """ABI version the loaded .so reports, or None when unavailable.
+    Compared against :data:`API_VERSION` by strom_check's abi probe."""
+    lib = _load()
+    if lib is None:
+        return None
+    try:
+        return int(lib.nstpu_engine_version())
+    except Exception:
+        return None
 
 
 def native_signature() -> Optional[str]:
@@ -441,9 +459,12 @@ class NativeEngine:
             return out
 
     def close(self) -> None:
-        if self._h:
-            self._lib.nstpu_engine_destroy(self._h)
-            self._h = 0
+        # swap the handle out under the lock so two racing closers (user
+        # close vs __del__ on another thread) cannot double-destroy
+        with self._stats_lock:
+            h, self._h = self._h, 0
+        if h:
+            self._lib.nstpu_engine_destroy(h)
 
     def __del__(self):  # pragma: no cover
         try:

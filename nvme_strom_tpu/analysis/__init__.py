@@ -1,0 +1,32 @@
+"""stromlint — project-invariant static analysis for nvme_strom_tpu.
+
+An AST-based checker (stdlib only) enforcing the invariants nine PRs of
+growth made load-bearing: lock discipline over the engine-swap/lane/member
+locks, mmap buffer lifetimes flowing into owned slabs, the ctypes layer
+tracking ``csrc/strom_tpu.h`` field-for-field, the counter surface staying
+renderable end to end, and config/fault-taxonomy hygiene.
+
+Run it as ``strom_lint`` (console script), ``python -m
+nvme_strom_tpu.analysis``, or ``make lint-strom``; it is gated in
+``make check``.
+"""
+
+from __future__ import annotations
+
+from . import abi, buffers, confcheck, locks, surface
+from .core import (Baseline, BaselineError, Finding, Project,
+                   apply_baseline, format_finding, load_baseline)
+
+#: rule family -> module with a ``run(project) -> List[Finding]``
+RULE_MODULES = {
+    "locks": locks,
+    "buffers": buffers,
+    "abi": abi,
+    "surface": surface,
+    "config": confcheck,
+}
+
+__all__ = [
+    "RULE_MODULES", "Baseline", "BaselineError", "Finding", "Project",
+    "apply_baseline", "format_finding", "load_baseline",
+]

@@ -1,0 +1,7 @@
+"""``python -m nvme_strom_tpu.analysis`` == ``strom_lint``."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,307 @@
+"""Rule family ``abi``: native ABI drift between ``csrc/strom_tpu.h`` and
+the ctypes bindings.
+
+The header is the source of truth (the reference's kernel UAPI analog).
+A tolerant C parser extracts ``#define`` constants, the counter enum
+(order is ABI), struct layouts and every ``nstpu_*`` prototype; the
+bindings file is AST-parsed for module constants, ``ctypes.Structure``
+subclasses and every ``lib.<fn>.argtypes``/``restype`` assignment.  Any
+mismatch — missing binding for a pointer/64-bit signature, wrong arg
+count, wrong field type, reordered counter, drifted ``#define`` — is a
+finding at the binding's line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding, Project, SourceFile
+
+__all__ = ["run", "parse_header", "check_bindings_source", "HeaderABI"]
+
+
+# -- C header parsing ------------------------------------------------------
+
+_COMMENT_RE = re.compile(r"/\*.*?\*/|//[^\n]*", re.S)
+_DEFINE_RE = re.compile(r"^\s*#define\s+(NSTPU_\w+)\s+(\(?-?\w+\)?)",
+                        re.M)
+_ENUM_RE = re.compile(r"enum\s*\w*\s*\{(.*?)\}\s*;", re.S)
+_STRUCT_RE = re.compile(
+    r"(?:typedef\s+)?struct\s+(\w+)\s*\{(.*?)\}\s*(\w*)\s*;", re.S)
+_FIELD_RE = re.compile(r"([\w\s]+?)\s*(\**)\s*(\w+)\s*(\[\s*\w+\s*\])?\s*;")
+_PROTO_RE = re.compile(
+    r"([A-Za-z_][\w\s]*?[\w\*])\s*\**\s*(nstpu_\w+)\s*\(([^)]*)\)\s*;")
+
+
+@dataclass
+class HeaderABI:
+    defines: Dict[str, int] = field(default_factory=dict)
+    counters: List[str] = field(default_factory=list)     # enum order
+    structs: Dict[str, List[Tuple[str, str]]] = field(default_factory=dict)
+    protos: Dict[str, Tuple[str, List[str]]] = field(default_factory=dict)
+
+
+def _canon_ctype(c_type: str, ptr: bool,
+                 struct_names: Sequence[str]) -> Optional[str]:
+    """Canonical token for a C type (None = unknown, skip checking)."""
+    t = " ".join(w for w in c_type.split() if w not in ("const", "struct"))
+    if ptr:
+        if t == "void":
+            return "c_void_p"
+        if t == "char":
+            return "c_char_p"
+        if t in struct_names:
+            return f"POINTER({t})"
+        inner = _canon_ctype(t, False, struct_names)
+        return f"POINTER({inner})" if inner else None
+    return {
+        "int": "i32", "int32_t": "i32",
+        "unsigned": "u32", "uint32_t": "u32", "unsigned int": "u32",
+        "int64_t": "i64", "long long": "i64",
+        "uint64_t": "u64", "unsigned long long": "u64",
+        "size_t": "u64", "void": "void",
+    }.get(t)
+
+
+def parse_header(text: str) -> HeaderABI:
+    abi = HeaderABI()
+    clean = _COMMENT_RE.sub("", text)
+    for name, val in _DEFINE_RE.findall(clean):
+        try:
+            abi.defines[name] = int(val.strip("()"), 0)
+        except ValueError:
+            continue
+    for body in _ENUM_RE.findall(clean):
+        names = []
+        for entry in body.split(","):
+            entry = entry.split("=")[0].strip()
+            if entry:
+                names.append(entry)
+        if names and names[0].startswith("NSTPU_CTR_"):
+            abi.counters = [n[len("NSTPU_CTR_"):].lower() for n in names
+                            if not n[len("NSTPU_CTR_"):].startswith("_")]
+    struct_names = [m.group(1) for m in _STRUCT_RE.finditer(clean)]
+    for m in _STRUCT_RE.finditer(clean):
+        fields: List[Tuple[str, str]] = []
+        for fm in _FIELD_RE.finditer(m.group(2)):
+            ctype, stars, fname, arr = fm.groups()
+            canon = _canon_ctype(ctype.strip(), bool(stars), struct_names)
+            fields.append((fname, canon or ctype.strip()))
+        abi.structs[m.group(1)] = fields
+    for m in _PROTO_RE.finditer(clean):
+        ret, fn, args = m.groups()
+        ret_ptr = "*" in m.group(0).split(fn)[0][len(ret):] or ret.endswith("*")
+        ret = ret.rstrip("*").strip()
+        arg_types: List[str] = []
+        args = args.strip()
+        if args and args != "void":
+            for a in args.split(","):
+                a = a.strip()
+                ptr = "*" in a
+                toks = a.replace("*", " ").split()
+                base = " ".join(toks[:-1]) if len(toks) > 1 else toks[0]
+                canon = _canon_ctype(base, ptr, struct_names)
+                arg_types.append(canon or base)
+        ret_canon = _canon_ctype(ret, ret_ptr, struct_names) or ret
+        abi.protos[fn] = (ret_canon, arg_types)
+    return abi
+
+
+# -- bindings parsing ------------------------------------------------------
+
+_CANON_PY = {
+    "c_int": "i32", "c_int32": "i32", "c_uint": "u32", "c_uint32": "u32",
+    "c_int64": "i64", "c_longlong": "i64",
+    "c_uint64": "u64", "c_ulonglong": "u64", "c_size_t": "u64",
+    "c_void_p": "c_void_p", "c_char_p": "c_char_p",
+    "None": "void",
+}
+
+
+def _canon_py_type(expr: ast.AST) -> Optional[str]:
+    src = ast.unparse(expr).replace("ctypes.", "")
+    m = re.fullmatch(r"POINTER\((\w+)\)", src)
+    if m:
+        inner = _CANON_PY.get(m.group(1), m.group(1))
+        return f"POINTER({inner})"
+    return _CANON_PY.get(src, src)
+
+
+@dataclass
+class _Bindings:
+    constants: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    counters: Tuple[List[str], int] = ((), 0)
+    structures: Dict[str, Tuple[List[Tuple[str, str]], int]] = \
+        field(default_factory=dict)
+    argtypes: Dict[str, Tuple[List[str], int]] = field(default_factory=dict)
+    restype: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    struct_to_header: Dict[str, str] = field(default_factory=dict)
+
+
+def _parse_bindings(src: SourceFile) -> Optional[_Bindings]:
+    b = _Bindings()
+    tree = src.tree
+    relevant = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and any(
+                (isinstance(base, ast.Attribute) and base.attr == "Structure")
+                or (isinstance(base, ast.Name) and base.id == "Structure")
+                for base in node.bases):
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == "_fields_"):
+                    fields = []
+                    for el in stmt.value.elts:
+                        fname = el.elts[0].value
+                        fields.append((fname, _canon_py_type(el.elts[1])))
+                    b.structures[node.name] = (fields, stmt.lineno)
+        if not isinstance(node, ast.Assign):
+            continue
+        tgt = node.targets[0]
+        # module constants, incl. tuple unpack (BACKEND_* = 0, 1, 2)
+        if isinstance(tgt, ast.Name) and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, int):
+            b.constants[tgt.id] = (node.value.value, node.lineno)
+        elif isinstance(tgt, ast.Tuple) and isinstance(node.value, ast.Tuple):
+            for n, v in zip(tgt.elts, node.value.elts):
+                if isinstance(n, ast.Name) and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int):
+                    b.constants[n.id] = (v.value, node.lineno)
+        if isinstance(tgt, ast.Name) and tgt.id == "NATIVE_COUNTERS" \
+                and isinstance(node.value, ast.Tuple):
+            names = [e.value for e in node.value.elts
+                     if isinstance(e, ast.Constant)]
+            b.counters = (names, node.lineno)
+            relevant = True
+        # lib.<fn>.argtypes / .restype
+        if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Attribute):
+            fn = tgt.value.attr
+            if not fn.startswith("nstpu_"):
+                continue
+            relevant = True
+            if tgt.attr == "argtypes" and isinstance(node.value, ast.List):
+                b.argtypes[fn] = ([_canon_py_type(e)
+                                   for e in node.value.elts], node.lineno)
+            elif tgt.attr == "restype":
+                b.restype[fn] = (_canon_py_type(node.value), node.lineno)
+    return b if relevant else None
+
+
+def _needs_explicit(types: Sequence[str], ret: str) -> bool:
+    """ctypes defaults (int args / int return) are only safe for pure
+    32-bit-int signatures."""
+    wide = {"i64", "u64", "c_void_p", "c_char_p"}
+    if ret in wide or ret.startswith("POINTER"):
+        return True
+    return any(t in wide or t.startswith("POINTER") for t in types)
+
+
+def check_bindings_source(src: SourceFile, abi: HeaderABI) -> List[Finding]:
+    """Cross-check one bindings file against a parsed header."""
+    b = _parse_bindings(src)
+    if b is None:
+        return []
+    out: List[Finding] = []
+
+    def finding(line: int, msg: str) -> None:
+        out.append(Finding(src.relpath, line, "abi.drift", msg))
+
+    # structs: match each ctypes Structure to the header struct with the
+    # same field names, then compare types; remember the name map for
+    # prototype pointer checks
+    for pyname, (fields, line) in b.structures.items():
+        names = [f[0] for f in fields]
+        match = next((hn for hn, hf in abi.structs.items()
+                      if [f[0] for f in hf] == names), None)
+        if match is None:
+            finding(line, f"ctypes Structure {pyname} matches no header "
+                          f"struct (fields {names})")
+            continue
+        b.struct_to_header[pyname] = match
+        for (fname, ptype), (_, htype) in zip(fields, abi.structs[match]):
+            if ptype != htype:
+                finding(line, f"{pyname}.{fname} is {ptype} but header "
+                              f"struct {match} declares {htype}")
+    for hname, hfields in abi.structs.items():
+        if hname not in b.struct_to_header.values():
+            finding(1, f"header struct {hname} has no ctypes Structure "
+                       f"binding")
+
+    def map_struct_ptrs(t: str) -> str:
+        m = re.fullmatch(r"POINTER\((\w+)\)", t)
+        if m and m.group(1) in b.struct_to_header:
+            return f"POINTER({b.struct_to_header[m.group(1)]})"
+        return t
+
+    # counter enum order
+    counters, cline = b.counters
+    if abi.counters and counters and list(counters) != abi.counters:
+        finding(cline, f"NATIVE_COUNTERS does not match the NSTPU_CTR_ "
+                       f"enum order: {list(counters)} != {abi.counters}")
+
+    # module constants against their NSTPU_<name> defines
+    for name, (val, line) in b.constants.items():
+        want = abi.defines.get(f"NSTPU_{name}")
+        if want is not None and want != val:
+            finding(line, f"{name} = {val} but header defines "
+                          f"NSTPU_{name} = {want}")
+    if "NSTPU_API_VERSION" in abi.defines and "API_VERSION" not in b.constants:
+        finding(1, "bindings declare no API_VERSION constant to pin "
+                   "NSTPU_API_VERSION")
+
+    # prototypes
+    for fn, (types, line) in b.argtypes.items():
+        proto = abi.protos.get(fn)
+        if proto is None:
+            finding(line, f"binding for {fn} but the header declares no "
+                          f"such function")
+            continue
+        _, want_args = proto
+        if len(types) != len(want_args):
+            finding(line, f"{fn} takes {len(want_args)} args in the header "
+                          f"but the binding declares {len(types)}")
+            continue
+        for i, (got, want) in enumerate(zip(types, want_args)):
+            if map_struct_ptrs(got) != want:
+                finding(line, f"{fn} arg {i} is {got} but the header "
+                              f"declares {want}")
+    for fn, (got, line) in b.restype.items():
+        proto = abi.protos.get(fn)
+        if proto is None:
+            if fn not in b.argtypes:
+                finding(line, f"binding for {fn} but the header declares "
+                              f"no such function")
+            continue
+        want_ret, _ = proto
+        if want_ret not in ("i32", "void") and map_struct_ptrs(got) != want_ret:
+            finding(line, f"{fn} returns {want_ret} in the header but the "
+                          f"binding declares restype {got}")
+    # header functions with unsafe-by-default signatures need bindings
+    for fn, (ret, args) in abi.protos.items():
+        if fn in b.argtypes:
+            if ret not in ("i32", "void") and fn not in b.restype:
+                finding(b.argtypes[fn][1],
+                        f"{fn} returns {ret} but the binding declares no "
+                        f"restype (ctypes will truncate to int)")
+            continue
+        if fn in b.restype and not args:
+            continue       # e.g. nstpu_signature(void) with restype only
+        if _needs_explicit(args, ret):
+            finding(1, f"header function {fn}({', '.join(args)}) -> {ret} "
+                       f"has no argtypes binding; ctypes int defaults "
+                       f"would corrupt 64-bit/pointer args")
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    if not project.header_text:
+        return []
+    abi = parse_header(project.header_text)
+    findings: List[Finding] = []
+    for src, _tree in project.iter_trees():
+        findings.extend(check_bindings_source(src, abi))
+    return findings

@@ -1,0 +1,179 @@
+"""Rule family ``buffers``: mmap/aligned-alloc lifetime and ownership.
+
+``buffers.release`` — every ``mmap.mmap(...)`` site must have a reachable
+release path: stored on ``self`` it needs a ``self.<attr>.close()`` (or
+``munmap``-equivalent) somewhere in the class; kept local it needs a
+``.close()`` in the same function, a ``with`` scope, or a hand-off into an
+owning slab type (``_Entry`` in cache.py, ``DmaBuffer``/``LandingBuffer``
+via their constructors) whose release path is audited separately.
+
+``buffers.escape`` — a raw mmap returned from a function transfers
+ownership invisibly; inside the residency cache no raw slab (``.mm``) may
+escape a ``CacheLease`` scope at all.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, Project, SourceFile
+
+__all__ = ["run"]
+
+#: constructors that take ownership of a raw buffer passed to them
+_OWNER_SINKS = {"_Entry", "DmaBuffer", "LandingBuffer", "PinnedExtent"}
+
+
+def _is_mmap_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "mmap"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "mmap")
+
+
+def _enclosing(parents: Dict[ast.AST, ast.AST], node: ast.AST, kinds):
+    cur = parents.get(node)
+    while cur is not None and not isinstance(cur, kinds):
+        cur = parents.get(cur)
+    return cur
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    out: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            out[child] = parent
+    return out
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _class_releases_attr(cls: ast.ClassDef, attr: str) -> bool:
+    """True when some method calls ``self.<attr>.close()`` / ``.release()``
+    or hands ``self.<attr>`` to an owner sink."""
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (isinstance(fn, ast.Attribute)
+                and fn.attr in ("close", "release", "munmap")
+                and _self_attr(fn.value) == attr):
+            return True
+        for arg in node.args:
+            if _self_attr(arg) == attr and _sink_name(fn) in _OWNER_SINKS:
+                return True
+    return False
+
+
+def _sink_name(fn: ast.AST) -> Optional[str]:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _local_released(func: ast.AST, name: str) -> bool:
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr in ("close", "release")
+                and isinstance(fn.value, ast.Name) and fn.value.id == name):
+            return True
+        if _sink_name(fn) in _OWNER_SINKS:
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+    return False
+
+
+def _stored_to_self(func: ast.AST, name: str) -> Optional[str]:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Name) \
+                and node.value.id == name:
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr:
+                    return attr
+    return None
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src, tree in project.iter_trees():
+        parents = _parent_map(tree)
+        for node in ast.walk(tree):
+            if not _is_mmap_call(node):
+                continue
+            parent = parents.get(node)
+            # ``with mmap.mmap(...)`` scopes the release
+            if isinstance(parent, ast.withitem):
+                continue
+            func = _enclosing(parents, node,
+                              (ast.FunctionDef, ast.AsyncFunctionDef))
+            cls = _enclosing(parents, node, ast.ClassDef)
+            line = node.lineno
+            # direct ``self.X = mmap.mmap(...)``
+            attr = None
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    attr = _self_attr(t) or attr
+                local = (parent.targets[0].id
+                         if isinstance(parent.targets[0], ast.Name) else None)
+            else:
+                local = None
+            if attr is None and local is not None and func is not None:
+                attr = _stored_to_self(func, local)
+            if attr is not None:
+                owner_cls = cls
+                if owner_cls is None or not _class_releases_attr(owner_cls, attr):
+                    findings.append(Finding(
+                        src.relpath, line, "buffers.release",
+                        f"mmap stored to self.{attr} but no method of "
+                        f"{owner_cls.name if owner_cls else '<module>'} "
+                        f"closes it (unreachable release path)"))
+                continue
+            if local is not None and func is not None:
+                if not _local_released(func, local):
+                    findings.append(Finding(
+                        src.relpath, line, "buffers.release",
+                        f"mmap bound to local '{local}' is neither closed "
+                        f"in this function nor handed to an owning slab "
+                        f"({'/'.join(sorted(_OWNER_SINKS))})"))
+                continue
+            # returned raw, passed anonymously, or at module level
+            if isinstance(parent, ast.Return):
+                findings.append(Finding(
+                    src.relpath, line, "buffers.escape",
+                    "raw mmap returned from function: ownership escapes "
+                    "without a release path"))
+            elif isinstance(parent, ast.Call) and \
+                    _sink_name(parent.func) in _OWNER_SINKS:
+                pass
+            else:
+                findings.append(Finding(
+                    src.relpath, line, "buffers.release",
+                    "anonymous mmap allocation: no binding to close"))
+
+        # CacheLease scope: raw slab (.mm) must not escape the cache module
+        if src.relpath.endswith("cache.py"):
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                for sub in ast.walk(node.value):
+                    if (isinstance(sub, ast.Attribute) and sub.attr == "mm"
+                            and not (isinstance(sub.value, ast.Name)
+                                     and sub.value.id == "self")):
+                        findings.append(Finding(
+                            src.relpath, node.lineno, "buffers.escape",
+                            "raw slab buffer (.mm) escapes the cache via a "
+                            "return; only CacheLease may carry slab access"))
+    return findings

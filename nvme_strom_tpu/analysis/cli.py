@@ -1,0 +1,117 @@
+"""strom_lint — run every stromlint rule over the package.
+
+Usage: strom_lint [--root DIR] [--baseline FILE] [--rule FAMILY] [--list]
+
+Findings print as ``file:line rule message`` (clickable in editors/CI).
+Exit status: 0 clean, 1 findings or stale baseline entries, 2 bad
+invocation / unreadable baseline.
+
+Suppression, in precedence order:
+
+* inline ``# stromlint: ignore[rule.id]`` on (or immediately above) the
+  offending line — for one-off, self-documenting exemptions;
+* the baseline file (default ``stromlint.baseline`` at the root) — the
+  checked-in ratchet of deliberate exemptions, each with a reason.  A
+  finding NOT in the baseline fails the run; a baseline entry matching
+  NO finding also fails the run, so the ratchet can only tighten.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from . import RULE_MODULES
+from .core import (BaselineError, Finding, Project, apply_baseline,
+                   format_finding, load_baseline)
+
+__all__ = ["main", "run_rules"]
+
+
+def run_rules(project: Project, families=None) -> List[Finding]:
+    """All findings from the selected rule families, inline suppressions
+    already applied, sorted for stable output."""
+    findings: List[Finding] = []
+    by_path = {f.relpath: f for f in project.py_files}
+    for family, mod in RULE_MODULES.items():
+        if families and family not in families:
+            continue
+        for f in mod.run(project):
+            src = by_path.get(f.path)
+            if src is not None and src.is_suppressed(f.line, f.rule):
+                continue
+            findings.append(f)
+    return sorted(dict.fromkeys(findings), key=Finding.sort_key)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="strom_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--root", default=None,
+                    help="project root (default: auto-detect from the "
+                         "installed package location or cwd)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: ROOT/stromlint.baseline)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="FAMILY",
+                    help="run only this rule family (repeatable): "
+                         + ", ".join(sorted(RULE_MODULES)))
+    ap.add_argument("--list", action="store_true",
+                    help="list rule families and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for family, mod in sorted(RULE_MODULES.items()):
+            doc = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{family:<10} {doc}")
+        return 0
+
+    root = args.root
+    if root is None:
+        # package checkout layout: <root>/nvme_strom_tpu/analysis/cli.py
+        guess = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        root = guess if os.path.isdir(
+            os.path.join(guess, "nvme_strom_tpu")) else os.getcwd()
+    if args.rule:
+        unknown = set(args.rule) - set(RULE_MODULES)
+        if unknown:
+            print(f"strom_lint: unknown rule families: "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    project = Project.from_root(root)
+    if not project.py_files:
+        print(f"strom_lint: no package sources under {root}",
+              file=sys.stderr)
+        return 2
+    findings = run_rules(project, families=args.rule)
+
+    baseline_path = args.baseline or os.path.join(root, "stromlint.baseline")
+    try:
+        baseline = load_baseline(baseline_path)
+    except (BaselineError, ValueError) as e:
+        print(f"strom_lint: bad baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+    remaining, stale = apply_baseline(findings, baseline)
+
+    for f in remaining:
+        print(format_finding(f))
+    for e in stale:
+        print(f"{baseline_path}: stale baseline entry "
+              f"(rule={e['rule']} file={e['file']} match={e['match']!r}) "
+              f"matches no finding — remove it", file=sys.stderr)
+    n_base = len(findings) - len(remaining)
+    status = "clean" if not remaining and not stale else "FAILED"
+    print(f"strom_lint: {len(remaining)} finding(s), {n_base} baselined, "
+          f"{len(stale)} stale baseline entr(ies) — {status}",
+          file=sys.stderr)
+    return 1 if (remaining or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,209 @@
+"""stromlint core: project model, findings, suppressions, baseline ratchet.
+
+The analyzer is deliberately self-contained (stdlib ``ast`` only) and
+discovers its anchor points by CONTENT, not by path: the file that assigns
+``STAT_FIELDS`` is the stats surface, any file assigning ``lib.<fn>.argtypes``
+is the ctypes binding layer, and so on.  That keeps the rule modules honest
+(they cannot special-case a filename) and makes the test fixtures trivial —
+a three-line temp package exercises the same code path as the real tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "SourceFile", "Project", "Baseline", "BaselineError",
+    "load_baseline", "apply_baseline", "format_finding",
+]
+
+#: inline suppression: ``# stromlint: ignore[rule.id]`` (comma list) or the
+#: bare ``# stromlint: ignore`` to silence every rule on that line.  The
+#: comment suppresses findings on its own line and, when it is the only
+#: thing on the line, on the line below (so multi-line statements can carry
+#: a suppression above them).
+_SUPPRESS_RE = re.compile(
+    r"#\s*stromlint:\s*ignore(?:\[(?P<rules>[\w.,\s-]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, formatted as ``file:line rule message``."""
+    path: str          # project-relative path
+    line: int
+    rule: str          # dotted id, e.g. ``locks.lockset``
+    message: str
+
+    def sort_key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.rule)
+
+
+def format_finding(f: Finding) -> str:
+    return f"{f.path}:{f.line} {f.rule} {f.message}"
+
+
+class SourceFile:
+    """One parsed python file plus its suppression map."""
+
+    def __init__(self, relpath: str, text: str):
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self._tree: Optional[ast.Module] = None
+        self._suppress: Optional[Dict[int, Optional[Set[str]]]] = None
+
+    @property
+    def tree(self) -> ast.Module:
+        if self._tree is None:
+            self._tree = ast.parse(self.text, filename=self.relpath)
+        return self._tree
+
+    def _suppress_map(self) -> Dict[int, Optional[Set[str]]]:
+        """line -> set of suppressed rule ids (None = all rules)."""
+        if self._suppress is not None:
+            return self._suppress
+        out: Dict[int, Optional[Set[str]]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = m.group("rules")
+            ids: Optional[Set[str]] = None
+            if rules:
+                ids = {r.strip() for r in rules.split(",") if r.strip()}
+            targets = [i]
+            # a standalone suppression comment covers the next line too
+            if line.lstrip().startswith("#"):
+                targets.append(i + 1)
+            for t in targets:
+                if t in out and out[t] is not None and ids is not None:
+                    out[t] = set(out[t]) | ids
+                elif t not in out or ids is None:
+                    out[t] = ids if ids is None else set(ids)
+        self._suppress = out
+        return out
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        got = self._suppress_map().get(line, False)
+        if got is False:
+            return False
+        if got is None:          # bare ignore
+            return True
+        family = rule.split(".", 1)[0]
+        return rule in got or family in got
+
+
+class Project:
+    """The unit a lint run sees: python sources + the native header +
+    prose docs (README/deploy) for the documentation checks."""
+
+    def __init__(self, root: str, py_files: Sequence[SourceFile],
+                 header_text: Optional[str] = None,
+                 header_path: str = "csrc/strom_tpu.h",
+                 doc_texts: Optional[Dict[str, str]] = None):
+        self.root = root
+        self.py_files = list(py_files)
+        self.header_text = header_text
+        self.header_path = header_path
+        self.doc_texts = dict(doc_texts or {})
+
+    # -- discovery ---------------------------------------------------------
+    @classmethod
+    def from_root(cls, root: str,
+                  package: str = "nvme_strom_tpu") -> "Project":
+        pkg_dir = os.path.join(root, package)
+        files: List[SourceFile] = []
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root)
+                with open(full, "r", encoding="utf-8") as fh:
+                    files.append(SourceFile(rel, fh.read()))
+        header_text = None
+        header_path = os.path.join("csrc", "strom_tpu.h")
+        full_header = os.path.join(root, header_path)
+        if os.path.exists(full_header):
+            with open(full_header, "r", encoding="utf-8") as fh:
+                header_text = fh.read()
+        docs: Dict[str, str] = {}
+        for rel in ("README.md", os.path.join("deploy", "README.md")):
+            p = os.path.join(root, rel)
+            if os.path.exists(p):
+                with open(p, "r", encoding="utf-8") as fh:
+                    docs[rel] = fh.read()
+        return cls(root, files, header_text=header_text,
+                   header_path=header_path, doc_texts=docs)
+
+    def file(self, suffix: str) -> Optional[SourceFile]:
+        for f in self.py_files:
+            if f.relpath.endswith(suffix):
+                return f
+        return None
+
+    def iter_trees(self) -> Iterable[Tuple[SourceFile, ast.Module]]:
+        for f in self.py_files:
+            try:
+                yield f, f.tree
+            except SyntaxError:
+                # surfaced by whoever runs python; not a lint concern
+                continue
+
+
+# -- baseline ratchet ------------------------------------------------------
+#
+# The baseline is the list of DELIBERATE exemptions, each with a reason.
+# The ratchet has two jaws: a finding not covered by the baseline fails the
+# run (no silent growth), and a baseline entry matching nothing also fails
+# the run (no dead weight hiding future regressions behind a stale entry).
+
+class BaselineError(Exception):
+    pass
+
+
+@dataclass
+class Baseline:
+    entries: List[dict] = field(default_factory=list)
+    path: Optional[str] = None
+
+
+def load_baseline(path: str) -> Baseline:
+    if not os.path.exists(path):
+        return Baseline(entries=[], path=path)
+    with open(path, "r", encoding="utf-8") as fh:
+        raw = json.load(fh)
+    entries = raw.get("entries", raw if isinstance(raw, list) else [])
+    for e in entries:
+        for key in ("rule", "file", "match", "reason"):
+            if not e.get(key):
+                raise BaselineError(
+                    f"baseline entry {e!r} missing required key '{key}' "
+                    f"(every exemption needs a reason string)")
+    return Baseline(entries=entries, path=path)
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Baseline
+                   ) -> Tuple[List[Finding], List[dict]]:
+    """Returns ``(unsuppressed findings, stale entries)``.  A finding is
+    baselined when an entry's rule and file match exactly and its ``match``
+    string occurs in the message."""
+    used = [False] * len(baseline.entries)
+    out: List[Finding] = []
+    for f in findings:
+        hit = False
+        for i, e in enumerate(baseline.entries):
+            if (e["rule"] == f.rule and e["file"] == f.path
+                    and e["match"] in f.message):
+                used[i] = True
+                hit = True
+        if not hit:
+            out.append(f)
+    stale = [e for i, e in enumerate(baseline.entries) if not used[i]]
+    return out, stale

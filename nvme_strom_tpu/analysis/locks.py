@@ -1,0 +1,353 @@
+"""Rule family ``locks``: lockset discipline + lock-ordering.
+
+``locks.lockset`` — per class, the owning lock of each attribute is derived
+from the existing ``with self._lock:`` bodies (the map the ISSUE calls the
+per-class ``_lock``→fields map): a lock OWNS an attribute when some method
+mutates the attribute while holding it.  Any other mutation of that
+attribute outside the lock (excluding ``__init__``, where the object is
+thread-private) is a finding — exactly the shape of the PR 7 snapshot race.
+
+``locks.check-then-act`` — for attributes of a lock-owning class that are
+never mutated under any lock at all, flag the classic race seed: a method
+that tests ``self.attr`` and then assigns it (two threads both pass the
+test).  Single-writer designs baseline this with a reason.
+
+``locks.order`` — nested ``with`` acquisitions build a directed
+acquired-while-holding graph per class (with one level of private-method
+call propagation, so a helper that runs only under a caller's lock inherits
+that lockset); a cycle is a deadlock seed.
+
+``locks.swap-order`` — the engine swap lock (``_lane_lock``) must be the
+OUTERMOST lock: acquiring it while holding any other instance lock inverts
+the swap/member ordering that lane scale-out depends on.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, SourceFile
+
+__all__ = ["run"]
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+#: locks that must always be acquired first (no other instance lock held)
+_OUTERMOST = {"_lane_lock"}
+
+#: container mutators counted as attribute mutations
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "setdefault", "popitem", "add", "discard", "appendleft", "popleft",
+}
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_ctor_name(call: ast.AST) -> Optional[str]:
+    """'Lock' for threading.Lock() / Lock() / threading.Condition(...)."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    return name if name in _LOCK_CTORS else None
+
+
+@dataclass
+class _Mutation:
+    attr: str
+    line: int
+    held: frozenset            # canonical lock attr names held
+    method: str
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    file: SourceFile
+    locks: Set[str] = field(default_factory=set)
+    alias: Dict[str, str] = field(default_factory=dict)   # cond -> inner lock
+    mutations: List[_Mutation] = field(default_factory=list)
+    # method -> list of lock-attrs it acquires (top-level, for propagation)
+    acquires: Dict[str, Set[str]] = field(default_factory=dict)
+    # (holder_lock, acquired_lock, line) edges
+    order_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    # method -> list of (callee, heldset, line)
+    calls: Dict[str, List[Tuple[str, frozenset, int]]] = field(default_factory=dict)
+    # method -> {attr: first line an If-test reads self.attr}
+    tested: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+
+def _collect_class(cls: ast.ClassDef, src: SourceFile) -> _ClassInfo:
+    info = _ClassInfo(name=cls.name, file=src)
+    init = next((n for n in cls.body if isinstance(n, ast.FunctionDef)
+                 and n.name == "__init__"), None)
+    if init is not None:
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                attr = _is_self_attr(node.targets[0])
+                ctor = _lock_ctor_name(node.value)
+                if attr and ctor:
+                    info.locks.add(attr)
+                    if ctor == "Condition" and node.value.args:
+                        inner = _is_self_attr(node.value.args[0])
+                        if inner:
+                            info.alias[attr] = inner
+    if not info.locks:
+        return info
+
+    def canon(lock: str) -> str:
+        return info.alias.get(lock, lock)
+
+    for meth in cls.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        _walk_method(info, meth.name, meth.body, frozenset(), canon)
+        if meth.name != "__init__":
+            tested: Dict[str, int] = {}
+            for node in ast.walk(meth):
+                if isinstance(node, ast.If):
+                    for sub in ast.walk(node.test):
+                        attr = _is_self_attr(sub)
+                        if attr:
+                            tested.setdefault(attr, node.lineno)
+            if tested:
+                info.tested[meth.name] = tested
+    return info
+
+
+def _walk_method(info: _ClassInfo, method: str, stmts, held: frozenset,
+                 canon) -> None:
+    for stmt in stmts:
+        _walk_stmt(info, method, stmt, held, canon)
+
+
+def _walk_stmt(info: _ClassInfo, method: str, stmt: ast.AST,
+               held: frozenset, canon) -> None:
+    if isinstance(stmt, ast.With):
+        new_held = held
+        for item in stmt.items:
+            lock = _is_self_attr(item.context_expr)
+            if lock and lock in info.locks:
+                lock = canon(lock)
+                for h in new_held:
+                    info.order_edges.append((h, lock, stmt.lineno))
+                info.acquires.setdefault(method, set()).add(lock)
+                new_held = new_held | {lock}
+            else:
+                # record expressions inside the context manager too
+                _scan_expr(info, method, item.context_expr, held, canon)
+        _walk_method(info, method, stmt.body, new_held, canon)
+        return
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # a nested function runs later, on whatever thread calls it: it
+        # holds nothing of the enclosing lockset
+        _walk_method(info, stmt.name, stmt.body, frozenset(), canon)
+        return
+    if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        for t in targets:
+            base = t
+            while isinstance(base, (ast.Subscript, ast.Starred)):
+                base = base.value
+            if isinstance(base, ast.Tuple):
+                for el in base.elts:
+                    e = el
+                    while isinstance(e, (ast.Subscript, ast.Starred)):
+                        e = e.value
+                    attr = _is_self_attr(e)
+                    if attr:
+                        info.mutations.append(
+                            _Mutation(attr, t.lineno, held, method))
+                continue
+            attr = _is_self_attr(base)
+            if attr:
+                info.mutations.append(_Mutation(attr, t.lineno, held, method))
+        val = getattr(stmt, "value", None)
+        if val is not None:
+            _scan_expr(info, method, val, held, canon)
+        return
+    if isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _is_self_attr(base)
+            if attr:
+                info.mutations.append(_Mutation(attr, t.lineno, held, method))
+        return
+    # generic statement: scan expressions, then recurse into child bodies
+    for fname in ("test", "value", "exc", "iter", "msg"):
+        v = getattr(stmt, fname, None)
+        if isinstance(v, ast.AST):
+            _scan_expr(info, method, v, held, canon)
+    for fname in ("body", "orelse", "finalbody", "handlers"):
+        body = getattr(stmt, fname, None)
+        if body:
+            for child in body:
+                if isinstance(child, ast.ExceptHandler):
+                    _walk_method(info, method, child.body, held, canon)
+                else:
+                    _walk_stmt(info, method, child, held, canon)
+
+
+def _scan_expr(info: _ClassInfo, method: str, expr: ast.AST,
+               held: frozenset, canon) -> None:
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            # self.attr.mutator(...) counts as a mutation of attr
+            recv_attr = _is_self_attr(fn.value)
+            if recv_attr and fn.attr in _MUTATORS:
+                info.mutations.append(
+                    _Mutation(recv_attr, node.lineno, held, method))
+            # self.method(...) call for lockset propagation
+            if (isinstance(fn.value, ast.Name) and fn.value.id == "self"):
+                info.calls.setdefault(method, []).append(
+                    (fn.attr, held, node.lineno))
+
+
+def _propagate(info: _ClassInfo) -> None:
+    """Interprocedural lockset propagation to a fixpoint: a private method
+    whose EVERY same-class call site holds lock L effectively runs under L,
+    including call sites that themselves only hold L by propagation (so a
+    helper of a helper still inherits the caller's lockset)."""
+    effective: Dict[str, frozenset] = {}
+    for _ in range(16):          # fixpoint in <= call-graph depth rounds
+        nxt: Dict[str, frozenset] = {}
+        sites: Dict[str, List[frozenset]] = {}
+        for caller, calls in info.calls.items():
+            inherited = effective.get(caller, frozenset())
+            for callee, held, _line in calls:
+                sites.setdefault(callee, []).append(held | inherited)
+        for meth, locksets in sites.items():
+            if not meth.startswith("_") or meth.startswith("__"):
+                continue
+            common = frozenset.intersection(*locksets)
+            if common:
+                nxt[meth] = common
+        if nxt == effective:
+            break
+        effective = nxt
+    if not effective:
+        return
+    for m in info.mutations:
+        extra = effective.get(m.method)
+        if extra:
+            m.held = m.held | extra
+    # call-graph order edges: caller holds L (incl. propagated), callee
+    # acquires K  =>  L -> K
+    for meth, calls in info.calls.items():
+        base = effective.get(meth, frozenset())
+        for callee, held, line in calls:
+            for h in held | base:
+                for k in info.acquires.get(callee, ()):
+                    if k != h:
+                        info.order_edges.append((h, k, line))
+    # propagated methods acquiring further locks also order under the
+    # caller's lock
+    for meth, extra in effective.items():
+        for k in info.acquires.get(meth, ()):
+            for h in extra:
+                if k != h:
+                    info.order_edges.append((h, k, 0))
+
+
+def _cycles(edges: List[Tuple[str, str, int]]) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b, _ in edges:
+        graph.setdefault(a, set()).add(b)
+    seen: Set[str] = set()
+    out: List[List[str]] = []
+    def dfs(node: str, path: List[str]) -> None:
+        if node in path:
+            cyc = path[path.index(node):] + [node]
+            if sorted(cyc) not in [sorted(c) for c in out]:
+                out.append(cyc)
+            return
+        if node in seen:
+            return
+        seen.add(node)
+        for nxt in graph.get(node, ()):
+            dfs(nxt, path + [node])
+    for start in list(graph):
+        dfs(start, [])
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src, tree in project.iter_trees():
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            info = _collect_class(cls, src)
+            if not info.locks:
+                continue
+            _propagate(info)
+            canon_locks = {info.alias.get(l, l) for l in info.locks}
+
+            # owning-lock map: lock -> attrs mutated under it
+            owners: Dict[str, Set[str]] = {}
+            for m in info.mutations:
+                for lock in m.held:
+                    if lock in canon_locks and m.attr not in canon_locks:
+                        owners.setdefault(m.attr, set()).add(lock)
+            for m in info.mutations:
+                if m.method == "__init__" or m.attr not in owners:
+                    continue
+                own = owners[m.attr]
+                if not (m.held & own):
+                    lock_names = "/".join(sorted(own))
+                    findings.append(Finding(
+                        src.relpath, m.line, "locks.lockset",
+                        f"{info.name}.{m.attr} is guarded by "
+                        f"{lock_names} elsewhere but mutated here "
+                        f"(in {m.method}) without it"))
+
+            # check-then-act on never-locked attrs of a locking class:
+            # an If-test reads self.X and a later lockless mutation in
+            # the same method writes it (two threads both pass the test)
+            seen_cta = set()
+            for m in info.mutations:
+                tested = info.tested.get(m.method, {})
+                if (m.held or m.method == "__init__"
+                        or m.attr in owners or m.attr in canon_locks
+                        or m.attr not in tested
+                        or m.line <= tested[m.attr]
+                        or (m.attr, m.line) in seen_cta):
+                    continue
+                seen_cta.add((m.attr, m.line))
+                findings.append(Finding(
+                    src.relpath, m.line, "locks.check-then-act",
+                    f"{info.name}.{m.attr} is tested then assigned in "
+                    f"{m.method} without any of the class locks "
+                    f"({'/'.join(sorted(canon_locks))}) held"))
+
+            # ordering: cycles
+            for cyc in _cycles(info.order_edges):
+                findings.append(Finding(
+                    src.relpath, cls.lineno, "locks.order",
+                    f"{info.name} acquires its locks in a cycle: "
+                    f"{' -> '.join(cyc)}"))
+            # ordering: swap lock must be outermost
+            for holder, acquired, line in info.order_edges:
+                if acquired in _OUTERMOST and line:
+                    findings.append(Finding(
+                        src.relpath, line, "locks.swap-order",
+                        f"{info.name} acquires swap lock {acquired} while "
+                        f"holding {holder}; the engine swap lock must be "
+                        f"outermost"))
+    return findings

@@ -2299,6 +2299,7 @@ class Session:
             return False
 
         def _hedge_leg() -> None:
+            scratch = mv = None
             try:
                 if won.wait(delay_s) or task.errno_:
                     return            # primary beat the latch: never issued
@@ -2357,6 +2358,10 @@ class Session:
                                        offset=r.file_off, length=r.length,
                                        args={"reason": "primary_won"})
             finally:
+                if mv is not None:
+                    mv.release()
+                if scratch is not None:
+                    scratch.close()
                 hedge_settled.set()
 
         def _primary_leg() -> None:
@@ -2393,6 +2398,8 @@ class Session:
                 state["prim_ok"] = True
                 _finish("primary", scratch)
             finally:
+                mv.release()
+                scratch.close()
                 prim_settled.set()
 
         # both legs race off-thread so the extent completes at the FIRST
@@ -2762,9 +2769,12 @@ class Session:
 
         Returns task ids that were force-reaped with errors (the reference
         logs these on fd close, kmod/nvme_strom.c:2138-2166)."""
-        if self._closed:
-            return []
-        self._closed = True
+        with self._id_lock:
+            # atomic test-and-set: two racing closers must not both run
+            # the teardown (double engine destroy, double pool shutdown)
+            if self._closed:
+                return []
+            self._closed = True
         deadline = time.monotonic() + timeout
         reaped: List[int] = []
         for s, cv in enumerate(self._slot_cv):
@@ -2784,9 +2794,18 @@ class Session:
         self._canary_stop.set()
         self._canary.join(timeout=2.0)
         self._pool.shutdown(wait=True)
-        for p in self._member_pools.values():
+        if self._canary_buf is not None:
+            try:
+                self._canary_buf.close()
+            except BufferError:
+                pass  # a late canary still holds a view; dropped with it
+        # swap the pool map out under the swap lock (scale-out mutates it
+        # there), but shut the pools down outside it: a draining worker
+        # may need the lane lock to finish
+        with self._lane_lock:
+            pools, self._member_pools = self._member_pools, {}
+        for p in pools.values():
             p.shutdown(wait=True)
-        self._member_pools = {}
         # detach close hooks from long-lived (pool) buffers so a closed
         # session is not pinned in their callback lists; the engine close
         # below frees every kernel-side fixed slot wholesale
@@ -2809,7 +2828,9 @@ class Session:
         # engines retired by lane scale-out: every batch they accepted has
         # drained (pool shutdown above joins the awaiters), so reap any
         # residue, fold their remaining counters, and free them
-        for old in self._old_engines:
+        with self._lane_lock:
+            olds, self._old_engines = self._old_engines, []
+        for old in olds:
             try:
                 old.reap(timeout_ms=2000)
                 if _trace.active:
@@ -2818,7 +2839,6 @@ class Session:
                 old.close()
             except Exception:
                 pass
-        self._old_engines = []
         return reaped
 
     def __enter__(self):

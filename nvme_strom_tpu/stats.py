@@ -356,7 +356,9 @@ class StatRegistry:
             return
         import atexit
         self.start_export(pid_export_path())
-        if not getattr(self, "_cleanup_registered", False):
+        with self._lock:
+            if getattr(self, "_cleanup_registered", False):
+                return
             self._cleanup_registered = True
 
             def cleanup():
@@ -372,8 +374,6 @@ class StatRegistry:
         concurrently-running ``tpu_stat`` can watch, like ``nvme_stat``
         watching the kernel counters."""
         path = path or DEFAULT_STAT_EXPORT
-        if getattr(self, "_exporter", None):
-            return
         stop = threading.Event()
 
         def loop():
@@ -381,7 +381,13 @@ class StatRegistry:
                 self.export(path)
 
         t = threading.Thread(target=loop, daemon=True, name="strom-stat-export")
-        self._exporter = (t, stop, path)
+        # atomic test-and-set: two racing callers (session construction vs
+        # a tool's explicit start) must not spawn two exporter threads
+        # both rewriting the same file (the PR 7 snapshot-race shape)
+        with self._lock:
+            if getattr(self, "_exporter", None):
+                return
+            self._exporter = (t, stop, path)
         t.start()
 
     def stop_export(self) -> None:
@@ -392,12 +398,14 @@ class StatRegistry:
         last write, leaving the export file stale or absent (the round-1
         flake).  Joining then exporting inline makes the file's final
         content a postcondition of stop_export()."""
-        exp = getattr(self, "_exporter", None)
+        with self._lock:
+            exp, self._exporter = getattr(self, "_exporter", None), None
         if exp:
+            # join OUTSIDE the lock: the exporter loop's export() takes
+            # it for the snapshot, and a held lock would deadlock here
             t, stop, path = exp
             stop.set()
             t.join(timeout=5.0)
-            self._exporter = None
             self.export(path)
 
     def add_export_hook(self, fn) -> None:
@@ -406,15 +414,16 @@ class StatRegistry:
         right before each publish — without it an io_uring-backed
         workload would export zeros until stat_info/close (found driving
         `tpu_stat -l` against an unmodified workload, round 5)."""
-        hooks = getattr(self, "_export_hooks", None)
-        if hooks is None:
-            hooks = self._export_hooks = []
-        if fn not in hooks:
-            hooks.append(fn)
+        with self._lock:
+            hooks = getattr(self, "_export_hooks", None)
+            if hooks is None:
+                hooks = self._export_hooks = []
+            if fn not in hooks:
+                hooks.append(fn)
 
     def export(self, path: str = None) -> None:
         path = path or DEFAULT_STAT_EXPORT
-        for fn in getattr(self, "_export_hooks", ()):
+        for fn in list(getattr(self, "_export_hooks", ())):
             try:
                 fn()
             except Exception:   # noqa: BLE001 — publish must not die

@@ -61,6 +61,7 @@ def check_io_uring() -> bool:
                        "threadpool backend will be used instead")
     # io_uring itself is proven at this point: a probe-only failure must
     # degrade to "no fixed buffers", never misreport io_uring as absent
+    probe = None
     try:
         import ctypes
         import mmap
@@ -77,6 +78,11 @@ def check_io_uring() -> bool:
         fixed = f"fixed-buffer probe failed ({e}): plain opcodes"
     finally:
         eng.close()
+        if probe is not None:
+            try:
+                probe.close()
+            except BufferError:
+                pass   # from_buffer export still alive; dropped with it
     return _report("io_uring", OK, f"available; {fixed}")
 
 
@@ -144,6 +150,44 @@ def check_native_signature() -> bool:
         return _report("signature", WARN, f"python {__version__}, no native .so",
                        "make -C csrc")
     return _report("signature", OK, f"python {__version__}; {sig}")
+
+
+def check_abi() -> bool:
+    """Native ABI drift — stromlint's ``abi.drift`` rule at startup
+    (satellite of the stromlint PR): cross-check the ctypes bindings
+    against ``csrc/strom_tpu.h`` and the loaded .so's reported API
+    version, so a stale build is diagnosed HERE instead of surfacing as
+    a corrupted submit at first I/O."""
+    from .. import _native
+    from ..analysis.abi import check_bindings_source, parse_header
+    from ..analysis.core import SourceFile
+    hdr_path = os.path.join(_native._CSRC, "strom_tpu.h")
+    if not os.path.exists(hdr_path):
+        return _report("native abi", WARN,
+                       "csrc/strom_tpu.h not present (installed without "
+                       "sources): drift check skipped")
+    with open(hdr_path, "r", encoding="utf-8") as fh:
+        abi = parse_header(fh.read())
+    with open(_native.__file__, "r", encoding="utf-8") as fh:
+        src = SourceFile("nvme_strom_tpu/_native/__init__.py", fh.read())
+    findings = check_bindings_source(src, abi)
+    if findings:
+        for f in findings[:5]:
+            print(f"       {f.path}:{f.line} {f.message}")
+        return _report("native abi", FAIL,
+                       f"{len(findings)} ctypes/header drift(s)",
+                       "bindings no longer match csrc/strom_tpu.h — run "
+                       "strom_lint --rule abi and fix before trusting I/O")
+    want = abi.defines.get("NSTPU_API_VERSION")
+    got = _native.native_api_version()
+    if got is not None and want is not None and got != want:
+        return _report("native abi", FAIL,
+                       f"loaded .so reports api v{got}, header is "
+                       f"v{want}: stale build",
+                       "rebuild it: make -C csrc")
+    so = f", .so api v{got}" if got is not None else ", no .so loaded"
+    return _report("native abi", OK,
+                   f"bindings match strom_tpu.h (api v{want}){so}")
 
 
 def check_jax(timeout_s: float = 45.0) -> bool:
@@ -255,7 +299,7 @@ def main(argv=None) -> int:
                lambda: check_odirect(args.path),
                lambda: check_backing(args.path),
                check_hugepages, check_memlock, check_numa,
-               check_native_signature, check_backend_latch):
+               check_native_signature, check_abi, check_backend_latch):
         ok = fn() and ok
     if args.jax:
         ok = check_jax() and ok

@@ -49,6 +49,44 @@ MAX_FAILURE_DUMPS = 8
 #: (ts_ns, dur_ns|None, name, trace_id, member, lane, offset, length, args|None)
 _TS, _DUR, _NAME, _TID, _MEMBER, _LANE, _OFF, _LEN, _ARGS = range(9)
 
+#: the recorder's event-kind contract: every event name emitted anywhere
+#: in the package, mapped to how it records — "span" (has a duration),
+#: "instant" (a point), or "any" (legitimately emitted both ways).
+#: stromlint's surface.trace-* rules enforce this map in both directions:
+#: an emission missing here fails the lint, and an entry nothing emits is
+#: stale.  Names ending in ``_begin``/``_end`` must pair.
+EVENT_SCHEMA: Dict[str, str] = {
+    # task pipeline spans
+    "plan": "span",              # planner builds the request list
+    "nvme": "span",              # one extent's device service window
+    "extent": "span",            # python-pool extent service
+    "wait": "span",              # caller's wait window
+    "writeback": "span",         # write path device window
+    "landing": "span",           # direct/staged H2D landing
+    "staging_retire": "span",    # staging buffer retire/copy
+    "cache_hit": "span",         # residency-tier memcpy service
+    "cache_fill": "span",        # residency-tier slab fill
+    "hedge_won": "span",         # hedge leg that delivered the extent
+    # mirror reads are a span on the python pool path (service window)
+    # and an instant on the native path (completion attribution)
+    "mirror_read": "any",
+    # point events
+    "submit": "instant",         # task accepted
+    "native_submit": "instant",  # handed to the native engine
+    "task_failed": "instant",
+    "task_timeout": "instant",
+    "retry": "instant",
+    "route_away": "instant",     # unhealthy member avoided at plan time
+    "fallback_buffered": "instant",
+    "hedge_issued": "instant",
+    "hedge_cancelled": "instant",
+    "csum_fail": "instant",
+    "health": "instant",         # member health-machine transition
+    "landing_fallback": "instant",
+    "cache_evict": "instant",
+    "cache_invalidate": "instant",
+}
+
 
 def trace_dir() -> str:
     """Directory flight-recorder dumps land in (``STROM_TRACE_DIR`` env,
