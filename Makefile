@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos test check bench-smoke bench-stripe trace-gate landing-gate cache-gate probe-loop lint-strom sanitize sanitize-smoke clean
+.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -27,6 +27,14 @@ stress-faults:
 chaos:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.chaos
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m chaos
+
+# Write-side survival gate (ISSUE 11): seeded write-path fail-stop with
+# mirror failover + dirty-extent resync replay, ENOSPC first-error latch,
+# torn-mirror heal under write_verify, and SIGKILL-mid-save checkpoint
+# crash consistency (strom_ckpt verify rides inside).  Same seed knobs.
+chaos-write:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.chaos write
+	JAX_PLATFORMS=cpu python -m pytest tests/test_write_faults.py -q -m faults
 
 STRESS_FILE := /tmp/strom_stress_src.bin
 
@@ -134,7 +142,7 @@ sanitize-smoke:
 # then tier-1 tests plus the perf smokes, the seeded member-survival
 # schedules, the trace-overhead, landing and cache gates, and the
 # short sanitizer pass.
-check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos trace-gate landing-gate cache-gate
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

@@ -286,6 +286,15 @@ STAT_FIELDS: Tuple[str, ...] = (
     #                           coherency
     "bytes_cache_hit",        # payload bytes served from the tier
     "cache_resident_bytes",   # gauge: bytes currently resident
+    # mirror-coherent write ladder (ISSUE 11): the RAM->SSD leg fans out
+    # to paired mirrors, degrades to mirror-only with a dirty-extent
+    # resync journal, and (optionally) read-back-verifies at wait time
+    "nr_mirror_write",        # mirror-partner write legs landed
+    "nr_write_retry",         # write attempts re-driven (transient retry
+    #                           or native-completion failover to the pool)
+    "nr_resync_extent",       # journal extents replayed onto a rejoiner
+    "nr_write_verify_fail",   # write_verify read-back crc32c mismatches
+    "resync_pending_bytes",   # gauge: dirty-extent bytes awaiting resync
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",
@@ -313,7 +322,7 @@ class StatInfo:
         d = {k: new.counters.get(k, 0) - old.counters.get(k, 0) for k in new.counters}
         # gauges are point-in-time, not deltas
         for g in ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
-                  "cache_resident_bytes"):
+                  "cache_resident_bytes", "resync_pending_bytes"):
             if g in new.counters:
                 d[g] = new.counters[g]
         return StatInfo(version=new.version, has_debug=new.has_debug,

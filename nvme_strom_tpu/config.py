@@ -344,7 +344,15 @@ class Config:
                 help="token-bucket refill rate (direct reads per second) "
                      "allowed onto a REJOINING member during warmup; "
                      "requests past the bucket ride the mirror/buffered "
-                     "path (0 = no throttle: rejoin at full rate)"))
+                     "path (0 = no throttle: rejoin at full rate).  The "
+                     "dirty-extent resync replay draws from the same "
+                     "bucket, so it doubles as the resync budget"))
+        reg(Var("write_verify", False, "bool",
+                help="read each retired aligned write leg back at wait "
+                     "time and compare crc32c against the submitted "
+                     "bytes; a mismatch (torn or misdirected write) "
+                     "latches EBADMSG.  Costs one extra read per write "
+                     "leg; legs journaled for resync are skipped"))
         reg(Var("join_build_host_max", 256 << 20, "size", minval=1 << 12,
                 help="largest on-disk build-side table loaded whole "
                      "(one projection scan) when partitioning a join "

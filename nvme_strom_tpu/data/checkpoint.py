@@ -14,10 +14,18 @@ On-disk layout (single file)::
     [ leaf 0 bytes, padded to 4096 ]
     [ leaf 1 bytes, padded to 4096 ] ...
 
-Header json: ``{version, leaves: [{key, dtype, shape, offset, nbytes}]}``.
-Leaf offsets are 4096-aligned so restores ride the O_DIRECT path with a
-4KB chunk grid that the planner merges into ``dma_max_size`` requests
-(`engine.plan_requests`).
+Header json: ``{version, leaves: [{key, dtype, shape, offset, nbytes,
+crc32c?}]}``.  Leaf offsets are 4096-aligned so restores ride the O_DIRECT
+path with a 4KB chunk grid that the planner merges into ``dma_max_size``
+requests (`engine.plan_requests`).
+
+``crc32c`` (ISSUE 11) is the per-leaf checksum of the exact serialized
+bytes (padding excluded), written by :func:`save_checkpoint`;
+``restore_checkpoint(verify=True)`` and ``strom_ckpt verify`` recompute it
+so a torn write, bit rot, or a truncated leaf surfaces as EBADMSG instead
+of silently-wrong weights.  Sharded saves omit it (no process sees a whole
+leaf), so verification is when-present: headers without the key — older
+files or sharded saves — still restore.
 """
 
 from __future__ import annotations
@@ -36,6 +44,7 @@ from ..api import StromError
 from ..cache import residency_cache
 from ..engine import Session, open_source, read_chunk_ids
 from ..hbm.staging import default_device, safe_device_put
+from ..scan.heap import crc32c as _leaf_crc, crc32c_update as _leaf_crc_update
 
 __all__ = ["save_checkpoint", "save_checkpoint_sharded",
            "restore_checkpoint", "checkpoint_info"]
@@ -109,6 +118,11 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
                              f"process; gather before saving, or use "
                              f"save_checkpoint_sharded")
     entries = _entries_for(flat)
+    # per-leaf crc32c (ISSUE 11): the header precedes the data on disk,
+    # so checksums come from a pre-pass — one leaf materialized at a
+    # time, the same peak host memory as the writer loop below
+    for e, (key, leaf) in zip(entries, flat):
+        e["crc32c"] = _leaf_crc(_leaf_bytes(leaf, e))
     header = json.dumps({"version": _VERSION, "leaves": entries}).encode()
     header_len = _pad(16 + len(header))
     end = header_len + (entries[-1]["offset"] + _pad(entries[-1]["nbytes"])
@@ -148,10 +162,7 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
                 # leaf
                 for e, (key, leaf) in zip(entries, flat):
                     f.seek(header_len + e["offset"])
-                    arr = np.ascontiguousarray(np.asarray(leaf))
-                    if arr.dtype.str != e["dtype"]:
-                        arr = arr.astype(np.dtype(e["dtype"]))
-                    f.write(arr.data if arr.shape else arr.tobytes())
+                    f.write(_leaf_bytes(leaf, e))
             f.truncate(_pad(end))
             f.flush()
             os.fsync(f.fileno())
@@ -230,6 +241,15 @@ def _pwrite_all(fd: int, data, off: int) -> None:
             raise StromError(_errno.EIO,
                             f"pwrite returned {n} at offset {off + done}")
         done += n
+
+
+def _leaf_bytes(leaf, e: Dict):
+    """The exact bytes entry *e*'s leaf serializes to — shared by the
+    checksum pre-pass and the buffered writer so they cannot diverge."""
+    arr = np.ascontiguousarray(np.asarray(leaf))
+    if arr.dtype.str != e["dtype"]:
+        arr = arr.astype(np.dtype(e["dtype"]))
+    return arr.data if arr.shape else arr.tobytes()
 
 
 def _entries_for(flat) -> List[Dict]:
@@ -522,7 +542,8 @@ _INT32_MAX = (1 << 31) - 1
 
 
 def _restore_streamed(sess, source, base: int, dtype: np.dtype,
-                      shape, dev, ring: _PinnedRing):
+                      shape, dev, ring: _PinnedRing,
+                      compute_crc: bool = False):
     """Stream a leaf larger than one staging buffer straight onto the
     device: each staged sub-span lands with a donated
     ``dynamic_update_slice`` into the preallocated device leaf — no
@@ -562,6 +583,7 @@ def _restore_streamed(sess, source, base: int, dtype: np.dtype,
         return dest
 
     done = 0
+    crc = 0
     while done < nbytes:
         take = min(ring.cap, nbytes - done)
         # element-align every take (a staging buffer not divisible by the
@@ -569,6 +591,10 @@ def _restore_streamed(sess, source, base: int, dtype: np.dtype,
         # take is nbytes - done, already element-aligned by induction
         take -= take % dtype.itemsize
         view = _read_span(sess, source, base + done, take, ring)
+        if compute_crc:
+            # incremental: sub-spans are sequential and exhaustive, so
+            # the running crc equals the whole-leaf checksum at the end
+            crc = _leaf_crc_update(crc, view)
         chunk = ring.put(view.view(dtype), dev)
         if pending and pending[0][0].shape != chunk.shape:
             # a shape change (final short span) would force a fresh
@@ -579,12 +605,13 @@ def _restore_streamed(sess, source, base: int, dtype: np.dtype,
             dest = flush(dest)
         done += take
     dest = flush(dest)
-    return dest.reshape(shape)
+    return dest.reshape(shape), (crc if compute_crc else None)
 
 
 def restore_checkpoint(path: str, *, shardings=None, like=None,
                        session: Optional[Session] = None,
-                       device=None, staging_bytes: int = 64 << 20):
+                       device=None, staging_bytes: int = 64 << 20,
+                       verify: bool = False):
     """Load a checkpoint into device arrays through the direct path.
 
     ``shardings`` — None (single device, see *device*), one
@@ -594,6 +621,12 @@ def restore_checkpoint(path: str, *, shardings=None, like=None,
     multi-host restore only touches local shards.  ``like`` — optional
     pytree with the same structure used to rebuild the tree shape (by
     default a flat ``{key: array}`` dict is returned).
+
+    ``verify=True`` recomputes each leaf's crc32c from the bytes actually
+    read and compares it against the header's per-leaf checksum —
+    corruption latches EBADMSG naming the leaf.  When-present semantics:
+    leaves without a stored checksum (sharded saves, older files) and
+    sharded restores (no process reads a whole leaf) are skipped.
     """
     import jax
 
@@ -616,21 +649,33 @@ def restore_checkpoint(path: str, *, shardings=None, like=None,
                     shape = tuple(e["shape"])
                     base = data0 + e["offset"]
                     sh = _leaf_sharding(shardings, key)
+                    want = e.get("crc32c") if verify else None
                     if sh is None:
                         dev = device or default_device()
                         n_elems = int(e["nbytes"]) // dtype.itemsize
                         if (e["nbytes"] > ring.cap
                                 and ring.cap >= dtype.itemsize
                                 and n_elems <= _INT32_MAX):
-                            out[key] = _restore_streamed(
+                            out[key], got = _restore_streamed(
                                 sess, source, base, dtype, shape, dev,
-                                ring)
+                                ring, compute_crc=want is not None)
                         else:
-                            host = _read_span(sess, source, base,
-                                              e["nbytes"],
-                                              ring).view(dtype)
+                            span = _read_span(sess, source, base,
+                                              e["nbytes"], ring)
+                            got = _leaf_crc(span) if want is not None \
+                                else None
+                            host = span.view(dtype)
                             out[key] = ring.put(host.reshape(shape), dev)
+                        if want is not None and got != want:
+                            raise StromError(
+                                _errno.EBADMSG,
+                                f"{path}: leaf {key} crc32c mismatch "
+                                f"(header {want:#010x}, data {got:#010x})"
+                                f" — checkpoint is corrupt")
                     else:
+                        # sharded restores read only local row ranges —
+                        # no process sees a whole leaf, so per-leaf crc
+                        # verification cannot run here
                         out[key] = _restore_sharded(sess, source, base, dtype,
                                                     shape, sh, ring)
             finally:

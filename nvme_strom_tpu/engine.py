@@ -45,7 +45,8 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 from .api import (BufferInfo, DmaTaskState, ErrorClass, FileInfo, FsKind,
                   MemCopyResult, StromError)
 from .config import config
-from .fault import HealthState, MemberHealthMachine, RetryPolicy
+from .fault import (DirtyExtentJournal, HealthState, MemberHealthMachine,
+                    RetryPolicy)
 from .log import pr_info, pr_warn
 from .eligibility import probe_backing
 from .stats import stats
@@ -662,10 +663,10 @@ class StripedSource(Source):
                  mirror: Optional[str] = None):
         if mirror is None:
             mirror = str(config.get("mirror"))
-        if writable and mirror == "paired":
-            raise StromError(_errno.EINVAL,
-                             "mirror='paired' is read-path only: the write "
-                             "planner does not replicate to pair partners")
+        # mirror='paired' + writable is first-class since ISSUE 11: the
+        # engine fans each aligned write leg out to the pair partner
+        # (mirror-coherent writes), so written stripes keep the degraded-
+        # mode read guarantees instead of silently losing their replica
         self.members = [_FileMember(p, writable) for p in paths]
         self.map = StripeMap([m.size for m in self.members],
                              stripe_chunk_size, mirror=mirror)
@@ -1063,7 +1064,7 @@ class DmaTask:
     __slots__ = ("task_id", "state", "errno_", "errmsg", "pending", "frozen",
                  "result", "t_submit", "buf_handle", "deadline", "expired",
                  "verify_src", "verify_dest", "verify_reqs", "trace_id",
-                 "cache_fill", "cache_invalidate")
+                 "cache_fill", "cache_invalidate", "write_verify")
 
     def __init__(self, task_id: int, deadline_s: float = 0.0):
         self.task_id = task_id
@@ -1093,6 +1094,9 @@ class DmaTask:
         # extents to re-invalidate once the write has retired
         self.cache_fill: Optional[tuple] = None
         self.cache_invalidate: Optional[tuple] = None
+        # write_verify (ISSUE 11): (sink, reqs, src view) for the wait-time
+        # read-back crc32c check on retired write tasks
+        self.write_verify: Optional[tuple] = None
 
 
 class Session:
@@ -1145,6 +1149,11 @@ class Session:
         self._retry = RetryPolicy.from_config()
         self._member_health = MemberHealthMachine()
         self._retry_rng = random.Random(os.getpid() ^ id(self))
+        # mirror-coherent writes (ISSUE 11): extents a degraded member
+        # missed, replayed mirror->rejoiner by the canary thread before
+        # the health machine lets the member back to HEALTHY
+        self._resync = DirtyExtentJournal()
+        self._member_health.attach_resync(self._resync)
         # resilience tier (PR 6): striped sources seen by submits, probed
         # by the background canary thread while any member is FAILED or
         # REJOINING (weak: canaries must never keep a closed source alive)
@@ -1412,6 +1421,11 @@ class Session:
             cands = self._member_health.canary_candidates()
             if not cands:
                 continue
+            # dirty-extent resync first (ISSUE 11): drain what a
+            # REJOINING member owes before the probes below advance its
+            # warmup — the machine refuses HEALTHY while bytes are owed,
+            # so ordering is a latency nicety, not a correctness hinge
+            self._resync_replay(cands)
             for src in list(self._canary_sources):
                 nmem = len(getattr(src, "members", ()))
                 for m in cands:
@@ -1440,6 +1454,116 @@ class Session:
             return       # a broken probe must never kill the thread
         else:
             self._member_health.record_canary(member, True)
+
+    def _journal_skipped(self, sink: Source, member: int, file_off: int,
+                         length: int, trace_id: int = 0) -> None:
+        """Record an extent a degraded member missed (the write landed
+        only on its mirror partner) in the resync journal."""
+        self._resync.record(sink, member, file_off, length)
+        if _trace.active:
+            _trace.instant("resync_skip", tid=trace_id,
+                           member=member, offset=file_off, length=length)
+
+    def _resync_replay(self, members: Sequence[int]) -> None:
+        """Replay journaled dirty extents onto REJOINING members:
+        read-from-mirror -> write-to-rejoiner, throttled by the member's
+        rejoin token bucket (the resync budget).  Runs on the canary
+        thread; a replay failure re-journals the extent and debits the
+        failing member, so debt never silently evaporates."""
+        health = self._member_health
+        jrn = self._resync
+        for member in members:
+            if member not in jrn.members():
+                continue
+            if health.state(member) is not HealthState.REJOINING:
+                continue
+            for ref in jrn.sink_refs(member):
+                sink = ref()
+                if sink is None:
+                    continue
+                mirror = sink.mirror_of(member)
+                if mirror is None:    # mirror map changed under the debt:
+                    jrn.drop_sink(ref)  # nothing to replay from
+                    continue
+                while not self._canary_stop.is_set():
+                    if not health.take_rejoin_token(member):
+                        break          # budget spent; next canary tick
+                    ext = jrn.take_extent(ref, member)
+                    if ext is None:
+                        break
+                    off, length = ext
+                    if not self._replay_extent(sink, mirror, member,
+                                               off, length):
+                        break
+
+    def _replay_extent(self, sink: Source, mirror: int, member: int,
+                       file_off: int, length: int) -> bool:
+        """One resync extent: mirror's bytes -> rejoiner.  Aligned spans
+        ride the direct legs; misaligned (buffered-leg) debt rides the
+        buffered legs.  Returns False when replay must pause."""
+        t0 = time.monotonic_ns()
+        # per-extent anonymous scratch (page-aligned, so the direct legs
+        # accept it); its cost is noise next to the replayed I/O, and a
+        # local avoids sharing a cached buffer across threads
+        sz = max(length, PAGE_SIZE)
+        sz = (sz + PAGE_SIZE - 1) // PAGE_SIZE * PAGE_SIZE
+        scratch = mmap.mmap(-1, sz)
+        mv = memoryview(scratch)[:length]
+        try:
+            return self._replay_extent_into(
+                sink, mirror, member, file_off, length, mv, t0)
+        finally:
+            mv.release()
+            scratch.close()
+
+    def _replay_extent_into(self, sink: Source, mirror: int, member: int,
+                            file_off: int, length: int, buf: memoryview,
+                            t0: int) -> bool:
+        bs = max(int(getattr(sink, "block_size", 512)), 512)
+        aligned = file_off % bs == 0 and length % bs == 0
+        try:
+            if aligned:
+                sink.read_member_direct(mirror, file_off, buf)
+            else:
+                sink.read_member_buffered(mirror, file_off, buf)
+        except (StromError, OSError) as e:
+            if getattr(e, "errno", None) == _errno.EBADF:
+                return False   # sink closed under the replay
+            se = e if isinstance(e, StromError) else \
+                StromError(e.errno or _errno.EIO, str(e))
+            self._member_health.record_failure(
+                mirror, fatal=se.error_class is ErrorClass.PERSISTENT)
+            stats.member_error(mirror)
+            self._resync.put_back(sink, member, file_off, length)
+            return False
+        except Exception:
+            self._resync.put_back(sink, member, file_off, length)
+            return False
+        try:
+            if aligned:
+                sink.write_member_direct(member, file_off, buf)
+            else:
+                sink.write_member_buffered(member, file_off, buf)
+        except (StromError, OSError) as e:
+            if getattr(e, "errno", None) == _errno.EBADF:
+                return False
+            se = e if isinstance(e, StromError) else \
+                StromError(e.errno or _errno.EIO, str(e))
+            self._member_health.record_failure(
+                member, fatal=se.error_class is ErrorClass.PERSISTENT)
+            stats.member_error(member)
+            self._resync.put_back(sink, member, file_off, length)
+            return False
+        except Exception:
+            self._resync.put_back(sink, member, file_off, length)
+            return False
+        stats.add("nr_resync_extent")
+        stats.member_add(member, length, time.monotonic_ns() - t0)
+        if _trace.active:
+            _trace.span("resync", t0, time.monotonic_ns(), member=member,
+                        offset=file_off, length=length,
+                        args={"mirror": mirror})
+        return True
 
     def _task_get(self, task: DmaTask) -> None:
         s = self._slot_of(task.task_id)
@@ -1544,6 +1668,15 @@ class Session:
             skey, extents = task.cache_invalidate
             task.cache_invalidate = None
             _rcache.invalidate_extents(skey, extents)
+        if task.write_verify is not None:
+            # write_verify (ISSUE 11): read each retired write leg back
+            # and compare crc32c against the submitted bytes — a torn or
+            # misdirected write surfaces HERE, at the durability boundary,
+            # instead of on some future read.  Runs on the reaped slot,
+            # off the submission critical path, like verify_reqs above.
+            wsink, wreqs, wsrc = task.write_verify
+            task.write_verify = None
+            self._verify_writes(wsink, wreqs, wsrc, task)
         assert task.result is not None
         return task.result
 
@@ -1935,6 +2068,14 @@ class Session:
             with stats.stage("setup_prps"):
                 reqs = plan_requests(sink, [(cid, i) for i, cid in enumerate(chunk_ids)],
                                      chunk_size, src_offset)
+            if len(getattr(sink, "members", ())) > 1:
+                # written striped sinks become canary targets too
+                # (ISSUE 11): the canary thread replays their dirty-extent
+                # resync journal while a degraded member rejoins
+                self._canary_sources.add(sink)
+            if config.get("write_verify"):
+                # wait-time read-back verification rides the retired task
+                task.write_verify = (sink, list(reqs), src)
             # GIL-free write leg, mirroring the read path's native branch
             # (fakes overriding the write leg keep the Python path so
             # fault injection still works)
@@ -1945,26 +2086,53 @@ class Session:
             if use_native:
                 self._ensure_member_lanes(sink)
                 fds = sink.member_fds()
+                health = self._member_health
                 native_reqs = []
                 native_members = []
-                native_rs = []
+                native_rs = []      # unique planned requests riding native
+                n_mirror_legs = 0
                 for r in reqs:
-                    if r.buffered or fds[r.member] < 0:
-                        # misaligned tails: synchronous buffered write,
-                        # accounted like the pool path
-                        tb = time.monotonic_ns()
-                        sink.write_member_buffered(
-                            r.member, r.file_off,
-                            src[r.dest_off:r.dest_off + r.length])
-                        stats.member_add(r.member, r.length,
-                                         time.monotonic_ns() - tb)
-                        stats.count_clock("submit_dma", 0)
-                        stats.add("total_dma_length", r.length)
-                    else:
-                        native_reqs.append((fds[r.member], r.file_off,
+                    mirror = sink.mirror_of(r.member)
+                    if r.buffered or fds[r.member] < 0 or \
+                            (mirror is not None and fds[mirror] < 0):
+                        # misaligned tails (and legs without a direct fd)
+                        # ride the pool ladder (ISSUE 11) — transient
+                        # retry, cancellation-on-latch and mirror fan-out
+                        # instead of the old unpoliced synchronous write
+                        pool_reqs.append(r)
+                        continue
+                    # mirror-coherent fan-out: each aligned leg lands on
+                    # primary + pair partner; a member the health machine
+                    # routes away is skipped and journaled for resync
+                    legs = [(r.member, None)]
+                    if mirror is not None:
+                        away_p = health.routes_away(r.member)
+                        away_m = health.routes_away(mirror)
+                        if away_p and not away_m:
+                            self._journal_skipped(sink, r.member,
+                                                  r.file_off, r.length,
+                                                  task.trace_id)
+                            legs = [(mirror, r.member)]
+                        elif away_m and not away_p:
+                            self._journal_skipped(sink, mirror,
+                                                  r.file_off, r.length,
+                                                  task.trace_id)
+                        else:
+                            legs.append((mirror, r.member))
+                    native_rs.append(r)
+                    for m, covered in legs:
+                        if covered is not None:
+                            n_mirror_legs += 1
+                            if _trace.active and task.trace_id:
+                                _trace.instant("mirror_write",
+                                               tid=task.trace_id,
+                                               member=covered,
+                                               offset=r.file_off,
+                                               length=r.length,
+                                               args={"mirror": m})
+                        native_reqs.append((fds[m], r.file_off,
                                             r.length, r.dest_off))
-                        native_members.append(r.member)
-                        native_rs.append(r)
+                        native_members.append(m)
                 if native_reqs:
                     try:
                         self._members_used.update(native_members)
@@ -1976,8 +2144,9 @@ class Session:
                                          members=native_members)
                         self._task_get(task)
                         try:
-                            self._pool.submit(self._await_native, task, nat,
-                                              nid)
+                            self._pool.submit(
+                                self._await_native, task, nat, nid,
+                                (sink, native_rs, src, n_mirror_legs))
                         except BaseException as e:
                             self._task_put(task, StromError(
                                 _errno.ESHUTDOWN, str(e)))
@@ -1988,7 +2157,7 @@ class Session:
                         stats.add("nr_backend_fallback")
                         pr_warn("native write submit failed (%s); batch "
                                 "falls back to the python pool path", e)
-                        pool_reqs = native_rs
+                        pool_reqs.extend(native_rs)
             for r in pool_reqs:
                 self._task_get(task)
                 cur = stats.gauge_add("cur_dma_count", 1)
@@ -2028,18 +2197,64 @@ class Session:
             stats.gauge_add("cur_dma_count", -1)
             self._task_put(task, None)
             return
+        err = self._write_request_resilient(task, sink, r, src)
+        stats.gauge_add("cur_dma_count", -1)
+        self._task_put(task, err)
+
+    def _write_request_resilient(self, task: DmaTask, sink: Source,
+                                 r: Request, src: memoryview
+                                 ) -> Optional[StromError]:
+        """One write request through the full ladder (ISSUE 11, the
+        write-side peer of :meth:`_read_direct_resilient`): paired sinks
+        fan out to primary + mirror partner — both must land before the
+        task retires; a member the health machine routes away (or that
+        fails mid-stream and latches off the direct path) degrades the
+        write to mirror-only with the missed extent journaled for rejoin
+        resync.  Returns the error to latch, or None."""
         err: Optional[StromError] = None
         t0 = time.monotonic_ns()
-        attempt = 0
         try:
             piece = src[r.dest_off:r.dest_off + r.length]
+            mirror = sink.mirror_of(r.member)
+            if mirror is None:
+                self._write_leg(task, sink, r, r.member, piece)
+            else:
+                err = self._write_mirrored(task, sink, r, mirror, piece)
+        except StromError as e:
+            err = e
+        except BaseException as e:
+            err = StromError(_errno.EIO, f"unexpected write failure: {e!r}")
+        finally:
+            elapsed = time.monotonic_ns() - t0
+            if _trace.active and task.trace_id:
+                eargs: dict = {"write": True}
+                if r.buffered:
+                    eargs["buffered"] = True
+                if err is not None:
+                    eargs["errno"] = err.errno
+                _trace.span("extent", t0, t0 + elapsed, tid=task.trace_id,
+                            member=r.member, offset=r.file_off,
+                            length=r.length, args=eargs)
+        return err
+
+    def _write_leg(self, task: DmaTask, sink: Source, r: Request,
+                   member: int, piece: memoryview) -> None:
+        """One write leg with transient retry; failures debit the health
+        machine with the read-side taxonomy (ENOSPC/EDQUOT/EROFS are
+        PERSISTENT: first-error latch, never a retry storm) and successes
+        feed latency into suspect detection + the member's adaptive
+        sizer, so write-only traffic drives the ladder too."""
+        health = self._member_health
+        attempt = 0
+        t0 = time.monotonic_ns()
+        try:
             while True:
                 try:
                     if r.buffered:
-                        sink.write_member_buffered(r.member, r.file_off,
+                        sink.write_member_buffered(member, r.file_off,
                                                    piece)
                     else:
-                        sink.write_member_direct(r.member, r.file_off,
+                        sink.write_member_direct(member, r.file_off,
                                                  piece)
                     break
                 except (StromError, OSError) as e:
@@ -2051,19 +2266,156 @@ class Session:
                     if not se.transient or r.buffered \
                             or attempt >= self._retry.attempts \
                             or task.errno_:
+                        health.record_failure(
+                            member,
+                            fatal=se.error_class is ErrorClass.PERSISTENT)
+                        stats.member_error(member)
                         raise se
                     stats.add("nr_io_retry")
-                    stats.member_error(r.member, retried=True)
+                    stats.add("nr_write_retry")
+                    stats.member_error(member, retried=True)
+                    if _trace.active and task.trace_id:
+                        _trace.instant("retry", tid=task.trace_id,
+                                       member=member,
+                                       args={"attempt": attempt + 1,
+                                             "errno": se.errno,
+                                             "write": True})
                     self._retry.sleep(attempt, self._retry_rng)
                     attempt += 1
-        except StromError as e:
-            err = e
-        except BaseException as e:
-            err = StromError(_errno.EIO, f"unexpected write failure: {e!r}")
         finally:
-            stats.member_add(r.member, r.length, time.monotonic_ns() - t0)
-            stats.gauge_add("cur_dma_count", -1)
-            self._task_put(task, err)
+            elapsed = time.monotonic_ns() - t0
+            stats.member_add(member, r.length, elapsed)
+        if not r.buffered:
+            stats.observe_latency(elapsed)
+            health.observe_latency(member, elapsed)
+            # write latencies feed the member's adaptive sizer too —
+            # created here under the same config gates as the read
+            # planner, so write-only traffic still shapes the next
+            # native plan's coalescing cap
+            if config.get("chunk_adaptive"):
+                climit = int(config.get("coalesce_limit"))
+                if climit:
+                    self._adaptive_cap(int(config.get("dma_max_size")),
+                                       climit, member)
+            szr = self._chunk_sizers.get(member)
+            if szr is not None:
+                szr.observe(elapsed)
+        health.record_success(member)
+
+    def _write_mirrored(self, task: DmaTask, sink: Source, r: Request,
+                        mirror: int, piece: memoryview
+                        ) -> Optional[StromError]:
+        """Mirror fan-out for one request on a paired sink.  Both legs
+        must land for a clean retire; a leg whose member routes away is
+        skipped up front and journaled, and a leg that fails mid-stream
+        *and* leaves its member routed away (quarantined/failed) degrades
+        the same way — the stream stays alive on the surviving replica.
+        A failure on a member still serving the direct path latches:
+        swallowing it would leave readable stale bytes with no resync
+        owner."""
+        health = self._member_health
+        away_p = health.routes_away(r.member)
+        away_m = health.routes_away(mirror)
+        do_p = do_m = True
+        if away_p and not away_m:
+            self._journal_skipped(sink, r.member, r.file_off, r.length,
+                                  task.trace_id)
+            do_p = False
+        elif away_m and not away_p:
+            self._journal_skipped(sink, mirror, r.file_off, r.length,
+                                  task.trace_id)
+            do_m = False
+        p_err = m_err = None
+        if do_p:
+            try:
+                self._write_leg(task, sink, r, r.member, piece)
+            except StromError as e:
+                p_err = e
+        if do_m:
+            tm = time.monotonic_ns()
+            try:
+                self._write_leg(task, sink, r, mirror, piece)
+            except StromError as e:
+                m_err = e
+            else:
+                stats.add("nr_mirror_write")
+                if _trace.active and task.trace_id:
+                    _trace.span("mirror_write", tm, time.monotonic_ns(),
+                                tid=task.trace_id, member=r.member,
+                                offset=r.file_off, length=r.length,
+                                args={"mirror": mirror})
+        if p_err is not None and m_err is None and do_m \
+                and health.routes_away(r.member):
+            self._journal_skipped(sink, r.member, r.file_off, r.length,
+                                  task.trace_id)
+            p_err = None
+        if m_err is not None and p_err is None and do_p \
+                and health.routes_away(mirror):
+            self._journal_skipped(sink, mirror, r.file_off, r.length,
+                                  task.trace_id)
+            m_err = None
+        return p_err or m_err
+
+    def _verify_writes(self, sink: Source, reqs: List[Request],
+                       src: memoryview, task: DmaTask) -> None:
+        """write_verify (ISSUE 11): read every retired write leg back
+        and compare crc32c against the submitted bytes.  Legs whose
+        member routes away were degraded + journaled for resync (the
+        bytes there are known-stale until replay), so they are skipped;
+        everything else must match or EBADMSG (CORRUPTION) raises — a
+        torn or misdirected write caught at the durability boundary
+        instead of on some future read."""
+        from .scan.heap import crc32c
+        health = self._member_health
+        scratch: Optional[mmap.mmap] = None
+        try:
+            for r in reqs:
+                want = crc32c(src[r.dest_off:r.dest_off + r.length])
+                members = [r.member]
+                mirror = sink.mirror_of(r.member)
+                if mirror is not None:
+                    members.append(mirror)
+                for m in members:
+                    if health.routes_away(m):
+                        continue
+                    if r.buffered:
+                        back = bytearray(r.length)
+                        sink.read_member_buffered(m, r.file_off,
+                                                  memoryview(back))
+                        got = crc32c(back)
+                    else:
+                        if scratch is None or len(scratch) < r.length:
+                            if scratch is not None:
+                                scratch.close()
+                            sz = -(-r.length // mmap.PAGESIZE) \
+                                * mmap.PAGESIZE
+                            scratch = mmap.mmap(-1, sz)
+                        mv = memoryview(scratch)[:r.length]
+                        try:
+                            sink.read_member_direct(m, r.file_off, mv)
+                            got = crc32c(mv)
+                        finally:
+                            # release before any raise: an exported view
+                            # would make scratch.close() throw and mask
+                            # the verification error
+                            mv.release()
+                    stats.add("bytes_verify_reread", r.length)
+                    if got != want:
+                        stats.add("nr_write_verify_fail")
+                        if _trace.active and task.trace_id:
+                            _trace.instant("csum_fail", tid=task.trace_id,
+                                           member=m, offset=r.file_off,
+                                           length=r.length,
+                                           args={"write_verify": True})
+                        raise StromError(
+                            _errno.EBADMSG,
+                            f"write_verify: crc32c mismatch on member {m}"
+                            f" at file offset {r.file_off} ({r.length} "
+                            f"bytes): wrote {want:#010x}, read back "
+                            f"{got:#010x}")
+        finally:
+            if scratch is not None:
+                scratch.close()
 
     def _do_request(self, task: DmaTask, source: Source,
                     r: Request, dest: memoryview) -> None:
@@ -2477,7 +2829,8 @@ class Session:
                     piece[off:off + PAGE_SIZE])
             bad = verify_page_checksums(piece)
 
-    def _await_native(self, task: DmaTask, eng, native_id: int) -> None:
+    def _await_native(self, task: DmaTask, eng, native_id: int,
+                      write_ctx: Optional[tuple] = None) -> None:
         # *eng* is the engine that accepted the batch — NOT self._native,
         # which a lane scale-out may have swapped since submission
         err: Optional[StromError] = None
@@ -2510,6 +2863,30 @@ class Session:
             # per-lane event ring so the MEASURED device windows land in
             # the recorder close to their completion
             self._drain_native_trace(eng)
+        if write_ctx is not None:
+            sink, w_reqs, w_src, n_mirror = write_ctx
+            if err is None and not task.expired:
+                if n_mirror:
+                    stats.add("nr_mirror_write", n_mirror)
+            elif err is not None and not self._abandon_native \
+                    and not task.expired and not task.errno_:
+                # the native lane rejected or failed the write batch but
+                # the session is still live: redrive each request through
+                # the resilient pool ladder (per-leg retry, mirror
+                # degradation, journaling).  One batch ref covers the
+                # whole redrive; only the first residual error latches.
+                stats.add("nr_backend_fallback")
+                pr_warn("native write batch failed (%s); redriving %d "
+                        "request(s) on the pool ladder",
+                        err, len(w_reqs))
+                err = None
+                for r in w_reqs:
+                    if task.errno_:
+                        break
+                    stats.add("nr_write_retry")
+                    e2 = self._write_request_resilient(task, sink, r, w_src)
+                    if e2 is not None and err is None:
+                        err = e2
         self._task_put(task, err)
 
     def _drain_native_trace(self, eng=None) -> int:

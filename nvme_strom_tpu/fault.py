@@ -37,6 +37,7 @@ import enum
 import random
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -45,7 +46,7 @@ from .stats import LAT_HIST_BUCKETS, hist_percentiles, stats
 from .trace import recorder as _trace
 
 __all__ = ["RetryPolicy", "HealthState", "MemberHealthMachine",
-           "MemberHealth"]
+           "MemberHealth", "DirtyExtentJournal"]
 
 
 @dataclass(frozen=True)
@@ -121,6 +122,125 @@ _SUSPECT_MIN_SAMPLES = 32
 _SUSPECT_EVERY = 32
 
 
+#: replay granularity: merged journal intervals are consumed in chunks of
+#: this size so one token-bucket token maps to a bounded burst and the
+#: replay scratch buffer stays small
+_RESYNC_CHUNK = 1 << 20
+
+
+class DirtyExtentJournal:
+    """Per-member dirty-extent journal for mirror-coherent writes
+    (ISSUE 11).
+
+    When a write degrades to mirror-only because the health machine holds
+    a member QUARANTINED/FAILED, the extents the member *missed* are
+    recorded here (keyed by a weak sink reference so a closed sink drops
+    its debt).  The rejoin path replays them — read-from-mirror, write-to-
+    rejoiner — and :class:`MemberHealthMachine` refuses the
+    REJOINING→HEALTHY edge while a member still owes bytes, so a rejoined
+    disk never serves stale data.  Adjacent/overlapping records merge, so
+    rewriting one hot range while degraded journals it once.
+
+    The ``resync_pending_bytes`` gauge tracks journal content exactly:
+    :meth:`record` adds, :meth:`take_extent` subtracts, :meth:`put_back`
+    re-adds (replay failures don't leak debt).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # member -> sink weakref -> sorted disjoint [start, end) intervals
+        self._ext: Dict[int, Dict["weakref.ref", List[List[int]]]] = {}
+
+    def _drop_ref(self, ref: "weakref.ref") -> None:
+        dropped = 0
+        with self._lock:
+            for member in list(self._ext):
+                ivs = self._ext[member].pop(ref, None)
+                if ivs:
+                    dropped += sum(e - s for s, e in ivs)
+                if not self._ext[member]:
+                    del self._ext[member]
+        if dropped:
+            stats.gauge_add("resync_pending_bytes", -dropped)
+
+    def record(self, sink, member: int, file_off: int, length: int) -> None:
+        """Journal [file_off, file_off+length) as stale on *member*."""
+        if length <= 0:
+            return
+        start, end = int(file_off), int(file_off) + int(length)
+        with self._lock:
+            per = self._ext.setdefault(member, {})
+            ivs = None
+            for ref in per:
+                if ref() is sink:
+                    ivs = per[ref]
+                    break
+            if ivs is None:
+                ivs = per[weakref.ref(sink, self._drop_ref)] = []
+            before = sum(e - s for s, e in ivs)
+            merged: List[List[int]] = []
+            for s, e in ivs:
+                if e < start or s > end:
+                    merged.append([s, e])
+                else:
+                    start, end = min(start, s), max(end, e)
+            merged.append([start, end])
+            merged.sort()
+            ivs[:] = merged
+            added = sum(e - s for s, e in ivs) - before
+        if added:
+            stats.gauge_add("resync_pending_bytes", added)
+
+    def members(self) -> List[int]:
+        with self._lock:
+            return [m for m, per in self._ext.items()
+                    if any(ivs for ivs in per.values())]
+
+    def sink_refs(self, member: int) -> List["weakref.ref"]:
+        with self._lock:
+            return list(self._ext.get(member, {}))
+
+    def pending_bytes(self, member: int) -> int:
+        with self._lock:
+            per = self._ext.get(member)
+            if not per:
+                return 0
+            return sum(e - s for ivs in per.values() for s, e in ivs)
+
+    def pending_extents(self, member: int) -> List[Tuple[int, int]]:
+        """Snapshot of ``(file_off, length)`` owed by *member* (tests)."""
+        with self._lock:
+            per = self._ext.get(member, {})
+            return sorted((s, e - s) for ivs in per.values()
+                          for s, e in ivs)
+
+    def take_extent(self, ref: "weakref.ref", member: int
+                    ) -> Optional[Tuple[int, int]]:
+        """Pop up to ``_RESYNC_CHUNK`` bytes of the first owed interval
+        for replay; returns ``(file_off, length)`` or None when drained."""
+        with self._lock:
+            ivs = self._ext.get(member, {}).get(ref)
+            if not ivs:
+                return None
+            s, e = ivs[0]
+            take = min(e - s, _RESYNC_CHUNK)
+            if s + take >= e:
+                ivs.pop(0)
+            else:
+                ivs[0][0] = s + take
+        stats.gauge_add("resync_pending_bytes", -take)
+        return s, take
+
+    def put_back(self, sink, member: int, file_off: int,
+                 length: int) -> None:
+        """Re-journal an extent whose replay failed (no debt leaks)."""
+        self.record(sink, member, file_off, length)
+
+    def drop_sink(self, ref: "weakref.ref") -> None:
+        """Forget a sink's debt (its fds are gone; nothing to resync)."""
+        self._drop_ref(ref)
+
+
 @dataclass
 class _Member:
     state: HealthState = HealthState.HEALTHY
@@ -152,6 +272,18 @@ class MemberHealthMachine:
         self._lock = threading.Lock()
         self._m: Dict[int, _Member] = {}
         self._log: List[Tuple[int, str, str, float]] = []
+        # dirty-extent resync barrier (ISSUE 11): while attached, the
+        # REJOINING->HEALTHY edge is refused and REJOINING routes away
+        # until the member's journal is drained — a rejoined disk never
+        # serves bytes it missed while degraded
+        self._resync: Optional[DirtyExtentJournal] = None
+
+    def attach_resync(self, journal: DirtyExtentJournal) -> None:
+        self._resync = journal
+
+    def _resync_pending(self, member: int) -> bool:
+        j = self._resync
+        return j is not None and j.pending_bytes(member) > 0
 
     # -- internals -------------------------------------------------------
 
@@ -254,7 +386,10 @@ class MemberHealthMachine:
                 rec.rejoin_ok = 1
             elif rec.state is HealthState.REJOINING:
                 rec.rejoin_ok += 1
-                if rec.rejoin_ok >= int(config.get("rejoin_successes")):
+                if rec.rejoin_ok >= int(config.get("rejoin_successes")) \
+                        and not self._resync_pending(member):
+                    # resync completes before HEALTHY: warmup successes
+                    # alone never clear a member that still owes extents
                     self._to(member, rec, HealthState.HEALTHY, now)
 
     def record_canary(self, member: int, ok: bool) -> None:
@@ -337,8 +472,24 @@ class MemberHealthMachine:
             if rec.state in (HealthState.HEALTHY, HealthState.SUSPECT):
                 return True
             if rec.state is HealthState.REJOINING:
+                # a rejoiner still owing resync extents serves nothing:
+                # any direct read could return bytes it missed while
+                # degraded (its mirror has the truth)
+                if self._resync_pending(member):
+                    return False
                 return self._take_token(rec, now)
             return False
+
+    def take_rejoin_token(self, member: int) -> bool:
+        """Draw one warmup token for the resync replay (the same bucket
+        client traffic draws from, so replay rides the rejoin budget).
+        Non-REJOINING members are unthrottled."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._m.get(member)
+            if rec is None or rec.state is not HealthState.REJOINING:
+                return True
+            return self._take_token(rec, now)
 
     def quarantined(self, member: int) -> bool:
         """PR 1 compatibility predicate: True when the member's extents
@@ -347,15 +498,19 @@ class MemberHealthMachine:
 
     def routes_away(self, member: int) -> bool:
         """True for QUARANTINED/FAILED — the native-path mirror-remap
-        predicate (no token consumed, REJOINING serves native traffic)."""
+        predicate (no token consumed, REJOINING serves native traffic)
+        — and for a REJOINING member still owing resync extents (stale
+        until the journal drains)."""
         now = time.monotonic()
         with self._lock:
             rec = self._m.get(member)
             if rec is None:
                 return False
             self._expire(member, rec, now)
-            return rec.state in (HealthState.QUARANTINED,
-                                 HealthState.FAILED)
+            if rec.state in (HealthState.QUARANTINED, HealthState.FAILED):
+                return True
+            return rec.state is HealthState.REJOINING \
+                and self._resync_pending(member)
 
     def hedge_delay_s(self, member: int) -> Optional[float]:
         """Hedge latch for a chunk on *member*, or None when hedging is

@@ -79,6 +79,36 @@ except ImportError:   # pragma: no cover - depends on image
             return crc ^ 0xFFFFFFFF
 
 
+def crc32c(data) -> int:
+    """crc32c of an arbitrary buffer (the page-checksum polynomial) —
+    the shared checksum for checkpoint leaves and write_verify
+    read-back, so files verify identically whichever backend computed
+    them."""
+    return int(_crc32c(bytes(data)))
+
+
+# incremental form (crc32c(a+b) == crc32c_update(crc32c(a), b)) for
+# streaming verification over leaf spans that never assemble on host
+try:
+    from google_crc32c import extend as _crc32c_extend    # C extension
+
+    def crc32c_update(crc: int, data) -> int:
+        return int(_crc32c_extend(crc, bytes(data)))
+except ImportError:   # pragma: no cover - depends on image
+    try:
+        from crc32c import crc32c as _crc32c_pkg
+
+        def crc32c_update(crc: int, data) -> int:
+            return int(_crc32c_pkg(bytes(data), crc))
+    except ImportError:
+
+        def crc32c_update(crc: int, data) -> int:
+            c = crc ^ 0xFFFFFFFF
+            for b in bytes(data):
+                c = (c >> 8) ^ _CRC32C_TABLE[(c ^ b) & 0xFF]
+            return c ^ 0xFFFFFFFF
+
+
 def page_checksum(page) -> int:
     """crc32c of one page with its CHECKSUM_WORD zeroed (what the builder
     stores there and the verifier recomputes)."""

@@ -460,7 +460,8 @@ class StatRegistry:
         with self._lock:
             for k, v in native_counters.items():
                 if k in self._c and k not in ("cur_dma_count", "max_dma_count",
-                                              "cache_resident_bytes"):
+                                              "cache_resident_bytes",
+                                              "resync_pending_bytes"):
                     self._c[k] += v
 
 

@@ -18,6 +18,13 @@ the survival contract:
 The schedule is fixed by ``STROM_CHAOS_SEED`` (default 1234) so CI
 failures reproduce; ``STROM_CHAOS_ROUNDS`` sweeps the scenario list
 multiple times with fresh derived seeds.
+
+``python -m nvme_strom_tpu.testing.chaos write`` (``make chaos-write``,
+ISSUE 11) runs the WRITE-side schedules instead: write-path fail-stop
+with mirror failover and journal-replay rejoin, an ENOSPC first-error
+latch storm, a torn mirror pair healed from its primary under
+``write_verify``, and a SIGKILL-mid-save checkpoint crash with crc
+verification of the surviving file.  ``all`` runs both sets.
 """
 
 from __future__ import annotations
@@ -474,9 +481,281 @@ def scenario_cache_churn(rng: random.Random, dirpath: str) -> str:
     return "cache_churn"
 
 
+# ---------------------------------------------------------------------------
+# write-side scenarios (ISSUE 11): the survival contract, mirrored
+# ---------------------------------------------------------------------------
+
+def write_all(sess, sink, payload: bytes, chunk: int = CHUNK,
+              timeout: float = 60.0) -> None:
+    """Drive a whole-stream RAM→SSD write of *payload* and wait it out."""
+    handle, buf = sess.alloc_dma_buffer(len(payload))
+    try:
+        buf.view()[:len(payload)] = payload
+        res = sess.memcpy_ram2ssd(sink, handle, list(range(len(payload) // chunk)),
+                                  chunk)
+        sess.memcpy_wait(res.dma_task_id, timeout=timeout)
+        sink.sync()
+    finally:
+        sess.unmap_buffer(handle)
+
+
+def assert_pairs_identical(paths, scenario: str) -> None:
+    """Every mirror pair must hold byte-identical files — the rejoin
+    contract: a rejoined disk never differs from the replica that covered
+    for it."""
+    for pri in range(0, len(paths), 2):
+        with open(paths[pri], "rb") as a, open(paths[pri + 1], "rb") as b:
+            if a.read() != b.read():
+                raise AssertionError(
+                    f"{scenario}: mirror pair {pri}/{pri + 1} diverged "
+                    f"after resync")
+
+
+def _await_healthy(sess, member: int, scenario: str,
+                   deadline_s: float = 20.0) -> None:
+    from ..fault import HealthState
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if sess._member_health.state(member) is HealthState.HEALTHY:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"{scenario}: member {member} stuck in "
+        f"{sess._member_health.state(member)} with "
+        f"{sess._resync.pending_bytes(member)} resync bytes pending")
+
+
+def scenario_write_failstop(rng: random.Random, dirpath: str) -> str:
+    """A mirrored primary fail-stops for WRITES mid-stream (reads keep
+    answering — the canary's view of the device is fine, the media is
+    not): the stream must retire with the victim's extents landed on the
+    mirror and journaled, the rejoin replay must copy them back once the
+    member writes again, and HEALTHY must not be reached before the
+    journal drains — after which both pair files are byte-identical and
+    a logical read-back returns exactly the written payload."""
+    from ..config import config
+    from ..engine import Session
+    from .fake import FakeStripedNvmeSource, FaultPlan
+
+    config.set("io_retries", 1)
+    config.set("task_deadline_s", 30.0)
+    config.set("canary_interval_s", 0.05)
+    config.set("quarantine_s", 0.1)
+    config.set("rejoin_successes", 2)
+    config.set("rejoin_tokens_s", 1000.0)
+    config.set("dma_max_size", STRIPE)     # one request per stripe extent
+    config.set("member_queue_depth", 1)    # fail-stop bites mid-stream
+    victim = rng.choice([0, 2])
+    after = rng.randrange(2, 5)
+    plan = FaultPlan(write_failstop_member=victim,
+                     write_failstop_after=after,
+                     write_rejoin_after=after + rng.randrange(4, 9))
+    paths = make_mirrored_members(dirpath, tag=f"wf{rng.randrange(1 << 16)}-")
+    sink = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                 fault_plan=plan, force_cached_fraction=0.0,
+                                 mirror="paired", writable=True)
+    payload = rng.randbytes(2 * MEMBER_SIZE)
+    resyncs_before = _counter("nr_resync_extent")
+    try:
+        with Session() as sess:
+            write_all(sess, sink, payload)
+            _await_healthy(sess, victim, "write_failstop")
+            assert sess._resync.pending_bytes(victim) == 0, \
+                "write_failstop: HEALTHY with resync debt outstanding"
+            got, total = read_all(sess, sink)
+            assert got == payload[:total], \
+                "write_failstop: logical read-back diverged from payload"
+            assert_transitions_legal(sess, "write_failstop")
+    finally:
+        sink.close()
+    assert _counter("nr_resync_extent") > resyncs_before, \
+        "write_failstop: nothing was ever replayed from the journal"
+    assert_pairs_identical(paths, "write_failstop")
+    return "write_failstop"
+
+
+def scenario_write_enospc(rng: random.Random, dirpath: str) -> str:
+    """An ENOSPC storm on an unmirrored sink: PERSISTENT taxonomy means
+    the FIRST error latches the task — no retry storm against a full
+    disk (the write-retry counter must not move)."""
+    import errno as _errno
+
+    from ..api import StromError
+    from ..config import config
+    from ..engine import Session
+    from .fake import FakeNvmeSource, FaultPlan
+    from .fake import make_test_file as _mk
+
+    config.set("io_retries", 3)
+    config.set("task_deadline_s", 30.0)
+    config.set("dma_max_size", STRIPE)
+    path = os.path.join(dirpath, f"en{rng.randrange(1 << 16)}.bin")
+    _mk(path, MEMBER_SIZE)
+    plan = FaultPlan(write_fail_every_nth=rng.choice([2, 3]),
+                     write_errno=_errno.ENOSPC)
+    sink = FakeNvmeSource(path, fault_plan=plan, force_cached_fraction=0.0,
+                          writable=True)
+    retries_before = _counter("nr_write_retry")
+    try:
+        with Session() as sess:
+            handle, buf = sess.alloc_dma_buffer(MEMBER_SIZE)
+            try:
+                buf.view()[:MEMBER_SIZE] = rng.randbytes(MEMBER_SIZE)
+                res = sess.memcpy_ram2ssd(sink, handle,
+                                          list(range(MEMBER_SIZE // CHUNK)),
+                                          CHUNK)
+                try:
+                    sess.memcpy_wait(res.dma_task_id, timeout=30.0)
+                    raise AssertionError(
+                        "write_enospc: a full disk did not fail the task")
+                except StromError as e:
+                    assert e.errno == _errno.ENOSPC, \
+                        f"write_enospc: latched {e.errno}, wanted ENOSPC"
+            finally:
+                sess.unmap_buffer(handle)
+    finally:
+        sink.close()
+    assert _counter("nr_write_retry") == retries_before, \
+        "write_enospc: a PERSISTENT errno was retried"
+    return "write_enospc"
+
+
+def scenario_write_torn_mirror(rng: random.Random, dirpath: str) -> str:
+    """Crash between the mirror legs: the MIRROR member dies after the
+    primary leg lands (write-side fail-stop on an odd member), leaving
+    the pair torn — the journal owns the mirror's missed extents and the
+    replay heals the tear from the primary, with ``write_verify`` armed
+    the whole way (read-back of surviving legs must stay clean)."""
+    from ..config import config
+    from ..engine import Session
+    from .fake import FakeStripedNvmeSource, FaultPlan
+
+    config.set("io_retries", 1)
+    config.set("task_deadline_s", 30.0)
+    config.set("canary_interval_s", 0.05)
+    config.set("quarantine_s", 0.1)
+    config.set("rejoin_successes", 2)
+    config.set("rejoin_tokens_s", 1000.0)
+    config.set("dma_max_size", STRIPE)
+    config.set("member_queue_depth", 1)
+    config.set("write_verify", True)
+    victim = rng.choice([1, 3])            # a REPLICA tears, not a primary
+    after = rng.randrange(2, 5)
+    plan = FaultPlan(write_failstop_member=victim,
+                     write_failstop_after=after,
+                     write_rejoin_after=after + rng.randrange(4, 9))
+    paths = make_mirrored_members(dirpath, tag=f"tn{rng.randrange(1 << 16)}-")
+    sink = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                 fault_plan=plan, force_cached_fraction=0.0,
+                                 mirror="paired", writable=True)
+    payload = rng.randbytes(2 * MEMBER_SIZE)
+    try:
+        with Session() as sess:
+            write_all(sess, sink, payload)
+            _await_healthy(sess, victim, "write_torn_mirror")
+            got, total = read_all(sess, sink)
+            assert got == payload[:total], \
+                "write_torn_mirror: logical read-back diverged"
+            assert_transitions_legal(sess, "write_torn_mirror")
+    finally:
+        sink.close()
+    assert_pairs_identical(paths, "write_torn_mirror")
+    return "write_torn_mirror"
+
+
+_CKPT_CRASH_CHILD = r"""
+import sys, time
+import numpy as np
+import nvme_strom_tpu.data.checkpoint as ck
+_orig = ck.np.ascontiguousarray
+def _slow(a, *k, **kw):
+    time.sleep(0.08)           # widen the tmp-file-present window
+    return _orig(a, *k, **kw)
+ck.np.ascontiguousarray = _slow
+tree = {f"leaf{i:02d}": np.full(1024, i, np.float32) for i in range(48)}
+ck.save_checkpoint(sys.argv[1], tree)
+print("child save finished (should have been killed)")
+"""
+
+
+def scenario_ckpt_crash(rng: random.Random, dirpath: str) -> str:
+    """Crash-consistency of the checkpoint writer: SIGKILL a child
+    mid-save over an existing checkpoint.  The prior checkpoint must
+    restore byte-identical (crc-verified), the dead child's temp litter
+    must survive until it ages out and then be reaped by the next save,
+    and ``strom_ckpt verify`` must pass on the final file."""
+    import glob
+    import signal
+    import subprocess
+
+    import numpy as np
+
+    from ..data.checkpoint import (_TMP_SWEEP_AGE_S, restore_checkpoint,
+                                   save_checkpoint)
+    from ..tools.strom_ckpt import main as ckpt_cli
+
+    path = os.path.join(dirpath, "model.strom")
+    prior = {f"leaf{i:02d}": np.full(1024, 1000 + i, np.float32)
+             for i in range(48)}
+    save_checkpoint(path, prior)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CKPT_CRASH_CHILD, path],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        # the slow leaf pre-pass runs before mkstemp; poll for the temp
+        deadline = time.monotonic() + 120.0
+        tmps = []
+        while time.monotonic() < deadline:
+            tmps = glob.glob(path + ".tmp.*")
+            if tmps:
+                break
+            if child.poll() is not None:
+                raise AssertionError(
+                    f"ckpt_crash: child exited rc={child.returncode} "
+                    f"before its temp file appeared")
+            time.sleep(0.02)
+        assert tmps, "ckpt_crash: no temp file ever appeared"
+        time.sleep(0.3)        # let it get some leaves deep
+        child.send_signal(signal.SIGKILL)
+        rc = child.wait(timeout=30.0)
+        assert rc == -signal.SIGKILL, f"ckpt_crash: child rc {rc}"
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30.0)
+    # 1. the installed checkpoint is untouched: crc-verified restore
+    out = restore_checkpoint(path, verify=True)
+    for k, v in prior.items():
+        got = np.asarray(out[f"['{k}']"])
+        assert np.array_equal(got, v), f"ckpt_crash: leaf {k} diverged"
+    # 2. the kill left litter; a fresh save must NOT reap it while young
+    litter = glob.glob(path + ".tmp.*")
+    assert litter, "ckpt_crash: the SIGKILL left no temp litter to test"
+    final = {f"leaf{i:02d}": np.full(1024, 2000 + i, np.float32)
+             for i in range(48)}
+    save_checkpoint(path, final)
+    assert set(glob.glob(path + ".tmp.*")) >= set(litter), \
+        "ckpt_crash: young litter was swept (concurrent-save hazard)"
+    # 3. ...and must reap it once it ages past the sweep horizon
+    old = time.time() - _TMP_SWEEP_AGE_S - 60.0
+    for t in litter:
+        os.utime(t, (old, old))
+    save_checkpoint(path, final)
+    assert not glob.glob(path + ".tmp.*"), \
+        "ckpt_crash: aged litter survived the sweep"
+    # 4. the final checkpoint passes the CLI corruption oracle
+    assert ckpt_cli(["verify", path]) == 0, \
+        "ckpt_crash: strom_ckpt verify failed on the final checkpoint"
+    return "ckpt_crash"
+
+
 SCENARIOS = (scenario_fail_stop, scenario_flaky, scenario_slow_hedge,
              scenario_corrupt_once, scenario_rejoin,
              scenario_native_degraded, scenario_cache_churn)
+
+SCENARIOS_WRITE = (scenario_write_failstop, scenario_write_enospc,
+                   scenario_write_torn_mirror, scenario_ckpt_crash)
 
 
 def flaky_mirrored_round(rng: random.Random, dirpath: str) -> str:
@@ -488,11 +767,12 @@ def flaky_mirrored_round(rng: random.Random, dirpath: str) -> str:
 # driver
 # ---------------------------------------------------------------------------
 
-def run_all(seed: int, rounds: int = 1, verbose: bool = True) -> dict:
+def run_all(seed: int, rounds: int = 1, verbose: bool = True,
+            scenarios=SCENARIOS) -> dict:
     from ..config import config
     tally: dict = {}
     for r in range(rounds):
-        for i, scenario in enumerate(SCENARIOS):
+        for i, scenario in enumerate(scenarios):
             # integer-derived per-scenario seed: hash() of a str would
             # change per process (PYTHONHASHSEED) and kill reproducibility
             rng = random.Random(seed * 1_000_003 + r * 101 + i)
@@ -511,10 +791,17 @@ def run_all(seed: int, rounds: int = 1, verbose: bool = True) -> dict:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    sets = {"read": SCENARIOS, "write": SCENARIOS_WRITE,
+            "all": SCENARIOS + SCENARIOS_WRITE}
+    which = argv[0] if argv else "read"
+    if which not in sets:
+        print(f"usage: chaos [{'|'.join(sets)}]", file=sys.stderr)
+        return 2
     seed = int(os.environ.get("STROM_CHAOS_SEED", "1234"))
     rounds = int(os.environ.get("STROM_CHAOS_ROUNDS", "1"))
     t0 = time.monotonic()
-    tally = run_all(seed, rounds)
+    tally = run_all(seed, rounds, scenarios=sets[which])
     from ..stats import stats
     c = stats.snapshot(reset_max=False).counters
     print(f"chaos OK: {sum(tally.values())} scenarios in "
@@ -523,6 +810,8 @@ def main(argv=None) -> int:
           + f"; hedges won {c.get('nr_hedge_won', 0)}/"
           f"{c.get('nr_hedge_issued', 0)}, "
           f"mirror reads {c.get('nr_mirror_read', 0)}, "
+          f"mirror writes {c.get('nr_mirror_write', 0)}, "
+          f"resync extents {c.get('nr_resync_extent', 0)}, "
           f"canaries {c.get('nr_canary_probe', 0)}")
     return 0
 

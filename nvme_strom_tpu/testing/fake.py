@@ -74,6 +74,23 @@ class FaultPlan:
       driving the health machine to FAILED; from ``rejoin_after`` reads
       onward the member answers again, so canary probes observe recovery
       and walk it through REJOINING back to HEALTHY.
+
+    Write-side tiers (ISSUE 11) mirror the read tiers on an independent
+    op counter (``_wcount``), so a mixed read/write scenario schedules
+    each direction deterministically:
+
+    * ``write_fail_every_nth`` / ``write_fail_rate`` — periodic /
+      randomized write faults raising ``write_errno`` (default EIO, i.e.
+      TRANSIENT; set ENOSPC for a PERSISTENT first-error-latch storm).
+    * ``write_failstop_member`` + ``write_failstop_after``
+      [+ ``write_rejoin_after``] — fail-stop for the write path only:
+      reads (canary probes included) keep answering, writes hard-fail
+      until the member 'comes back', which is how a mirror-degraded
+      stream plus journal replay is exercised end to end.
+    * ``torn_write_offsets`` — each listed absolute member offset has one
+      byte flipped ON DISK after the covering write lands (fsynced, so
+      O_DIRECT read-back sees it): a torn/misdirected write for the
+      ``write_verify`` read-back oracle.  One-shot per offset.
     """
 
     fail_offsets: Set[int] = field(default_factory=set)   # file_off -> EIO
@@ -88,8 +105,19 @@ class FaultPlan:
     failstop_member: Optional[int] = None   # member that hard-fails...
     failstop_after: int = 0                 # ...once _count reaches this
     rejoin_after: Optional[int] = None      # ...and heals at this count
+    write_fail_every_nth: int = 0           # every Nth write raises write_errno
+    write_fail_rate: float = 0.0            # P(write fault) per write
+    write_errno: int = _errno.EIO           # errno those write faults carry
+    write_failstop_member: Optional[int] = None  # write-path fail-stop...
+    write_failstop_after: int = 0                # ...from this write count
+    write_rejoin_after: Optional[int] = None     # ...healing at this count
+    torn_write_offsets: Set[int] = field(default_factory=set)  # flip after landing
+    slow_write_member: Optional[int] = None  # member whose writes stall
+    slow_write_s: float = 0.0                # the extra write latency
     _count: int = 0
+    _wcount: int = 0
     _rng: object = field(default=None, repr=False)
+    _wrng: object = field(default=None, repr=False)
 
     def failstopped(self, member: Optional[int]) -> bool:
         """Is *member* inside its fail-stop window right now?"""
@@ -98,6 +126,48 @@ class FaultPlan:
                 and self._count >= self.failstop_after
                 and (self.rejoin_after is None
                      or self._count < self.rejoin_after))
+
+    def write_failstopped(self, member: Optional[int]) -> bool:
+        """Is *member* inside its WRITE fail-stop window right now?"""
+        return (self.write_failstop_member is not None
+                and member == self.write_failstop_member
+                and self._wcount >= self.write_failstop_after
+                and (self.write_rejoin_after is None
+                     or self._wcount < self.write_rejoin_after))
+
+    def check_write(self, file_off: int, length: int,
+                    member: Optional[int] = None) -> None:
+        """Write-path injection gate: consulted by both write legs (the
+        engine's pool ladder AND the resync replay write through here)."""
+        self._wcount += 1
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        if self.slow_write_s and member is not None \
+                and member == self.slow_write_member:
+            time.sleep(self.slow_write_s)
+        if self.write_failstopped(member):
+            raise StromError(_errno.EIO,
+                             f"injected write fail-stop of member {member}",
+                             error_class=ErrorClass.PERSISTENT)
+        if self.write_fail_every_nth \
+                and self._wcount % self.write_fail_every_nth == 0:
+            raise StromError(self.write_errno,
+                             f"injected periodic write fault #{self._wcount}")
+        if self.write_fail_rate > 0.0:
+            if self._wrng is None:
+                import random
+                self._wrng = random.Random(self.seed ^ 0x5A5A5A5A)
+            if self._wrng.random() < self.write_fail_rate:
+                raise StromError(self.write_errno,
+                                 f"injected random write fault #{self._wcount}")
+
+    def take_torn(self, file_off: int, length: int) -> list:
+        """Pop-and-return the torn offsets a landed write covers."""
+        hit = [off for off in self.torn_write_offsets
+               if file_off <= off < file_off + length]
+        for off in hit:
+            self.torn_write_offsets.discard(off)
+        return hit
 
     def check(self, file_off: int, length: int,
               member: Optional[int] = None) -> None:
@@ -144,6 +214,22 @@ class FaultPlan:
             self.corrupt_once_offsets.discard(off)
 
 
+def _tear_landed(member_obj, plan: FaultPlan, file_off: int,
+                 length: int) -> None:
+    """Apply one-shot torn-write corruption to bytes a write just landed:
+    flip the listed byte directly on disk through the member's buffered fd
+    and fsync, so a subsequent O_DIRECT read-back (the ``write_verify``
+    oracle) observes the torn state, not a cached page."""
+    hit = plan.take_torn(file_off, length)
+    if not hit:
+        return
+    fd = member_obj.fd_buffered
+    for off in hit:
+        b = os.pread(fd, 1, off)
+        os.pwrite(fd, bytes([b[0] ^ 0xFF]), off)
+    os.fsync(fd)
+
+
 class FakeNvmeSource(PlainSource):
     """Loopback 'NVMe device': a plain file plus injected latency/faults.
 
@@ -153,8 +239,9 @@ class FakeNvmeSource(PlainSource):
     """
 
     def __init__(self, path: str, *, fault_plan: Optional[FaultPlan] = None,
-                 block_size: int = 512, force_cached_fraction: Optional[float] = None):
-        super().__init__(path, block_size)
+                 block_size: int = 512, force_cached_fraction: Optional[float] = None,
+                 writable: bool = False):
+        super().__init__(path, block_size, writable=writable)
         self.fault_plan = fault_plan or FaultPlan()
         self.force_cached_fraction = force_cached_fraction
 
@@ -168,6 +255,18 @@ class FakeNvmeSource(PlainSource):
         # regions must fail it too, transient/periodic plans must not
         self.fault_plan.check_buffered(file_off, len(dest), member=member)
         super().read_member_buffered(member, file_off, dest)
+
+    # overriding the write legs routes writes down the engine's Python
+    # pool ladder (ISSUE 11), the same trick the read overrides use
+    def write_member_direct(self, member: int, file_off: int, src: memoryview) -> None:
+        self.fault_plan.check_write(file_off, len(src), member=member)
+        super().write_member_direct(member, file_off, src)
+        _tear_landed(self._m, self.fault_plan, file_off, len(src))
+
+    def write_member_buffered(self, member: int, file_off: int, src: memoryview) -> None:
+        self.fault_plan.check_write(file_off, len(src), member=member)
+        super().write_member_buffered(member, file_off, src)
+        _tear_landed(self._m, self.fault_plan, file_off, len(src))
 
     def cached_fraction(self, offset: int, length: int) -> float:
         if self.force_cached_fraction is not None:
@@ -201,9 +300,10 @@ class FakeStripedNvmeSource(StripedSource):
                  fault_plan: Optional[FaultPlan] = None,
                  block_size: int = 512,
                  force_cached_fraction: Optional[float] = None,
-                 mirror: Optional[str] = None):
+                 mirror: Optional[str] = None,
+                 writable: bool = False):
         super().__init__(paths, stripe_chunk_size, block_size,
-                         mirror=mirror)
+                         writable=writable, mirror=mirror)
         self.fault_plan = fault_plan or FaultPlan()
         self.force_cached_fraction = force_cached_fraction
 
@@ -215,6 +315,19 @@ class FakeStripedNvmeSource(StripedSource):
     def read_member_buffered(self, member: int, file_off: int, dest: memoryview) -> None:
         self.fault_plan.check_buffered(file_off, len(dest), member=member)
         super().read_member_buffered(member, file_off, dest)
+
+    # write legs through the pool ladder + write-side injection (ISSUE 11)
+    def write_member_direct(self, member: int, file_off: int, src: memoryview) -> None:
+        self.fault_plan.check_write(file_off, len(src), member=member)
+        super().write_member_direct(member, file_off, src)
+        _tear_landed(self.members[member], self.fault_plan,
+                     file_off, len(src))
+
+    def write_member_buffered(self, member: int, file_off: int, src: memoryview) -> None:
+        self.fault_plan.check_write(file_off, len(src), member=member)
+        super().write_member_buffered(member, file_off, src)
+        _tear_landed(self.members[member], self.fault_plan,
+                     file_off, len(src))
 
     def cached_fraction(self, offset: int, length: int) -> float:
         if self.force_cached_fraction is not None:

@@ -39,24 +39,39 @@ def _info(path: str) -> int:
 
 
 def _verify(path: str) -> int:
+    from ..scan.heap import crc32c
     meta = checkpoint_info(path)
     out = restore_checkpoint(path)
     bad = 0
+    with_crc = 0
     with open(path, "rb") as f:
         for e in meta["leaves"]:
             f.seek(meta["data_offset"] + e["offset"])
-            want = np.frombuffer(f.read(e["nbytes"]), np.dtype(e["dtype"]))
+            raw = f.read(e["nbytes"])
+            want = np.frombuffer(raw, np.dtype(e["dtype"]))
             got = np.asarray(out[e["key"]]).ravel().view(np.dtype(e["dtype"]))
             if not np.array_equal(
                     got.view(np.uint8), want.view(np.uint8)):
-                print(f"  CORRUPT: {e['key']}", file=sys.stderr)
+                print(f"  CORRUPT: {e['key']} (direct != buffered)",
+                      file=sys.stderr)
                 bad += 1
+                continue
+            # crash-consistency oracle (ISSUE 11): the header's per-leaf
+            # crc32c pins the bytes the SAVER intended — a torn write
+            # that both read paths agree on still fails here
+            if "crc32c" in e:
+                with_crc += 1
+                if crc32c(raw) != e["crc32c"]:
+                    print(f"  CORRUPT: {e['key']} (crc32c mismatch, "
+                          f"header {e['crc32c']:#010x})", file=sys.stderr)
+                    bad += 1
     if bad:
         print(f"verify: {bad}/{len(meta['leaves'])} leaves corrupt",
               file=sys.stderr)
         return 1
+    crc_note = f", {with_crc} crc32c-checked" if with_crc else ""
     print(f"verify: all {len(meta['leaves'])} leaves OK "
-          f"(direct restore == buffered read)")
+          f"(direct restore == buffered read{crc_note})")
     return 0
 
 

@@ -282,6 +282,20 @@ def main(argv=None) -> int:
                       f"invalidate {c.get('nr_cache_invalidate', 0)}  "
                       f"resident "
                       f"{c.get('cache_resident_bytes', 0) / 1048576:.1f}MB")
+            # write-ladder scoreboard (ISSUE 11): mirror fan-out volume,
+            # transient write retries, resync replay progress and
+            # read-back verification failures — pending bytes above zero
+            # means a rejoining member still owes its mirror a replay
+            if (c.get("nr_mirror_write") or c.get("nr_write_retry")
+                    or c.get("nr_resync_extent")
+                    or c.get("nr_write_verify_fail")
+                    or c.get("resync_pending_bytes")):
+                print(f"writes: mirror {c.get('nr_mirror_write', 0)}  "
+                      f"retry {c.get('nr_write_retry', 0)}  "
+                      f"resync {c.get('nr_resync_extent', 0)}  "
+                      f"verify-fail {c.get('nr_write_verify_fail', 0)}  "
+                      f"resync-pending "
+                      f"{c.get('resync_pending_bytes', 0) / 1048576:.1f}MB")
             # write-amplification of the recovery/staging stack: every
             # byte the pipeline touched (staging hop + verify re-reads +
             # duplicated hedge legs) over every byte delivered — 1.0 is

@@ -70,6 +70,11 @@ EVENT_SCHEMA: Dict[str, str] = {
     # mirror reads are a span on the python pool path (service window)
     # and an instant on the native path (completion attribution)
     "mirror_read": "any",
+    # mirror-coherent writes (ISSUE 11): the pool path emits the mirror
+    # leg's service window as a span; the native path records fan-out at
+    # submit as an instant
+    "mirror_write": "any",
+    "resync": "span",            # one dirty-extent replay (read+write)
     # point events
     "submit": "instant",         # task accepted
     "native_submit": "instant",  # handed to the native engine
@@ -85,6 +90,7 @@ EVENT_SCHEMA: Dict[str, str] = {
     "landing_fallback": "instant",
     "cache_evict": "instant",
     "cache_invalidate": "instant",
+    "resync_skip": "instant",    # degraded write leg journaled for resync
 }
 
 
@@ -496,7 +502,8 @@ def _prom_name(counter: str) -> str:
 
 
 _PROM_GAUGES = ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
-                "occ_integral_ns", "occ_busy_ns", "cache_resident_bytes")
+                "occ_integral_ns", "occ_busy_ns", "cache_resident_bytes",
+                "resync_pending_bytes")
 
 
 def render_prometheus(payload: dict) -> str:
@@ -517,8 +524,10 @@ def render_prometheus(payload: dict) -> str:
 
     for k in sorted(counters):
         if "debug" in k or k.startswith("nr_landing_") \
-                or k.startswith("nr_cache_"):
-            continue    # landing/cache counters render as labeled series
+                or k.startswith("nr_cache_") \
+                or k in ("nr_mirror_write", "nr_write_retry",
+                         "nr_resync_extent", "nr_write_verify_fail"):
+            continue    # landing/cache/write counters render as labeled series
         mtype = "gauge" if k in _PROM_GAUGES else "counter"
         emit(_prom_name(k if k in _PROM_GAUGES else k + "_total"),
              mtype, counters[k])
@@ -546,6 +555,17 @@ def render_prometheus(payload: dict) -> str:
         out.append("# TYPE strom_tpu_cache_ops_total counter")
         for op, v in ops:
             out.append(f'strom_tpu_cache_ops_total{{op="{op}"}} {v}')
+    # write-ladder attribution (ISSUE 11): mirror fan-out, transient
+    # retries, resync replays and read-back verification failures as one
+    # labeled family, so dashboards can plot write-path degradation
+    wops = [("mirror", counters.get("nr_mirror_write", 0)),
+            ("retry", counters.get("nr_write_retry", 0)),
+            ("resync", counters.get("nr_resync_extent", 0)),
+            ("verify_fail", counters.get("nr_write_verify_fail", 0))]
+    if any(v for _, v in wops):
+        out.append("# TYPE strom_tpu_write_ops_total counter")
+        for op, v in wops:
+            out.append(f'strom_tpu_write_ops_total{{op="{op}"}} {v}')
     ratio = bytes_touched_ratio(counters)
     if ratio is not None:
         emit("strom_tpu_bytes_touched_per_byte_delivered", "gauge",

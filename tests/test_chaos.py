@@ -5,13 +5,12 @@ come from FaultPlan schedules over the striped loopback fake; the native
 leg drives real files through the io_uring lanes.  The seeded chaos
 sweep itself runs as ``make chaos`` (testing/chaos.py)."""
 
-import errno
 import random
 import time
 
 import pytest
 
-from nvme_strom_tpu import Session, StromError, config, stats
+from nvme_strom_tpu import Session, config, stats
 from nvme_strom_tpu.engine import StripedSource
 from nvme_strom_tpu.fault import ALLOWED_TRANSITIONS, HealthState
 from nvme_strom_tpu.stripe import StripeMap
@@ -59,14 +58,19 @@ def test_paired_needs_even_member_count():
         StripeMap([1 << 20] * 3, chunk_size=64 << 10, mirror="paired")
 
 
-def test_writable_paired_rejected(tmp_path):
-    """The mirror map is a read-path feature: a writable paired source
-    would desync the replicas, so it is refused outright."""
+def test_writable_paired_accepted(tmp_path):
+    """Writable paired sources are legal since ISSUE 11: the write path
+    fans every aligned leg out to both pair members (tests/
+    test_write_faults.py proves the coherency), so opening one is no
+    longer a desync hazard — geometry is unchanged by writability."""
     paths = make_mirrored_members(str(tmp_path))
-    with pytest.raises(StromError) as ei:
-        StripedSource(paths, stripe_chunk_size=STRIPE, writable=True,
-                      mirror="paired")
-    assert ei.value.errno == errno.EINVAL
+    src = StripedSource(paths, stripe_chunk_size=STRIPE, writable=True,
+                        mirror="paired")
+    try:
+        assert src.mirror_of(0) == 1 and src.mirror_of(1) == 0
+        src._check_writable()   # must not raise
+    finally:
+        src.close()
 
 
 # ---------------------------------------------------------------------------

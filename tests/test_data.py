@@ -509,10 +509,19 @@ def test_save_checkpoint_sharded_roundtrip(tmp_path):
                       offset=meta["data_offset"] + leaves["['w']"]["offset"])
     np.testing.assert_array_equal(raw.reshape(16, 8), w)
 
-    # byte-identical to the plain writer (restore-compat both ways)
+    # same layout as the plain writer (restore-compat both ways): the
+    # data sections are byte-identical and the leaf tables agree modulo
+    # the per-leaf crc32c (ISSUE 11) that only the plain writer can
+    # compute — no sharded process holds a whole leaf
     ref = str(tmp_path / "ref.strom")
     save_checkpoint(ref, {"w": w, "step": np.int32(9)})
+    ref_meta = checkpoint_info(ref)
+    assert all("crc32c" in e for e in ref_meta["leaves"])
+    assert [{k: v for k, v in e.items() if k != "crc32c"}
+            for e in ref_meta["leaves"]] == meta["leaves"]
     with open(path, "rb") as a, open(ref, "rb") as b:
+        a.seek(meta["data_offset"])
+        b.seek(ref_meta["data_offset"])
         assert a.read() == b.read()
 
     restored = restore_checkpoint(path, shardings={"['w']": sh})
