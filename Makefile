@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate probe-loop lint-strom sanitize sanitize-smoke clean
+.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -110,6 +110,15 @@ cache-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.cache_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q -m cache
 
+# QoS fairness gate (ISSUE 12): against a real stromd on the
+# latency-injected synthetic, 3:1-weighted tenants must receive bytes
+# within 25% of 3:1 while both are backlogged, and a latency-class
+# tenant's p95 queue wait must stay bounded under a bulk antagonist.
+# Override STROM_QOS_GATE_RATIO / _TOL / _P95_MS.
+qos-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.qos_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_daemon.py -q -m daemon
+
 # stromlint (ISSUE 10): the project-invariant static checker — lock
 # discipline, buffer lifetimes, native-ABI drift against csrc/strom_tpu.h,
 # stats/trace surface completeness, config hygiene.  Zero unsuppressed
@@ -142,7 +151,7 @@ sanitize-smoke:
 # then tier-1 tests plus the perf smokes, the seeded member-survival
 # schedules, the trace-overhead, landing and cache gates, and the
 # short sanitizer pass.
-check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

@@ -295,6 +295,22 @@ STAT_FIELDS: Tuple[str, ...] = (
     "nr_resync_extent",       # journal extents replayed onto a rejoiner
     "nr_write_verify_fail",   # write_verify read-back crc32c mismatches
     "resync_pending_bytes",   # gauge: dirty-extent bytes awaiting resync
+    # shared serving daemon (ISSUE 12): stromd arbitrates N clients over
+    # one engine the way the reference's /proc/nvme-strom entry
+    # arbitrates N processes in the kernel — session lifecycle, admission
+    # control, and the QoS scheduler each account here
+    "nr_session_attach",      # client sessions attached
+    "nr_session_detach",      # sessions released by clean detach
+    "nr_session_reap",        # orphans reaped after client disconnect
+    #                           (crash/SIGKILL) without detach
+    "nr_admission_reject",    # submits bounced with EAGAIN by per-tenant
+    #                           in-flight quota (backpressure, not queueing)
+    "nr_qos_wait", "clk_qos_wait",  # per-dispatch queue wait (enqueue ->
+    #                                 scheduler pick) count+clock pair
+    "nr_qos_throttle",        # tenants token-bucket-gated at the head of
+    #                           their class ring (edge, not per-poll)
+    "daemon_sessions",        # gauge: sessions currently attached
+    "qos_queue_depth",        # gauge: items queued ahead of dispatch
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",
@@ -322,7 +338,8 @@ class StatInfo:
         d = {k: new.counters.get(k, 0) - old.counters.get(k, 0) for k in new.counters}
         # gauges are point-in-time, not deltas
         for g in ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
-                  "cache_resident_bytes", "resync_pending_bytes"):
+                  "cache_resident_bytes", "resync_pending_bytes",
+                  "daemon_sessions", "qos_queue_depth"):
             if g in new.counters:
                 d[g] = new.counters[g]
         return StatInfo(version=new.version, has_debug=new.has_debug,

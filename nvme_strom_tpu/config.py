@@ -129,6 +129,12 @@ def _check_trace_policy(val: str, _cfg: "Config") -> None:
         raise ConfigError(f"trace_policy must be off|sampled|all, got {val!r}")
 
 
+def _check_qos_class(val: str, _cfg: "Config") -> None:
+    if val not in ("latency", "normal", "bulk"):
+        raise ConfigError(f"qos_default_class must be latency|normal|bulk, "
+                          f"got {val!r}")
+
+
 def _check_coalesce_limit(val: int, cfg: "Config") -> None:
     # 0 = coalescing off; otherwise the merge window must cover at least
     # one dma_max_size request or planning could emit nothing mergeable
@@ -432,6 +438,44 @@ class Config:
                 help="flight-recorder capacity per thread (bounded ring; "
                      "oldest events overwrite, the dump reports the "
                      "overwrite count)"))
+        # shared serving daemon + per-tenant QoS (ISSUE 12)
+        reg(Var("daemon_socket", "", "str",
+                help="stromd Unix-socket path; empty = the per-uid default "
+                     "under the temp dir (protocol.default_socket_path)"))
+        reg(Var("daemon_max_sessions", 64, "int", minval=0,
+                help="max concurrently attached client sessions "
+                     "(0 = unlimited); further attaches get EAGAIN"))
+        reg(Var("daemon_dispatch", 2, "int", minval=0, maxval=64,
+                help="stromd dispatcher threads draining the QoS queue "
+                     "into the engine (0 = none until "
+                     "start_dispatchers(), the deterministic-test idiom)"))
+        reg(Var("daemon_quota_tasks", 0, "int", minval=0,
+                help="per-tenant in-flight task quota (0 = unlimited); "
+                     "submits over quota are rejected with EAGAIN "
+                     "backpressure, never queued unboundedly"))
+        reg(Var("daemon_quota_bytes", 0, "size", minval=0,
+                help="per-tenant in-flight byte quota (0 = unlimited); "
+                     "the memlock-budget knob — see deploy checklist 17"))
+        reg(Var("qos_quantum", 256 << 10, "size", minval=4 << 10,
+                help="deficit-round-robin quantum: bytes of deficit one "
+                     "round earns a weight-1.0 tenant; fairness slack is "
+                     "within one quantum per tenant"))
+        reg(Var("qos_default_class", "normal", "str",
+                help="QoS class for tenants that do not request one at "
+                     "attach: 'latency' > 'normal' > 'bulk' (strict "
+                     "priority between classes)",
+                validate=_check_qos_class))
+        reg(Var("qos_default_weight", 1.0, "float", minval=0.001,
+                help="DRR weight for tenants that do not request one "
+                     "(bytes delivered scale ~linearly with weight "
+                     "within a class)"))
+        reg(Var("qos_rate", 0, "size", minval=0,
+                help="default per-tenant token-bucket rate in bytes/s "
+                     "(0 = unshaped); a gated tenant yields its slot "
+                     "instead of idling the lane"))
+        reg(Var("qos_burst", 8 << 20, "size", minval=64 << 10,
+                help="token-bucket burst capacity in bytes: how far a "
+                     "shaped tenant may exceed its rate transiently"))
 
     # -- layered loading ---------------------------------------------------
     def _load_file(self) -> None:

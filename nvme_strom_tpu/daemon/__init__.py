@@ -1,0 +1,19 @@
+"""stromd: the shared serving daemon (ISSUE 12).
+
+The reference arbitrates every process's DMA through one
+``/proc/nvme-strom`` kernel entry; stromd is that shared-service seam in
+userspace — one daemon owns the engine, clients attach over a Unix
+socket with explicit session lifecycle, admission control and per-tenant
+QoS.
+
+This package namespace stays import-light (protocol + client only): a
+subprocess test client or a monitoring tool must not pull the engine —
+or jax — in just to talk to a socket.  The server side imports
+explicitly: ``from nvme_strom_tpu.daemon.server import StromDaemon``.
+"""
+
+from .client import DaemonBuffer, DaemonSession, DaemonSource
+from .protocol import PROTOCOL_VERSION, default_socket_path
+
+__all__ = ["DaemonBuffer", "DaemonSession", "DaemonSource",
+           "PROTOCOL_VERSION", "default_socket_path"]

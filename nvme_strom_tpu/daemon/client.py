@@ -1,0 +1,266 @@
+"""stromd thin client: the engine-shaped API over the daemon socket.
+
+:class:`DaemonSession` mirrors the in-process engine Session's command
+surface — ``alloc_dma_buffer`` / ``open_source`` / ``memcpy_ssd2ram`` /
+``memcpy_wait`` / ``unmap_buffer`` / ``stat_info`` — so callers written
+against the engine (``ssd2ram_test``, ``ssd2tpu_test``, the scan path)
+run unmodified against a shared daemon: swap the constructor, keep the
+loop.
+
+Destination memory is genuinely shared, not copied: ``alloc_dma_buffer``
+backs the buffer with ``memfd_create`` pages, ships the descriptor to the
+daemon via SCM_RIGHTS, and the daemon registers its own mapping of the
+SAME pages with the engine — DMA completions appear in :meth:`DaemonBuffer
+.view` with zero socket traffic (the MAP_GPU_MEMORY handle-passing analog).
+
+This module stays import-light on purpose (no engine, no jax, no numpy):
+a subprocess client in the SIGKILL-reap test must start in milliseconds,
+and a monitoring tool must not drag the whole engine in to ping a socket.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import mmap
+import os
+import socket
+import threading
+from typing import List, Optional, Tuple
+
+from ..api import MemCopyResult, StatInfo, StromError
+from ..config import config
+from .protocol import PROTOCOL_VERSION, Framer, default_socket_path, send_msg
+
+__all__ = ["DaemonBuffer", "DaemonSource", "DaemonSession"]
+
+
+class DaemonBuffer:
+    """Client-side shared DMA destination: memfd pages both processes map.
+
+    ``view()`` exposes the bytes the daemon's engine lands into; ``close``
+    is idempotent and the session closes any still-registered buffers on
+    teardown, so leak-free either way."""
+
+    def __init__(self, length: int):
+        if length <= 0:
+            raise StromError(_errno.EINVAL, f"bad buffer length {length}")
+        self.length = int(length)
+        self._fd = os.memfd_create("strom-daemon-buf")
+        try:
+            os.ftruncate(self._fd, self.length)
+            self._mm = mmap.mmap(self._fd, self.length)
+        except BaseException:
+            os.close(self._fd)
+            raise
+        self._open = True
+
+    def fileno(self) -> int:
+        return self._fd
+
+    def view(self) -> memoryview:
+        return memoryview(self._mm)
+
+    def close(self) -> None:
+        if not self._open:
+            return
+        self._open = False
+        try:
+            self._mm.close()
+        except BufferError:
+            pass    # live view()s pin the mapping; it unmaps when they die
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+
+
+class DaemonSource:
+    """Handle to a source the daemon opened on this session's behalf."""
+
+    def __init__(self, sess: "DaemonSession", handle: int, size: int):
+        self._sess = sess
+        self.handle = handle
+        self.size = int(size)
+
+    def close(self) -> None:
+        self._sess._close_source(self.handle)
+
+
+class DaemonSession:
+    """One attached client session.
+
+    Thread-safe the way the engine Session is: one lock serializes the
+    socket (request/reply protocol — one RPC in flight per session), and
+    submitted tasks are waited via their daemon task id, so a submit-ahead
+    /wait-behind pipeline works exactly as against the engine."""
+
+    def __init__(self, socket_path: Optional[str] = None, *,
+                 tenant: Optional[str] = None,
+                 qos_class: Optional[str] = None,
+                 weight: Optional[float] = None,
+                 rate: Optional[float] = None,
+                 timeout: float = 30.0):
+        path = socket_path or config.get("daemon_socket") \
+            or default_socket_path()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._buffers: dict = {}
+        self.tenant = tenant or f"pid{os.getpid()}"
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            self._sock.settimeout(timeout)
+            self._sock.connect(path)
+            self._framer = Framer(self._sock)
+            attach = {"op": "attach", "version": PROTOCOL_VERSION,
+                      "tenant": self.tenant, "pid": os.getpid()}
+            if qos_class is not None:
+                attach["class"] = qos_class
+            if weight is not None:
+                attach["weight"] = float(weight)
+            if rate is not None:
+                attach["rate"] = float(rate)
+            reply = self._rpc(attach)
+        except BaseException:
+            self._sock.close()
+            raise
+        self.session_id = int(reply["session"])
+
+    # -- plumbing -----------------------------------------------------------
+    def _rpc(self, msg: dict, fds: Tuple[int, ...] = ()) -> dict:
+        with self._lock:
+            if self._closed:
+                raise StromError(_errno.EBADF, "session closed")
+            send_msg(self._sock, msg, fds)
+            got = self._framer.recv()
+        if got is None:
+            raise StromError(_errno.ECONNRESET,
+                             "daemon closed the connection")
+        reply, stray = got
+        for fd in stray:        # this protocol never sends fds back
+            os.close(fd)
+        if not reply.get("ok"):
+            raise StromError(int(reply.get("errno", _errno.EIO)),
+                             reply.get("error", "daemon error"))
+        return reply
+
+    # -- engine-shaped API --------------------------------------------------
+    def ping(self) -> bool:
+        return bool(self._rpc({"op": "ping"}).get("pong"))
+
+    def configure(self, *, qos_class: Optional[str] = None,
+                  weight: Optional[float] = None,
+                  rate: Optional[float] = None) -> dict:
+        msg = {"op": "configure"}
+        if qos_class is not None:
+            msg["class"] = qos_class
+        if weight is not None:
+            msg["weight"] = float(weight)
+        if rate is not None:
+            msg["rate"] = float(rate)
+        return self._rpc(msg)
+
+    def alloc_dma_buffer(self, length: int, *,
+                         numa_node: int = -1) -> Tuple[int, DaemonBuffer]:
+        """Engine ``alloc_dma_buffer`` analog: returns (daemon buffer
+        handle, shared :class:`DaemonBuffer`).  *numa_node* is accepted
+        for signature parity; placement is the daemon's concern."""
+        buf = DaemonBuffer(length)
+        try:
+            reply = self._rpc({"op": "map", "length": buf.length},
+                              fds=(buf.fileno(),))
+        except BaseException:
+            buf.close()
+            raise
+        handle = int(reply["handle"])
+        with self._lock:
+            self._buffers[handle] = buf
+        return handle, buf
+
+    def unmap_buffer(self, handle: int, *, wait: bool = True,
+                     timeout: float = 30.0) -> None:
+        self._rpc({"op": "unmap", "handle": int(handle)})
+        with self._lock:
+            buf = self._buffers.pop(handle, None)
+        if buf is not None:
+            buf.close()
+
+    def open_source(self, spec, **kw) -> DaemonSource:
+        """Open a source daemon-side.  *spec* is a path/url string (the
+        engine ``open_source`` forms) or — against an ``allow_fake``
+        daemon — a dict naming the loopback test source."""
+        msg = {"op": "open", "spec": spec}
+        for k in ("stripe_chunk_size", "segment_size", "mirror"):
+            if kw.get(k) is not None:
+                msg[k] = kw[k]
+        reply = self._rpc(msg)
+        return DaemonSource(self, int(reply["handle"]), reply["size"])
+
+    def _close_source(self, handle: int) -> None:
+        self._rpc({"op": "close_source", "handle": int(handle)})
+
+    def memcpy_ssd2ram(self, source: DaemonSource, buf_handle: int,
+                       chunk_ids: List[int], chunk_size: int, *,
+                       dest_offset: int = 0,
+                       wb_buffer=None) -> MemCopyResult:
+        """Submit one DMA command through the daemon's QoS queue.
+
+        Returns the submit-time result (task id + preliminary routing,
+        like the engine's async submit); :meth:`memcpy_wait` returns the
+        authoritative result including the engine's chunk reordering."""
+        ids = [int(c) for c in chunk_ids]
+        reply = self._rpc({"op": "submit", "source": source.handle,
+                           "buffer": int(buf_handle), "chunk_ids": ids,
+                           "chunk_size": int(chunk_size),
+                           "dest_offset": int(dest_offset)})
+        return MemCopyResult(dma_task_id=int(reply["task_id"]),
+                             nr_chunks=len(ids), nr_ssd2dev=len(ids),
+                             nr_ram2dev=0, chunk_ids=ids)
+
+    def memcpy_wait(self, task_id: int,
+                    timeout: Optional[float] = None) -> MemCopyResult:
+        msg = {"op": "wait", "task_id": int(task_id)}
+        if timeout is not None:
+            msg["timeout"] = float(timeout)
+        reply = self._rpc(msg)
+        return MemCopyResult(dma_task_id=int(reply["task_id"]),
+                             nr_chunks=int(reply["nr_chunks"]),
+                             nr_ssd2dev=int(reply["nr_ssd2dev"]),
+                             nr_ram2dev=int(reply["nr_ram2dev"]),
+                             chunk_ids=[int(c) for c in reply["chunk_ids"]],
+                             landing=reply.get("landing", ""))
+
+    def stat_info(self, *, debug: bool = False) -> StatInfo:
+        reply = self._rpc({"op": "stat", "debug": debug})
+        return StatInfo(version=1, has_debug=debug,
+                        timestamp_ns=int(reply["timestamp_ns"]),
+                        counters=reply["counters"])
+
+    def daemon_stat(self, *, debug: bool = False) -> dict:
+        """Full daemon scoreboard: counters + per-tenant table + session
+        count + queue depth (what ``tpu_stat --daemon`` renders)."""
+        return self._rpc({"op": "stat", "debug": debug})
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            bufs, self._buffers = dict(self._buffers), {}
+            try:
+                send_msg(self._sock, {"op": "detach"})
+                self._framer.recv()
+            except (OSError, StromError):
+                pass            # daemon already gone: nothing to detach
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for buf in bufs.values():
+            buf.close()
+
+    def __enter__(self) -> "DaemonSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

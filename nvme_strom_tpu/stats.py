@@ -150,6 +150,12 @@ class StatRegistry:
         # last cur_dma_count transition timestamp for the occupancy
         # integral (0 = no transition seen yet)
         self._occ_last_ns = 0
+        # per-tenant QoS accounting (ISSUE 12): stromd attributes every
+        # admitted byte to the tenant that submitted it — config echo
+        # (class/weight/rate/quota), delivered totals, in-flight gauges,
+        # reject/throttle counts, and a log2-ns queue-wait histogram.
+        # tenant -> dict; shape documented at tenant_snapshot().
+        self._tenants: dict = {}
 
     def enabled(self) -> bool:
         return bool(config.get("stat_info"))
@@ -301,6 +307,71 @@ class StatRegistry:
                 d["state_s"] = round(now - since, 3)
             return out
 
+    def _tenant(self, tenant: str) -> dict:
+        # caller holds self._lock
+        return self._tenants.setdefault(tenant, {
+            "class": "normal", "weight": 1.0, "rate": 0.0,
+            "quota_tasks": 0, "quota_bytes": 0,
+            "tasks": 0, "bytes": 0, "rejects": 0, "throttles": 0,
+            "inflight_tasks": 0, "inflight_bytes": 0,
+            "wait_hist": [0] * LAT_HIST_BUCKETS,
+        })
+
+    def tenant_configure(self, tenant: str, *, qos_class: str = None,
+                         weight: float = None, rate: float = None,
+                         quota_tasks: int = None,
+                         quota_bytes: int = None) -> None:
+        """Record a tenant's QoS configuration (attach/configure echo) so
+        the scoreboard shows policy next to delivery.  None = keep."""
+        with self._lock:
+            t = self._tenant(tenant)
+            if qos_class is not None:
+                t["class"] = qos_class
+            if weight is not None:
+                t["weight"] = float(weight)
+            if rate is not None:
+                t["rate"] = float(rate)
+            if quota_tasks is not None:
+                t["quota_tasks"] = int(quota_tasks)
+            if quota_bytes is not None:
+                t["quota_bytes"] = int(quota_bytes)
+
+    def tenant_inflight(self, tenant: str, dtasks: int, dbytes: int) -> None:
+        """Adjust a tenant's in-flight quota gauges (admission +, finalize
+        -).  Not gated on enabled(): quota gauges must track reality."""
+        with self._lock:
+            t = self._tenant(tenant)
+            t["inflight_tasks"] += dtasks
+            t["inflight_bytes"] += dbytes
+
+    def tenant_task(self, tenant: str, nbytes: int, wait_ns: int) -> None:
+        """Account one delivered task: bytes plus its scheduler queue wait
+        into the tenant's log2 wait histogram (p50/p95 via
+        :func:`hist_percentiles`)."""
+        with self._lock:
+            t = self._tenant(tenant)
+            t["tasks"] += 1
+            t["bytes"] += nbytes
+            b = min(max(int(wait_ns), 1).bit_length() - 1,
+                    LAT_HIST_BUCKETS - 1)
+            t["wait_hist"][b] += 1
+
+    def tenant_reject(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant(tenant)["rejects"] += 1
+
+    def tenant_throttle(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant(tenant)["throttles"] += 1
+
+    def tenant_snapshot(self) -> dict:
+        """{tenant: {class, weight, rate, quota_tasks, quota_bytes, tasks,
+        bytes, rejects, throttles, inflight_tasks, inflight_bytes,
+        wait_hist}} — deep-copied so callers can diff intervals."""
+        with self._lock:
+            return {k: dict(v, wait_hist=list(v["wait_hist"]))
+                    for k, v in sorted(self._tenants.items())}
+
     @contextmanager
     def stage(self, name: str):
         """Time a pipeline stage into its count+clock pair."""
@@ -435,7 +506,8 @@ class StatRegistry:
         payload = {"timestamp_ns": snap.timestamp_ns, "pid": os.getpid(),
                    "version": snap.version, "counters": snap.counters,
                    "members": self.member_snapshot(),
-                   "lat_hist": self.lat_hist_snapshot()}
+                   "lat_hist": self.lat_hist_snapshot(),
+                   "tenants": self.tenant_snapshot()}
         try:
             # mkstemp: O_EXCL private temp (no symlink following in shared
             # /tmp), then atomic replace

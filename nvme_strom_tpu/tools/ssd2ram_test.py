@@ -13,11 +13,15 @@ Usage: ssd2ram_test [-c] [-n LOOPS] [-p DEPTH] [-s UNIT_SZ] [--chunk SZ] FILE
   -s UNIT_SZ    ring unit size, e.g. 32m (default 32MB, the reference's)
   --chunk SZ    chunk size within a unit (default 1m)
   --backend B   io_uring | threadpool | python (default config)
+  --daemon SOCK run against a shared stromd at SOCK instead of an
+                in-process engine (same ring loop over the thin client)
+  --tenant T    tenant name to attach as in --daemon mode
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 import time
 
@@ -39,6 +43,10 @@ def main(argv=None) -> int:
     ap.add_argument("-s", "--unit", type=parse_size, default=32 << 20)
     ap.add_argument("--chunk", type=parse_size, default=1 << 20)
     ap.add_argument("--backend", default=None)
+    ap.add_argument("--daemon", metavar="SOCK", default=None,
+                    help="stromd socket path (exercise the client path)")
+    ap.add_argument("--tenant", default=None,
+                    help="tenant name for --daemon mode")
     ap.add_argument("--no-drop-cache", action="store_true")
     args = ap.parse_args(argv)
 
@@ -77,9 +85,22 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     total = 0
     wait_ns = 0
-    with open_source(args.file) as src, Session() as sess:
+    with contextlib.ExitStack() as stack:
+        # the daemon client mirrors the engine's command surface, so the
+        # submit-ahead/wait-behind ring below is backend-agnostic
+        if args.daemon:
+            from ..daemon import DaemonSession
+            sess = stack.enter_context(
+                DaemonSession(args.daemon, tenant=args.tenant))
+            src = sess.open_source(args.file)
+            stack.callback(src.close)
+            backend = f"daemon ({sess.tenant})"
+        else:
+            src = stack.enter_context(open_source(args.file))
+            sess = stack.enter_context(Session())
+            backend = sess.backend_name
         ring = [sess.alloc_dma_buffer(unit) for _ in range(depth)]
-        print(f"backend: {sess.backend_name}   ring: {depth} x "
+        print(f"backend: {backend}   ring: {depth} x "
               f"{unit >> 20}MB units   chunk: {args.chunk >> 10}KB")
         inflight = []  # (task_id, ring_idx)
         gu = 0  # monotonic across loops: ring slot gu % depth is only reused

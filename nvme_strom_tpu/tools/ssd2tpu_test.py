@@ -117,6 +117,12 @@ def main(argv=None) -> int:
     ap.add_argument("-p", "--print-memory", action="store_true",
                     help="dump registered device buffers")
     ap.add_argument("--backend", default=None)
+    ap.add_argument("--daemon", metavar="SOCK", default=None,
+                    help="route the SSD leg through a shared stromd at "
+                         "SOCK (DMA lands in shared memory, the H2D hop "
+                         "stays client-side)")
+    ap.add_argument("--tenant", default=None,
+                    help="tenant name for --daemon mode")
     ap.add_argument("--no-drop-cache", action="store_true")
     ap.add_argument("--loops", type=int, default=1,
                     help="repeat the transfer; per-loop GB/s is printed and "
@@ -220,6 +226,80 @@ def main(argv=None) -> int:
         arr = registry.get(handle).array
         arr.block_until_ready()
         mode = f"vfs baseline (iosize {args.vfs >> 10}KB)"
+    elif args.daemon:
+        # shared-daemon path: stromd QoS-schedules each segment's DMA into
+        # a memfd both processes map, then this client lands the bytes in
+        # HBM — SSD arbitration is the daemon's, the H2D hop ours
+        from types import SimpleNamespace
+        from ..daemon import DaemonSession
+        from ..hbm.staging import _land
+        seg = args.segment_size
+        per_seg = max(seg // chunk, 1)
+        n_segs = (n_chunks + per_seg - 1) // per_seg
+        handle = registry.map_device_memory(nbytes, device=dev)
+        hbm = registry.acquire(handle)
+        order: list = []
+        wbc = [0]
+        try:
+            with DaemonSession(args.daemon, tenant=args.tenant) as dsess:
+                spec = paths if striped else paths[0]
+                dsrc = dsess.open_source(
+                    spec, stripe_chunk_size=args.stripe_chunk
+                    if striped else None)
+                depth = max(1, min(args.segments, 4))
+                dbufs = [dsess.alloc_dma_buffer(seg) for _ in range(depth)]
+                inflight: list = []   # (task_id, ring_idx, dest_off, nbytes)
+
+                def retire():
+                    tid, ridx, off, nb = inflight.pop(0)
+                    r = dsess.memcpy_wait(tid)
+                    order.extend(r.chunk_ids)
+                    wbc[0] += r.nr_ram2dev
+                    # copy out before the ring slot is reused: device_put
+                    # is async and must never watch a refilling buffer
+                    host = np.frombuffer(
+                        dbufs[ridx][1].view()[:nb], dtype=np.uint8).copy()
+                    _land(hbm, jax.device_put(host, dev), off, seg)
+
+                # warmup compiles the landing kernels with the run's shapes
+                warm = jax.device_put(np.zeros(min(seg, nbytes), np.uint8),
+                                      dev)
+                _land(hbm, warm, 0, seg)
+                registry.get(handle).array.block_until_ready()
+                for loop in range(args.loops):
+                    _drop()
+                    order.clear()
+                    wbc[0] = 0
+                    tl = time.monotonic()
+                    for s in range(n_segs):
+                        if len(inflight) >= depth:
+                            retire()
+                        ids = list(range(s * per_seg,
+                                         min((s + 1) * per_seg, n_chunks)))
+                        ridx = s % depth
+                        r = dsess.memcpy_ssd2ram(dsrc, dbufs[ridx][0], ids,
+                                                 chunk)
+                        inflight.append((r.dma_task_id, ridx,
+                                         s * per_seg * chunk,
+                                         len(ids) * chunk))
+                    while inflight:
+                        retire()
+                    registry.get(handle).array.block_until_ready()
+                    dt = time.monotonic() - tl
+                    if args.loops > 1:
+                        print(f"  loop {loop + 1}: "
+                              f"{nbytes / dt / (1 << 30):.2f} GB/s")
+                    best = dt if best is None else min(best, dt)
+                snap = dsess.stat_info(debug=True)
+                dsrc.close()
+        finally:
+            registry.release(hbm)
+        arr = registry.get(handle).array
+        arr.block_until_ready()
+        res = SimpleNamespace(chunk_ids=order, nr_ram2dev=wbc[0],
+                              nr_chunks=n_chunks)
+        mode = (f"daemon ({args.daemon}, {args.segments} x "
+                f"{seg >> 20}MB segments)")
     else:
         with _open() as src, Session() as sess:
             handle = registry.map_device_memory(nbytes, device=dev)

@@ -124,6 +124,74 @@ def _header(verbose: bool) -> str:
     return " ".join(cols)
 
 
+def _tenant_scoreboard(tenants: dict, prev: dict = None,
+                       dt: float = 0.0) -> None:
+    """Per-tenant QoS scoreboard (ISSUE 12): policy (class/weight/shape/
+    quota) next to delivery (in-flight, GB/s, rejects/throttles, queue
+    wait p50/p95).  With *prev*+*dt* the GB/s column is the interval
+    delta (shaped-vs-delivered comparison); one-shot shows lifetime."""
+    if not tenants:
+        print("no tenants attached")
+        return
+    print("tenant            class    wgt  shape-GB/s  quota(t/B)     "
+          "infl(t/B)      deliv-GB/s  rej  thr  wait-p50 wait-p95")
+    for name, t in sorted(tenants.items()):
+        p50, p95 = hist_percentiles(t.get("wait_hist") or [0],
+                                    qs=(0.50, 0.95))
+        pbytes = (prev or {}).get(name, {}).get("bytes", 0)
+        if prev is not None and dt > 0:
+            gbs = (t.get("bytes", 0) - pbytes) / dt / (1 << 30)
+        else:
+            gbs = t.get("bytes", 0) / (1 << 30)  # lifetime GB, not a rate
+        rate = t.get("rate", 0.0)
+        shape = f"{rate / (1 << 30):10.2f}" if rate else "  unshaped"
+        qt, qb = t.get("quota_tasks", 0), t.get("quota_bytes", 0)
+        quota = f"{qt or '-':>5}/{(qb >> 20) if qb else '-':>6}"
+        infl = f"{t.get('inflight_tasks', 0):>4}/" \
+               f"{t.get('inflight_bytes', 0) >> 20:>6}M"
+        print(f"{name:<17} {t.get('class', '?'):<8} "
+              f"{t.get('weight', 1.0):4.1f}  {shape}  {quota:>12}  "
+              f"{infl:>12}  {gbs:10.2f}  "
+              f"{t.get('rejects', 0):>3}  {t.get('throttles', 0):>3}  "
+              f"{_pshow(p50)} {_pshow(p95)}")
+
+
+def _daemon_view(args) -> int:
+    """`tpu_stat --daemon [SOCK]`: with a socket, attach a monitor
+    session and read the live scoreboard; with no socket, render the
+    ``tenants`` table from the selected stats-export payload."""
+    if args.daemon:
+        from ..daemon import DaemonSession
+        with DaemonSession(args.daemon, tenant="_tpu_stat") as mon:
+            st = mon.daemon_stat()
+            print(f"stromd @ {args.daemon}: {st.get('sessions', 0)} "
+                  f"session(s), queue depth {st.get('queue_depth', 0)}")
+            if args.interval is None:
+                _tenant_scoreboard(st.get("tenants", {}))
+                return 0
+            prev, t_prev = st.get("tenants", {}), time.monotonic()
+            try:
+                while True:
+                    time.sleep(args.interval)
+                    st = mon.daemon_stat()
+                    now = time.monotonic()
+                    print(f"-- depth {st.get('queue_depth', 0)}  "
+                          f"sessions {st.get('sessions', 0)}")
+                    _tenant_scoreboard(st.get("tenants", {}), prev,
+                                       now - t_prev)
+                    prev, t_prev = st.get("tenants", {}), now
+            except KeyboardInterrupt:
+                return 0
+    snap = _read(args.file) if args.file else None
+    if snap is None:
+        print("no stats payload — give --daemon a socket path or select "
+              "an export with -f/-p", file=sys.stderr)
+        return 1
+    print(f"pid {snap.get('pid')} tenants:")
+    _tenant_scoreboard(snap.get("tenants", {}))
+    return 0
+
+
 def _list_sessions() -> int:
     """`tpu_stat -l`: every per-pid export under the shared dir, with
     liveness, snapshot age, and headline counters."""
@@ -181,6 +249,11 @@ def main(argv=None) -> int:
                     help="list flight-recorder dumps (newest first) with "
                          "a per-file summary; open them with strom_trace "
                          "or Perfetto")
+    ap.add_argument("--daemon", nargs="?", const="", default=None,
+                    metavar="SOCK",
+                    help="per-tenant stromd scoreboard: with SOCK attach "
+                         "to the live daemon, without it render the "
+                         "tenants table of the selected export (-f/-p)")
     args = ap.parse_args(argv)
     if args.trace:
         from .strom_trace import list_cmd
@@ -191,6 +264,9 @@ def main(argv=None) -> int:
         if args.file or args.pid or args.interval is not None:
             ap.error("-l lists sessions; drop the other selectors")
         return _list_sessions()
+    if args.daemon:
+        # a socket path queries the live daemon; no export file needed
+        return _daemon_view(args)
     if args.file and args.pid is not None:
         ap.error("-f and -p are exclusive selectors")
     if args.pid is not None:
@@ -217,6 +293,10 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 _list_sessions()
                 return 1
+
+    if args.daemon is not None:
+        # no socket: render the selected export's tenants table
+        return _daemon_view(args)
 
     snap = _read(args.file)
     if snap is None:

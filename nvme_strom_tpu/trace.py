@@ -91,6 +91,15 @@ EVENT_SCHEMA: Dict[str, str] = {
     "cache_evict": "instant",
     "cache_invalidate": "instant",
     "resync_skip": "instant",    # degraded write leg journaled for resync
+    # shared serving daemon (ISSUE 12): stromd session lifecycle and the
+    # QoS scheduler in front of the lanes
+    "session_attach": "instant",   # client attached (tenant/class in args)
+    "session_detach": "instant",   # clean detach released the session
+    "session_reap": "instant",     # orphan reaped after client disconnect
+    "admission_reject": "instant",  # submit bounced by per-tenant quota
+    "qos_enqueue": "instant",      # task admitted into the QoS queue
+    "qos_throttle": "instant",     # tenant token-bucket-gated (edge)
+    "qos_wait": "span",            # enqueue -> scheduler-dispatch window
 }
 
 
@@ -503,7 +512,8 @@ def _prom_name(counter: str) -> str:
 
 _PROM_GAUGES = ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
                 "occ_integral_ns", "occ_busy_ns", "cache_resident_bytes",
-                "resync_pending_bytes")
+                "resync_pending_bytes", "daemon_sessions",
+                "qos_queue_depth")
 
 
 def render_prometheus(payload: dict) -> str:
@@ -593,6 +603,43 @@ def render_prometheus(payload: dict) -> str:
         for m, st in states:
             out.append(f'strom_tpu_member_state{{member="{m}",'
                        f'state="{st}"}} 1')
+    # per-tenant QoS attribution (ISSUE 12): one series per tenant so
+    # dashboards can plot delivered bandwidth, quota pressure and queue
+    # wait per tenant of a shared stromd — mirrors the member family
+    tenants = payload.get("tenants", {})
+    for metric, key, mtype in (
+            ("strom_tpu_tenant_tasks_total", "tasks", "counter"),
+            ("strom_tpu_tenant_bytes_total", "bytes", "counter"),
+            ("strom_tpu_tenant_rejects_total", "rejects", "counter"),
+            ("strom_tpu_tenant_throttles_total", "throttles", "counter"),
+            ("strom_tpu_tenant_inflight_tasks", "inflight_tasks", "gauge"),
+            ("strom_tpu_tenant_inflight_bytes", "inflight_bytes", "gauge"),
+            ("strom_tpu_tenant_weight", "weight", "gauge")):
+        rows = [(t, d.get(key, 0)) for t, d in sorted(tenants.items())]
+        if not any(v for _, v in rows):
+            continue
+        out.append(f"# TYPE {metric} {mtype}")
+        for t, v in rows:
+            out.append(f'{metric}{{tenant="{t}"}} {v}')
+    for t, d in sorted(tenants.items()):
+        whist = d.get("wait_hist") or []
+        if not any(whist):
+            continue
+        name = "strom_tpu_tenant_wait_seconds"
+        out.append(f"# TYPE {name} histogram")
+        acc = 0
+        total = sum(whist)
+        wsum_ns = 0
+        for b in range(min(len(whist), LAT_HIST_BUCKETS)):
+            n = whist[b]
+            acc += n
+            wsum_ns += n * ((1 << b) + ((1 << b) >> 1))
+            if n:
+                le = (1 << (b + 1)) / 1e9
+                out.append(f'{name}_bucket{{tenant="{t}",le="{le:g}"}} {acc}')
+        out.append(f'{name}_bucket{{tenant="{t}",le="+Inf"}} {total}')
+        out.append(f'{name}_sum{{tenant="{t}"}} {wsum_ns / 1e9:.9f}')
+        out.append(f'{name}_count{{tenant="{t}"}} {total}')
     # request-latency histogram: cumulative le buckets in seconds
     if any(hist):
         name = "strom_tpu_request_latency_seconds"
