@@ -67,6 +67,14 @@ CACHE_AB.jsonl and prints one JSON line with both numbers, the speedup
 and the measured hit ratio.  The deterministic latency-bound gate on
 this path is ``make cache-gate``; this bench records the real-file
 numbers for the trend journal.
+
+Compute-pushdown A/B (ISSUE 14): ``python bench.py --pushdown``
+interleaves a raw-transport scan with a packed + on-chip-decode scan of
+the same compressible synthetic table, journals to PUSHDOWN_AB.jsonl and
+prints one JSON line with both effective LOGICAL GB/s medians, the codec
+ratio, a result-identity check and the packed rate vs the ``h2d_peak``
+ceiling (which the packed leg can exceed: only wire bytes cross the
+link).  The deterministic gate is ``make pushdown-gate``.
 """
 
 import fcntl
@@ -787,6 +795,122 @@ print("ROW=" + json.dumps(row))
 """
 
 
+_PUSHDOWN_CODE = """
+import json, os, statistics, time
+import numpy as np
+from nvme_strom_tpu import config, stats
+from nvme_strom_tpu.scan import colpack
+from nvme_strom_tpu.scan.heap import HeapSchema, PAGE_SIZE, build_heap_file
+from nvme_strom_tpu.scan.query import Query
+
+path = os.environ["PUSHDOWN_BENCH_FILE"]
+rounds = int(os.environ.get("PUSHDOWN_BENCH_ROUNDS", "3"))
+size_mb = int(os.environ.get("PUSHDOWN_BENCH_MB", "64"))
+
+# compressible synthetic: two low-cardinality dims (dict/bitpack), one
+# narrow measure (bitpack), one incompressible float (raw) — the OLAP
+# shape the codec ratio argument is about
+schema = HeapSchema(4, dtypes=("i4", "i4", "i4", "f4"))
+rows = (size_mb << 20) // PAGE_SIZE * schema.tuples_per_page
+if not os.path.exists(path) or os.path.getsize(path) \
+        != ((rows + schema.tuples_per_page - 1)
+            // schema.tuples_per_page) * PAGE_SIZE:
+    rng = np.random.default_rng(7)
+    build_heap_file(path, [
+        (np.arange(rows) % 16).astype(np.int32),
+        np.repeat(np.arange((rows + 1023) // 1024), 1024)[:rows]
+          .astype(np.int32),
+        rng.integers(0, 200, rows).astype(np.int32),
+        rng.random(rows).astype(np.float32)], schema)
+meta = colpack.probe_packed(path) or colpack.build_packed(path, schema)
+logical = meta.logical_bytes
+heap_bytes = os.path.getsize(path)
+
+q = (Query(path, schema).where(lambda c: c[0] > 3).aggregate([1, 2]))
+
+
+def leg(mode):
+    config.set("pushdown", mode)
+    t0 = time.monotonic()
+    out = q.run()
+    dt = time.monotonic() - t0
+    return logical / dt / (1 << 30), out
+
+
+runs = {"raw": [], "packed": []}
+outs = {}
+chip0 = stats.snapshot(reset_max=False).counters.get(
+    "nr_pushdown_decode_chip", 0)
+for r in range(rounds):
+    order = ["raw", "packed"] if r % 2 == 0 else ["packed", "raw"]
+    for mode in order:
+        gbps, out = leg("off" if mode == "raw" else "on")
+        runs[mode].append(gbps)
+        outs[mode] = out
+chip1 = stats.snapshot(reset_max=False).counters.get(
+    "nr_pushdown_decode_chip", 0)
+
+identical = (int(outs["raw"]["count"]) == int(outs["packed"]["count"])
+             and all(int(np.asarray(a)) == int(np.asarray(b))
+                     for a, b in zip(outs["raw"]["sums"],
+                                     outs["packed"]["sums"])))
+row = {m: round(statistics.median(v), 3) for m, v in runs.items()}
+row["speedup"] = (round(row["packed"] / row["raw"], 3)
+                  if row["raw"] else None)
+row["codec_ratio"] = round(meta.ratio, 3)
+row["wire_mb"] = round(meta.packed_bytes / (1 << 20), 1)
+row["logical_mb"] = round(logical / (1 << 20), 1)
+row["identical"] = identical
+row["chip_decodes"] = int(chip1 - chip0)
+try:   # cwd is the repo root (the driver passes cwd=REPO)
+    with open("BENCH_MATRIX.json") as f:
+        h2d = json.load(f)["results"].get("h2d_peak")
+except (OSError, KeyError, ValueError):
+    h2d = None
+row["h2d_peak"] = h2d
+# the headline: effective LOGICAL GB/s of the packed path against the
+# transport ceiling raw bytes can never beat
+row["vs_h2d_peak"] = (round(row["packed"] / h2d, 3) if h2d else None)
+print("ROW=" + json.dumps(row))
+"""
+
+
+def _pushdown_ab() -> int:
+    """``bench.py --pushdown``: interleaved A/B of raw transport vs
+    packed + on-chip decode on a compressible synthetic table, journaled
+    to PUSHDOWN_AB.jsonl.  The reported rate is effective LOGICAL GB/s —
+    logical bytes the query consumed per wall second — which for the
+    packed leg can exceed ``h2d_peak`` because only wire bytes cross the
+    link.  The deterministic latency-bound gate is ``make
+    pushdown-gate``; this records the real-file trend numbers."""
+    smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
+    size_mb = 16 if smoke else int(os.environ.get("BENCH_SIZE_MB", "64"))
+    path = os.environ.get("BENCH_FILE",
+                          f"/tmp/strom_tpu_pushdown_{size_mb}.tbl")
+    _lock = hold_bench_lock("bench.py --pushdown")
+    env = _env()
+    env["PUSHDOWN_BENCH_FILE"] = path
+    env["PUSHDOWN_BENCH_MB"] = str(size_mb)
+    env.setdefault("PUSHDOWN_BENCH_ROUNDS", "1" if smoke else "3")
+    out = subprocess.run([sys.executable, "-c", _PUSHDOWN_CODE],
+                         capture_output=True, text=True, cwd=REPO, env=env,
+                         timeout=1800)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise RuntimeError("pushdown A/B run failed")
+    m = re.search(r"ROW=(\{.*\})", out.stdout)
+    row = {"metric": "pushdown_ab_logical_GBps", "unit": "GB/s",
+           **json.loads(m.group(1))}
+    entry = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **row}
+    try:
+        with open(os.path.join(REPO, "PUSHDOWN_AB.jsonl"), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        sys.stderr.write(f"bench: could not journal pushdown A/B: {e}\n")
+    print(json.dumps(row))
+    return 0
+
+
 def _cache_ab() -> int:
     """``bench.py --cache``: interleaved cold-vs-hot A/B of the
     cross-query residency tier on a real file (same chunking, tier
@@ -1020,6 +1144,8 @@ def main() -> int:
         return _landing_ab()
     if "--cache" in sys.argv[1:]:
         return _cache_ab()
+    if "--pushdown" in sys.argv[1:]:
+        return _pushdown_ab()
     smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")

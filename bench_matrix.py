@@ -770,11 +770,18 @@ def _write_matrix(size_mb: int, results: dict, captured_at: dict) -> str:
                    "parallel_speedup": parallel_speedup,
                    "pallas_vs_xla": pallas_vs_xla,
                    "pallas_vs_xla_groupby": pallas_vs_xla_groupby,
+                   # the planner's auto-selection is driven by this row
+                   # (ops/groupby.groupby_kernel_auto, crossover 1.0),
+                   # so the record states which kernel auto now picks
                    "groupby_kernel_routing":
-                       "auto=xla for value-keyed GROUP BY and float "
-                       "aggregations (pallas_vs_xla_groupby < 1 across "
-                       "r4/r5 sessions; the pallas filter kernel keeps "
-                       "auto=pallas on chip at pallas_vs_xla > 1)"}, f,
+                       "auto=%s for float GROUP BY aggregation "
+                       "(measured pallas_vs_xla_groupby=%s, crossover "
+                       "1.0; value-keyed GROUP BY always XLA; the "
+                       "pallas filter kernel keeps auto=pallas on chip "
+                       "at pallas_vs_xla > 1)" % (
+                           "xla" if (pallas_vs_xla_groupby or 0.851)
+                           < 1.0 else "pallas",
+                           pallas_vs_xla_groupby)}, f,
                   indent=2)
         f.write("\n")
     os.replace(tmp, path)

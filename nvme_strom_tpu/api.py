@@ -311,6 +311,14 @@ STAT_FIELDS: Tuple[str, ...] = (
     #                           their class ring (edge, not per-poll)
     "daemon_sessions",        # gauge: sessions currently attached
     "qos_queue_depth",        # gauge: items queued ahead of dispatch
+    # compute pushdown (ISSUE 14): packed-extent scans that expand the
+    # codec on chip (fused decode->filter->project kernel) or on the
+    # host (SSD-bound: packed crosses the disk link only)
+    "nr_pushdown_decode_chip",   # packed batches expanded in VMEM by
+    #                              the fused decode kernel
+    "nr_pushdown_decode_host",   # packed batches expanded host-side
+    "bytes_wire_saved",          # logical-minus-packed bytes that never
+    #                              crossed the bottleneck transport
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

@@ -57,13 +57,19 @@ except OSError:  # pragma: no cover
 
 
 class _Entry:
-    __slots__ = ("key", "mm", "view", "length", "refs", "stale")
+    __slots__ = ("key", "mm", "view", "length", "logical_length", "refs",
+                 "stale")
 
-    def __init__(self, key, mm, length: int) -> None:
+    def __init__(self, key, mm, length: int,
+                 logical_length: int = 0) -> None:
         self.key = key
         self.mm = mm
         self.view = memoryview(mm)
         self.length = length
+        # packed extents (compute pushdown) serve more logical bytes
+        # than they occupy; capacity is charged at `length`, service
+        # credited at `logical_length`
+        self.logical_length = logical_length or length
         self.refs = 0
         self.stale = False
 
@@ -176,16 +182,22 @@ class ResidencyCache:
         """Stable identity for a source: the tuple of its members' real
         paths (works for plain, segmented and striped sources, and the
         loopback fakes, which subclass them)."""
+        # representation tags (e.g. a packed .cpk sidecar's
+        # "#repr=cpk"/"#gen=..." pair) extend the identity so a
+        # re-encoded file can never alias a stale cached extent; tags
+        # start with '#' and thus never collide with real paths
+        extra = tuple(getattr(source, "cache_key_extra", ()) or ())
         members = getattr(source, "members", None)
         if members:
             try:
-                return tuple(os.path.realpath(m.path) for m in members)
+                return tuple(os.path.realpath(m.path)
+                             for m in members) + extra
             except AttributeError:
                 pass
         path = getattr(source, "path", None)
         if isinstance(path, str):
-            return (os.path.realpath(path),)
-        return ("<anon:%d>" % id(source),)
+            return (os.path.realpath(path),) + extra
+        return ("<anon:%d>" % id(source),) + extra
 
     # -- read side ----------------------------------------------------
 
@@ -218,10 +230,13 @@ class ResidencyCache:
 
     # -- fill side ----------------------------------------------------
 
-    def fill(self, skey: tuple, base: int, length: int, data) -> bool:
+    def fill(self, skey: tuple, base: int, length: int, data, *,
+             logical_length: int = 0) -> bool:
         """Install healed bytes for an extent.  Returns True when the
         extent is now resident (skipped when the tier is off, the
-        extent exceeds capacity, or every candidate victim is pinned)."""
+        extent exceeds capacity, or every candidate victim is pinned).
+        ``logical_length`` — logical bytes this extent serves when it
+        holds a compressed representation (defaults to *length*)."""
         if not self.active or length <= 0:
             return False
         key = (skey, base, length)
@@ -253,7 +268,7 @@ class ResidencyCache:
             except (OSError, ValueError):  # pragma: no cover
                 return False
             self._mlock(mm, length)
-            e = _Entry(key, mm, length)
+            e = _Entry(key, mm, length, logical_length)
             e.view[:length] = data
             if in_b1 or in_b2:
                 self._t2[key] = e
@@ -386,6 +401,15 @@ class ResidencyCache:
     def resident_bytes(self) -> int:
         with self._lock:
             return self._bytes
+
+    def logical_resident_bytes(self) -> int:
+        """Logical bytes the tier can serve — equals
+        :meth:`resident_bytes` unless packed (compressed) extents are
+        resident, which serve more logical bytes than they pin."""
+        with self._lock:
+            return sum(e.logical_length
+                       for od in (self._t1, self._t2)
+                       for e in od.values() if not e.stale)
 
     def resident_fraction(self, paths: Sequence[str],
                           total_bytes: int) -> float:

@@ -135,6 +135,19 @@ def _check_qos_class(val: str, _cfg: "Config") -> None:
                           f"got {val!r}")
 
 
+def _check_pushdown(val: str, _cfg: "Config") -> None:
+    if val not in ("auto", "on", "off"):
+        raise ConfigError(f"pushdown must be auto|on|off, got {val!r}")
+
+
+def _check_pushdown_codecs(val: str, _cfg: "Config") -> None:
+    bad = [c for c in val.split(",") if c.strip()
+           and c.strip() not in ("bitpack", "dict", "rle")]
+    if bad:
+        raise ConfigError(f"pushdown_codecs must be a comma list of "
+                          f"bitpack|dict|rle, got {bad[0]!r}")
+
+
 def _check_coalesce_limit(val: int, cfg: "Config") -> None:
     # 0 = coalescing off; otherwise the merge window must cover at least
     # one dma_max_size request or planning could emit nothing mergeable
@@ -476,6 +489,38 @@ class Config:
         reg(Var("qos_burst", 8 << 20, "size", minval=64 << 10,
                 help="token-bucket burst capacity in bytes: how far a "
                      "shaped tenant may exceed its rate transiently"))
+        # compute pushdown: packed columnar extents decoded on-chip (ISSUE 14)
+        reg(Var("pushdown", "auto", "str",
+                help="packed-extent scans for pushdown-eligible queries: "
+                     "'auto' takes the packed representation when the "
+                     "per-column cost decision says the denser wire "
+                     "format wins (observed codec ratio vs the live h2d "
+                     "estimate), 'on' always scans a fresh .cpk sidecar "
+                     "when one exists, 'off' never does",
+                validate=_check_pushdown))
+        reg(Var("pushdown_codecs", "bitpack,dict,rle", "str",
+                help="codecs the packed-extent encoder may choose from "
+                     "(comma list of bitpack|dict|rle; raw is always "
+                     "available).  Narrowing this forces a representation "
+                     "— e.g. 'rle' alone for run-length-only tables",
+                validate=_check_pushdown_codecs))
+        reg(Var("pushdown_chip_ratio", 1.15, "float", minval=1.0,
+                help="chip-decode threshold: minimum observed codec ratio "
+                     "(logical/packed bytes) for on-chip expansion to pay "
+                     "for its decode dispatch; below it the column "
+                     "expands on the host (or ships raw when the whole "
+                     "scan compresses worse than this)"))
+        reg(Var("pushdown_h2d_gbps", 0.0, "float", minval=0.0,
+                help="override the planner's h2d link estimate in GB/s "
+                     "(0 = auto: live H2D rate meter, else the "
+                     "BENCH_MATRIX h2d_peak row, else 1.06 — the value "
+                     "measured for this host in round 4)"))
+        reg(Var("pushdown_ssd_gbps", 0.0, "float", minval=0.0,
+                help="override the planner's SSD read estimate in GB/s "
+                     "(0 = auto: BENCH_MATRIX raw_seq_read, else 3.36); "
+                     "together with pushdown_h2d_gbps this decides "
+                     "host-vs-chip expansion, so tests can force either "
+                     "decision deterministically"))
 
     # -- layered loading ---------------------------------------------------
     def _load_file(self) -> None:

@@ -1651,12 +1651,13 @@ class Session:
             # ladder (retry/hedge/mirror/checksum re-read), so a
             # degraded member still populates the tier via its
             # surviving legs — and a latched failure never fills
-            skey, fills, fdest = task.cache_fill
+            skey, fills, fdest, lscale = task.cache_fill
             task.cache_fill = None
             for base, length, doff in fills:
                 tf0 = time.monotonic_ns()
                 if _rcache.fill(skey, base, length,
-                                fdest[doff:doff + length]) \
+                                fdest[doff:doff + length],
+                                logical_length=int(length * lscale)) \
                         and _trace.active and task.trace_id:
                     _trace.span("cache_fill", tf0, time.monotonic_ns(),
                                 tid=task.trace_id, offset=base,
@@ -2001,7 +2002,8 @@ class Session:
                     fills.append((base,
                                   min(chunk_size, source.size - base),
                                   dest_offset + i * chunk_size))
-                task.cache_fill = (skey, fills, dest)
+                task.cache_fill = (skey, fills, dest,
+                                   getattr(source, "logical_scale", 1.0))
         except BaseException:
             while cache_hits:  # leases not yet served: unpin them
                 cache_hits.pop()[3].release()

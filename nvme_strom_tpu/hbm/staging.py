@@ -40,7 +40,7 @@ from ..trace import recorder as _tr
 from .registry import HbmRegistry, LandingBuffer, registry as global_registry
 
 __all__ = ["StagingPipeline", "load_file_to_device", "AdaptiveH2DDepth",
-           "plan_landing"]
+           "plan_landing", "H2DRateMeter", "h2d_meter"]
 
 
 class AdaptiveH2DDepth:
@@ -81,6 +81,39 @@ class AdaptiveH2DDepth:
                 self.depth -= 1
                 self._streak = 0
         return self.depth
+
+
+class H2DRateMeter:
+    """Live estimate of the host->device link rate, fed by the scan
+    pipeline's fence waits (executor.retire_oldest).
+
+    Only transfer-BOUND retirements update it: a fence that returned
+    immediately says nothing about the link (the transfer overlapped with
+    compute), while a blocking fence's bytes/blocked-time approximates
+    the drain rate of a backlogged link.  When no sample has landed yet,
+    consumers (the pushdown planner) fall back to the BENCH_MATRIX
+    calibration — the estimate refines under load instead of guessing.
+    EWMA so one anomalous burst cannot repoint the planner."""
+
+    _ALPHA = 0.2
+
+    def __init__(self) -> None:
+        self.rate_gbps = 0.0
+        self.samples = 0
+
+    def note(self, nbytes: int, blocked_ns: int) -> None:
+        if nbytes <= 0 or blocked_ns <= AdaptiveH2DDepth.BLOCK_NS:
+            return
+        gbps = nbytes / blocked_ns * (1e9 / (1 << 30))
+        self.rate_gbps = gbps if self.samples == 0 else \
+            (1 - self._ALPHA) * self.rate_gbps + self._ALPHA * gbps
+        self.samples += 1
+
+    def observed_gbps(self) -> Optional[float]:
+        return self.rate_gbps if self.samples else None
+
+
+h2d_meter = H2DRateMeter()
 
 
 def bounded_fence(arr, what: str = "h2d"):

@@ -349,7 +349,7 @@ class TableScanner:
         import jax
 
         from ..hbm.staging import (AdaptiveH2DDepth, bounded_fence,
-                                   safe_device_put)
+                                   h2d_meter, safe_device_put)
         # local_devices, not devices: under jax.distributed the
         # global list leads with process 0's device, and a
         # device_put onto a non-addressable device poisons the
@@ -400,6 +400,9 @@ class TableScanner:
             # ENODEV instead of hanging the fence
             bounded_fence(dev_pages, "scan-h2d")
             blocked_ns = _time.monotonic_ns() - t0
+            # transfer-bound retirements feed the live link estimate the
+            # pushdown planner keys its host-vs-chip decision on
+            h2d_meter.note(int(dev_pages.nbytes), blocked_ns)
             self.recycle(b)
             ready.append(dev_pages)
             if len(ready) >= kmax:

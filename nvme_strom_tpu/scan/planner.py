@@ -34,7 +34,8 @@ from ..engine import check_file
 
 __all__ = ["CapabilityCache", "capability_cache", "direct_scan_threshold",
            "should_use_direct_scan", "ScanCost", "cost_direct_scan",
-           "cost_vfs_scan"]
+           "cost_vfs_scan", "PushdownDecision", "decide_pushdown",
+           "transport_rates"]
 
 # conventional-path reference cost per 8KB page (PG's seq_page_cost = 1.0)
 VFS_PAGE_COST = 1.0
@@ -169,3 +170,145 @@ def cost_vfs_scan(n_pages: int, n_tuples: int, *, workers: int = 0) -> ScanCost:
                                          _MAX_PARALLEL_DISK_DIVISOR)
     cpu = n_tuples * CPU_TUPLE_COST / _parallel_divisor(workers)
     return ScanCost(startup=0.0, total=disk + cpu, pages=n_pages, workers=workers)
+
+
+# -- compute pushdown: where does each column expand? (ISSUE 14) -----------
+#
+# The AXI4MLIR question (PAPERS.md, arXiv:2402.19184): for each column,
+# does decompression happen on the host, on the chip, or not at all (ship
+# raw)?  The inputs are the OBSERVED codec ratio (exact, recorded by the
+# encoder per column) and the live transport picture: when h2d is the
+# ceiling (the measured reality here: h2d_peak 1.06 vs raw_seq_read 3.36
+# GB/s), packed bytes must stay packed across the link and expand in
+# VMEM; when the SSD is the ceiling instead, host expansion already
+# captures the win and keeps the decode off the accelerator.
+
+# round-4 measured fallbacks, used when BENCH_MATRIX.json is absent and
+# no override/live sample exists
+_H2D_GBPS_DEFAULT = 1.06
+_SSD_GBPS_DEFAULT = 3.36
+
+_bench_rates_cache: Optional[Tuple[Optional[float], Optional[float]]] = None
+
+
+def _bench_matrix_rates() -> Tuple[Optional[float], Optional[float]]:
+    """(h2d_peak, raw_seq_read) GB/s from the repo's BENCH_MATRIX.json,
+    (None, None) when absent/unreadable.  Cached: the file only changes
+    when `make bench-matrix` reruns."""
+    global _bench_rates_cache
+    if _bench_rates_cache is not None:
+        return _bench_rates_cache
+    import json
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "BENCH_MATRIX.json")
+    h2d = ssd = None
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        s = d.get("summary", d)
+        h2d = float(s.get("h2d_peak")) if s.get("h2d_peak") else None
+        ssd = float(s.get("raw_seq_read")) if s.get("raw_seq_read") else None
+    except (OSError, ValueError, TypeError):
+        pass
+    _bench_rates_cache = (h2d, ssd)
+    return _bench_rates_cache
+
+
+def transport_rates() -> Tuple[float, float]:
+    """(h2d_gbps, ssd_gbps) the pushdown decision runs on.
+
+    h2d precedence: config override > live H2D rate meter (fed by
+    transfer-bound scan fences) > BENCH_MATRIX calibration > measured
+    default.  ssd precedence is the same minus the live meter (the scan
+    path has no clean SSD-only probe)."""
+    h2d = float(config.get("pushdown_h2d_gbps"))
+    ssd = float(config.get("pushdown_ssd_gbps"))
+    bh2d, bssd = _bench_matrix_rates()
+    if not h2d:
+        from ..hbm.staging import h2d_meter
+        live = h2d_meter.observed_gbps()
+        h2d = live if live else (bh2d or _H2D_GBPS_DEFAULT)
+    if not ssd:
+        ssd = bssd or _SSD_GBPS_DEFAULT
+    return h2d, ssd
+
+
+@dataclass(frozen=True)
+class PushdownDecision:
+    """Where a packed scan expands, and the wire-byte prediction EXPLAIN
+    reports."""
+
+    mode: str                    # "chip" | "host" | "raw"
+    wire_bytes: int              # predicted bytes crossing host->device
+    logical_bytes: int           # bytes the query logically consumes
+    per_column: Tuple[tuple, ...]   # (col, codec, ratio, "chip"|"host"|"raw")
+    reason: str
+
+    def explain(self) -> str:
+        cols = ", ".join(
+            f"col{c}={where}({codec}" +
+            (f" {ratio:.1f}x)" if codec != "raw" else ")")
+            for c, codec, ratio, where in self.per_column)
+        codecs = "+".join(sorted({codec for _c, codec, _r, _w
+                                  in self.per_column})) or "none"
+        return (f"pushdown {self.mode}: predicted wire bytes: "
+                f"{self.wire_bytes} ({self.logical_bytes} logical, "
+                f"codec={codecs}); {cols}; {self.reason}")
+
+
+def decide_pushdown(meta, need_cols=None) -> PushdownDecision:
+    """Per-column host/chip/raw expansion decision for a packed sidecar.
+
+    *meta* is a ``scan/colpack.py`` PackedMeta; *need_cols* restricts the
+    decision to the columns the query touches (projection pushdown).
+    ``pushdown=on`` forces chip; ``auto`` keys on the observed codec
+    ratio vs ``pushdown_chip_ratio`` and on which transport is the
+    ceiling."""
+    mode_cfg = config.get("pushdown")
+    h2d, ssd = transport_rates()
+    thresh = float(config.get("pushdown_chip_ratio"))
+    need = set(range(len(meta.cols))) if need_cols is None \
+        else set(need_cols)
+    h2d_bound = ssd > h2d
+    per_col, wire = [], 0
+    for c, cm in enumerate(meta.cols):
+        if c not in need:
+            continue
+        ratio = cm.ratio
+        if mode_cfg == "on" or (ratio >= thresh and h2d_bound):
+            where = "chip"         # packed across the link, expand in VMEM
+        elif ratio >= thresh:
+            where = "host"         # SSD-bound: packed off disk only
+        else:
+            where = "raw"          # codec never paid for itself
+        per_col.append((c, cm.codec, round(ratio, 3), where))
+        wire += cm.packed_bytes
+    logical = 4 * meta.n_rows * len(per_col)
+    # the file is ONE representation: per-page headers + unselected-column
+    # regions ride along, so the honest wire prediction is whole packed
+    # pages, scaled to nothing only when the scan goes raw
+    wire_pages = meta.packed_bytes
+    scan_ratio = logical / wire_pages if wire_pages else 1.0
+    if mode_cfg == "off":
+        mode, why = "raw", "pushdown=off"
+    elif mode_cfg == "on":
+        mode, why = "chip", "pushdown=on (forced)"
+    elif not per_col:
+        mode, why = "raw", "no packable columns in the projection"
+    elif scan_ratio < thresh:
+        mode, why = "raw", (f"whole-scan codec ratio {scan_ratio:.2f}x "
+                            f"below chip threshold {thresh:.2f}x")
+    elif h2d_bound:
+        mode, why = "chip", (f"h2d is the ceiling ({h2d:.2f} vs SSD "
+                             f"{ssd:.2f} GB/s): packed bytes cross the "
+                             f"link, expand in VMEM")
+    else:
+        mode, why = "host", (f"SSD is the ceiling ({ssd:.2f} vs h2d "
+                             f"{h2d:.2f} GB/s): packed off disk, "
+                             f"expanded on host")
+    return PushdownDecision(
+        mode=mode,
+        wire_bytes=int(wire_pages if mode != "raw"
+                       else 4 * meta.n_rows * len(meta.cols)),
+        logical_bytes=int(logical),
+        per_column=tuple(per_col), reason=why)

@@ -202,6 +202,7 @@ class QueryPlan:
     join_strategy: Optional[str] = None  # broadcast | partitioned(N)
     workers: int = 0       # parallel worker processes (0 = serial)
     cache_hit_ratio: float = 0.0  # expected residency-tier hit fraction
+    pushdown: str = ""     # "" | chip | host | raw (packed-sidecar scan)
 
     def __str__(self) -> str:
         par = f", workers={self.workers}" if self.workers else ""
@@ -1273,15 +1274,13 @@ class Query:
                 return "xla", "x64 accumulators (i64/f64) exceed the " \
                               "pallas kernel's SMEM dtype support"
             from ..ops.groupby import _check_agg_cols as _cac
-            if _cac(self.schema, agg)[1].kind == "f":
-                # measured routing decision (VERDICT r4 weak #4 / next
-                # #8): pallas_vs_xla_groupby < 1.0 for float
-                # aggregations across r4/r5 sessions — recorded in
-                # BENCH_MATRIX's groupby_kernel_routing
-                return "xla", ("float aggregation routes to XLA "
-                               "(bench: pallas_vs_xla_groupby < 1.0 — "
-                               "the pallas GROUP BY earns its keep on "
-                               "int accumulators only)")
+            from ..ops.groupby import groupby_kernel_auto
+            # measured routing decision (VERDICT r4 weak #4 / next #8):
+            # the auto-selector keys on BENCH_MATRIX's live
+            # pallas_vs_xla_groupby ratio, crossover at 1.0
+            gk, gwhy = groupby_kernel_auto(_cac(self.schema, agg)[1].kind)
+            if gk == "xla":
+                return "xla", gwhy
             if on_tpu and g <= _PALLAS_MAX_GROUPS:
                 return "pallas", f"G={g} within the static-unroll bound " \
                                  f"({_PALLAS_MAX_GROUPS})"
@@ -1624,12 +1623,24 @@ class Query:
         elif ratio > 0:
             reason += (f"; residency tier holds ~{ratio:.0%} of the "
                        f"table (memcpy hits, no mincore probe)")
+        # compute pushdown (ISSUE 14): a fresh packed sidecar re-plans
+        # the scan over compressed extents; the per-column host/chip
+        # decision and the wire-byte prediction surface here so EXPLAIN
+        # shows exactly what will cross the transport
+        pd = ""
+        if mode == "local" and kernel != "invalid":
+            probe = self._pushdown_probe()
+            if probe is not None:
+                dec, _meta = probe
+                pd = dec.mode
+                reason += "; " + dec.explain()
         return QueryPlan(operator=self._op,
                          access_path="direct" if direct else "vfs",
                          kernel=kernel, mode=mode, n_pages=n_pages,
                          cost_direct=cd.total, cost_vfs=cv.total,
                          reason=reason,
-                         cache_hit_ratio=round(ratio, 4))
+                         cache_hit_ratio=round(ratio, 4),
+                         pushdown=pd)
 
     # -- compute builders ---------------------------------------------------
     def _build_fn(self, kernel: str):
@@ -1724,6 +1735,135 @@ class Query:
                            predicate=(lambda cols: pred(cols))
                            if pred else None, how=self._join_how)
         return (lambda pages: run(pages)), None
+
+    # -- compute pushdown (ISSUE 14) ----------------------------------------
+    def _pushdown_need_cols(self):
+        """Columns the packed scan must expand: the aggregate projection
+        when no predicate can read other columns, else all (an opaque
+        ``where()`` lambda may touch any column)."""
+        if self._pred is None and self._agg_cols is not None:
+            return tuple(self._agg_cols)
+        return None
+
+    def _pushdown_probe(self):
+        """(PushdownDecision, PackedMeta) when a fresh packed sidecar can
+        serve this query, else None.
+
+        Structural eligibility mirrors what the fused decode kernels
+        implement: plain aggregate (no expression sums), 4-byte non-null
+        layout, serial local scan over one table file.  Freshness is the
+        sidecar's size+mtime stamp (the scan/index.py contract), so any
+        table write silently retires the packed plan."""
+        if self._op != "aggregate" or self._agg_exprs is not None:
+            return None
+        if not isinstance(self.source, str) or self._workers >= 2:
+            return None
+        if self.schema.has_wide or any(self.schema.nullable or ()):
+            return None
+        from .colpack import probe_packed
+        meta = probe_packed(self.source)
+        if meta is None:
+            return None
+        from .planner import decide_pushdown
+        return decide_pushdown(meta, self._pushdown_need_cols()), meta
+
+    def _run_pushdown(self, dec, meta, device, session,
+                      kernel: str = "auto") -> dict:
+        """Aggregate over the packed sidecar instead of the heap table.
+
+        ``chip``: the ``.cpk`` pages stream SSD -> landing buffer ->
+        device UNEXPANDED and the fused decode->filter->project kernel
+        expands them in VMEM — the h2d link (the measured ceiling) only
+        ever carries wire bytes.  ``host``: the SSD is the ceiling
+        instead, so packed bytes leave the disk, expand to heap pages on
+        the host, and the ordinary XLA filter kernel consumes them.
+        Integer aggregates are byte-identical to the unpacked scan on
+        both legs (same accumulator dtypes, same masked-sum shape)."""
+        import time as _time
+
+        import jax
+
+        from ..engine import open_source
+        from ..stats import stats
+        from ..trace import recorder
+        need = self._pushdown_need_cols()
+        scale = meta.logical_bytes / max(meta.packed_bytes, 1)
+        src = open_source(meta.path)
+        # residency-tier identity: packed extents are a DIFFERENT
+        # representation of the table, so the cache key carries a repr
+        # tag + the encode generation — a re-encoded sidecar can never
+        # alias a stale cached extent, and capacity accounting can
+        # credit the tier with the LOGICAL bytes each packed slab serves
+        src.cache_key_extra = ("#repr=cpk", f"#gen={meta.table_mtime_ns}")
+        src.logical_scale = scale
+        t0 = _time.monotonic_ns()
+        try:
+            if dec.mode == "chip":
+                use_pallas = kernel == "pallas" or (
+                    kernel == "auto" and jax.default_backend() == "tpu")
+                if use_pallas:
+                    from ..ops.decode_pallas import \
+                        make_decode_filter_fn_pallas
+                    run = make_decode_filter_fn_pallas(
+                        meta, self.schema, self._pred, need_cols=need)
+                else:
+                    from ..ops.decode_xla import make_decode_filter_fn_xla
+                    run = make_decode_filter_fn_xla(
+                        meta, self._pred, need_cols=need)
+
+                # counted OUTSIDE the jitted decode (a traced stats.add
+                # would fire once at trace time, not per batch) — so no
+                # dispatch coalescing on this path
+                def fn(pages):
+                    stats.add("nr_pushdown_decode_chip")
+                    stats.add("bytes_wire_saved",
+                              int(pages.shape[0] * PAGE_SIZE
+                                  * (scale - 1.0)))
+                    return run(pages)
+
+                from .executor import TableScanner
+                with TableScanner(src, self.schema, session=session) as sc:
+                    out = sc.scan_filter(fn, device=device)
+                    self._last_scan_h2d_depth = getattr(
+                        sc, "last_h2d_depth", 0)
+            else:   # host expansion (SSD-bound)
+                from .colpack import decode_pages_numpy
+                from .executor import fold_results
+                from .heap import build_pages
+                fn, _combine = self._build_fn("xla")
+                dev = device or jax.local_devices()[0]
+                n_pages = src.size // PAGE_SIZE
+                batch = max((8 << 20) // PAGE_SIZE, 1)
+                acc = None
+                for p0 in range(0, n_pages, batch):
+                    n = min(batch, n_pages - p0)
+                    raw = bytearray(n * PAGE_SIZE)
+                    src.read_buffered(p0 * PAGE_SIZE, memoryview(raw))
+                    packed = np.frombuffer(raw, np.uint8).reshape(
+                        n, PAGE_SIZE)
+                    cols, nr = decode_pages_numpy(packed, meta)
+                    stats.add("nr_pushdown_decode_host")
+                    stats.add("bytes_wire_saved",
+                              int(n * PAGE_SIZE * (scale - 1.0)))
+                    if nr == 0:
+                        continue
+                    pages = build_pages(cols, self.schema)
+                    acc = fold_results(
+                        acc, fn(jax.device_put(pages, dev)), None)
+                out = jax.tree.map(np.asarray, acc) if acc else {}
+        finally:
+            src.close()
+            recorder.span("pushdown_decode", t0, _time.monotonic_ns(),
+                          length=meta.packed_bytes,
+                          args={"mode": dec.mode,
+                                "wire_bytes": dec.wire_bytes,
+                                "logical_bytes": dec.logical_bytes})
+        if dec.mode == "chip" and out and self._agg_cols is not None:
+            # the fused kernel returns every schema column's sum slot
+            # (un-needed ones as zeros); project like _build_fn does
+            out = {"count": out["count"],
+                   "sums": [out["sums"][c] for c in self._agg_cols]}
+        return self._finalize(out)
 
     # -- execution ----------------------------------------------------------
     def run(self, *, mesh=None, device=None, kernel: str = "auto",
@@ -1903,6 +2043,15 @@ class Query:
             return self._run_count_distinct(plan, mesh, device, session)
         if self._op == "quantiles":
             return self._run_quantiles(plan, mesh, device, session)
+        if plan.pushdown in ("chip", "host") and mesh is None \
+                and self._op == "aggregate":
+            # packed-sidecar scan: re-probe (the sidecar may have been
+            # retired between EXPLAIN and now) and fall through to the
+            # heap path when it raced away
+            probe = self._pushdown_probe()
+            if probe is not None and probe[0].mode in ("chip", "host"):
+                return self._run_pushdown(probe[0], probe[1], device,
+                                          session, kernel)
         chosen = plan.kernel if kernel == "auto" else kernel
         fn, combine = self._build_fn(chosen)
         if mesh is not None:

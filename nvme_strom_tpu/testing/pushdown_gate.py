@@ -1,0 +1,268 @@
+"""Compute-pushdown gate (ISSUE 14, ``make pushdown-gate``).
+
+Holds the tentpole's transport contract on deterministic synthetics:
+
+* **Throughput** — with per-request latency injected into the loopback
+  fake, the packed scan (decode+filter fused on the device side of the
+  wire) must deliver a higher *effective logical* rate than the same-run
+  raw transport by at least ``STROM_PUSHDOWN_GATE_RATIO`` (default
+  1.2x).  Both legs pay the injected device latency per chunk; the
+  packed leg simply moves ~1/ratio of the chunks for the same logical
+  rows, so the win is latency-bound and reproduces on any machine.
+* **Identity under eviction churn** — through the real ``Query`` path
+  with residency capacity far below the packed file, the pushdown
+  answer must stay byte-identical to the unpacked scan across repeated
+  passes while the ARC lists churn, and the tier must account packed
+  extents in logical bytes served (``logical_resident_bytes``).
+* **Chaos fail-stop** — the packed file striped over a mirrored pair
+  with a mid-scan fail-stop schedule: the decode pipeline's extents are
+  served from the pair partner and the aggregate stays identical, so
+  the fault ladder sees packed extents too.
+
+Runs in ``make pushdown-gate`` (wired into ``make check``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+RATIO_LIMIT = float(os.environ.get("STROM_PUSHDOWN_GATE_RATIO", "1.2"))
+ROUNDS = int(os.environ.get("STROM_PUSHDOWN_GATE_ROUNDS", "3"))
+
+CHUNK = 64 << 10          # scan chunk: one injected latency per chunk
+STRIPE = 64 << 10         # chaos-leg stripe chunk
+N_ROWS = 200_000
+LATENCY_S = 0.002
+
+
+def _pred(cols):
+    return cols[0] > 3
+
+
+def _make_table(dirpath: str, tag: str):
+    """A compressible 3-int-col heap table + its packed sidecar.
+
+    Column 0 cycles 0..15 (bitpack), column 1 holds 1024-long runs
+    (rle/bitpack), column 2 draws from 200 small values (dict/bitpack) —
+    the shape the pushdown planner is built for, small enough that int32
+    masked sums cannot overflow."""
+    import numpy as np
+
+    from ..scan.colpack import build_packed
+    from ..scan.heap import HeapSchema, build_heap_file
+
+    schema = HeapSchema(3, dtypes=("i4", "i4", "i4"))
+    rng = np.random.default_rng(14)
+    c0 = (np.arange(N_ROWS, dtype=np.int64) % 16).astype(np.int32)
+    c1 = np.repeat(np.arange((N_ROWS + 1023) // 1024, dtype=np.int32) % 8,
+                   1024)[:N_ROWS]
+    c2 = rng.integers(0, 200, N_ROWS).astype(np.int32)
+    path = os.path.join(dirpath, f"{tag}.tbl")
+    build_heap_file(path, [c0, c1, c2], schema)
+    meta = build_packed(path, schema)
+    mask = c0 > 3
+    truth = (int(mask.sum()),
+             int(c1[mask].sum()), int(c2[mask].sum()))
+    return path, schema, meta, truth
+
+
+def _project(out):
+    return (int(out["count"]), int(out["sums"][1]), int(out["sums"][2]))
+
+
+def _leg_throughput(dirpath: str) -> None:
+    """Packed effective logical GB/s >= RATIO_LIMIT x same-run raw."""
+    import statistics
+
+    from ..cache import residency_cache
+    from ..config import config
+    from ..engine import Session
+    from ..ops.decode_xla import make_decode_filter_fn_xla
+    from ..ops.filter_xla import make_filter_fn
+    from ..scan.executor import TableScanner
+    from . import FakeNvmeSource, FaultPlan
+
+    path, schema, meta, truth = _make_table(dirpath, "speed")
+    cpk = meta.path or (path + ".cpk")
+    config.set("cache_bytes", 0)          # no RAM tier: wire bytes only
+    config.set("cache_arbitration", False)
+    config.set("dma_max_size", CHUNK)
+    residency_cache.configure()
+    residency_cache.clear()
+    raw_fn = make_filter_fn(schema, _pred)
+    dec_fn = make_decode_filter_fn_xla(meta, _pred)
+    heap_bytes = os.path.getsize(path)
+    raw_t, packed_t = [], []
+    with Session() as sess:
+        def scan(fpath, fn):
+            src = FakeNvmeSource(fpath,
+                                 fault_plan=FaultPlan(latency_s=LATENCY_S),
+                                 force_cached_fraction=0.0)
+            try:
+                return TableScanner(src, schema, session=sess,
+                                    chunk_size=CHUNK).scan_filter(fn)
+            finally:
+                src.close()
+
+        # untimed warmup: pays jit compilation for every batch shape
+        assert _project(scan(path, raw_fn)) == truth, "raw warmup diverged"
+        assert _project(scan(cpk, dec_fn)) == truth, \
+            "packed warmup diverged from the unpacked truth"
+        for r in range(ROUNDS):
+            t0 = time.perf_counter()
+            got_raw = scan(path, raw_fn)
+            raw_t.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            got_packed = scan(cpk, dec_fn)
+            packed_t.append(time.perf_counter() - t0)
+            assert _project(got_raw) == _project(got_packed) == truth, \
+                f"legs diverged (round {r})"
+    rt, pt = statistics.median(raw_t), statistics.median(packed_t)
+    logical_gb = meta.logical_bytes / 1e9
+    raw_rate, packed_rate = logical_gb / rt, logical_gb / pt
+    ratio = packed_rate / raw_rate if raw_rate > 0 else float("inf")
+    assert ratio >= RATIO_LIMIT, \
+        f"packed only {ratio:.2f}x raw logical rate (limit " \
+        f"{RATIO_LIMIT}x; raw {raw_rate:.3f} vs packed " \
+        f"{packed_rate:.3f} GB/s logical)"
+    print(f"pushdown-gate throughput leg ok: packed {packed_rate:.3f} "
+          f"GB/s logical vs raw {raw_rate:.3f} ({ratio:.1f}x, codec "
+          f"{meta.ratio:.1f}x, wire {meta.packed_bytes >> 10}KB vs "
+          f"{heap_bytes >> 10}KB, {ROUNDS} interleaved rounds)")
+
+
+def _leg_identity_eviction(dirpath: str) -> None:
+    """Query-path pushdown stays identical to the unpacked scan while
+    the residency tier churns, and packed extents are accounted in
+    logical bytes."""
+    from ..cache import residency_cache
+    from ..config import config
+    from ..scan.query import Query
+    from ..stats import stats
+
+    path, schema, meta, truth = _make_table(dirpath, "evict")
+    q = Query(path, schema).where(_pred).aggregate([1, 2])
+    config.set("pushdown", "off")
+    base = q.run()
+    got = (int(base["count"]), int(base["sums"][0]), int(base["sums"][1]))
+    assert got == truth, f"unpacked baseline diverged: {got} != {truth}"
+    # 64KB scan chunks (= fill extents) with capacity well below the
+    # packed file: every pass churns the ARC lists
+    config.set("chunk_size", CHUNK)
+    config.set("cache_bytes", 4 * CHUNK)
+    config.set("cache_arbitration", False)
+    residency_cache.configure()
+    residency_cache.clear()
+    config.set("pushdown", "on")
+    before = stats.snapshot(reset_max=False).counters
+    for r in range(3):
+        out = q.run()
+        got = (int(out["count"]), int(out["sums"][0]),
+               int(out["sums"][1]))
+        assert got == truth, \
+            f"pushdown pass {r} diverged under churn: {got} != {truth}"
+    after = stats.snapshot(reset_max=False).counters
+    decodes = (after.get("nr_pushdown_decode_chip", 0)
+               + after.get("nr_pushdown_decode_host", 0)
+               - before.get("nr_pushdown_decode_chip", 0)
+               - before.get("nr_pushdown_decode_host", 0))
+    saved = (after.get("bytes_wire_saved", 0)
+             - before.get("bytes_wire_saved", 0))
+    evicted = (after.get("nr_cache_evict", 0)
+               - before.get("nr_cache_evict", 0))
+    assert decodes > 0, "pushdown path never decoded (planner fell back?)"
+    assert saved > 0, "pushdown moved no fewer wire bytes than raw"
+    assert evicted > 0, "eviction never churned (capacity not binding?)"
+    res = residency_cache.resident_bytes()
+    lres = residency_cache.logical_resident_bytes()
+    assert lres > res > 0, \
+        f"packed extents not logically accounted ({lres} !> {res})"
+    print(f"pushdown-gate identity leg ok: 3 churned passes identical "
+          f"({evicted} evictions), {decodes} packed batches, "
+          f"{saved >> 10}KB wire saved, resident {res >> 10}KB serves "
+          f"{lres >> 10}KB logical")
+
+
+def _leg_chaos_failstop(dirpath: str) -> None:
+    """Mid-scan fail-stop on the packed file's member: extents come from
+    the mirror partner and the aggregate stays identical."""
+    from ..cache import residency_cache
+    from ..config import config
+    from ..engine import Session
+    from ..ops.decode_xla import make_decode_filter_fn_xla
+    from ..scan.executor import TableScanner
+    from ..stats import stats
+    from . import FakeStripedNvmeSource, FaultPlan
+
+    path, schema, meta, truth = _make_table(dirpath, "chaos")
+    cpk = meta.path or (path + ".cpk")
+    with open(cpk, "rb") as f:
+        blob = f.read()
+    blob += b"\0" * ((-len(blob)) % STRIPE)   # zero pages scan as no rows
+    m0 = os.path.join(dirpath, "pk0.bin")
+    m1 = os.path.join(dirpath, "pk1.bin")
+    with open(m0, "wb") as f:
+        f.write(blob)
+    shutil.copyfile(m0, m1)
+    config.set("cache_bytes", 0)
+    config.set("cache_arbitration", False)
+    config.set("dma_max_size", CHUNK)
+    config.set("io_retries", 1)
+    config.set("canary_interval_s", 0.0)
+    residency_cache.configure()
+    residency_cache.clear()
+    plan = FaultPlan(failstop_member=0, failstop_after=4)
+    src = FakeStripedNvmeSource([m0, m1], stripe_chunk_size=STRIPE,
+                                fault_plan=plan,
+                                force_cached_fraction=0.0,
+                                mirror="paired")
+    dec_fn = make_decode_filter_fn_xla(meta, _pred)
+    before = stats.snapshot(reset_max=False).counters
+    try:
+        with Session() as sess:
+            out = TableScanner(src, schema, session=sess,
+                               chunk_size=CHUNK).scan_filter(dec_fn)
+    finally:
+        src.close()
+    after = stats.snapshot(reset_max=False).counters
+    got = _project(out)
+    assert got == truth, \
+        f"degraded packed scan diverged: {got} != {truth}"
+    mirror = (after.get("nr_mirror_read", 0)
+              - before.get("nr_mirror_read", 0))
+    failed = (after.get("nr_member_failed", 0)
+              - before.get("nr_member_failed", 0))
+    assert mirror > 0, "fail-stop never routed packed extents to mirror"
+    assert failed >= 1, "fail-stop member never latched FAILED"
+    print(f"pushdown-gate chaos leg ok: member fail-stop mid-scan, "
+          f"{mirror} mirror reads, aggregate identical")
+
+
+def main() -> int:
+    from ..cache import residency_cache
+    from ..config import config
+
+    snap = config.snapshot()
+    try:
+        with tempfile.TemporaryDirectory(prefix="strom_pushdown_") as d:
+            _leg_throughput(d)
+            _leg_identity_eviction(d)
+            _leg_chaos_failstop(d)
+    except AssertionError as e:
+        print(f"pushdown-gate FAIL: {e}")
+        return 1
+    finally:
+        config.restore(snap)
+        residency_cache.clear()
+        residency_cache.configure()
+    print("pushdown-gate ok: packed beats raw transport, identity holds "
+          "under churn and fail-stop")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

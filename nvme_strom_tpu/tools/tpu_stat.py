@@ -362,6 +362,20 @@ def main(argv=None) -> int:
                       f"invalidate {c.get('nr_cache_invalidate', 0)}  "
                       f"resident "
                       f"{c.get('cache_resident_bytes', 0) / 1048576:.1f}MB")
+            # compute-pushdown scoreboard (ISSUE 14): packed batches
+            # decoded on chip vs expanded on host, and the wire bytes the
+            # codec saved vs shipping logical rows — zero decodes on a
+            # pushdown-eligible workload means stale sidecars or a codec
+            # ratio below pushdown_chip_ratio
+            if (c.get("nr_pushdown_decode_chip")
+                    or c.get("nr_pushdown_decode_host")
+                    or c.get("bytes_wire_saved")):
+                print(f"pushdown: chip-decodes "
+                      f"{c.get('nr_pushdown_decode_chip', 0)}  "
+                      f"host-decodes "
+                      f"{c.get('nr_pushdown_decode_host', 0)}  "
+                      f"wire-saved "
+                      f"{c.get('bytes_wire_saved', 0) / 1048576:.1f}MB")
             # write-ladder scoreboard (ISSUE 11): mirror fan-out volume,
             # transient write retries, resync replay progress and
             # read-back verification failures — pending bytes above zero

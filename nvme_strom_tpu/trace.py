@@ -100,6 +100,10 @@ EVENT_SCHEMA: Dict[str, str] = {
     "qos_enqueue": "instant",      # task admitted into the QoS queue
     "qos_throttle": "instant",     # tenant token-bucket-gated (edge)
     "qos_wait": "span",            # enqueue -> scheduler-dispatch window
+    # compute pushdown (ISSUE 14): one span per packed scan — the whole
+    # decode->filter->project window over the compressed representation
+    # (wire/logical byte counts ride in args)
+    "pushdown_decode": "span",
 }
 
 
