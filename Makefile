@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate probe-loop lint-strom sanitize sanitize-smoke clean
+.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate kvpage-smoke probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -122,6 +122,23 @@ pushdown-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.pushdown_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_pushdown.py -q -m pushdown
 
+# Cold-start gate (ISSUE 15): depth-pipelined weight streaming must
+# beat the serial load-then-adopt baseline by STROM_COLDSTART_GATE_RATIO
+# (default 2x) on the latency-injected synthetic checkpoint, land every
+# leaf byte-identical under crc verification, adopt layers in order
+# (asserted from weight_stream flight-recorder spans), and refuse a
+# flipped byte with EBADMSG.  The serving pytest marker rides along.
+coldstart-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.coldstart_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -m serving
+
+# KV-paging A/B smoke (ISSUE 15): the serving KV block pool over a
+# paired-mirror spill, working set 4x hbm_cache_bytes, every block
+# byte-identical including one seeded mirror-member fail-stop pass;
+# journals to KVPAGE_AB.jsonl and fails on any identity miss.
+kvpage-smoke:
+	BENCH_SMOKE=1 JAX_PLATFORMS=cpu python bench.py --kvpage
+
 # QoS fairness gate (ISSUE 12): against a real stromd on the
 # latency-injected synthetic, 3:1-weighted tenants must receive bytes
 # within 25% of 3:1 while both are backlogged, and a latency-class
@@ -163,7 +180,7 @@ sanitize-smoke:
 # then tier-1 tests plus the perf smokes, the seeded member-survival
 # schedules, the trace-overhead, landing and cache gates, and the
 # short sanitizer pass.
-check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate kvpage-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

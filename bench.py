@@ -75,6 +75,14 @@ prints one JSON line with both effective LOGICAL GB/s medians, the codec
 ratio, a result-identity check and the packed rate vs the ``h2d_peak``
 ceiling (which the packed leg can exceed: only wire bytes cross the
 link).  The deterministic gate is ``make pushdown-gate``.
+
+KV-cache paging A/B (ISSUE 15): ``python bench.py --kvpage`` drives the
+serving KV block pool over a paired-mirror spill with a working set 4x
+``hbm_cache_bytes`` (tiered leg) against an HBM-off, 2-block-RAM
+baseline that pays an SSD page-in per read, verifies every block
+byte-identical — including one seeded chaos pass that fail-stops a
+mirror member mid-run — and journals to KVPAGE_AB.jsonl.  The
+cold-start counterpart gate is ``make coldstart-gate``.
 """
 
 import fcntl
@@ -943,6 +951,166 @@ def _cache_ab() -> int:
     return 0
 
 
+_KVPAGE_CODE = """
+import json, os, statistics, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from nvme_strom_tpu import Session, config, stats
+from nvme_strom_tpu.serving import KvBlockPool
+from nvme_strom_tpu.serving.hbm_tier import hbm_tier
+from nvme_strom_tpu.testing import FakeStripedNvmeSource, FaultPlan
+
+dirpath = os.environ["KVPAGE_BENCH_DIR"]
+rounds = int(os.environ.get("KVPAGE_BENCH_ROUNDS", "3"))
+bb = 16 << 10
+ws_blocks = int(os.environ.get("KVPAGE_BENCH_BLOCKS", "64"))
+ws_bytes = ws_blocks * bb
+n_seq = 4
+per_seq = ws_blocks // n_seq
+LAT = 0.0005      # per-request SSD latency; HBM/RAM hits never pay it
+
+def make_spill(tag):
+    # one spill per leg: pools hand out SSD slots from offset 0, so two
+    # pools sharing a file would clobber each other's paged-out blocks
+    paths = []
+    for i in range(4):
+        p = os.path.join(dirpath, "spill_%s_%d.bin" % (tag, i))
+        with open(p, "wb") as f:
+            f.truncate(ws_bytes)
+        paths.append(p)
+    return FakeStripedNvmeSource(paths, bb, mirror="paired", writable=True,
+                                 force_cached_fraction=0.0)
+
+
+def pattern(s, i):
+    return bytes([(s * 31 + i * 7 + 1) % 256]) * bb
+
+
+import random
+_order_rng = random.Random(17)
+# one seeded random visit order per pass, shared by both legs: LRU under
+# a pure sequential sweep thrashes on BOTH legs and hides the tier; a
+# random order makes the hit ratio track each leg's resident fraction
+orders = [[(s, i) for s in range(n_seq) for i in range(per_seq)]
+          for _ in range(rounds + 1)]     # last one is the warmup order
+for o in orders:
+    _order_rng.shuffle(o)
+
+
+def read_pass(pool, order):
+    t0 = time.monotonic()
+    bad = 0
+    for s, i in order:
+        if pool.read("seq%d" % s, i) != pattern(s, i):
+            bad += 1
+    return ws_bytes / (time.monotonic() - t0) / (1 << 20), bad
+
+
+def build(sess, spill, tiered):
+    # working set is 4x the HBM cap on the tiered leg (full cap spent
+    # on pinned KV blocks); the SSD leg gets no HBM and a 2-block RAM
+    # tier, so nearly every read is a page-in
+    config.set("hbm_cache_bytes", ws_bytes // 4 if tiered else 0)
+    hbm_tier.configure()
+    pool = KvBlockPool(sess, spill, block_bytes=bb,
+                       ram_blocks=8 if tiered else 2,
+                       hbm_blocks=ws_blocks // 4 if tiered else 0)
+    for s in range(n_seq):
+        for i in range(per_seq):
+            pool.append("seq%d" % s, pattern(s, i))
+    return pool
+
+
+runs = {"tiered": [], "ssd": []}
+mismatches = 0
+row = {}
+with Session() as sess:
+    with make_spill("tiered") as sp_t, make_spill("ssd") as sp_s:
+        spills = {"tiered": sp_t, "ssd": sp_s}
+        # ssd leg first: its build sets hbm_cache_bytes=0, which would
+        # revoke the tiered pool's pinned blocks if it ran second
+        pools = {leg: build(sess, spills[leg], leg == "tiered")
+                 for leg in ("ssd", "tiered")}
+        for sp in spills.values():
+            sp.fault_plan = FaultPlan(latency_s=LAT)
+        # untimed warmup: read-time promotion fills each leg's HBM share
+        # so the timed rounds measure steady-state serving, not cold fill
+        for pool in pools.values():
+            read_pass(pool, orders[-1])
+        b = dict(stats.snapshot(reset_max=False).counters)
+        for r in range(rounds):
+            legs = (["tiered", "ssd"] if r % 2 == 0
+                    else ["ssd", "tiered"])
+            for leg in legs:
+                mbps, bad = read_pass(pools[leg], orders[r])
+                runs[leg].append(mbps)
+                mismatches += bad
+        a = dict(stats.snapshot(reset_max=False).counters)
+        # seeded chaos: member 0 fail-stops mid-run; page-ins must be
+        # served byte-identical from its mirror twin
+        sp_t.fault_plan = FaultPlan(latency_s=LAT, failstop_member=0,
+                                    failstop_after=0)
+        _, chaos_bad = read_pass(pools["tiered"], orders[0])
+        sp_t.fault_plan = FaultPlan()
+        row["residency"] = pools["tiered"].residency()
+        for p in pools.values():
+            p.close()
+
+row.update({m: round(statistics.median(v), 3) for m, v in runs.items()})
+row["unit"] = "MB/s"
+row["speedup"] = (round(row["tiered"] / row["ssd"], 3)
+                  if row["ssd"] else None)
+row["working_set_x_hbm"] = 4
+row["identical"] = mismatches == 0
+row["chaos_identical"] = chaos_bad == 0
+for k in ("nr_kv_pagein", "nr_kv_pageout"):
+    row[k] = a.get(k, 0) - b.get(k, 0)
+reads = 2 * rounds * ws_blocks
+row["hit_ratio"] = round(1 - row["nr_kv_pagein"] / reads, 4) if reads else 0.0
+print("ROW=" + json.dumps(row))
+"""
+
+
+def _kvpage_ab() -> int:
+    """``bench.py --kvpage``: KV-cache paging A/B on a paired-mirror
+    spill with injected per-request SSD latency.  The tiered leg runs
+    with ``hbm_cache_bytes`` set to a QUARTER of the working set (so the
+    pool must page HBM→RAM→SSD continuously); the baseline leg runs with
+    the HBM tier off and a 2-block RAM tier, paying a page-in per read.
+    Every read is checked against the deterministic per-block pattern,
+    then one seeded chaos pass fail-stops a mirror member mid-run and
+    re-verifies identity.  Journaled to KVPAGE_AB.jsonl."""
+    import tempfile
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
+    _lock = hold_bench_lock("bench.py --kvpage")
+    env = _env()
+    env.setdefault("KVPAGE_BENCH_ROUNDS", "1" if smoke else "3")
+    env.setdefault("KVPAGE_BENCH_BLOCKS", "32" if smoke else "64")
+    with tempfile.TemporaryDirectory(prefix="strom_kvpage_") as d:
+        env["KVPAGE_BENCH_DIR"] = d
+        out = subprocess.run([sys.executable, "-c", _KVPAGE_CODE],
+                             capture_output=True, text=True, cwd=REPO,
+                             env=env, timeout=1800)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise RuntimeError("kvpage A/B run failed")
+    m = re.search(r"ROW=(\{.*\})", out.stdout)
+    row = {"metric": "kvpage_ab_MBps", **json.loads(m.group(1))}
+    entry = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **row}
+    try:
+        with open(os.path.join(REPO, "KVPAGE_AB.jsonl"), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        sys.stderr.write(f"bench: could not journal kvpage A/B: {e}\n")
+    if not (row["identical"] and row["chaos_identical"]):
+        sys.stderr.write("bench: kvpage A/B identity check FAILED\n")
+        print(json.dumps(row))
+        return 1
+    print(json.dumps(row))
+    return 0
+
+
 def _landing_ab() -> int:
     """``bench.py --landing``: A/B the zero-copy landing against the
     staged ring on the CPU engine (same file, same chunking, alternating
@@ -1146,6 +1314,8 @@ def main() -> int:
         return _cache_ab()
     if "--pushdown" in sys.argv[1:]:
         return _pushdown_ab()
+    if "--kvpage" in sys.argv[1:]:
+        return _kvpage_ab()
     smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")

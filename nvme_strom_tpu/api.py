@@ -319,6 +319,20 @@ STAT_FIELDS: Tuple[str, ...] = (
     "nr_pushdown_decode_host",   # packed batches expanded host-side
     "bytes_wire_saved",          # logical-minus-packed bytes that never
     #                              crossed the bottleneck transport
+    # LLM serving stack (ISSUE 15): the device-side HBM residency tier
+    # above the host ARC tier, checkpoint weight streaming, and the
+    # SSD-backed KV-cache block pool
+    "nr_hbm_hit",             # chunks served from HBM-resident extents
+    #                           (outranks host hits; one device->dest copy)
+    "nr_hbm_promote",         # extents promoted host tier -> HBM
+    #                           (second-touch t1->t2 transition, KV pins)
+    "nr_hbm_demote",          # extents demoted HBM -> host tier by
+    #                           capacity eviction
+    "nr_kv_pagein",           # KV blocks paged SSD -> RAM (+ promotion)
+    "nr_kv_pageout",          # KV blocks spilled RAM -> SSD (mirrored
+    #                           write ladder)
+    "hbm_resident_bytes",     # gauge: bytes currently HBM-resident
+    "coldstart_bytes_per_sec",  # gauge: last weight-stream landing rate
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",
@@ -347,7 +361,8 @@ class StatInfo:
         # gauges are point-in-time, not deltas
         for g in ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
                   "cache_resident_bytes", "resync_pending_bytes",
-                  "daemon_sessions", "qos_queue_depth"):
+                  "daemon_sessions", "qos_queue_depth",
+                  "hbm_resident_bytes", "coldstart_bytes_per_sec"):
             if g in new.counters:
                 d[g] = new.counters[g]
         return StatInfo(version=new.version, has_debug=new.has_debug,

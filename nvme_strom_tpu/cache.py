@@ -130,6 +130,14 @@ class ResidencyCache:
 
     def __init__(self) -> None:
         self.active = False
+        # device-tier hooks (serving.hbm_tier registers these): the ARC
+        # second-touch transition promotes the extent's bytes UP into
+        # HBM, and every invalidation is forwarded so the device tier
+        # can never serve stale bytes a host-side write dropped here.
+        # Both are None until the HBM tier is configured on — the
+        # one-branch-when-off contract holds for the device leg too.
+        self.promote_hook = None
+        self.device_tier = None
         self._lock = threading.Lock()
         self._cap = 0
         self._p = 0  # adaptive target for t1 (recency), in bytes
@@ -208,10 +216,12 @@ class ResidencyCache:
         if not self.active:
             return None
         key = (skey, base, length)
+        hot = False
         with self._lock:
             e = self._t1.pop(key, None)
             if e is not None:
                 self._t2[key] = e  # second touch: promote to frequency
+                hot = True
             else:
                 e = self._t2.get(key)
                 if e is not None:
@@ -219,7 +229,17 @@ class ResidencyCache:
             if e is None or e.stale:
                 return None
             e.refs += 1
-            return CacheLease(self, e)
+        lease = CacheLease(self, e)
+        if hot and self.promote_hook is not None:
+            # the t1→t2 transition IS the hotness signal: hand the bytes
+            # up to the HBM tier outside our lock (the hook may device_put,
+            # and its eviction demotes back through fill(), which relocks).
+            # The lease's ref pins the slab, so the view is stable here.
+            try:
+                self.promote_hook(skey, base, length, bytes(e.view))
+            except Exception:  # noqa: BLE001 - promotion is best-effort
+                pass
+        return lease
 
     def _release(self, e: _Entry) -> None:
         with self._lock:
@@ -344,10 +364,15 @@ class ResidencyCache:
         entries are matched by byte overlap; entries under a different
         key that shares a file are dropped wholesale (offsets do not
         map across framings).  Returns the number dropped."""
+        fwd = 0
+        if self.device_tier is not None:
+            # the device tier drops its copies regardless of whether the
+            # host tier is even on (it checks its own active flag)
+            fwd = self.device_tier.invalidate_extents(skey, extents)
         if not self.active:
-            return 0
+            return fwd
         pathset = set(skey)
-        dropped = 0
+        dropped = fwd
         with self._lock:
             for od in (self._t1, self._t2):
                 for key in list(od):
@@ -360,23 +385,26 @@ class ResidencyCache:
                         continue
                     self._drop_locked(od, key)
                     dropped += 1
-        self._note_invalidated(dropped, extents)
+        self._note_invalidated(dropped - fwd, extents)
         return dropped
 
     def invalidate_paths(self, paths: Sequence[str]) -> int:
         """Drop every resident extent over any of *paths* (used by the
         checkpoint savers after an atomic rename installs new bytes)."""
+        fwd = 0
+        if self.device_tier is not None:
+            fwd = self.device_tier.invalidate_paths(paths)
         if not self.active:
-            return 0
+            return fwd
         want = {os.path.realpath(p) for p in paths}
-        dropped = 0
+        dropped = fwd
         with self._lock:
             for od in (self._t1, self._t2):
                 for key in list(od):
                     if want & set(key[0]):
                         self._drop_locked(od, key)
                         dropped += 1
-        self._note_invalidated(dropped, [])
+        self._note_invalidated(dropped - fwd, [])
         return dropped
 
     def _drop_locked(self, od, key) -> None:

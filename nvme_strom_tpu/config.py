@@ -433,6 +433,30 @@ class Config:
                      "ladder heals them.  0 (default) disables the tier "
                      "entirely — one branch per task.  Read at Session "
                      "construction (residency_cache.configure())"))
+        # LLM serving: HBM residency tier + weight streaming + KV paging
+        # (ISSUE 15)
+        reg(Var("hbm_cache_bytes", 0, "size", minval=0,
+                help="capacity of the device-side HBM residency tier "
+                     "(serving.hbm_tier): extents the host ARC tier "
+                     "touches twice are promoted into device-resident "
+                     "buffers and served with no host memcpy at all; "
+                     "eviction demotes the bytes back into the host "
+                     "tier.  0 (default) disables the tier entirely — "
+                     "one branch per task.  Read at Session "
+                     "construction (hbm_tier.configure())"))
+        reg(Var("kv_block_bytes", 64 << 10, "size", minval=4 << 10,
+                maxval=16 << 20,
+                help="KV-cache page size for serving.kvcache block "
+                     "pools: the unit of HBM pinning, RAM slotting and "
+                     "SSD spill I/O (power of two; it is the pool's "
+                     "chunk grid on the spill source)",
+                validate=_check_pow2))
+        reg(Var("weight_stream_depth", 2, "int", minval=1, maxval=16,
+                help="layers of a streamed checkpoint in flight at "
+                     "once during serving.weights cold-start: layer "
+                     "N+1's SSD reads land in its own LandingBuffer "
+                     "while layer N's buffers are adopted as device "
+                     "arrays (double-buffered default)"))
         # flight recorder + end-to-end task tracing (PR 7)
         reg(Var("trace_policy", "off", "str",
                 help="per-task span tracing into the flight recorder: "

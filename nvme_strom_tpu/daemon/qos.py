@@ -91,11 +91,13 @@ class WorkItem:
     __slots__ = ("session_id", "tenant", "task_id", "source_handle",
                  "buf_handle", "chunk_ids", "chunk_size", "dest_offset",
                  "nbytes", "enqueue_ns", "dispatch_ns", "done", "result",
-                 "error", "cancelled", "trace_tid", "source")
+                 "error", "cancelled", "trace_tid", "source", "kv",
+                 "submit_id")
 
     def __init__(self, *, session_id: int, tenant: str, task_id: int,
                  source_handle: int, buf_handle: int, chunk_ids: List[int],
-                 chunk_size: int, dest_offset: int = 0):
+                 chunk_size: int, dest_offset: int = 0,
+                 kv: Optional[tuple] = None, submit_id: Optional[str] = None):
         self.session_id = session_id
         self.tenant = tenant
         self.task_id = task_id
@@ -113,6 +115,8 @@ class WorkItem:
         self.cancelled = False
         self.trace_tid = 0
         self.source = None      # server attaches the resolved source object
+        self.kv = kv            # (op, args) for KV-pool items, else None
+        self.submit_id = submit_id  # client idempotency key, else None
 
 
 class _Tenant:

@@ -33,11 +33,14 @@ Prometheus render see them) and ``session_*``/``qos_*``/
 
 from __future__ import annotations
 
+import base64
 import errno as _errno
 import mmap
 import os
+import secrets
 import socket
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from ..api import StromError
@@ -51,7 +54,49 @@ __all__ = ["StromDaemon"]
 
 #: ops a session may issue after attach
 _OPS = ("configure", "map", "unmap", "open", "close_source", "submit",
-        "wait", "stat", "ping", "detach")
+        "wait", "stat", "ping", "detach", "kv_open", "kv")
+
+#: live lease records kept after an unclean disconnect (the re-attach
+#: window) — bounded so a flapping client cannot grow the daemon
+_MAX_LEASES = 256
+#: per-lease idempotency window: submit_ids remembered for dedup
+_MAX_LEASE_SUBMITS = 1024
+
+
+class _Lease:
+    """Session identity that survives the connection (ISSUE 15).
+
+    Attach mints a lease token and returns it; a client that loses its
+    connection — or outlives a daemon restart — RE-attaches presenting
+    the token and gets its tenant/QoS identity back plus its unacked
+    task table, so idempotent resubmission (``submit_id`` dedup) cannot
+    double-run work the daemon already holds.  After a daemon restart
+    the presented token is unknown; it is adopted as a fresh record
+    (single-host trust domain — the socket mode is the privilege
+    boundary), which makes the client's replay re-execute, exactly the
+    recovery the restart lost."""
+
+    __slots__ = ("token", "tenant", "qos_class", "weight", "submits")
+
+    def __init__(self, token: str, tenant: str, qos_class: str,
+                 weight: float) -> None:
+        self.token = token
+        self.tenant = tenant
+        self.qos_class = qos_class
+        self.weight = weight
+        #: submit_id -> WorkItem (done items keep results until waited)
+        self.submits: "OrderedDict[str, WorkItem]" = OrderedDict()
+
+    def remember(self, submit_id: str, item: WorkItem) -> None:
+        self.submits[submit_id] = item
+        while len(self.submits) > _MAX_LEASE_SUBMITS:
+            # oldest acked-or-done first; never drop an in-flight item
+            for k, it in self.submits.items():
+                if it.done.is_set():
+                    del self.submits[k]
+                    break
+            else:
+                break
 
 
 class _MappedBuffer:
@@ -88,11 +133,13 @@ class _ClientSession:
     the resource tables; cross-thread counters (in-flight quota usage) are
     guarded by the daemon lock."""
 
-    def __init__(self, sid: int, tenant: str, qos_class: str, weight: float):
+    def __init__(self, sid: int, tenant: str, qos_class: str, weight: float,
+                 lease: Optional[_Lease] = None):
         self.sid = sid
         self.tenant = tenant
         self.qos_class = qos_class
         self.weight = weight
+        self.lease = lease
         self.buffers: Dict[int, _MappedBuffer] = {}
         self.sources: Dict[int, object] = {}
         self.tasks: Dict[int, WorkItem] = {}
@@ -138,9 +185,13 @@ class StromDaemon:
         self._sched = QosScheduler(quantum=int(config.get("qos_quantum")),
                                    on_throttle=self._throttled)
         self._sessions: Dict[int, _ClientSession] = {}
+        self._leases: "OrderedDict[str, _Lease]" = OrderedDict()
+        self._kv_pool = None
+        self._kv_spill = None
         self._next_sid = 0
         self._next_task = 0
         self._sock: Optional[socket.socket] = None
+        self._live_conns: Dict[int, socket.socket] = {}
         self._threads: List[threading.Thread] = []
         self._dispatch_threads: List[threading.Thread] = []
         self._started = False
@@ -195,10 +246,24 @@ class StromDaemon:
             self._closed = True
             sids = list(self._sessions)
             threads = list(self._threads) + list(self._dispatch_threads)
+            conns = list(self._live_conns.values())
         self._sched.close()
         if self._sock is not None:
             try:
+                # shutdown() before close(): close() alone does not wake
+                # a thread blocked in accept() on Linux
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._sock.close()
+            except OSError:
+                pass
+        for c in conns:
+            # wake handler threads blocked in recv() on still-attached
+            # clients so the joins below do not burn their timeout
+            try:
+                c.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
         for sid in sids:
@@ -209,6 +274,9 @@ class StromDaemon:
             os.unlink(self.socket_path)
         except OSError:
             pass
+        if self._kv_pool is not None:
+            self._kv_pool.close()
+            self._kv_spill.close()
         if self._own_engine:
             self._engine.close()
 
@@ -233,6 +301,7 @@ class StromDaemon:
                     conn.close()
                     return
                 self._threads.append(t)
+                self._live_conns[id(conn)] = conn
             t.start()
 
     def _serve(self, conn: socket.socket) -> None:
@@ -273,6 +342,8 @@ class StromDaemon:
         except (OSError, StromError, ValueError):
             pass                # connection died mid-frame: reap below
         finally:
+            with self._lock:
+                self._live_conns.pop(id(conn), None)
             try:
                 conn.close()
             except OSError:
@@ -310,6 +381,7 @@ class StromDaemon:
             send_msg(conn, {"ok": False, "errno": _errno.EINVAL,
                             "error": f"class must be one of {QOS_CLASSES}"})
             return None
+        token = msg.get("lease")
         with self._lock:
             if self._closed:
                 send_msg(conn, {"ok": False, "errno": _errno.ESHUTDOWN,
@@ -321,8 +393,45 @@ class StromDaemon:
                                 "error": f"max sessions "
                                          f"({self._max_sessions}) attached"})
                 return None
+            # lease-renewal handshake: a presented token re-adopts the
+            # surviving record (reconnect) or is adopted fresh (daemon
+            # restarted and lost it); no token mints one
+            reattach = False
+            lease = self._leases.get(token) if token else None
+            if lease is not None:
+                reattach = True
+                self._leases.move_to_end(token)
+                tenant = lease.tenant       # identity rides the lease
+                qos_class = str(msg.get("class") or lease.qos_class)
+                weight = float(msg.get("weight") or lease.weight)
+            else:
+                lease = _Lease(token or secrets.token_hex(8), tenant,
+                               qos_class, weight)
+                self._leases[lease.token] = lease
+                while len(self._leases) > _MAX_LEASES:
+                    # oldest lease with no live session goes first
+                    live = {s.lease.token for s in self._sessions.values()
+                            if s.lease is not None}
+                    for k in self._leases:
+                        if k not in live:
+                            del self._leases[k]
+                            break
+                    else:
+                        break
+            lease.qos_class, lease.weight = qos_class, weight
             self._next_sid += 1
-            sess = _ClientSession(self._next_sid, tenant, qos_class, weight)
+            sess = _ClientSession(self._next_sid, tenant, qos_class, weight,
+                                  lease=lease)
+            # re-adopt the lease's surviving tasks so a wait issued after
+            # the re-attach finds work submitted before the disconnect;
+            # cancelled ones are forgotten so a resubmit re-runs them
+            for sub_id in list(lease.submits):
+                item = lease.submits[sub_id]
+                if item.cancelled:
+                    del lease.submits[sub_id]
+                else:
+                    item.session_id = sess.sid
+                    sess.tasks[item.task_id] = item
             self._sessions[sess.sid] = sess
         self._sched.register_tenant(tenant, qos_class=qos_class,
                                     weight=weight, rate=rate,
@@ -335,9 +444,10 @@ class StromDaemon:
         if _trace.active:
             _trace.instant("session_attach",
                            args={"session": sess.sid, "tenant": tenant,
-                                 "class": qos_class})
+                                 "class": qos_class, "reattach": reattach})
         send_msg(conn, {"ok": True, "session": sess.sid, "tenant": tenant,
-                        "version": PROTOCOL_VERSION})
+                        "version": PROTOCOL_VERSION,
+                        "lease": sess.lease.token, "reattach": reattach})
         return sess
 
     # -- session ops --------------------------------------------------------
@@ -444,6 +554,18 @@ class StromDaemon:
         chunk_size = int(msg.get("chunk_size", 0))
         if not chunk_ids or chunk_size <= 0:
             raise StromError(_errno.EINVAL, "need chunk_ids and chunk_size")
+        submit_id = msg.get("submit_id")
+        if submit_id is not None:
+            # idempotent resubmission: a replayed submit_id the lease
+            # already holds returns the live task instead of running the
+            # DMA twice (a restarted daemon has an empty table, so the
+            # replay genuinely re-executes — the recovery case)
+            with self._lock:
+                prior = sess.lease.submits.get(str(submit_id))
+                if prior is not None and not prior.cancelled:
+                    return {"task_id": prior.task_id,
+                            "nr_chunks": len(prior.chunk_ids),
+                            "dedup": True}
         nbytes = len(chunk_ids) * chunk_size
         with self._lock:
             if (self._quota_tasks
@@ -474,9 +596,14 @@ class StromDaemon:
                         task_id=task_id, source_handle=id(src),
                         buf_handle=buf_handle, chunk_ids=chunk_ids,
                         chunk_size=chunk_size,
-                        dest_offset=int(msg.get("dest_offset", 0)))
+                        dest_offset=int(msg.get("dest_offset", 0)),
+                        submit_id=None if submit_id is None
+                        else str(submit_id))
         item.source = src       # resolved object rides the item
         sess.tasks[task_id] = item
+        if item.submit_id is not None:
+            with self._lock:
+                sess.lease.remember(item.submit_id, item)
         if _trace.active:
             item.trace_tid = task_id
             _trace.instant("qos_enqueue",
@@ -496,6 +623,10 @@ class StromDaemon:
             raise StromError(_errno.ETIMEDOUT,
                              f"daemon task {task_id} timeout")
         sess.tasks.pop(task_id, None)
+        if item.submit_id is not None:
+            # the wait IS the ack: the idempotency window closes here
+            with self._lock:
+                sess.lease.submits.pop(item.submit_id, None)
         if item.cancelled:
             raise StromError(_errno.ECANCELED,
                              f"daemon task {task_id} cancelled by session "
@@ -503,10 +634,133 @@ class StromDaemon:
         if item.error is not None:
             raise StromError(item.error[0], item.error[1])
         res = item.result
+        if isinstance(res, dict):       # KV-pool item: payload as-is
+            return dict(res, task_id=task_id,
+                        wait_ns=item.dispatch_ns - item.enqueue_ns)
         return {"task_id": task_id, "nr_chunks": res.nr_chunks,
                 "nr_ssd2dev": res.nr_ssd2dev, "nr_ram2dev": res.nr_ram2dev,
                 "chunk_ids": list(res.chunk_ids), "landing": res.landing,
                 "wait_ns": item.dispatch_ns - item.enqueue_ns}
+
+    # -- KV-cache paging (ISSUE 15): one shared pool, QoS-scheduled ---------
+    def _op_kv_open(self, sess, msg, fds) -> dict:
+        """Open (or join) the daemon's shared KV block pool.  The first
+        caller supplies the spill spec; later callers just get the pool
+        geometry — one pool, many sequences, every tenant's page
+        traffic ordered by the same QoS classes as its DMA."""
+        with self._lock:
+            pool = self._kv_pool
+        if pool is None:
+            from ..serving.kvcache import KvBlockPool
+            spill = self._open_spill(msg.get("spill"), msg)
+            try:
+                pool = KvBlockPool(
+                    self._engine, spill,
+                    block_bytes=msg.get("block_bytes"),
+                    ram_blocks=int(msg.get("ram_blocks", 16)))
+            except BaseException:
+                spill.close()
+                raise
+            with self._lock:
+                if self._kv_pool is None:
+                    self._kv_pool, self._kv_spill = pool, spill
+                else:           # racing open won; keep theirs
+                    pool.close()
+                    spill.close()
+                    pool = self._kv_pool
+        return {"block_bytes": pool.block_bytes,
+                "residency": pool.residency()}
+
+    def _open_spill(self, spec, msg):
+        if isinstance(spec, dict):
+            if not self._allow_fake:
+                raise StromError(_errno.EPERM,
+                                 "fake spill needs allow_fake=True")
+            from ..testing import FakeNvmeSource, FakeStripedNvmeSource
+            if "paths" in spec:
+                return FakeStripedNvmeSource(
+                    [str(p) for p in spec["paths"]],
+                    int(spec["stripe_chunk_size"]),
+                    mirror=str(spec.get("mirror") or "none"),
+                    writable=True, force_cached_fraction=0.0)
+            return FakeNvmeSource(str(spec["path"]), writable=True,
+                                  force_cached_fraction=0.0)
+        if not spec:
+            raise StromError(_errno.EINVAL, "kv_open needs a spill spec")
+        from ..engine import open_source
+        kw = {k: msg[k] for k in ("stripe_chunk_size", "segment_size",
+                                  "mirror") if msg.get(k)}
+        return open_source(spec, writable=True, **kw)
+
+    def _op_kv(self, sess, msg, fds) -> dict:
+        """One KV-pool operation, admitted and QoS-scheduled exactly
+        like a DMA submit (the block's bytes are the shaping weight),
+        then answered synchronously — the page-in a latency tenant
+        issues overtakes a bulk tenant's queued scan traffic."""
+        with self._lock:
+            pool = self._kv_pool
+        if pool is None:
+            raise StromError(_errno.ENXIO, "no KV pool: kv_open first")
+        kvop = str(msg.get("kv_op"))
+        if kvop not in ("append", "read", "write", "resume", "release",
+                        "residency"):
+            raise StromError(_errno.EINVAL, f"unknown kv_op {kvop!r}")
+        args = {"seq": msg.get("seq"), "idx": msg.get("idx")}
+        if msg.get("data") is not None:
+            args["data"] = base64.b64decode(msg["data"])
+        nbytes = pool.block_bytes if kvop in ("append", "read",
+                                              "write") else 0
+        with self._lock:
+            if (self._quota_tasks
+                    and sess.inflight_tasks + 1 > self._quota_tasks) or \
+               (self._quota_bytes and nbytes
+                    and sess.inflight_bytes + nbytes > self._quota_bytes):
+                rejected = True
+            else:
+                rejected = False
+                sess.inflight_tasks += 1
+                sess.inflight_bytes += nbytes
+                self._next_task += 1
+                task_id = self._next_task
+        if rejected:
+            stats.add("nr_admission_reject")
+            stats.tenant_reject(sess.tenant)
+            raise StromError(_errno.EAGAIN,
+                             f"tenant {sess.tenant} over quota: back off")
+        stats.tenant_inflight(sess.tenant, 1, nbytes)
+        item = WorkItem(session_id=sess.sid, tenant=sess.tenant,
+                        task_id=task_id, source_handle=0, buf_handle=0,
+                        chunk_ids=[0], chunk_size=max(1, nbytes),
+                        kv=(kvop, args))
+        sess.tasks[task_id] = item
+        self._sched.enqueue(item)
+        stats.gauge_set("qos_queue_depth", self._sched.depth())
+        if not item.done.wait(float(msg.get("timeout", 60.0))):
+            raise StromError(_errno.ETIMEDOUT, f"kv {kvop} timeout")
+        sess.tasks.pop(task_id, None)
+        if item.cancelled:
+            raise StromError(_errno.ECANCELED, f"kv {kvop} cancelled")
+        if item.error is not None:
+            raise StromError(item.error[0], item.error[1])
+        return dict(item.result)
+
+    def _kv_execute(self, kvop: str, args: dict) -> dict:
+        pool = self._kv_pool
+        seq = args.get("seq")
+        if kvop == "append":
+            return {"idx": pool.append(seq, args["data"])}
+        if kvop == "read":
+            data = pool.read(seq, int(args["idx"]))
+            return {"data": base64.b64encode(data).decode("ascii")}
+        if kvop == "write":
+            pool.write(seq, int(args["idx"]), args["data"])
+            return {}
+        if kvop == "resume":
+            return {"paged_in": pool.resume(seq)}
+        if kvop == "release":
+            pool.release(seq)
+            return {}
+        return {"residency": pool.residency()}
 
     def _op_stat(self, sess, msg, fds) -> dict:
         snap = stats.snapshot(debug=bool(msg.get("debug")))
@@ -541,10 +795,13 @@ class StromDaemon:
                         args={"tenant": item.tenant,
                               "session": item.session_id})
         try:
-            res = self._engine.memcpy_ssd2ram(
-                item.source, item.buf_handle, list(item.chunk_ids),
-                item.chunk_size, dest_offset=item.dest_offset)
-            item.result = self._engine.memcpy_wait(res.dma_task_id)
+            if item.kv is not None:
+                item.result = self._kv_execute(*item.kv)
+            else:
+                res = self._engine.memcpy_ssd2ram(
+                    item.source, item.buf_handle, list(item.chunk_ids),
+                    item.chunk_size, dest_offset=item.dest_offset)
+                item.result = self._engine.memcpy_wait(res.dma_task_id)
         except StromError as e:
             item.error = (e.errno or _errno.EIO, str(e))
         except Exception as e:          # noqa: BLE001 — must not kill the

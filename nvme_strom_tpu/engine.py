@@ -52,6 +52,7 @@ from .eligibility import probe_backing
 from .stats import stats
 from .trace import recorder as _trace
 from .cache import residency_cache as _rcache
+from .serving.hbm_tier import hbm_tier as _hbm_tier
 from . import numa as _numa
 
 #: live sessions, for the stat exporter's pre-publish fold (weak: the
@@ -1130,6 +1131,10 @@ class Session:
         # residency cache (ISSUE 9): same contract — cache_bytes is read
         # here and hit/miss sites cost one `_rcache.active` branch when off
         _rcache.configure()
+        # HBM residency tier (ISSUE 15): the device leg above the host
+        # tier — hbm_cache_bytes read here, one `_hbm_tier.active` branch
+        # per task when off
+        _hbm_tier.configure()
         self._slots: List[Dict[int, DmaTask]] = [dict() for _ in range(_N_TASK_SLOTS)]
         self._slot_cv = [threading.Condition() for _ in range(_N_TASK_SLOTS)]
         self._id_lock = threading.Lock()
@@ -1742,22 +1747,37 @@ class Session:
             # page-cache arbitration and the member lanes
             skey = None
             miss_ids, spans = chunk_ids, spans_all
-            if _rcache.active:
+            if _rcache.active or _hbm_tier.active:
                 skey = _rcache.source_key(source)
                 miss_ids, spans = [], []
+                nr_hbm = 0
                 for cid, (base, length) in zip(chunk_ids, spans_all):
-                    lease = _rcache.lookup(skey, base, length)
+                    # the DEVICE tier outranks the host tier (ISSUE 15):
+                    # an HBM-resident extent costs one device→dest copy
+                    # and never touches a host slab
+                    lease = _hbm_tier.lookup(skey, base, length) \
+                        if _hbm_tier.active else None
+                    hbm = lease is not None
+                    if hbm:
+                        nr_hbm += 1
+                    elif _rcache.active:
+                        lease = _rcache.lookup(skey, base, length)
                     if lease is not None:
-                        cache_hits.append((cid, base, length, lease))
+                        cache_hits.append((cid, base, length, lease, hbm))
                     else:
                         miss_ids.append(cid)
                         spans.append((base, length))
+                if nr_hbm:
+                    stats.add("nr_hbm_hit", nr_hbm)
+                if len(cache_hits) > nr_hbm:
+                    stats.add("nr_cache_hit", len(cache_hits) - nr_hbm)
                 if cache_hits:
-                    stats.add("nr_cache_hit", len(cache_hits))
                     stats.add("bytes_cache_hit",
                               sum(h[2] for h in cache_hits))
                 if miss_ids:
                     stats.add("nr_cache_miss", len(miss_ids))
+                if not _rcache.active:
+                    skey = None  # no host tier: nothing to fill at wait
 
             # --- cache arbitration (write-back vs direct) -----------------
             threshold = config.get("cache_threshold")
@@ -1972,7 +1992,7 @@ class Session:
             #     nothing submitted at all
             j = 0
             while cache_hits:
-                cid, base, length, lease = cache_hits.pop(0)
+                cid, base, length, lease, hbm = cache_hits.pop(0)
                 slot = nr_ssd + len(wb_ids) + j
                 j += 1
                 target = wb_buffer if wb_buffer is not None else dest
@@ -1990,7 +2010,8 @@ class Session:
                 if _trace.active and task.trace_id:
                     _trace.span("cache_hit", th0, time.monotonic_ns(),
                                 tid=task.trace_id, offset=base,
-                                length=length)
+                                length=length,
+                                args=({"tier": "hbm"} if hbm else None))
 
             # --- record the miss fills, consumed at wait time once the
             #     fault ladder has healed the destination bytes (direct

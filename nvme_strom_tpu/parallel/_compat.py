@@ -1,0 +1,18 @@
+"""jax API compatibility shims for the parallel layer.
+
+``shard_map`` moved between jax releases: new enough versions export it as
+``jax.shard_map``; older ones only ship the experimental spelling
+``jax.experimental.shard_map.shard_map``.  Resolve it exactly once here so
+every call site (dscan/sort/ring/exchange/pjoin/stream consumers) stays
+version-agnostic — this is the project's only tolerated feature probe on
+the jax surface (stromlint pins the rest to literal APIs).
+"""
+
+from __future__ import annotations
+
+try:                                    # jax >= 0.4.34 public spelling
+    from jax import shard_map           # type: ignore[attr-defined]
+except ImportError:                     # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["shard_map"]

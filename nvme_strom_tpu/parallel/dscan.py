@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.filter_xla import DEFAULT_SCHEMA, decode_pages
 from ..scan.heap import HeapSchema
+from ._compat import shard_map
 from .mesh import make_scan_mesh, pages_sharding
 
 __all__ = ["make_distributed_scan_step", "shard_pages"]
@@ -66,7 +67,7 @@ def make_distributed_scan_step(devices: Optional[Sequence[jax.Device]] = None,
         return {"count": jax.lax.psum(count, "dp"),
                 "sums": jax.lax.psum(sums, ("sp", "dp"))}
 
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp", None), P()),
         out_specs={"count": P(), "sums": P()})

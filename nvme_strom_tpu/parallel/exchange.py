@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
 from .mesh import make_scan_mesh
 
 __all__ = ["make_bucket_exchange", "bucket_dispatch"]
@@ -110,7 +111,7 @@ def make_bucket_exchange(devices: Optional[Sequence[jax.Device]] = None, *,
         return {"rows": recv[None], "count": count[None],
                 "n_dropped": jax.lax.psum(n_dropped, "dp")}
 
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp", None), P("dp"), P("dp")),
         out_specs={"rows": P("dp", None, None), "count": P("dp"),

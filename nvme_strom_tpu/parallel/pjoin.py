@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
 from ..api import StromError
 
 from ..ops.filter_xla import decode_pages, global_row_positions
@@ -264,7 +265,7 @@ def make_partitioned_join_step(mesh: Mesh, schema: HeapSchema,
         out_specs["payload_sum"] = P()
     if how == "left":
         out_specs["null_count"] = P()
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp", None), P("dp", None), P("dp", None),
                   P("dp", None)),
@@ -362,7 +363,7 @@ def make_partitioned_join_rows_step(mesh: Mesh, schema: HeapSchema,
         out_specs["payload"] = P("dp")
     if how == "left":
         out_specs["partner"] = P("dp")
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp", None), P("dp", None), P("dp", None),
                   P("dp", None)),

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
 from ..ops.filter_xla import DEFAULT_SCHEMA, decode_pages
 from ..scan.heap import HeapSchema
 from .mesh import make_scan_mesh
@@ -85,7 +86,7 @@ def make_ring_multi_query_scan(devices: Optional[Sequence[jax.Device]] = None,
         # leading axis 1: shard_map concatenates over the mesh into (dp,...)
         return {"count": count[None], "sums": sums[None]}
 
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp", None), P("dp")),
         out_specs={"count": P("dp"), "sums": P("dp", None)})

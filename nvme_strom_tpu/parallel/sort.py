@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map
 from .mesh import make_scan_mesh
 
 __all__ = ["make_distributed_sort", "make_distributed_distinct",
@@ -153,7 +154,7 @@ def make_distributed_sort(devices: Optional[Sequence[jax.Device]] = None, *,
                  "n_dropped": P()}
     if with_payload:
         out_specs["payload"] = P("dp", None)
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp"), P("dp"), P("dp")),
         out_specs=out_specs)
@@ -265,7 +266,7 @@ def make_distributed_distinct(devices=None, *, capacity: int,
             prev_ok, v != jnp.roll(v, 1), True)   # first valid starts a run
         return jax.lax.psum(jnp.sum(new_run.astype(jnp.int32)), "dp")[None]
 
-    counted = jax.jit(jax.shard_map(
+    counted = jax.jit(shard_map(
         _local, mesh=mesh,
         in_specs=(P("dp", None), P("dp")),
         out_specs=P()))

@@ -202,12 +202,15 @@ class QueryPlan:
     join_strategy: Optional[str] = None  # broadcast | partitioned(N)
     workers: int = 0       # parallel worker processes (0 = serial)
     cache_hit_ratio: float = 0.0  # expected residency-tier hit fraction
+    hbm_hit_ratio: float = 0.0    # expected DEVICE-tier hit fraction
     pushdown: str = ""     # "" | chip | host | raw (packed-sidecar scan)
 
     def __str__(self) -> str:
         par = f", workers={self.workers}" if self.workers else ""
         cache = (f"  cache-resident: ~{self.cache_hit_ratio:.0%}"
                  if self.cache_hit_ratio > 0 else "")
+        cache += (f"  hbm-resident: ~{self.hbm_hit_ratio:.0%}"
+                  if self.hbm_hit_ratio > 0 else "")
         return (f"{self.operator} scan  [{self.access_path} path, "
                 f"{self.kernel} kernel, {self.mode}{par}]\n"
                 f"  pages: {self.n_pages}  cost: direct={self.cost_direct:.0f} "
@@ -1608,15 +1611,25 @@ class Query:
         # expected hit ratio for this table — at 1.0 the scan is served
         # entirely from pinned slabs and skips engine submission
         from ..cache import residency_cache
+        from ..serving.hbm_tier import hbm_tier
         ratio = 0.0
-        if residency_cache.active and size:
+        hbm_ratio = 0.0
+        if (residency_cache.active or hbm_tier.active) and size:
             if isinstance(self.source, (list, tuple)):
                 cpaths = list(self.source)
             elif path is not None:
                 cpaths = [path]
             else:
                 cpaths = []
-            ratio = residency_cache.resident_fraction(cpaths, size)
+            if residency_cache.active:
+                ratio = residency_cache.resident_fraction(cpaths, size)
+            # device tier (ISSUE 15): the engine consults HBM FIRST, so
+            # its expected hit share surfaces separately — those chunks
+            # cost one device->dest memcpy, not even a host-slab touch
+            hbm_ratio = hbm_tier.resident_fraction(cpaths, size)
+        if hbm_ratio > 0:
+            reason += (f"; hbm tier holds ~{hbm_ratio:.0%} of the table "
+                       f"(device hits, checked before the host tier)")
         if ratio >= 1.0:
             reason += ("; fully cache-resident: served from the "
                        "residency tier, engine submission skipped")
@@ -1640,6 +1653,7 @@ class Query:
                          cost_direct=cd.total, cost_vfs=cv.total,
                          reason=reason,
                          cache_hit_ratio=round(ratio, 4),
+                         hbm_hit_ratio=round(hbm_ratio, 4),
                          pushdown=pd)
 
     # -- compute builders ---------------------------------------------------

@@ -1,0 +1,25 @@
+"""strom_serve: the LLM serving stack over the SSD→HBM data path (ISSUE 15).
+
+Three legs, layered strictly on the existing machinery:
+
+* :mod:`.hbm_tier` — a capacity-bounded DEVICE-side extent tier (the
+  missing device leg of ROADMAP item 2): the host ARC tier promotes
+  twice-touched extents into HBM-resident buffers, the engine serves
+  them ahead of host hits, and eviction demotes the bytes back into the
+  host tier.  Config ``hbm_cache_bytes``, default 0 = off.
+* :mod:`.weights` — model cold-start: checkpoint shards streamed
+  layer-ordered into donated HBM weight buffers, layer N+1 landing
+  while layer N's buffers are adopted (``plan_landing`` zero-copy where
+  eligible), crc-verified by default.
+* :mod:`.kvcache` — an SSD-backed KV-cache block pool: fixed-size
+  blocks with per-sequence block tables, the working set pinned in the
+  HBM tier, LRU demotion HBM→pinned-RAM→SSD (writes ride the mirrored
+  write ladder) and prefetch-on-sequence-resume.  stromd exposes one
+  shared pool to its tenants under the existing QoS classes.
+"""
+
+from .hbm_tier import HbmLease, HbmResidencyTier, hbm_tier
+from .kvcache import KvBlockPool
+from .weights import StreamedModel, stream_weights
+
+__all__ = ["HbmLease", "HbmResidencyTier", "hbm_tier"]

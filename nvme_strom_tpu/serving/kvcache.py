@@ -1,0 +1,386 @@
+"""SSD-backed KV-cache paging over the HBM residency tier (ISSUE 15).
+
+Serving long contexts means the KV cache outgrows HBM; the batch story
+(vLLM's PagedAttention) solves fragmentation with fixed-size blocks and
+per-sequence block tables, and this module adds the tier below it: a
+block that falls out of the device working set demotes to pinned host
+RAM, and out of THAT to the SSD spill extent — riding the session's
+write ladder, so a mirrored spill source keeps paging byte-identical
+through member fail-stop (the read path heals page-ins via the mirror,
+the write path keeps legs coherent).
+
+Tier placement is exclusive — a block lives in exactly ONE of:
+
+* **HBM** — pinned into :data:`.hbm_tier.hbm_tier` through an
+  :class:`~.hbm_tier.HbmLease` (``refs>0`` makes the tier's own LRU
+  skip it; only the pool demotes its blocks, via
+  :meth:`HbmResidencyTier.drop`, which bypasses host-ARC demotion
+  because the pool owns the bytes' next home),
+* **pinned RAM** — a slot in one session DMA buffer (pinned +
+  io_uring-fixed, so page-out/page-in are zero-staging engine copies),
+* **SSD** — a ``block_bytes``-chunk slot in the writable spill source.
+
+Movement down is pool-LRU driven and counted/traced: ``nr_kv_pageout``
+with a ``kv_page`` span per RAM→SSD write, ``nr_kv_pagein`` + span per
+SSD→RAM read, and :meth:`KvBlockPool.resume` batch-prefetches a parked
+sequence's spilled blocks with one async submit per block (the
+``DeviceLoader`` prefetch discipline applied to sequence resumption).
+
+Keys in the HBM tier use a per-pool synthetic source key (``#kvpool:N``
+tag — the same '#'-tag convention ``cache.source_key`` uses for source
+framing), so KV extents can never collide with file-backed cache
+entries and path invalidation never touches them.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import itertools
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..api import StromError
+from ..config import config
+from ..stats import stats
+from ..trace import recorder as _trace
+from .hbm_tier import hbm_tier
+
+__all__ = ["KvBlockPool"]
+
+_pool_ids = itertools.count(1)
+
+
+class _Block:
+    __slots__ = ("seq", "idx", "gid", "tier", "slot", "lease")
+
+    def __init__(self, seq, idx: int, gid: int) -> None:
+        self.seq = seq
+        self.idx = idx
+        self.gid = gid      # pool-global id; HBM-tier base = gid*block_bytes
+        self.tier = "ram"   # "hbm" | "ram" | "ssd"
+        self.slot = -1      # ram slot or ssd slot, by tier
+        self.lease = None   # HbmLease while tier == "hbm"
+
+
+class KvBlockPool:
+    """Fixed-size KV block pool with per-sequence block tables.
+
+    *spill* is a writable :class:`~..engine.Source` (mirror it for
+    fail-stop survival) whose size bounds the SSD tier; *ram_blocks*
+    bounds the pinned-RAM tier; the HBM share defaults to half the
+    device tier's capacity (``hbm_cache_bytes``), leaving room for the
+    scan-promotion traffic the tier also serves."""
+
+    def __init__(self, session, spill, *, block_bytes: Optional[int] = None,
+                 ram_blocks: int = 16, hbm_blocks: Optional[int] = None,
+                 durable: bool = False) -> None:
+        bb = int(block_bytes or config.get("kv_block_bytes"))
+        if bb <= 0 or (bb & (bb - 1)):
+            raise StromError(_errno.EINVAL,
+                             f"block_bytes {bb} must be a power of two")
+        if ram_blocks < 2:
+            raise StromError(_errno.EINVAL, "need at least 2 RAM blocks")
+        spill._check_writable()
+        self.block_bytes = bb
+        self._session = session
+        self._spill = spill
+        self._durable = durable
+        self._handle, self._dma = session.alloc_dma_buffer(ram_blocks * bb)
+        self._ram_free = list(range(ram_blocks))
+        self._ssd_free = list(range(spill.size // bb))
+        if not self._ssd_free:
+            raise StromError(_errno.EINVAL,
+                             f"spill source smaller than one {bb}B block")
+        if hbm_blocks is None:
+            hbm_blocks = (int(config.get("hbm_cache_bytes")) // 2 // bb
+                          if hbm_tier.active else 0)
+        self._hbm_budget = hbm_blocks
+        self._hbm_used = 0
+        self._skey = ("#kvpool:%d" % next(_pool_ids),)
+        self._tables: Dict[object, List[_Block]] = {}
+        self._lru: "OrderedDict[int, _Block]" = OrderedDict()  # ram+hbm
+        self._gids = itertools.count()
+        self._lock = threading.RLock()
+        self._closed = False
+
+    # -- introspection -------------------------------------------------
+
+    def residency(self) -> Dict[str, int]:
+        """Block counts per tier (the tpu_stat serving scoreboard and
+        the A/B bench read this)."""
+        with self._lock:
+            out = {"hbm": 0, "ram": 0, "ssd": 0}
+            for table in self._tables.values():
+                for b in table:
+                    out[b.tier] += 1
+            return out
+
+    def sequences(self) -> List[object]:
+        with self._lock:
+            return list(self._tables)
+
+    def blocks(self, seq) -> int:
+        with self._lock:
+            return len(self._tables.get(seq, ()))
+
+    # -- block table ops ----------------------------------------------
+
+    def append(self, seq, data) -> int:
+        """Append *data* (≤ block_bytes; short blocks are zero-padded)
+        as the sequence's next block; returns its block index."""
+        with self._lock:
+            self._check_open()
+            table = self._tables.setdefault(seq, [])
+            blk = _Block(seq, len(table), next(self._gids))
+            blk.slot = self._get_ram_slot()
+            self._lru[blk.gid] = blk
+            table.append(blk)
+            self._fill_ram(blk.slot, data)
+            return blk.idx
+
+    def write(self, seq, idx: int, data) -> None:
+        """Overwrite block *idx* in place (decode-step KV updates land
+        here).  An HBM-resident block demotes to RAM first — the device
+        copy is immutable — and re-promotes on its next read."""
+        with self._lock:
+            self._check_open()
+            blk = self._get_block(seq, idx)
+            if blk.tier == "hbm":
+                self._demote_hbm(blk)
+            elif blk.tier == "ssd":
+                self._page_in(blk)
+            self._lru.move_to_end(blk.gid)
+            self._fill_ram(blk.slot, data)
+
+    def read(self, seq, idx: int) -> bytes:
+        """Block bytes, paged in / promoted as a side effect: an SSD
+        block pages into RAM (healed via mirror when a member is down),
+        a RAM block promotes into the pool's pinned HBM share while the
+        budget allows."""
+        with self._lock:
+            self._check_open()
+            blk = self._get_block(seq, idx)
+            if blk.tier == "ssd":
+                self._page_in(blk)
+            if blk.tier == "ram":
+                self._promote(blk)
+            self._lru.move_to_end(blk.gid)
+            if blk.tier == "hbm":
+                out = bytearray(self.block_bytes)
+                if not blk.lease.copy_into(memoryview(out)):
+                    # invalidated between pin and copy (backend
+                    # revocation): exclusive placement means the bytes
+                    # have no other home — hard error
+                    self._drop_hbm(blk)
+                    blk.tier, blk.slot = "ram", self._get_ram_slot()
+                    raise StromError(
+                        _errno.EIO,
+                        f"KV block {blk.idx} lost to HBM revocation")
+                return bytes(out)
+            return bytes(self._ram_view(blk.slot))
+
+    def device_array(self, seq, idx: int):
+        """The block as its device-resident uint8 array (attention
+        kernels consume this without a host round-trip), promoting it
+        if needed; None when the HBM share is exhausted or the tier is
+        off."""
+        with self._lock:
+            self._check_open()
+            blk = self._get_block(seq, idx)
+            if blk.tier == "ssd":
+                self._page_in(blk)
+            if blk.tier == "ram":
+                self._promote(blk)
+            self._lru.move_to_end(blk.gid)
+            return blk.lease.device_array() if blk.tier == "hbm" else None
+
+    def resume(self, seq) -> int:
+        """Prefetch-on-sequence-resume: page every spilled block of
+        *seq* back into RAM with ONE async submit per block, waiting
+        once at the end (the cross-epoch overlap discipline).  Returns
+        the number of blocks paged in."""
+        with self._lock:
+            self._check_open()
+            table = self._tables.get(seq, [])
+            spilled = [b for b in table if b.tier == "ssd"]
+            # cap at what RAM can hold without evicting this sequence
+            budget = len(self._ram_free) + sum(
+                1 for b in self._lru.values()
+                if b.tier == "ram" and b.seq != seq)
+            spilled = spilled[:max(0, budget)]
+            inflight = []
+            for blk in spilled:
+                slot = self._get_ram_slot(avoid_seq=seq)
+                ts = time.monotonic_ns()
+                res = self._session.memcpy_ssd2ram(
+                    self._spill, self._handle, [blk.slot],
+                    self.block_bytes, dest_offset=slot * self.block_bytes)
+                inflight.append((blk, slot, res, ts))
+            for blk, slot, res, ts in inflight:
+                self._session.memcpy_wait(res.dma_task_id)
+                self._ssd_free.append(blk.slot)
+                blk.tier, blk.slot = "ram", slot
+                self._lru[blk.gid] = blk
+                self._lru.move_to_end(blk.gid)
+                stats.add("nr_kv_pagein")
+                if _trace.active:
+                    _trace.span("kv_page", ts, time.monotonic_ns(),
+                                offset=blk.gid * self.block_bytes,
+                                length=self.block_bytes,
+                                args={"dir": "in", "block": blk.idx,
+                                      "resume": True})
+            return len(inflight)
+
+    def release(self, seq) -> None:
+        """Drop a finished sequence: every tier slot returns to its
+        free list, HBM pins release and drop."""
+        with self._lock:
+            table = self._tables.pop(seq, [])
+            for blk in table:
+                if blk.tier == "hbm":
+                    blk.lease.release()
+                    hbm_tier.drop(self._skey, blk.gid * self.block_bytes,
+                                  self.block_bytes)
+                    self._hbm_used -= 1
+                elif blk.tier == "ram":
+                    self._ram_free.append(blk.slot)
+                else:
+                    self._ssd_free.append(blk.slot)
+                self._lru.pop(blk.gid, None)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            for seq in list(self._tables):
+                self.release(seq)
+            self._closed = True
+            try:
+                self._session.unmap_buffer(self._handle)
+            except StromError:
+                pass
+
+    # -- internals (pool lock held) ------------------------------------
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise StromError(_errno.EBADF, "KV pool closed")
+
+    def _get_block(self, seq, idx: int) -> _Block:
+        try:
+            return self._tables[seq][idx]
+        except (KeyError, IndexError):
+            raise StromError(
+                _errno.ENOENT, f"no KV block {idx} for sequence {seq!r}"
+            ) from None
+
+    def _ram_view(self, slot: int) -> memoryview:
+        base = slot * self.block_bytes
+        return self._dma.view()[base:base + self.block_bytes]
+
+    def _fill_ram(self, slot: int, data) -> None:
+        n = len(data)
+        if n > self.block_bytes:
+            raise StromError(_errno.EINVAL,
+                             f"{n}B exceeds the {self.block_bytes}B block")
+        view = self._ram_view(slot)
+        view[:n] = bytes(data) if not isinstance(data, (bytes, bytearray,
+                                                        memoryview)) else data
+        if n < self.block_bytes:
+            view[n:] = b"\0" * (self.block_bytes - n)
+
+    def _get_ram_slot(self, avoid_seq=None) -> int:
+        """A free RAM slot, paging out the pool-LRU RAM block if none
+        is free (HBM blocks are pinned and SSD blocks hold no slot, so
+        only ``tier=="ram"`` entries are candidates)."""
+        if self._ram_free:
+            return self._ram_free.pop()
+        for gid, blk in self._lru.items():
+            if blk.tier == "ram" and (avoid_seq is None
+                                      or blk.seq != avoid_seq):
+                self._page_out(blk)
+                break
+        if not self._ram_free:
+            raise StromError(_errno.ENOSPC,
+                             "KV RAM tier exhausted and nothing evictable")
+        return self._ram_free.pop()
+
+    def _page_out(self, blk: _Block) -> None:
+        """RAM→SSD demotion over the session's write ladder (mirrored
+        spill sources keep both legs coherent)."""
+        if not self._ssd_free:
+            raise StromError(_errno.ENOSPC, "KV spill extent full")
+        ssd_slot = self._ssd_free.pop()
+        ts = time.monotonic_ns()
+        res = self._session.memcpy_ram2ssd(
+            self._spill, self._handle, [ssd_slot], self.block_bytes,
+            src_offset=blk.slot * self.block_bytes)
+        self._session.memcpy_wait(res.dma_task_id)
+        if self._durable:
+            self._spill.sync()
+        self._ram_free.append(blk.slot)
+        self._lru.pop(blk.gid, None)
+        blk.tier, blk.slot = "ssd", ssd_slot
+        stats.add("nr_kv_pageout")
+        if _trace.active:
+            _trace.span("kv_page", ts, time.monotonic_ns(),
+                        offset=blk.gid * self.block_bytes,
+                        length=self.block_bytes,
+                        args={"dir": "out", "block": blk.idx})
+
+    def _page_in(self, blk: _Block) -> None:
+        """SSD→RAM page-in; the engine's fault ladder (hedges, mirror
+        reads) serves it even with a spill member fail-stopped."""
+        slot = self._get_ram_slot()
+        ts = time.monotonic_ns()
+        res = self._session.memcpy_ssd2ram(
+            self._spill, self._handle, [blk.slot], self.block_bytes,
+            dest_offset=slot * self.block_bytes)
+        self._session.memcpy_wait(res.dma_task_id)
+        self._ssd_free.append(blk.slot)
+        self._lru[blk.gid] = blk
+        blk.tier, blk.slot = "ram", slot
+        stats.add("nr_kv_pagein")
+        if _trace.active:
+            _trace.span("kv_page", ts, time.monotonic_ns(),
+                        offset=blk.gid * self.block_bytes,
+                        length=self.block_bytes,
+                        args={"dir": "in", "block": blk.idx})
+
+    def _promote(self, blk: _Block) -> None:
+        """RAM→HBM while the pool's pinned share allows; the lease pin
+        makes the tier's own LRU skip the block."""
+        if not hbm_tier.active or self._hbm_used >= self._hbm_budget:
+            return
+        base = blk.gid * self.block_bytes
+        data = self._ram_view(blk.slot)
+        if not hbm_tier.admit(self._skey, base, self.block_bytes, data):
+            return
+        lease = hbm_tier.lookup(self._skey, base, self.block_bytes)
+        if lease is None:  # pragma: no cover - raced a revocation
+            hbm_tier.drop(self._skey, base, self.block_bytes)
+            return
+        self._ram_free.append(blk.slot)
+        blk.tier, blk.slot, blk.lease = "hbm", -1, lease
+        self._hbm_used += 1
+
+    def _demote_hbm(self, blk: _Block) -> None:
+        """HBM→RAM: copy the device bytes into a fresh RAM slot, then
+        drop the tier entry WITHOUT host-ARC demotion (the pool is the
+        bytes' home)."""
+        slot = self._get_ram_slot()
+        ok = blk.lease.copy_into(self._ram_view(slot))
+        self._drop_hbm(blk)
+        blk.tier, blk.slot = "ram", slot
+        if not ok:  # pragma: no cover - invalidated between pin and copy
+            raise StromError(_errno.EIO,
+                             f"KV block {blk.idx} lost to HBM revocation")
+
+    def _drop_hbm(self, blk: _Block) -> None:
+        blk.lease.release()
+        blk.lease = None
+        hbm_tier.drop(self._skey, blk.gid * self.block_bytes,
+                      self.block_bytes)
+        self._hbm_used -= 1

@@ -533,7 +533,9 @@ class StatRegistry:
             for k, v in native_counters.items():
                 if k in self._c and k not in ("cur_dma_count", "max_dma_count",
                                               "cache_resident_bytes",
-                                              "resync_pending_bytes"):
+                                              "resync_pending_bytes",
+                                              "hbm_resident_bytes",
+                                              "coldstart_bytes_per_sec"):
                     self._c[k] += v
 
 

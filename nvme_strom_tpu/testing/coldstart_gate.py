@@ -1,0 +1,216 @@
+"""Cold-start gate (ISSUE 15, ``make coldstart-gate``).
+
+Holds the weight-streaming tentpole's contracts on a deterministic
+latency-injected synthetic checkpoint:
+
+* **Speedup** — ``stream_weights`` (depth-pipelined: layer N+1's SSD
+  DMA in flight while layer N verifies and adopts) must beat the naive
+  cold-start — load a layer, wait, adopt, repeat, the
+  restore-then-device_put discipline every serial loader uses — by at
+  least ``STROM_COLDSTART_GATE_RATIO`` (default 2x).  Both legs pay the
+  same injected per-request device latency, so the ratio measures
+  overlap, not I/O luck, and reproduces on any machine.
+* **Byte identity** — every leaf the streamer lands must equal the
+  tree that was checkpointed, on both legs, with crc verification on.
+* **Layer-ordered landing** — the flight recorder's ``weight_stream``
+  spans must retire in stream order (the ``layer`` arg strictly
+  increasing): the pipeline may keep many layers in FLIGHT but must
+  ADOPT them in order, or a consumer could touch layer N+1 before
+  layer N exists.
+* **Corruption refusal** — a flipped byte in a streamed leaf must fail
+  the manifest crc check with EBADMSG before adoption.
+
+Runs in ``make coldstart-gate`` (wired into ``make check``).
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import os
+import sys
+import tempfile
+import time
+
+RATIO_LIMIT = float(os.environ.get("STROM_COLDSTART_GATE_RATIO", "2.0"))
+ROUNDS = int(os.environ.get("STROM_COLDSTART_GATE_ROUNDS", "3"))
+
+#: every layer is one pow2 span so dma_max merges it into ONE request —
+#: one injected latency per layer on both legs
+_LAYER_BYTES = 256 << 10
+_N_LAYERS = 12
+_DEPTH = 4
+_LAT_S = 0.004
+
+
+def _make_checkpoint(dirpath: str):
+    import numpy as np
+
+    from ..data.checkpoint import save_checkpoint
+
+    rng = np.random.default_rng(11)
+    # each leaf exactly _LAYER_BYTES once padded: f32 elements
+    n_el = _LAYER_BYTES // 4
+    tree = {"layers": [
+        {"w": rng.standard_normal(n_el).astype(np.float32)}
+        for _ in range(_N_LAYERS)
+    ]}
+    path = os.path.join(dirpath, "model.ckpt")
+    save_checkpoint(path, tree)
+    return path, tree
+
+
+def _naive_coldstart(path: str, src, dev):
+    """The baseline every serial loader implements: read layer, WAIT,
+    adopt, next layer — same chunk grid, same landing buffers, zero
+    overlap."""
+    import numpy as np
+
+    from ..data.checkpoint import checkpoint_info
+    from ..engine import Session
+    from ..hbm.registry import LandingBuffer, registry
+    from ..serving.weights import _plan_layers
+
+    meta = checkpoint_info(path)
+    handles = []
+    with Session() as sess:
+        for ly in _plan_layers(meta):
+            landing = LandingBuffer(sess, ly.nbytes)
+            c0 = ly.base // 4096
+            res = sess.memcpy_ssd2ram(src, landing.handle,
+                                      list(range(c0, c0 + ly.nbytes // 4096)),
+                                      4096)
+            sess.memcpy_wait(res.dma_task_id, timeout=120.0)
+            arr = landing.adopt_array(np.uint8, dev)
+            handle = registry.map_device_memory(arr)
+            registry.get(handle).adopt(arr, landing)
+            handles.append(handle)
+    return handles
+
+
+def _release(handles) -> None:
+    from ..hbm.registry import registry
+    for h in handles:
+        try:
+            registry.unmap(h, timeout=5.0)
+        except Exception:  # noqa: BLE001 - already gone
+            pass
+
+
+def _check_tree(model, tree) -> None:
+    import jax.tree_util as jtu
+    import numpy as np
+
+    for kp, leaf in jtu.tree_flatten_with_path(tree)[0]:
+        key = jtu.keystr(kp)
+        got = np.asarray(model.leaf(key))
+        assert np.array_equal(got, np.asarray(leaf)), \
+            f"streamed leaf {key} diverged from the checkpointed tree"
+
+
+def _leg_speedup_identity_order(dirpath: str) -> None:
+    import statistics
+
+    import jax
+
+    from ..config import config
+    from ..serving.weights import stream_weights
+    from ..trace import recorder
+    from . import FakeNvmeSource, FaultPlan
+
+    path, tree = _make_checkpoint(dirpath)
+    config.set("dma_max_size", _LAYER_BYTES)
+    config.set("trace_policy", "all")
+    recorder.configure()
+    recorder.clear()
+    dev = jax.local_devices()[0]
+    naive_t, stream_t = [], []
+    try:
+        for _ in range(ROUNDS):
+            src = FakeNvmeSource(path, fault_plan=FaultPlan(latency_s=_LAT_S),
+                                 force_cached_fraction=0.0)
+            t0 = time.perf_counter()
+            handles = _naive_coldstart(path, src, dev)
+            naive_t.append(time.perf_counter() - t0)
+            _release(handles)
+            src.close()
+
+            src = FakeNvmeSource(path, fault_plan=FaultPlan(latency_s=_LAT_S),
+                                 force_cached_fraction=0.0)
+            t0 = time.perf_counter()
+            model = stream_weights(path, source=src, depth=_DEPTH)
+            stream_t.append(time.perf_counter() - t0)
+            _check_tree(model, tree)
+            model.close()
+            src.close()
+    finally:
+        config.set("trace_policy", "off")
+        recorder.configure()
+
+    # layer-ordered landing, read back from the flight recorder
+    spans = [e for e in recorder.snapshot_events()
+             if e[2] == "weight_stream"]
+    assert spans, "no weight_stream spans recorded under trace_policy=all"
+    order = [e[8]["layer"] for e in sorted(spans, key=lambda e: e[0])]
+    assert len(order) == ROUNDS * _N_LAYERS, \
+        f"expected {ROUNDS * _N_LAYERS} weight_stream spans, got {len(order)}"
+    for r in range(ROUNDS):
+        window = order[r * _N_LAYERS:(r + 1) * _N_LAYERS]
+        assert window == sorted(window), \
+            f"layers adopted out of order in round {r}: {window}"
+
+    n, s = statistics.median(naive_t), statistics.median(stream_t)
+    ratio = n / s if s > 0 else float("inf")
+    assert ratio >= RATIO_LIMIT, \
+        f"streamed cold-start only {ratio:.2f}x naive (limit " \
+        f"{RATIO_LIMIT}x; naive {n * 1e3:.0f}ms streamed {s * 1e3:.0f}ms)"
+    print(f"coldstart-gate speedup leg ok: streamed {ratio:.1f}x naive "
+          f"(naive {n * 1e3:.0f}ms, streamed {s * 1e3:.0f}ms, "
+          f"{ROUNDS} rounds), layer order asserted from "
+          f"{len(spans)} weight_stream spans")
+
+
+def _leg_crc_refusal(dirpath: str) -> None:
+    from ..api import StromError
+    from ..serving.weights import stream_weights
+
+    path, _tree = _make_checkpoint(dirpath)
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) - _LAYER_BYTES // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    try:
+        model = stream_weights(path)
+    except StromError as e:
+        assert e.errno == _errno.EBADMSG, \
+            f"corruption raised errno {e.errno}, want EBADMSG"
+    else:
+        model.close()
+        raise AssertionError("corrupted checkpoint streamed without "
+                             "a crc refusal")
+    print("coldstart-gate crc leg ok: flipped byte refused with EBADMSG")
+
+
+def main() -> int:
+    from ..config import config
+    from ..trace import recorder
+
+    snap = config.snapshot()
+    try:
+        with tempfile.TemporaryDirectory(prefix="strom_coldstart_gate_") as d:
+            _leg_speedup_identity_order(d)
+            _leg_crc_refusal(d)
+    except AssertionError as e:
+        print(f"coldstart-gate FAIL: {e}")
+        return 1
+    finally:
+        config.restore(snap)
+        recorder.configure()
+        recorder.clear()
+    print("coldstart-gate ok: pipelined cold-start beats serial, leaves "
+          "byte-identical, layers land in order, corruption refused")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -376,6 +376,25 @@ def main(argv=None) -> int:
                       f"{c.get('nr_pushdown_decode_host', 0)}  "
                       f"wire-saved "
                       f"{c.get('bytes_wire_saved', 0) / 1048576:.1f}MB")
+            # serving scoreboard (ISSUE 15): device-tier traffic (hits/
+            # promotions/demotions against the resident-bytes gauge), KV
+            # paging churn, and the last cold-start's streaming rate —
+            # pageins far above pageouts means resumes are re-reading a
+            # stable spilled set; the reverse means the HBM+RAM share is
+            # too small for the live working set
+            if (c.get("nr_hbm_hit") or c.get("nr_hbm_promote")
+                    or c.get("nr_kv_pagein") or c.get("nr_kv_pageout")
+                    or c.get("coldstart_bytes_per_sec")):
+                print(f"serving: hbm-hit {c.get('nr_hbm_hit', 0)}  "
+                      f"promote {c.get('nr_hbm_promote', 0)}  "
+                      f"demote {c.get('nr_hbm_demote', 0)}  "
+                      f"resident "
+                      f"{c.get('hbm_resident_bytes', 0) / 1048576:.1f}MB  "
+                      f"kv-pagein {c.get('nr_kv_pagein', 0)}  "
+                      f"kv-pageout {c.get('nr_kv_pageout', 0)}  "
+                      f"coldstart "
+                      f"{c.get('coldstart_bytes_per_sec', 0) / 1048576:.0f}"
+                      f"MB/s")
             # write-ladder scoreboard (ISSUE 11): mirror fan-out volume,
             # transient write retries, resync replay progress and
             # read-back verification failures — pending bytes above zero

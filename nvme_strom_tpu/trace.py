@@ -104,6 +104,10 @@ EVENT_SCHEMA: Dict[str, str] = {
     # decode->filter->project window over the compressed representation
     # (wire/logical byte counts ride in args)
     "pushdown_decode": "span",
+    # LLM serving (ISSUE 15): cold-start weight streaming and KV-cache
+    # paging over the HBM residency tier
+    "weight_stream": "span",   # one layer span: submit -> crc -> adopt
+    "kv_page": "span",         # one KV block crossing a tier boundary
 }
 
 
@@ -517,7 +521,8 @@ def _prom_name(counter: str) -> str:
 _PROM_GAUGES = ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
                 "occ_integral_ns", "occ_busy_ns", "cache_resident_bytes",
                 "resync_pending_bytes", "daemon_sessions",
-                "qos_queue_depth")
+                "qos_queue_depth", "hbm_resident_bytes",
+                "coldstart_bytes_per_sec")
 
 
 def render_prometheus(payload: dict) -> str:

@@ -57,3 +57,7 @@ def _reset_config():
     recorder.clear()
     residency_cache.clear()
     residency_cache.configure()
+    # the device tier caches hbm_cache_bytes the same way (and holds
+    # device arrays across tests otherwise); restore turns it back off
+    from nvme_strom_tpu.serving.hbm_tier import hbm_tier
+    hbm_tier.configure()

@@ -409,3 +409,106 @@ def test_daemon_buffer_roundtrip():
     assert bytes(buf.view()[:4]) == b"abcd"
     buf.close()
     buf.close()                                       # idempotent
+
+
+# -- serving leases / restart survival (ISSUE 15) ----------------------------
+
+def test_attach_mints_lease_and_submit_id_dedups(daemon, data_file):
+    """Every attach carries a lease token; resubmitting the same
+    submit_id returns the SAME task instead of re-enqueuing (idempotent
+    retry after a dropped reply)."""
+    with DaemonSession(daemon.socket_path, tenant="t-lease") as sess:
+        assert sess.lease
+        src = sess.open_source(data_file)
+        handle, buf = sess.alloc_dma_buffer(4 * CHUNK)
+        r1 = sess.memcpy_ssd2ram(src, handle, [0, 1, 2, 3], CHUNK,
+                                 submit_id="job-a")
+        r2 = sess.memcpy_ssd2ram(src, handle, [0, 1, 2, 3], CHUNK,
+                                 submit_id="job-a")
+        assert r2.dma_task_id == r1.dma_task_id
+        sess.memcpy_wait(r1.dma_task_id, timeout=60)
+        assert bytes(buf.view()[:4 * CHUNK]) == expected_bytes(0, 4 * CHUNK)
+        # wait acked the submit: the SAME id now names a fresh task
+        r3 = sess.memcpy_ssd2ram(src, handle, [0, 1, 2, 3], CHUNK,
+                                 submit_id="job-a")
+        assert r3.dma_task_id != r1.dma_task_id
+        sess.memcpy_wait(r3.dma_task_id, timeout=60)
+
+
+def test_lease_reattach_same_daemon(daemon, data_file):
+    """A dropped connection re-attaches under its lease token: the
+    daemon recognizes it (reattach=True) and handles keep working."""
+    with DaemonSession(daemon.socket_path, tenant="t-re") as sess:
+        src = sess.open_source(data_file)
+        handle, buf = sess.alloc_dma_buffer(4 * CHUNK)
+        token = sess.lease
+        # simulate a dropped TCP-level connection without detach
+        sess._sock.close()
+        assert sess.reattach() is True
+        assert sess.lease == token
+        r = sess.memcpy_ssd2ram(src, handle, [0, 1, 2, 3], CHUNK)
+        sess.memcpy_wait(r.dma_task_id, timeout=60)
+        assert bytes(buf.view()[:4 * CHUNK]) == expected_bytes(0, 4 * CHUNK)
+
+
+def test_daemon_restart_reattach_and_idempotent_replay(tmp_path, data_file):
+    """The daemon dies and is restarted on the same socket.  reattach()
+    returns False (lease adopted fresh), remapped buffers keep their
+    caller handles, and replaying the unacked submit_id re-runs it
+    byte-identically."""
+    sock = str(tmp_path / "stromd.sock")
+    d1 = StromDaemon(sock, allow_fake=True).start()
+    sess = DaemonSession(sock, tenant="t-restart")
+    try:
+        src = sess.open_source(data_file)
+        handle, buf = sess.alloc_dma_buffer(4 * CHUNK)
+        r = sess.memcpy_ssd2ram(src, handle, [0, 1, 2, 3], CHUNK,
+                                submit_id="job-1")
+        sess.memcpy_wait(r.dma_task_id, timeout=60)
+        buf.view()[:4 * CHUNK] = b"\0" * (4 * CHUNK)   # scrub the landing
+        d1.close()
+        d2 = StromDaemon(sock, allow_fake=True).start()
+        try:
+            assert sess.reattach() is False    # fresh daemon adopted it
+            # unacked-from-the-caller's-view work replays idempotently
+            r2 = sess.memcpy_ssd2ram(src, handle, [0, 1, 2, 3], CHUNK,
+                                     submit_id="job-1")
+            sess.memcpy_wait(r2.dma_task_id, timeout=60)
+            assert bytes(buf.view()[:4 * CHUNK]) == \
+                expected_bytes(0, 4 * CHUNK)
+        finally:
+            sess.close()
+            d2.close()
+    finally:
+        d1.close()
+
+
+def test_kv_pool_over_daemon_qos(daemon, tmp_path):
+    """The shared KV pool speaks the same admission/QoS path as DMA:
+    append/read/write/resume/release round-trip byte-identically through
+    stromd with a paired-mirror fake spill."""
+    bb = 16 << 10
+    paths = []
+    for i in range(4):
+        p = str(tmp_path / f"spill{i}.bin")
+        with open(p, "wb") as f:
+            f.truncate(16 * bb)
+        paths.append(p)
+    with DaemonSession(daemon.socket_path, tenant="t-kv",
+                       qos_class="latency") as sess:
+        geo = sess.kv_open({"paths": paths, "stripe_chunk_size": bb,
+                            "mirror": "paired"}, block_bytes=bb,
+                           ram_blocks=4)
+        assert geo["block_bytes"] == bb
+        blobs = [bytes([i + 1]) * bb for i in range(8)]
+        for i, b in enumerate(blobs):
+            assert sess.kv_append("s0", b) == i
+        res = sess.kv_residency()
+        assert sum(res.values()) == 8 and res["ssd"] > 0
+        for i, b in enumerate(blobs):
+            assert sess.kv_read("s0", i) == b
+        sess.kv_write("s0", 3, b"\xAB" * bb)
+        assert sess.kv_read("s0", 3) == b"\xAB" * bb
+        assert sess.kv_resume("s0") >= 0
+        sess.kv_release("s0")
+        assert sum(sess.kv_residency().values()) == 0

@@ -1,0 +1,385 @@
+"""LLM serving tests (ISSUE 15, `serving` marker).
+
+Covers the serving subsystem's three legs end-to-end on the CPU engine:
+the HBM residency tier (admit/lookup/lease pinning, LRU eviction with
+host-tier demotion, invalidation staleness), cold-start weight streaming
+(byte identity, layer-ordered landing proved from flight-recorder spans,
+crc refusal), KV-cache paging (working set 4x the HBM share, identity
+through HBM→RAM→SSD, mirror-healed page-ins under a seeded member
+fail-stop, prefetch-on-resume), the planner's ``hbm-resident`` EXPLAIN
+surface, and the loader's cross-epoch prefetch overlap.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import time
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.api import StromError
+from nvme_strom_tpu.config import config
+from nvme_strom_tpu.data import save_checkpoint
+from nvme_strom_tpu.serving import KvBlockPool, stream_weights
+from nvme_strom_tpu.serving.hbm_tier import hbm_tier
+from nvme_strom_tpu.stats import stats
+from nvme_strom_tpu.testing import (FakeNvmeSource, FakeStripedNvmeSource,
+                                    FaultPlan)
+from nvme_strom_tpu.trace import recorder
+
+pytestmark = pytest.mark.serving
+
+EXT = 64 << 10          # tier-test extent size
+BB = 16 << 10           # KV block size
+
+
+def _counters():
+    return dict(stats.snapshot(reset_max=False).counters)
+
+
+# -- HBM residency tier ------------------------------------------------------
+
+def _tier_on(nbytes):
+    config.set("hbm_cache_bytes", nbytes)
+    hbm_tier.configure()
+
+
+def test_tier_admit_lookup_identity_and_lru():
+    _tier_on(4 * EXT)
+    skey = ("#t1",)
+    blobs = {i: bytes([i + 1]) * EXT for i in range(6)}
+    for i in range(4):
+        assert hbm_tier.admit(skey, i * EXT, EXT, blobs[i])
+    assert hbm_tier.resident_bytes() == 4 * EXT
+    # identity through the lease, and the lookup bumps recency
+    lease = hbm_tier.lookup(skey, 0, EXT)
+    out = bytearray(EXT)
+    assert lease.copy_into(out) and bytes(out) == blobs[0]
+    lease.release()
+    # two more admits overflow the cap: the LRU (extent 1, since 0 was
+    # just touched) is evicted, the refreshed 0 survives
+    assert hbm_tier.admit(skey, 4 * EXT, EXT, blobs[4])
+    assert hbm_tier.resident_bytes() <= 4 * EXT
+    assert hbm_tier.lookup(skey, 1 * EXT, EXT) is None
+    keep = hbm_tier.lookup(skey, 0, EXT)
+    assert keep is not None
+    keep.release()
+
+
+def test_tier_pinned_lease_is_not_evictable_and_goes_stale_on_clear():
+    _tier_on(2 * EXT)
+    skey = ("#t2",)
+    assert hbm_tier.admit(skey, 0, EXT, b"\x11" * EXT)
+    pin = hbm_tier.lookup(skey, 0, EXT)
+    # fill past the cap: the pinned extent must be skipped by eviction
+    assert hbm_tier.admit(skey, EXT, EXT, b"\x22" * EXT)
+    assert hbm_tier.admit(skey, 2 * EXT, EXT, b"\x33" * EXT)
+    out = bytearray(EXT)
+    assert pin.copy_into(out) and bytes(out) == b"\x11" * EXT
+    # clear() with the pin held marks it stale instead of freeing it
+    hbm_tier.clear()
+    assert pin.stale
+    assert pin.copy_into(out) is False
+    assert pin.device_array() is None
+    pin.release()
+
+
+def test_tier_eviction_demotes_into_host_tier():
+    config.set("cache_bytes", 32 << 20)
+    from nvme_strom_tpu.cache import residency_cache
+    residency_cache.configure()
+    _tier_on(2 * EXT)
+    skey = ("#t3",)
+    before = _counters()
+    for i in range(3):
+        assert hbm_tier.admit(skey, i * EXT, EXT, bytes([i + 5]) * EXT)
+    after = _counters()
+    assert after.get("nr_hbm_demote", 0) > before.get("nr_hbm_demote", 0)
+    # the victim's bytes moved down a tier, they did not vanish
+    lease = residency_cache.lookup(skey, 0, EXT)
+    assert lease is not None
+    dst = bytearray(EXT)
+    lease.copy_into(dst)
+    assert bytes(dst) == bytes([5]) * EXT
+    lease.release()
+
+
+def test_tier_resident_fraction_matches_admitted_share(tmp_path):
+    path = str(tmp_path / "w.bin")
+    with open(path, "wb") as f:
+        f.write(b"x" * (4 * EXT))
+    _tier_on(4 * EXT)
+    skey = (os.path.realpath(path),)
+    hbm_tier.admit(skey, 0, EXT, b"a" * EXT)
+    hbm_tier.admit(skey, EXT, EXT, b"b" * EXT)
+    frac = hbm_tier.resident_fraction([path], 4 * EXT)
+    assert abs(frac - 0.5) < 1e-6
+    assert hbm_tier.resident_fraction(["/no/such"], 4 * EXT) == 0.0
+
+
+# -- weight streaming --------------------------------------------------------
+
+def _ckpt(tmp_path, n_layers=4, n_el=2048):
+    rng = np.random.default_rng(3)
+    tree = {"layers": [{"w": rng.standard_normal(n_el).astype(np.float32),
+                        "b": rng.standard_normal(n_el // 16)
+                        .astype(np.float32)}
+                       for _ in range(n_layers)]}
+    path = str(tmp_path / "model.ckpt")
+    save_checkpoint(path, tree)
+    return path, tree
+
+
+def test_stream_weights_byte_identity_and_layer_order(tmp_path):
+    import jax.tree_util as jtu
+
+    path, tree = _ckpt(tmp_path)
+    config.set("trace_policy", "all")
+    recorder.configure()
+    recorder.clear()
+    model = stream_weights(path)
+    try:
+        for kp, leaf in jtu.tree_flatten_with_path(tree)[0]:
+            got = np.asarray(model.leaf(jtu.keystr(kp)))
+            np.testing.assert_array_equal(got, leaf)
+        spans = [e for e in recorder.snapshot_events()
+                 if e[2] == "weight_stream"]
+        order = [e[8]["layer"] for e in sorted(spans, key=lambda e: e[0])]
+        assert order == sorted(order) and len(order) == 4
+        # a cold start publishes its streaming rate for tpu_stat
+        assert _counters().get("coldstart_bytes_per_sec", 0) > 0
+    finally:
+        model.close()
+
+
+def test_stream_weights_depth_pipelines_but_adopts_in_order(tmp_path):
+    path, tree = _ckpt(tmp_path, n_layers=8)
+    config.set("trace_policy", "all")
+    recorder.configure()
+    recorder.clear()
+    model = stream_weights(path, depth=4)
+    try:
+        spans = [e for e in recorder.snapshot_events()
+                 if e[2] == "weight_stream"]
+        order = [e[8]["layer"] for e in sorted(spans, key=lambda e: e[0])]
+        assert order == list(range(8))
+    finally:
+        model.close()
+
+
+def test_stream_weights_crc_refusal(tmp_path):
+    path, _tree = _ckpt(tmp_path)
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) - 4097)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x5A]))
+    with pytest.raises(StromError) as e:
+        stream_weights(path)
+    assert e.value.errno == errno.EBADMSG
+    assert "crc32c" in str(e.value)
+
+
+def test_stream_weights_verify_off_streams_corrupt_bytes(tmp_path):
+    """verify=False is the explicit escape hatch: no manifest check, the
+    flipped byte lands (callers own integrity then)."""
+    path, _tree = _ckpt(tmp_path)
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) - 4097)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x5A]))
+    model = stream_weights(path, verify=False)
+    model.close()
+
+
+# -- KV-cache paging ---------------------------------------------------------
+
+def _spill_paths(tmp_path, nbytes, n=4, tag="sp"):
+    paths = []
+    for i in range(n):
+        p = str(tmp_path / f"{tag}{i}.bin")
+        with open(p, "wb") as f:
+            f.truncate(nbytes)
+        paths.append(p)
+    return paths
+
+
+def _pattern(s, i):
+    return bytes([(s * 13 + i * 7 + 1) % 256]) * BB
+
+
+def test_kv_pool_pages_through_all_three_tiers(tmp_path):
+    """Working set 4x the HBM share: fill spills to SSD, reads page in,
+    promote, and stay byte-identical; a write through an HBM-resident
+    block demotes and reads back fresh."""
+    from nvme_strom_tpu.engine import Session
+
+    ws_blocks = 32
+    _tier_on(ws_blocks * BB // 4)
+    paths = _spill_paths(tmp_path, ws_blocks * BB)
+    before = _counters()
+    with Session() as sess, \
+            FakeStripedNvmeSource(paths, BB, mirror="paired", writable=True,
+                                  force_cached_fraction=0.0) as spill:
+        pool = KvBlockPool(sess, spill, block_bytes=BB, ram_blocks=4,
+                           hbm_blocks=ws_blocks // 4)
+        for s in range(4):
+            for i in range(8):
+                assert pool.append(f"seq{s}", _pattern(s, i)) == i
+        res = pool.residency()
+        assert res["ssd"] > 0 and sum(res.values()) == ws_blocks
+        for s in range(4):
+            for i in range(8):
+                assert pool.read(f"seq{s}", i) == _pattern(s, i)
+        res = pool.residency()
+        assert res["hbm"] == ws_blocks // 4   # promoted up to the share
+        # in-place update of a promoted block: demote, overwrite, read
+        hot = next((s, i) for s in range(4) for i in range(8)
+                   if pool._tables[f"seq{s}"][i].tier == "hbm")
+        pool.write(f"seq{hot[0]}", hot[1], b"\xEE" * BB)
+        assert pool.read(f"seq{hot[0]}", hot[1]) == b"\xEE" * BB
+        after = _counters()
+        assert after.get("nr_kv_pagein", 0) > before.get("nr_kv_pagein", 0)
+        assert after.get("nr_kv_pageout", 0) > before.get("nr_kv_pageout", 0)
+        pool.close()
+        with pytest.raises(StromError) as e:
+            pool.read("seq0", 0)
+        assert e.value.errno == errno.EBADF
+
+
+def test_kv_pool_chaos_failstop_member_heals_via_mirror(tmp_path):
+    """A spill member fail-stops mid-serving; page-ins are served from
+    its mirror twin byte-identically (the acceptance chaos pass)."""
+    from nvme_strom_tpu.engine import Session
+
+    ws_blocks = 32
+    _tier_on(ws_blocks * BB // 4)
+    paths = _spill_paths(tmp_path, ws_blocks * BB)
+    with Session() as sess, \
+            FakeStripedNvmeSource(paths, BB, mirror="paired", writable=True,
+                                  force_cached_fraction=0.0) as spill:
+        pool = KvBlockPool(sess, spill, block_bytes=BB, ram_blocks=4,
+                           hbm_blocks=ws_blocks // 4)
+        for s in range(4):
+            for i in range(8):
+                pool.append(f"seq{s}", _pattern(s, i))
+        before = _counters()
+        spill.fault_plan = FaultPlan(failstop_member=0, failstop_after=0)
+        try:
+            for s in range(4):
+                for i in range(8):
+                    assert pool.read(f"seq{s}", i) == _pattern(s, i)
+        finally:
+            spill.fault_plan = FaultPlan()
+        after = _counters()
+        assert after.get("nr_kv_pagein", 0) > before.get("nr_kv_pagein", 0)
+        pool.close()
+
+
+def test_kv_pool_resume_prefetches_async(tmp_path):
+    from nvme_strom_tpu.engine import Session
+
+    ws_blocks = 16
+    _tier_on(0)     # no HBM: resume purely exercises SSD→RAM batching
+    paths = _spill_paths(tmp_path, ws_blocks * BB, n=2)
+    config.set("trace_policy", "all")
+    recorder.configure()
+    recorder.clear()
+    with Session() as sess, \
+            FakeStripedNvmeSource(paths, BB, writable=True,
+                                  force_cached_fraction=0.0) as spill:
+        pool = KvBlockPool(sess, spill, block_bytes=BB, ram_blocks=4,
+                           hbm_blocks=0)
+        for s in range(2):
+            for i in range(8):
+                pool.append(f"seq{s}", _pattern(s, i))
+        # seq0 is fully spilled by seq1's fill; resuming pages it back
+        assert all(b.tier == "ssd" for b in pool._tables["seq0"])
+        n = pool.resume("seq0")
+        assert n > 0
+        spans = [e for e in recorder.snapshot_events()
+                 if e[2] == "kv_page" and (e[8] or {}).get("resume")]
+        assert len(spans) == n
+        for i in range(8):
+            assert pool.read("seq0", i) == _pattern(0, i)
+        pool.release("seq0")
+        assert "seq0" not in pool.sequences()
+        pool.close()
+
+
+def test_kv_pool_spill_exhaustion_is_enospc(tmp_path):
+    from nvme_strom_tpu.engine import Session
+
+    _tier_on(0)
+    paths = _spill_paths(tmp_path, 4 * BB, n=2)   # 8 SSD slots
+    with Session() as sess, \
+            FakeStripedNvmeSource(paths, BB, writable=True,
+                                  force_cached_fraction=0.0) as spill:
+        pool = KvBlockPool(sess, spill, block_bytes=BB, ram_blocks=2,
+                           hbm_blocks=0)
+        with pytest.raises(StromError) as e:
+            for i in range(16):
+                pool.append("big", _pattern(0, i))
+        assert e.value.errno == errno.ENOSPC
+        pool.close()
+
+
+# -- planner surface ---------------------------------------------------------
+
+def test_explain_reports_hbm_resident_share(tmp_path):
+    from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file
+    from nvme_strom_tpu.scan.query import Query
+
+    rng = np.random.default_rng(5)
+    schema = HeapSchema(n_cols=2)
+    n = schema.tuples_per_page * 24
+    path = str(tmp_path / "t.heap")
+    build_heap_file(path, [rng.integers(0, 99, n).astype(np.int32),
+                           rng.integers(0, 16, n).astype(np.int32)], schema)
+    size = os.path.getsize(path)
+    _tier_on(size)
+    skey = (os.path.realpath(path),)
+    half = (size // 2 // 4096) * 4096
+    assert hbm_tier.admit(skey, 0, half, b"\0" * half)
+    plan = Query(path, schema).where(lambda c: c[0] > 10).explain()
+    assert plan.hbm_hit_ratio == pytest.approx(half / size, abs=0.01)
+    s = str(plan)
+    assert "hbm-resident: ~50%" in s
+    assert "hbm tier holds" in plan.reason
+
+
+# -- loader cross-epoch overlap ----------------------------------------------
+
+def test_epochs_keeps_prefetch_in_flight_across_epoch_boundary(tmp_path):
+    """epochs() must submit epoch e+1's first batch while epoch e's tail
+    is still in flight — proved by pairing the engine's per-task submit
+    instants with their wait spans in the flight recorder."""
+    from nvme_strom_tpu.data import DeviceLoader, write_records
+
+    rng = np.random.default_rng(7)
+    a = rng.integers(-1000, 1000, (64, 128)).astype(np.int32)
+    ds = write_records(str(tmp_path / "d.rec"), a)
+    config.set("trace_policy", "all")
+    recorder.configure()
+    recorder.clear()
+    with DeviceLoader(ds, batch_records=16, chunk_size=4096,
+                      prefetch=2) as dl:
+        assert dl.batches_per_epoch == 4
+        batches = [np.asarray(b) for b in dl.epochs(2)]
+    assert len(batches) == 8
+    np.testing.assert_array_equal(np.concatenate(batches[:4]), a)
+    evs = recorder.snapshot_events()
+    submits = {e[3]: e[0] for e in evs if e[2] == "submit"}
+    waits = {e[3]: (e[0], e[0] + e[1]) for e in evs if e[2] == "wait"}
+    # order tasks by submit time = global batch order (one task/batch)
+    tids = sorted(submits, key=submits.get)
+    assert len(tids) == 8
+    # epoch 2's first batch (global index 4) was submitted before epoch
+    # 1's last batch (global index 3) was even waited on
+    assert submits[tids[4]] < waits[tids[3]][0]
+    # ...and in general the ring keeps one batch in flight at every yield
+    for g in range(1, 8):
+        assert submits[tids[g]] < waits[tids[g]][1]
