@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate kvpage-smoke probe-loop lint-strom sanitize sanitize-smoke clean
+.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -148,6 +148,17 @@ qos-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.qos_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_daemon.py -q -m daemon
 
+# Resident-integrity gate (ISSUE 16): seeded bit-rot in all three
+# residency tiers (host ARC slab, HBM extent, KV spill block) must be
+# detected by the background scrubber and healed byte-identically from
+# SSD / the mirror leg — with the rotten member health-debited — and a
+# mid-run memlock-budget shrink must shed + degrade to pass-through
+# with zero reader-visible ENOMEM.  The `integrity` pytest marker
+# rides along.
+scrub-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.scrub_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py -q -m integrity
+
 # stromlint (ISSUE 10): the project-invariant static checker — lock
 # discipline, buffer lifetimes, native-ABI drift against csrc/strom_tpu.h,
 # stats/trace surface completeness, config hygiene.  Zero unsuppressed
@@ -180,7 +191,7 @@ sanitize-smoke:
 # then tier-1 tests plus the perf smokes, the seeded member-survival
 # schedules, the trace-overhead, landing and cache gates, and the
 # short sanitizer pass.
-check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate kvpage-smoke
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

@@ -333,6 +333,20 @@ STAT_FIELDS: Tuple[str, ...] = (
     #                           write ladder)
     "hbm_resident_bytes",     # gauge: bytes currently HBM-resident
     "coldstart_bytes_per_sec",  # gauge: last weight-stream landing rate
+    # resident-data integrity domain (ISSUE 16)
+    "nr_integrity_verify",    # resident checksums verified (transitions,
+    #                           lease reads under integrity=always, scrub)
+    "nr_integrity_fail",      # resident checksum mismatches detected
+    "nr_scrub_extent",        # extents walked by the background scrubber
+    "bytes_scrubbed",         # bytes the scrubber has verified
+    "nr_scrub_repair",        # corrupt residents healed (SSD re-fill or
+    #                           mirror-leg read-back)
+    "nr_scrub_fail",          # corrupt residents that could NOT be healed
+    "nr_cache_mlock_fail",    # mlock(2) failures: slab runs unpinned
+    "cache_unpinned_bytes",   # gauge: resident slab bytes not mlock-pinned
+    "nr_pressure_shed",       # residents shed under memlock/HBM pressure
+    "nr_pressure_passthrough",  # fills refused under pressure (reads pass
+    #                           through to SSD instead of ENOMEM)
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",
@@ -362,7 +376,8 @@ class StatInfo:
         for g in ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
                   "cache_resident_bytes", "resync_pending_bytes",
                   "daemon_sessions", "qos_queue_depth",
-                  "hbm_resident_bytes", "coldstart_bytes_per_sec"):
+                  "hbm_resident_bytes", "coldstart_bytes_per_sec",
+                  "cache_unpinned_bytes"):
             if g in new.counters:
                 d[g] = new.counters[g]
         return StatInfo(version=new.version, has_debug=new.has_debug,

@@ -46,6 +46,7 @@ from typing import Optional, Sequence, Tuple
 from .config import config
 from .stats import stats
 from .trace import recorder as _trace
+from .integrity import domain as _integrity
 
 __all__ = ["ResidencyCache", "CacheLease", "residency_cache"]
 
@@ -58,10 +59,10 @@ except OSError:  # pragma: no cover
 
 class _Entry:
     __slots__ = ("key", "mm", "view", "length", "logical_length", "refs",
-                 "stale")
+                 "stale", "crc", "source_ref", "pinned")
 
     def __init__(self, key, mm, length: int,
-                 logical_length: int = 0) -> None:
+                 logical_length: int = 0, crc=None, source_ref=None) -> None:
         self.key = key
         self.mm = mm
         self.view = memoryview(mm)
@@ -72,6 +73,12 @@ class _Entry:
         self.logical_length = logical_length or length
         self.refs = 0
         self.stale = False
+        # integrity domain (ISSUE 16): fill-time crc32c (None under
+        # integrity=off), a weakref to the source for scrub healing, and
+        # whether mlock(2) actually pinned this slab
+        self.crc = crc
+        self.source_ref = source_ref
+        self.pinned = False
 
     def free(self) -> None:
         try:
@@ -115,6 +122,13 @@ class CacheLease:
         e = self._entry
         if e.stale:
             return False
+        if _integrity.verify_reads and \
+                not _integrity.verify(e.view[:e.length], e.crc):
+            # integrity=always: a rotted slab is dropped under its lease
+            # rules (stale while we pin it) and the caller falls back to
+            # SSD — fail-open, never EBADMSG from a cached copy
+            self._cache._drop_corrupt(e)
+            return False
         n = len(dest)
         dest[:] = e.view[:n]
         return not e.stale
@@ -142,6 +156,12 @@ class ResidencyCache:
         self._cap = 0
         self._p = 0  # adaptive target for t1 (recency), in bytes
         self._bytes = 0
+        # memlock accounting (ISSUE 16): bytes mlock(2) actually pinned
+        # vs slabs running unpinned (RLIMIT_MEMLOCK refusals), and the
+        # operator budget fills must stay under (0 = unlimited)
+        self._pinned_bytes = 0
+        self._unpinned_bytes = 0
+        self._mlock_budget = 0
         self._t1: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._t2: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._b1: "OrderedDict[tuple, int]" = OrderedDict()
@@ -152,10 +172,16 @@ class ResidencyCache:
     # -- configuration ------------------------------------------------
 
     def configure(self) -> None:
-        """Re-read ``cache_bytes``; 0 disables the tier and frees it."""
+        """Re-read ``cache_bytes`` (0 disables the tier and frees it) and
+        ``memlock_budget``; shrinking the budget below the bytes already
+        pinned sheds slabs — bulk-class KV chains first, via the pressure
+        registry — instead of ever surfacing ENOMEM to a reader."""
         cap = int(config.get("cache_bytes"))
+        budget = int(config.get("memlock_budget"))
+        excess = 0
         with self._lock:
             self._cap = cap
+            self._mlock_budget = budget
             self.active = cap > 0
             if not self.active:
                 self._clear_locked()
@@ -163,6 +189,19 @@ class ResidencyCache:
                 while self._bytes > cap and self._evict_one(False):
                     pass
                 self._p = min(self._p, cap)
+                if budget:
+                    excess = max(0, self._pinned_bytes - budget)
+        if excess:
+            # other tiers shed first (bulk KV chains ride the PR 12 QoS
+            # classes); the registry import is deferred — integrity
+            # imports this module back for scrubbing
+            from .integrity import request_shed
+            request_shed(excess, reason="memlock")
+            with self._lock:
+                while self._mlock_budget and \
+                        self._pinned_bytes > self._mlock_budget:
+                    if not self._shed_one():
+                        break
 
     def clear(self) -> None:
         with self._lock:
@@ -181,7 +220,10 @@ class ResidencyCache:
         self._b1_bytes = self._b2_bytes = 0
         self._bytes = 0
         self._p = 0
+        self._pinned_bytes = 0
+        self._unpinned_bytes = 0
         stats.gauge_set("cache_resident_bytes", 0)
+        stats.gauge_set("cache_unpinned_bytes", 0)
 
     # -- identity -----------------------------------------------------
 
@@ -235,8 +277,17 @@ class ResidencyCache:
             # up to the HBM tier outside our lock (the hook may device_put,
             # and its eviction demotes back through fill(), which relocks).
             # The lease's ref pins the slab, so the view is stable here.
+            data = bytes(e.view)
+            if _integrity.active and not _integrity.verify(data, e.crc):
+                # promote is a tier transition: a rotted slab must neither
+                # go up to HBM nor be served — drop it and report a miss
+                # so the engine re-reads through the fault ladder
+                self._drop_corrupt(e)
+                lease.release()
+                return None
             try:
-                self.promote_hook(skey, base, length, bytes(e.view))
+                self.promote_hook(skey, base, length, data,
+                                  crc=e.crc, source_ref=e.source_ref)
             except Exception:  # noqa: BLE001 - promotion is best-effort
                 pass
         return lease
@@ -251,15 +302,19 @@ class ResidencyCache:
     # -- fill side ----------------------------------------------------
 
     def fill(self, skey: tuple, base: int, length: int, data, *,
-             logical_length: int = 0) -> bool:
+             logical_length: int = 0, source_ref=None) -> bool:
         """Install healed bytes for an extent.  Returns True when the
         extent is now resident (skipped when the tier is off, the
-        extent exceeds capacity, or every candidate victim is pinned).
+        extent exceeds capacity, every candidate victim is pinned, or
+        the memlock budget is exhausted — the pass-through degradation).
         ``logical_length`` — logical bytes this extent serves when it
-        holds a compressed representation (defaults to *length*)."""
+        holds a compressed representation (defaults to *length*);
+        ``source_ref`` — weakref to the source, kept so the scrubber can
+        heal a rotted slab through the fault ladder."""
         if not self.active or length <= 0:
             return False
         key = (skey, base, length)
+        crc = _integrity.checksum(data)
         with self._lock:
             cap = self._cap
             if length > cap:
@@ -270,7 +325,16 @@ class ResidencyCache:
                 # the bytes unless a reader is mid-copy on the slab
                 if not e.refs:
                     e.view[:length] = data
+                    e.crc = crc
+                    if source_ref is not None:
+                        e.source_ref = source_ref
                 return True
+            if self._mlock_budget and \
+                    self._pinned_bytes + length > self._mlock_budget:
+                # memlock pressure: refuse the fill and let the read pass
+                # through to SSD — degraded, never ENOMEM (ISSUE 16)
+                stats.add("nr_pressure_passthrough")
+                return False
             # ghost hits steer the recency/frequency balance
             in_b1 = key in self._b1
             in_b2 = key in self._b2
@@ -287,8 +351,14 @@ class ResidencyCache:
                 mm = mmap.mmap(-1, length)
             except (OSError, ValueError):  # pragma: no cover
                 return False
-            self._mlock(mm, length)
-            e = _Entry(key, mm, length, logical_length)
+            e = _Entry(key, mm, length, logical_length, crc, source_ref)
+            e.pinned = self._try_pin(mm, length)
+            if e.pinned:
+                self._pinned_bytes += length
+            else:
+                self._unpinned_bytes += length
+                stats.gauge_set("cache_unpinned_bytes",
+                                self._unpinned_bytes)
             e.view[:length] = data
             if in_b1 or in_b2:
                 self._t2[key] = e
@@ -302,20 +372,31 @@ class ResidencyCache:
         return True
 
     @staticmethod
-    def _mlock(mm, length: int) -> None:
-        """Best-effort pin; harmless to fail under RLIMIT_MEMLOCK."""
+    def _try_pin(mm, length: int) -> bool:
+        """mlock(2) the slab, *checking the result*: a refusal (typically
+        RLIMIT_MEMLOCK) runs the slab unpinned — counted in
+        ``nr_cache_mlock_fail`` and gauged in ``cache_unpinned_bytes`` by
+        the caller, never raised (fail-open)."""
         if _libc is None:
-            return
+            return False
+        rc = -1
         try:
             buf = (ctypes.c_char * length).from_buffer(mm)
-            _libc.mlock(ctypes.addressof(buf), ctypes.c_size_t(length))
-        except Exception:  # pragma: no cover - best effort only
-            pass
+            # c_void_p: a bare int would marshal as a 32-bit C int and
+            # truncate the 64-bit slab address
+            rc = _libc.mlock(ctypes.c_void_p(ctypes.addressof(buf)),
+                             ctypes.c_size_t(length))
+        except Exception:  # pragma: no cover - ctypes failure == unpinned
+            rc = -1
         finally:
             try:
                 del buf
             except UnboundLocalError:
                 pass
+        if rc != 0:
+            stats.add("nr_cache_mlock_fail")
+            return False
+        return True
 
     def _evict_one(self, prefer_t2: bool) -> bool:
         """ARC REPLACE: evict one unpinned LRU entry, ghosting its key.
@@ -331,6 +412,7 @@ class ResidencyCache:
                 del od[key]
                 e.free()
                 self._bytes -= e.length
+                self._unaccount_pin(e)
                 ghost[key] = e.length
                 if ghost is self._b1:
                     self._b1_bytes += e.length
@@ -342,6 +424,37 @@ class ResidencyCache:
                 if _trace.active:
                     _trace.instant("cache_evict", offset=e.key[1],
                                    length=e.length)
+                return True
+        return False
+
+    def _unaccount_pin(self, e: _Entry) -> None:
+        """Entry left the tier: release its memlock accounting."""
+        if e.pinned:
+            self._pinned_bytes -= e.length
+        else:
+            self._unpinned_bytes -= e.length
+            stats.gauge_set("cache_unpinned_bytes",
+                            max(0, self._unpinned_bytes))
+
+    def _shed_one(self) -> bool:
+        """Memlock pressure: free one unreferenced pinned slab (LRU,
+        recency list first — pressure evictions do not train the ARC
+        ghosts).  Returns False when every pinned slab is leased."""
+        for od in (self._t1, self._t2):
+            for key, e in list(od.items()):
+                if e.refs or not e.pinned:
+                    continue
+                del od[key]
+                e.free()
+                self._bytes -= e.length
+                self._pinned_bytes -= e.length
+                stats.add("nr_pressure_shed")
+                stats.gauge_set("cache_resident_bytes", self._bytes)
+                if _trace.active:
+                    _trace.instant("pressure_shed", offset=key[1],
+                                   length=e.length,
+                                   args={"tier": "ram",
+                                         "reason": "memlock"})
                 return True
         return False
 
@@ -410,11 +523,21 @@ class ResidencyCache:
     def _drop_locked(self, od, key) -> None:
         e = od.pop(key)
         self._bytes -= e.length
+        self._unaccount_pin(e)
         if e.refs:
             e.stale = True  # pinned: freed at the last lease release
         else:
             e.free()
         stats.gauge_set("cache_resident_bytes", self._bytes)
+
+    def _drop_corrupt(self, e: _Entry) -> None:
+        """Integrity mismatch on a resident slab: drop it under its lease
+        rules (stale while any lease pins it, freed otherwise)."""
+        with self._lock:
+            for od in (self._t1, self._t2):
+                if od.get(e.key) is e:
+                    self._drop_locked(od, e.key)
+                    return
 
     def _note_invalidated(self, dropped: int, extents) -> None:
         if not dropped:
@@ -424,11 +547,64 @@ class ResidencyCache:
             off = extents[0][0] if extents else -1
             _trace.instant("cache_invalidate", offset=off, length=dropped)
 
+    # -- integrity scrub (ISSUE 16) -----------------------------------
+
+    def scrub_keys(self) -> list:
+        """Snapshot of verifiable resident keys for the scrubber walk."""
+        with self._lock:
+            return [k for od in (self._t1, self._t2)
+                    for k, e in od.items()
+                    if not e.stale and e.crc is not None]
+
+    def scrub_extent(self, key: tuple):
+        """Verify one resident slab against its fill-time crc.  Returns
+        ``(ok, length, source_ref)`` or None when the entry is gone or
+        unverifiable.  A mismatch drops the entry under its lease rules
+        (stale while pinned) so it is never served again."""
+        with self._lock:
+            e = self._t1.get(key) or self._t2.get(key)
+            if e is None or e.stale or e.crc is None:
+                return None
+            e.refs += 1  # pin the slab while hashing outside the lock
+        ok = _integrity.verify(e.view[:e.length], e.crc)
+        src = e.source_ref
+        with self._lock:
+            e.refs -= 1
+            if not ok and not e.stale:
+                for od in (self._t1, self._t2):
+                    if od.get(key) is e:
+                        self._drop_locked(od, key)
+                        break
+            elif e.stale and e.refs <= 0:
+                e.free()  # invalidated under the scrub pin
+        return ok, e.length, src
+
+    def _flip_resident_byte(self, skey: tuple, base: int, length: int,
+                            pos: int = 0) -> bool:
+        """Testing hook (FaultPlan resident-corruption tiers): flip one
+        byte of a resident slab in place, as host-RAM bit-rot would."""
+        key = (skey, base, length)
+        with self._lock:
+            e = self._t1.get(key) or self._t2.get(key)
+            if e is None or e.stale:
+                return False
+            i = pos % e.length
+            e.view[i] = e.view[i] ^ 0xFF
+            return True
+
     # -- introspection ------------------------------------------------
 
     def resident_bytes(self) -> int:
         with self._lock:
             return self._bytes
+
+    def unpinned_bytes(self) -> int:
+        with self._lock:
+            return self._unpinned_bytes
+
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return self._pinned_bytes
 
     def logical_resident_bytes(self) -> int:
         """Logical bytes the tier can serve — equals

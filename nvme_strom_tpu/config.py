@@ -129,6 +129,12 @@ def _check_trace_policy(val: str, _cfg: "Config") -> None:
         raise ConfigError(f"trace_policy must be off|sampled|all, got {val!r}")
 
 
+def _check_integrity(val: str, _cfg: "Config") -> None:
+    if val not in ("off", "transitions", "always"):
+        raise ConfigError(f"integrity must be off|transitions|always, "
+                          f"got {val!r}")
+
+
 def _check_qos_class(val: str, _cfg: "Config") -> None:
     if val not in ("latency", "normal", "bulk"):
         raise ConfigError(f"qos_default_class must be latency|normal|bulk, "
@@ -451,6 +457,41 @@ class Config:
                      "SSD spill I/O (power of two; it is the pool's "
                      "chunk grid on the spill source)",
                 validate=_check_pow2))
+        # resident-data integrity domain (ISSUE 16): checksummed tiers,
+        # background scrub, pressure-driven degradation
+        reg(Var("integrity", "off", "str",
+                help="resident-data checksumming across the residency "
+                     "hierarchy (host ARC slabs, HBM extents, KV blocks "
+                     "incl. SSD spill): 'off' stores no checksums — one "
+                     "branch per fill; 'transitions' stores crc32c at "
+                     "fill time and re-verifies on every tier transition "
+                     "(promote, demote, page-in, page-out); 'always' "
+                     "additionally verifies on every lease-served read.  "
+                     "A mismatch marks the entry stale under its lease "
+                     "rules and the reader falls back to SSD (fail-open, "
+                     "never EBADMSG from a cached copy).  Read at "
+                     "Session construction (integrity.domain.configure())",
+                validate=_check_integrity))
+        reg(Var("scrub_bytes_per_sec", 0, "size", minval=0,
+                help="background scrubber rate limit: a session thread "
+                     "walks resident extents of all tiers verifying "
+                     "stored crc32c at most this many bytes per second; "
+                     "mismatches are healed by re-reading through the "
+                     "fault ladder (host/HBM) or the mirror leg (KV "
+                     "spill) and debit the stripe member's health "
+                     "machine when attributable.  0 (default) disables "
+                     "the scrubber; requires integrity != off.  Re-read "
+                     "each scrub tick"))
+        reg(Var("memlock_budget", 0, "size", minval=0,
+                help="upper bound on bytes the residency cache may pin "
+                     "with mlock(2): fills beyond the budget are refused "
+                     "(pass-through to SSD, nr_pressure_passthrough) and "
+                     "shrinking it mid-run sheds pinned slabs "
+                     "(nr_pressure_shed) — readers never see ENOMEM.  "
+                     "0 (default) = unlimited (bounded only by "
+                     "RLIMIT_MEMLOCK, whose failures run the slab "
+                     "unpinned and count nr_cache_mlock_fail).  Read at "
+                     "residency_cache.configure()"))
         reg(Var("weight_stream_depth", 2, "int", minval=1, maxval=16,
                 help="layers of a streamed checkpoint in flight at "
                      "once during serving.weights cold-start: layer "

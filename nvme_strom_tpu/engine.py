@@ -53,6 +53,7 @@ from .stats import stats
 from .trace import recorder as _trace
 from .cache import residency_cache as _rcache
 from .serving.hbm_tier import hbm_tier as _hbm_tier
+from .integrity import domain as _integrity, Scrubber as _Scrubber
 from . import numa as _numa
 
 #: live sessions, for the stat exporter's pre-publish fold (weak: the
@@ -1135,6 +1136,9 @@ class Session:
         # tier — hbm_cache_bytes read here, one `_hbm_tier.active` branch
         # per task when off
         _hbm_tier.configure()
+        # resident-data integrity domain (ISSUE 16): `integrity` is read
+        # here; fill/verify sites cost one `_integrity.active` branch off
+        _integrity.configure()
         self._slots: List[Dict[int, DmaTask]] = [dict() for _ in range(_N_TASK_SLOTS)]
         self._slot_cv = [threading.Condition() for _ in range(_N_TASK_SLOTS)]
         self._id_lock = threading.Lock()
@@ -1169,6 +1173,11 @@ class Session:
                                         daemon=True,
                                         name="strom-canary")
         self._canary.start()
+        # background scrubber (ISSUE 16): walks resident extents of all
+        # tiers verifying stored crc32c, rate-limited by
+        # scrub_bytes_per_sec (re-read each tick, canary-style); idles on
+        # one Event wait per tick while disabled
+        self._scrubber = _Scrubber(self)
         # adaptive chunk sizing (PR 4, per-member since PR 5): one sizer
         # per stripe member so the effective request cap converges per
         # DEVICE — a slow member shrinks its own merges without throttling
@@ -1460,6 +1469,43 @@ class Session:
         else:
             self._member_health.record_canary(member, True)
 
+    def _scrub_refill(self, source: Optional[Source], base: int,
+                      length: int) -> Optional[bytes]:
+        """Scrub heal (ISSUE 16): re-read one resident extent's bytes
+        from SSD through the normal submit path — the full fault ladder
+        (retry/hedge/mirror/checksum re-read) heals them, and the
+        wait-time cache_fill hook reinstalls the extent under the same
+        key (the corrupt entry was already dropped, so the read is a
+        clean miss).  Returns the healed bytes, or None when the source
+        is gone or the extent no longer maps onto its chunk grid."""
+        if source is None or getattr(source, "closed", False):
+            return None
+        size = getattr(source, "size", 0)
+        # recover the chunk grid from (base, length): a full chunk is its
+        # own pow2 grid; a tail chunk's grid is the smallest pow2 that
+        # both covers it and divides base
+        cs = length
+        if cs & (cs - 1):
+            cs = 1 << (length - 1).bit_length()
+        while cs < size and base % cs:
+            cs <<= 1
+        if cs <= 0 or base % cs or min(cs, size - base) != length:
+            return None
+        handle = None
+        try:
+            handle, buf = self.alloc_dma_buffer(max(length, PAGE_SIZE))
+            res = self.memcpy_ssd2ram(source, handle, [base // cs], cs)
+            self.memcpy_wait(res.dma_task_id)
+            return bytes(buf.view()[:length])
+        except (StromError, OSError):
+            return None
+        finally:
+            if handle is not None:
+                try:
+                    self.unmap_buffer(handle)
+                except StromError:  # pragma: no cover - closing session
+                    pass
+
     def _journal_skipped(self, sink: Source, member: int, file_off: int,
                          length: int, trace_id: int = 0) -> None:
         """Record an extent a degraded member missed (the write landed
@@ -1656,13 +1702,14 @@ class Session:
             # ladder (retry/hedge/mirror/checksum re-read), so a
             # degraded member still populates the tier via its
             # surviving legs — and a latched failure never fills
-            skey, fills, fdest, lscale = task.cache_fill
+            skey, fills, fdest, lscale, src_ref = task.cache_fill
             task.cache_fill = None
             for base, length, doff in fills:
                 tf0 = time.monotonic_ns()
                 if _rcache.fill(skey, base, length,
                                 fdest[doff:doff + length],
-                                logical_length=int(length * lscale)) \
+                                logical_length=int(length * lscale),
+                                source_ref=src_ref) \
                         and _trace.active and task.trace_id:
                     _trace.span("cache_fill", tf0, time.monotonic_ns(),
                                 tid=task.trace_id, offset=base,
@@ -2024,7 +2071,8 @@ class Session:
                                   min(chunk_size, source.size - base),
                                   dest_offset + i * chunk_size))
                 task.cache_fill = (skey, fills, dest,
-                                   getattr(source, "logical_scale", 1.0))
+                                   getattr(source, "logical_scale", 1.0),
+                                   _weakref.ref(source))
         except BaseException:
             while cache_hits:  # leases not yet served: unpin them
                 cache_hits.pop()[3].release()
@@ -3193,6 +3241,7 @@ class Session:
         self._watchdog.join(timeout=2.0)
         self._canary_stop.set()
         self._canary.join(timeout=2.0)
+        self._scrubber.stop()
         self._pool.shutdown(wait=True)
         if self._canary_buf is not None:
             try:

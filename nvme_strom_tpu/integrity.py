@@ -1,0 +1,299 @@
+"""Resident-data integrity domain (ISSUE 16).
+
+The reference arbitrates coherency for bytes already resident outside the
+DMA path (page-cache pages vs in-flight P2P reads,
+kmod/nvme_strom.c:1639-1663); this module is the reproduction's analog for
+its *owned* residency hierarchy: once an extent lands in the pinned-RAM ARC
+cache, the HBM tier or a KV block, nothing used to re-check it — bit-rot
+and torn-demote corruption were served silently forever.
+
+Three pieces, all config-gated so the default build pays one branch:
+
+* :data:`domain` — process-global mode switch (``integrity`` Var:
+  ``off|transitions|always``) plus the checksum/verify primitives every
+  tier shares.  crc32c (the SSD read-verify polynomial, scan.heap) is
+  stored alongside each resident entry at fill time and re-verified on
+  tier transitions, and on every lease-served read under ``always``.
+  A mismatch marks the entry stale under its lease rules; readers fall
+  back to SSD (fail-open — a cached copy never surfaces EBADMSG).
+
+* :class:`Scrubber` — a per-session background thread (canary-thread
+  pattern) that walks resident extents of all three tiers verifying
+  stored checksums, rate-limited by ``scrub_bytes_per_sec``.  Corrupt
+  host/HBM extents are dropped and re-filled from SSD through the full
+  fault ladder; corrupt KV spill blocks are healed from their mirror
+  leg and the corrupt primary member is debited in the session's
+  MemberHealthMachine (repeated debits quarantine it, fault.py rules).
+
+* a pressure registry — KV block pools register here so that memlock /
+  HBM pressure in one tier can shed capacity in another, bulk QoS class
+  first (PR 12 classes), instead of surfacing ENOMEM to readers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Optional
+
+from .config import config
+from .stats import stats
+from . import trace as _trace_mod
+
+_trace = _trace_mod.recorder
+
+# crc32c lives in scan.heap (the page-checksum polynomial), but the scan
+# package pulls in the engine at import time — bind lazily to keep
+# engine → cache → integrity acyclic (engine does the same at its
+# write-verify site)
+_crc32c = None
+
+
+def crc32c(data) -> int:
+    global _crc32c
+    if _crc32c is None:
+        from .scan.heap import crc32c as f
+        _crc32c = f
+    return _crc32c(data)
+
+
+class IntegrityDomain:
+    """Process-global integrity mode + shared checksum/verify primitives.
+
+    ``active`` is False under ``integrity=off`` so every tier's hot path
+    costs one attribute test; ``verify_reads`` adds lease-read verification
+    under ``integrity=always``."""
+
+    def __init__(self) -> None:
+        self.mode = "off"
+        self.active = False
+        self.verify_reads = False
+
+    def configure(self) -> None:
+        """Re-read the ``integrity`` Var (Session construction)."""
+        mode = str(config.get("integrity"))
+        self.mode = mode
+        self.active = mode != "off"
+        self.verify_reads = mode == "always"
+
+    def checksum(self, data) -> Optional[int]:
+        """crc32c of a resident buffer, or None when the domain is off
+        (entries then carry no checksum and are never verified)."""
+        if not self.active:
+            return None
+        return crc32c(data)
+
+    def verify(self, data, crc: Optional[int]) -> bool:
+        """Verify a resident buffer against its stored fill-time crc.
+
+        Counts every check; a pre-checksum entry (crc None) passes — it
+        predates the domain being switched on."""
+        if crc is None:
+            return True
+        stats.add("nr_integrity_verify")
+        if crc32c(data) == crc:
+            return True
+        stats.add("nr_integrity_fail")
+        return False
+
+
+#: process-global domain (mirrors cache.residency_cache / trace.recorder)
+domain = IntegrityDomain()
+
+
+# -- pressure registry ------------------------------------------------------
+# KV block pools register themselves so (a) the scrubber can walk their
+# spill blocks and (b) memlock/HBM pressure elsewhere can ask them to shed
+# capacity.  WeakSet: a dropped pool unregisters itself.
+_pools: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_pool(pool) -> None:
+    _pools.add(pool)
+
+
+def pools() -> list:
+    return list(_pools)
+
+
+def request_shed(nbytes: int, reason: str = "memlock") -> int:
+    """Shed ~*nbytes* of resident capacity from registered KV pools,
+    bulk-class chains first (each pool orders internally).  Returns bytes
+    actually shed.  Never raises — pressure relief must not create new
+    errors on the reader path."""
+    shed = 0
+    for pool in pools():
+        if shed >= nbytes:
+            break
+        try:
+            shed += pool.shed(nbytes - shed, reason=reason)
+        except Exception:
+            continue
+    return shed
+
+
+# -- background scrubber ----------------------------------------------------
+
+def _rotate(keys: list, cursor) -> list:
+    """Round-robin: resume the walk after the last key scrubbed so a
+    rate-limited scrubber eventually covers every resident extent."""
+    if cursor is None or cursor not in keys:
+        return keys
+    i = keys.index(cursor) + 1
+    return keys[i:] + keys[:i]
+
+
+class Scrubber:
+    """Rate-limited resident-extent scrub thread, one per Session.
+
+    Follows the canary-thread pattern: daemon thread started at Session
+    construction, stopped at close; re-reads ``scrub_bytes_per_sec`` each
+    tick so tests (and operators) can retune a live session.  Idles on one
+    Event wait per tick while disabled."""
+
+    INTERVAL = 0.05              # seconds per token-bucket refill tick
+
+    def __init__(self, session) -> None:
+        self._session = session
+        self._stop = threading.Event()
+        self._carry = 0.0        # unspent byte budget carried between ticks
+        self._cursor: dict = {}  # tier -> last key scrubbed (round-robin)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="strom-scrub")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    # -- pacing -------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.INTERVAL):
+            try:
+                rate = int(config.get("scrub_bytes_per_sec"))
+            except Exception:  # pragma: no cover - config torn down at exit
+                return
+            if rate <= 0 or not domain.active:
+                self._carry = 0.0
+                continue
+            budget = int(rate * self.INTERVAL + self._carry)
+            if budget <= 0:
+                self._carry += rate * self.INTERVAL
+                continue
+            try:
+                done = self._scrub_round(budget)
+            except Exception:  # pragma: no cover - must never kill thread
+                continue
+            # carry the unspent budget, capped at one second of rate so a
+            # long idle stretch cannot bankroll an unbounded burst
+            self._carry = min(budget - done, rate)
+
+    def _scrub_round(self, budget: int) -> int:
+        done = self._scrub_host(budget)
+        if done < budget and not self._stop.is_set():
+            done += self._scrub_hbm(budget - done)
+        if done < budget and not self._stop.is_set():
+            done += self._scrub_pools(budget - done)
+        return done
+
+    # -- host ARC tier ------------------------------------------------------
+    def _scrub_host(self, budget: int) -> int:
+        from .cache import residency_cache as rc
+        if not rc.active:
+            return 0
+        scanned = 0
+        for key in _rotate(rc.scrub_keys(), self._cursor.get("ram")):
+            if scanned >= budget or self._stop.is_set():
+                break
+            res = rc.scrub_extent(key)
+            if res is None:
+                continue
+            ok, length, source_ref = res
+            self._cursor["ram"] = key
+            scanned += length
+            t0 = time.monotonic_ns()
+            stats.add("nr_scrub_extent")
+            stats.add("bytes_scrubbed", length)
+            if _trace.active:
+                _trace.span("scrub", t0, time.monotonic_ns(),
+                            offset=key[1], length=length,
+                            args={"tier": "ram", "ok": ok})
+            if not ok:
+                self._heal(key, source_ref, tier="ram")
+        return scanned
+
+    # -- HBM tier -----------------------------------------------------------
+    def _scrub_hbm(self, budget: int) -> int:
+        from .serving.hbm_tier import hbm_tier as ht
+        if not ht.active:
+            return 0
+        scanned = 0
+        for key in _rotate(ht.scrub_keys(), self._cursor.get("hbm")):
+            if scanned >= budget or self._stop.is_set():
+                break
+            res = ht.scrub_extent(key)
+            if res is None:
+                continue
+            ok, length, source_ref = res
+            self._cursor["hbm"] = key
+            scanned += length
+            t0 = time.monotonic_ns()
+            stats.add("nr_scrub_extent")
+            stats.add("bytes_scrubbed", length)
+            if _trace.active:
+                _trace.span("scrub", t0, time.monotonic_ns(),
+                            offset=key[1], length=length,
+                            args={"tier": "hbm", "ok": ok})
+            if not ok:
+                healed = self._heal(key, source_ref, tier="hbm")
+                # re-promote the healed bytes so the extent stays
+                # device-resident (the host tier already re-filled)
+                if healed is not None:
+                    ht.admit(key[0], key[1], key[2], healed,
+                             crc=domain.checksum(healed),
+                             source_ref=source_ref)
+        return scanned
+
+    # -- KV spill tier ------------------------------------------------------
+    def _scrub_pools(self, budget: int) -> int:
+        scanned = 0
+        for pool in pools():
+            if scanned >= budget or self._stop.is_set():
+                break
+            try:
+                done, debits = pool.scrub_spill(budget - scanned)
+            except Exception:
+                continue
+            scanned += done
+            for member in debits:
+                self._debit(member)
+        return scanned
+
+    # -- healing ------------------------------------------------------------
+    def _heal(self, key, source_ref, *, tier: str) -> Optional[bytes]:
+        """Re-fill one corrupt (already dropped/stale) extent from SSD
+        through the session's full fault ladder — a mirrored source heals
+        a bad primary leg there; the wait-time cache_fill hook reinstalls
+        the healed bytes under the same key."""
+        skey, base, length = key
+        src = source_ref() if source_ref is not None else None
+        t0 = time.monotonic_ns()
+        data = self._session._scrub_refill(src, base, length)
+        if data is None:
+            stats.add("nr_scrub_fail")
+            return None
+        stats.add("nr_scrub_repair")
+        if _trace.active:
+            _trace.span("repair", t0, time.monotonic_ns(),
+                        offset=base, length=length, args={"tier": tier})
+        return data
+
+    def _debit(self, member: int) -> None:
+        """A scrub failure attributable to a stripe member: debit its
+        health machine (repeated debits quarantine it, fault.py rules)."""
+        stats.member_error(member)
+        try:
+            self._session._member_health.record_failure(member)
+        except Exception:  # pragma: no cover - session tearing down
+            pass

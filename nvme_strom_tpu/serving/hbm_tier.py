@@ -41,20 +41,27 @@ from ..config import config
 from ..stats import stats
 from ..trace import recorder as _trace
 from ..cache import ResidencyCache, residency_cache
+from ..integrity import domain as _integrity
 
 __all__ = ["HbmLease", "HbmResidencyTier", "hbm_tier"]
 
 
 class _Entry:
-    __slots__ = ("key", "array", "handle", "length", "refs", "stale")
+    __slots__ = ("key", "array", "handle", "length", "refs", "stale",
+                 "crc", "source_ref")
 
-    def __init__(self, key, array, handle: int, length: int) -> None:
+    def __init__(self, key, array, handle: int, length: int,
+                 crc=None, source_ref=None) -> None:
         self.key = key
         self.array = array          # device-resident uint8 jax.Array
         self.handle = handle        # hbm.registry handle (revocation tie-in)
         self.length = length
         self.refs = 0
         self.stale = False
+        # integrity domain (ISSUE 16): the extent's fill-time crc32c and
+        # a source weakref so the scrubber can heal a rotted extent
+        self.crc = crc
+        self.source_ref = source_ref
 
 
 class HbmLease:
@@ -93,8 +100,15 @@ class HbmLease:
         e = self._entry
         if e.stale:
             return False
+        host = memoryview(np.asarray(e.array).data)
+        if _integrity.verify_reads and \
+                not _integrity.verify(host[:e.length], e.crc):
+            # integrity=always: a rotted device extent is dropped under
+            # its lease rules and the caller falls back to SSD
+            self._tier._drop_corrupt(e)
+            return False
         n = len(dest)
-        dest[:] = memoryview(np.asarray(e.array).data)[:n]
+        dest[:] = host[:n]
         return not e.stale
 
     def release(self) -> None:
@@ -149,7 +163,7 @@ class HbmResidencyTier:
             if e.refs:
                 e.stale = True
             else:
-                demoted.append((e.key, self._take_bytes(e)))
+                demoted.append((e.key, self._take_bytes(e), e.source_ref))
                 self._free_entry(e)
         self._entries.clear()
         self._bytes = 0
@@ -188,18 +202,27 @@ class HbmResidencyTier:
 
     # -- fill / promotion side -----------------------------------------
 
-    def admit(self, skey: tuple, base: int, length: int, data) -> bool:
+    def admit(self, skey: tuple, base: int, length: int, data, *,
+              crc=None, source_ref=None) -> bool:
         """Promote healed host bytes into a device-resident buffer.
         Called by the host tier on its second-touch transition (outside
         its lock) and by the KV pool when pinning a block.  Returns
         True when the extent is now HBM-resident; evicted victims are
-        demoted into the host tier, never dropped."""
+        demoted into the host tier, never dropped.  ``crc`` is the
+        extent's fill-time crc32c when the caller already has one
+        (verified here — admit is a tier transition); ``source_ref``
+        lets the scrubber heal the extent later."""
         if not self.active or length <= 0:
             return False
         key = (skey, base, length)
         # the device_put happens OUTSIDE the tier lock: it may be slow
         # (real H2D DMA) and needs no tier state
         host = np.frombuffer(bytes(data[:length]), dtype=np.uint8)
+        if _integrity.active:
+            if crc is None:
+                crc = _integrity.checksum(host)
+            elif not _integrity.verify(host, crc):
+                return False  # corrupt promote: never lands in HBM
         arr, handle = self._place(host)
         if arr is None:
             return False
@@ -218,7 +241,8 @@ class HbmResidencyTier:
                         break
                     demoted.append(d)
                 if ok:
-                    self._entries[key] = _Entry(key, arr, handle, length)
+                    self._entries[key] = _Entry(key, arr, handle, length,
+                                                crc, source_ref)
                     self._bytes += length
                     installed = True
                     stats.add("nr_hbm_promote")
@@ -253,6 +277,9 @@ class HbmResidencyTier:
                 continue
             del self._entries[key]
             data = self._take_bytes(e)
+            if data is not None and _integrity.active and \
+                    not _integrity.verify(data, e.crc):
+                data = None  # corrupt demote: never poisons the host tier
             self._bytes -= e.length
             self._free_entry(e)
             stats.add("nr_hbm_demote")
@@ -260,7 +287,7 @@ class HbmResidencyTier:
             if _trace.active:
                 _trace.instant("cache_evict", offset=key[1],
                                length=e.length, args={"tier": "hbm"})
-            return key, data
+            return key, data, e.source_ref
         return None
 
     @staticmethod
@@ -274,10 +301,11 @@ class HbmResidencyTier:
         """Demoted extents re-enter the host ARC tier: capacity
         pressure moves data down the hierarchy instead of dropping it
         (a failed host fill just means a future SSD re-read)."""
-        for key, data in demoted:
+        for key, data, source_ref in demoted:
             if data is not None:
                 skey, base, length = key
-                residency_cache.fill(skey, base, length, data)
+                residency_cache.fill(skey, base, length, data,
+                                     source_ref=source_ref)
 
     def _free_entry(self, e: _Entry) -> None:
         self._unmap(e.handle)
@@ -311,6 +339,85 @@ class HbmResidencyTier:
                 return True
         self._free_entry(e)
         return True
+
+    # -- integrity scrub (ISSUE 16) ------------------------------------
+
+    def scrub_keys(self) -> list:
+        """Snapshot of verifiable resident keys.  Pinned entries (the KV
+        pool's HBM working set) are skipped: the pool verifies its own
+        blocks at its page/promote transitions, and exclusive placement
+        means dropping one here would lose the only copy."""
+        with self._lock:
+            return [k for k, e in self._entries.items()
+                    if not e.stale and e.crc is not None and not e.refs]
+
+    def scrub_extent(self, key: tuple):
+        """Verify one HBM-resident extent (one D2H copy) against its
+        fill-time crc.  Returns ``(ok, length, source_ref)`` or None.
+        A mismatch drops the entry WITHOUT host demotion — corrupt bytes
+        never move down the hierarchy."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.stale or e.crc is None or e.refs:
+                return None
+            e.refs += 1  # pin while the D2H copy + hash run unlocked
+        data = self._take_bytes(e)
+        ok = data is not None and _integrity.verify(data, e.crc)
+        src = e.source_ref
+        drop = None
+        with self._lock:
+            e.refs -= 1
+            if not ok and not e.stale:
+                if self._entries.get(key) is e:
+                    del self._entries[key]
+                    self._bytes -= e.length
+                    stats.gauge_set("hbm_resident_bytes", self._bytes)
+                    if e.refs:
+                        e.stale = True
+                    else:
+                        drop = e
+            elif e.stale and e.refs <= 0:
+                drop = e  # invalidated under the scrub pin
+        if drop is not None:
+            self._free_entry(drop)
+        return ok, e.length, src
+
+    def _drop_corrupt(self, e: _Entry) -> None:
+        """Integrity mismatch on a leased extent: drop it under its
+        lease rules (the caller holds a ref, so it goes stale and frees
+        at the last release)."""
+        with self._lock:
+            if self._entries.get(e.key) is e:
+                del self._entries[e.key]
+                self._bytes -= e.length
+                stats.gauge_set("hbm_resident_bytes", self._bytes)
+                e.stale = True
+
+    def _flip_resident_byte(self, skey: tuple, base: int, length: int,
+                            pos: int = 0) -> bool:
+        """Testing hook (FaultPlan resident-corruption tiers): replace
+        the device array with a one-byte-flipped copy, modelling HBM
+        bit-rot.  The registry handle keeps mapping the original array —
+        acceptable for a test-only flip; it is still unmapped on free."""
+        key = (skey, base, length)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.stale:
+                return False
+        try:
+            import jax
+            host = np.array(np.asarray(e.array), dtype=np.uint8, copy=True)
+            host[pos % host.size] ^= 0xFF
+            flipped = jax.device_put(
+                host, self._device or jax.local_devices()[0])
+            flipped.block_until_ready()
+        except Exception:  # pragma: no cover - backend loss
+            return False
+        with self._lock:
+            if self._entries.get(key) is e and not e.stale:
+                e.array = flipped
+                return True
+        return False
 
     # -- coherency (forwarded by the host tier) ------------------------
 

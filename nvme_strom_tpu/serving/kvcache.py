@@ -45,15 +45,20 @@ from ..api import StromError
 from ..config import config
 from ..stats import stats
 from ..trace import recorder as _trace
+from ..integrity import domain as _integrity, register_pool
 from .hbm_tier import hbm_tier
 
 __all__ = ["KvBlockPool"]
 
 _pool_ids = itertools.count(1)
 
+#: pressure-shed priority (ISSUE 16): bulk chains demote first, the
+#: latency class last — the PR 12 QoS ordering applied to residency
+_SHED_ORDER = {"bulk": 0, "normal": 1, "latency": 2}
+
 
 class _Block:
-    __slots__ = ("seq", "idx", "gid", "tier", "slot", "lease")
+    __slots__ = ("seq", "idx", "gid", "tier", "slot", "lease", "crc")
 
     def __init__(self, seq, idx: int, gid: int) -> None:
         self.seq = seq
@@ -62,6 +67,7 @@ class _Block:
         self.tier = "ram"   # "hbm" | "ram" | "ssd"
         self.slot = -1      # ram slot or ssd slot, by tier
         self.lease = None   # HbmLease while tier == "hbm"
+        self.crc = None     # fill-time crc32c (None under integrity=off)
 
 
 class KvBlockPool:
@@ -100,10 +106,14 @@ class KvBlockPool:
         self._hbm_used = 0
         self._skey = ("#kvpool:%d" % next(_pool_ids),)
         self._tables: Dict[object, List[_Block]] = {}
+        self._classes: Dict[object, str] = {}  # seq -> QoS class (PR 12)
         self._lru: "OrderedDict[int, _Block]" = OrderedDict()  # ram+hbm
         self._gids = itertools.count()
         self._lock = threading.RLock()
         self._closed = False
+        # integrity domain (ISSUE 16): the scrubber walks this pool's
+        # spill blocks and memlock pressure can ask it to shed capacity
+        register_pool(self)
 
     # -- introspection -------------------------------------------------
 
@@ -127,17 +137,24 @@ class KvBlockPool:
 
     # -- block table ops ----------------------------------------------
 
-    def append(self, seq, data) -> int:
+    def append(self, seq, data, *, qos_class: Optional[str] = None) -> int:
         """Append *data* (≤ block_bytes; short blocks are zero-padded)
-        as the sequence's next block; returns its block index."""
+        as the sequence's next block; returns its block index.
+        ``qos_class`` pins the sequence's pressure-shed priority (PR 12
+        classes; bulk sheds first) — defaults to ``qos_default_class``."""
         with self._lock:
             self._check_open()
             table = self._tables.setdefault(seq, [])
+            if seq not in self._classes:
+                self._classes[seq] = qos_class or \
+                    str(config.get("qos_default_class"))
+            elif qos_class:
+                self._classes[seq] = qos_class
             blk = _Block(seq, len(table), next(self._gids))
             blk.slot = self._get_ram_slot()
             self._lru[blk.gid] = blk
             table.append(blk)
-            self._fill_ram(blk.slot, data)
+            self._fill_ram(blk, data)
             return blk.idx
 
     def write(self, seq, idx: int, data) -> None:
@@ -152,7 +169,7 @@ class KvBlockPool:
             elif blk.tier == "ssd":
                 self._page_in(blk)
             self._lru.move_to_end(blk.gid)
-            self._fill_ram(blk.slot, data)
+            self._fill_ram(blk, data)
 
     def read(self, seq, idx: int) -> bytes:
         """Block bytes, paged in / promoted as a side effect: an SSD
@@ -220,6 +237,7 @@ class KvBlockPool:
                 inflight.append((blk, slot, res, ts))
             for blk, slot, res, ts in inflight:
                 self._session.memcpy_wait(res.dma_task_id)
+                self._verify_landed(blk, blk.slot, self._ram_view(slot))
                 self._ssd_free.append(blk.slot)
                 blk.tier, blk.slot = "ram", slot
                 self._lru[blk.gid] = blk
@@ -238,6 +256,7 @@ class KvBlockPool:
         free list, HBM pins release and drop."""
         with self._lock:
             table = self._tables.pop(seq, [])
+            self._classes.pop(seq, None)
             for blk in table:
                 if blk.tier == "hbm":
                     blk.lease.release()
@@ -280,16 +299,19 @@ class KvBlockPool:
         base = slot * self.block_bytes
         return self._dma.view()[base:base + self.block_bytes]
 
-    def _fill_ram(self, slot: int, data) -> None:
+    def _fill_ram(self, blk: _Block, data) -> None:
         n = len(data)
         if n > self.block_bytes:
             raise StromError(_errno.EINVAL,
                              f"{n}B exceeds the {self.block_bytes}B block")
-        view = self._ram_view(slot)
+        view = self._ram_view(blk.slot)
         view[:n] = bytes(data) if not isinstance(data, (bytes, bytearray,
                                                         memoryview)) else data
         if n < self.block_bytes:
             view[n:] = b"\0" * (self.block_bytes - n)
+        # the crc covers the whole (zero-padded) block: page-out writes
+        # and page-in reads move full blocks
+        blk.crc = _integrity.checksum(view)
 
     def _get_ram_slot(self, avoid_seq=None) -> int:
         """A free RAM slot, paging out the pool-LRU RAM block if none
@@ -312,6 +334,11 @@ class KvBlockPool:
         spill sources keep both legs coherent)."""
         if not self._ssd_free:
             raise StromError(_errno.ENOSPC, "KV spill extent full")
+        if _integrity.active:
+            # page-out is a tier transition: catch RAM rot before it is
+            # made durable (counted; the write still proceeds — this is
+            # the only copy, and the counter is the operator's signal)
+            _integrity.verify(self._ram_view(blk.slot), blk.crc)
         ssd_slot = self._ssd_free.pop()
         ts = time.monotonic_ns()
         res = self._session.memcpy_ram2ssd(
@@ -332,14 +359,23 @@ class KvBlockPool:
 
     def _page_in(self, blk: _Block) -> None:
         """SSD→RAM page-in; the engine's fault ladder (hedges, mirror
-        reads) serves it even with a spill member fail-stopped."""
+        reads) serves it even with a spill member fail-stopped.  Under
+        the integrity domain the landed bytes are verified against the
+        page-out crc, and a mismatch is healed from the mirror leg
+        (write-back to the corrupt primary) or raises EBADMSG."""
         slot = self._get_ram_slot()
         ts = time.monotonic_ns()
+        ssd_slot = blk.slot
         res = self._session.memcpy_ssd2ram(
-            self._spill, self._handle, [blk.slot], self.block_bytes,
+            self._spill, self._handle, [ssd_slot], self.block_bytes,
             dest_offset=slot * self.block_bytes)
         self._session.memcpy_wait(res.dma_task_id)
-        self._ssd_free.append(blk.slot)
+        try:
+            self._verify_landed(blk, ssd_slot, self._ram_view(slot))
+        except StromError:
+            self._ram_free.append(slot)  # block stays on SSD, corrupt
+            raise
+        self._ssd_free.append(ssd_slot)
         self._lru[blk.gid] = blk
         blk.tier, blk.slot = "ram", slot
         stats.add("nr_kv_pagein")
@@ -356,7 +392,10 @@ class KvBlockPool:
             return
         base = blk.gid * self.block_bytes
         data = self._ram_view(blk.slot)
-        if not hbm_tier.admit(self._skey, base, self.block_bytes, data):
+        # admit verifies data against the crc (promote is a transition);
+        # a rotted RAM block simply stays in RAM, counted
+        if not hbm_tier.admit(self._skey, base, self.block_bytes, data,
+                              crc=blk.crc):
             return
         lease = hbm_tier.lookup(self._skey, base, self.block_bytes)
         if lease is None:  # pragma: no cover - raced a revocation
@@ -372,6 +411,10 @@ class KvBlockPool:
         bytes' home)."""
         slot = self._get_ram_slot()
         ok = blk.lease.copy_into(self._ram_view(slot))
+        if ok and _integrity.active:
+            # demote is a tier transition: a rotted device copy is the
+            # only copy, so the mismatch is counted, not raised
+            _integrity.verify(self._ram_view(slot), blk.crc)
         self._drop_hbm(blk)
         blk.tier, blk.slot = "ram", slot
         if not ok:  # pragma: no cover - invalidated between pin and copy
@@ -384,3 +427,165 @@ class KvBlockPool:
         hbm_tier.drop(self._skey, blk.gid * self.block_bytes,
                       self.block_bytes)
         self._hbm_used -= 1
+
+    # -- integrity domain (ISSUE 16) -----------------------------------
+
+    def _verify_landed(self, blk: _Block, ssd_slot: int, view) -> None:
+        """Verify a page-in's landed bytes against the page-out crc;
+        on mismatch heal from the mirror leg (fixing the corrupt
+        primary on disk too) or raise EBADMSG — a spill block has no
+        other copy to fail open to."""
+        if blk.crc is None or not _integrity.active:
+            return
+        if _integrity.verify(view, blk.crc):
+            return
+        t0 = time.monotonic_ns()
+        debits = self._heal_spill(blk, ssd_slot, view)
+        if debits is None:
+            stats.add("nr_scrub_fail")
+            raise StromError(
+                _errno.EBADMSG,
+                f"KV block {blk.idx} corrupt on spill and unhealable")
+        stats.add("nr_scrub_repair")
+        if _trace.active:
+            _trace.span("repair", t0, time.monotonic_ns(),
+                        offset=ssd_slot * self.block_bytes,
+                        length=self.block_bytes,
+                        args={"tier": "ssd", "block": blk.idx})
+        for m in debits:
+            self._debit(m)
+
+    def _heal_spill(self, blk: _Block, ssd_slot: int, view):
+        """Re-assemble the block from each extent's mirror leg into
+        *view*, verify, and write the healed bytes back to the corrupt
+        primary members.  Returns the list of primary members healed
+        over (for health debits), or None when unhealable (no mirror,
+        or the mirror leg is corrupt too)."""
+        spill = self._spill
+        if getattr(spill, "mirror_of", None) is None:
+            return None
+        base = ssd_slot * self.block_bytes
+        try:
+            spans = spill.extents(base, self.block_bytes)
+        except Exception:
+            return None
+        for ext in spans:
+            mirror = spill.mirror_of(ext.member)
+            if mirror is None:
+                return None
+            off = ext.logical_off - base
+            try:
+                spill.read_member_buffered(
+                    mirror, ext.file_off, view[off:off + ext.length])
+            except Exception:
+                return None
+        if not _integrity.verify(view, blk.crc):
+            return None  # both legs rotted: data is gone
+        debits = []
+        for ext in spans:
+            try:
+                spill.write_member_buffered(
+                    ext.member, ext.file_off,
+                    view[ext.logical_off - base:
+                         ext.logical_off - base + ext.length])
+                debits.append(ext.member)
+            except Exception:
+                continue  # primary still down: RAM copy is good anyway
+        if self._durable:
+            try:
+                spill.sync()
+            except Exception:
+                pass
+        return debits
+
+    def _debit(self, member: int) -> None:
+        """A scrub/page-in failure attributable to a spill member."""
+        stats.member_error(member)
+        try:
+            self._session._member_health.record_failure(member)
+        except Exception:  # pragma: no cover - session tearing down
+            pass
+
+    def scrub_spill(self, budget: int):
+        """Scrubber entry point: verify SSD-resident blocks against
+        their page-out crcs, healing mismatches from the mirror leg.
+        Returns ``(bytes_scanned, member_debits)``; never raises."""
+        with self._lock:
+            if self._closed:
+                return 0, []
+            blocks = [b for t in self._tables.values() for b in t
+                      if b.tier == "ssd" and b.crc is not None]
+        scanned = 0
+        debits: List[int] = []
+        buf = memoryview(bytearray(self.block_bytes))
+        for blk in blocks:
+            if scanned >= budget:
+                break
+            with self._lock:
+                if self._closed:
+                    break
+                if blk.tier != "ssd":
+                    continue
+                ssd_slot = blk.slot
+                t0 = time.monotonic_ns()
+                try:
+                    self._spill.read_buffered(
+                        ssd_slot * self.block_bytes, buf)
+                except Exception:
+                    continue
+                scanned += self.block_bytes
+                stats.add("nr_scrub_extent")
+                stats.add("bytes_scrubbed", self.block_bytes)
+                ok = _integrity.verify(buf, blk.crc)
+                if _trace.active:
+                    _trace.span("scrub", t0, time.monotonic_ns(),
+                                offset=ssd_slot * self.block_bytes,
+                                length=self.block_bytes,
+                                args={"tier": "ssd", "ok": ok})
+                if ok:
+                    continue
+                t0 = time.monotonic_ns()
+                healed = self._heal_spill(blk, ssd_slot, buf)
+                if healed is None:
+                    stats.add("nr_scrub_fail")
+                    continue
+                stats.add("nr_scrub_repair")
+                if _trace.active:
+                    _trace.span("repair", t0, time.monotonic_ns(),
+                                offset=ssd_slot * self.block_bytes,
+                                length=self.block_bytes,
+                                args={"tier": "ssd", "block": blk.idx})
+                debits.extend(healed)
+        return scanned, debits
+
+    def shed(self, nbytes: int, *, reason: str = "memlock") -> int:
+        """Pressure relief: demote resident (HBM/RAM) blocks to SSD,
+        bulk-class sequences first (the PR 12 QoS ordering), never
+        raising — a full spill just bounds what can shed."""
+        shed = 0
+        with self._lock:
+            if self._closed:
+                return 0
+            cands = [b for t in self._tables.values() for b in t
+                     if b.tier != "ssd"]
+            cands.sort(key=lambda b: _SHED_ORDER.get(
+                self._classes.get(b.seq, "normal"), 1))
+            for blk in cands:
+                if shed >= nbytes:
+                    break
+                try:
+                    if blk.tier == "hbm":
+                        self._demote_hbm(blk)
+                    self._page_out(blk)
+                except StromError:
+                    break  # spill full / revoked: shed what we could
+                shed += self.block_bytes
+                stats.add("nr_pressure_shed")
+                if _trace.active:
+                    _trace.instant(
+                        "pressure_shed", offset=blk.gid * self.block_bytes,
+                        length=self.block_bytes,
+                        args={"tier": "kv", "reason": reason,
+                              "class": self._classes.get(blk.seq,
+                                                         "normal")})
+        return shed

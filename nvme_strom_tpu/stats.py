@@ -535,7 +535,8 @@ class StatRegistry:
                                               "cache_resident_bytes",
                                               "resync_pending_bytes",
                                               "hbm_resident_bytes",
-                                              "coldstart_bytes_per_sec"):
+                                              "coldstart_bytes_per_sec",
+                                              "cache_unpinned_bytes"):
                     self._c[k] += v
 
 

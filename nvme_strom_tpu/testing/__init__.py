@@ -1,5 +1,7 @@
 from .fake import (FakeNvmeSource, FakeStripedNvmeSource, FaultPlan,
-                   backend_fault, make_test_file)
+                   backend_fault, flip_resident_hbm, flip_resident_host,
+                   make_test_file)
 
 __all__ = ["FakeNvmeSource", "FakeStripedNvmeSource", "FaultPlan",
-           "backend_fault", "make_test_file"]
+           "backend_fault", "flip_resident_hbm", "flip_resident_host",
+           "make_test_file"]

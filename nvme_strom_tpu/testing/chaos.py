@@ -2,7 +2,8 @@
 chaos``, PR 6).
 
 Each scenario drives a seeded fault schedule — fail-stop, flaky, slow
-member, corrupt-once, fail-stop-then-rejoin — through a mirrored striped
+member, corrupt-once, fail-stop-then-rejoin, resident bit-rot healed by
+the background scrubber (ISSUE 16) — through a mirrored striped
 loopback set (plus one native-engine leg against real files) and checks
 the survival contract:
 
@@ -381,6 +382,108 @@ def scenario_native_degraded(rng: random.Random, dirpath: str) -> str:
     return "native_degraded"
 
 
+def scenario_scrub_heal(rng: random.Random, dirpath: str) -> str:
+    """Seeded resident bit-rot across the hierarchy (ISSUE 16): a byte
+    flipped in a HOST-resident ARC slab must be detected by the
+    background scrubber and re-filled from SSD byte-identically; a KV
+    spill block whose PRIMARY mirror leg rots on disk must be healed
+    from the surviving replica at page-in with the rotten member debited
+    into QUARANTINED (``quarantine_after=1``) — and every read stays
+    byte-identical throughout."""
+    from ..cache import residency_cache
+    from ..config import config
+    from ..engine import Session
+    from ..fault import HealthState
+    from ..serving.kvcache import KvBlockPool
+    from .fake import FakeStripedNvmeSource, FaultPlan, flip_resident_host
+
+    config.set("io_retries", 2)
+    config.set("task_deadline_s", 30.0)
+    config.set("integrity", "always")
+    config.set("scrub_bytes_per_sec", 1 << 30)
+    config.set("cache_arbitration", False)
+    config.set("cache_bytes", 16 * CHUNK)   # whole stream stays resident
+    config.set("dma_max_size", CHUNK)
+    config.set("canary_interval_s", 0.0)    # the debit must HOLD
+    config.set("quarantine_after", 1)
+    config.set("quarantine_s", 60.0)
+    residency_cache.clear()
+    paths = make_mirrored_members(dirpath, tag=f"sh{rng.randrange(1 << 16)}-")
+    src = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                force_cached_fraction=0.0, mirror="paired")
+    # KV spill set: every member-0 block row carries one seeded-rot byte
+    # (flipped after the covering page-out lands); the mirror leg on
+    # member 1 stays clean, so page-in heals are mirror-attributable
+    bbk = 16 << 10
+    rows = 4
+    rot = rng.randrange(64, bbk - 64)
+    spaths = []
+    for i in range(4):
+        p = os.path.join(dirpath, f"kv{rng.randrange(1 << 16)}-{i}.bin")
+        with open(p, "wb") as f:
+            f.truncate(rows * bbk)
+        spaths.append(p)
+    plan = FaultPlan(corrupt_member_offsets={
+        0: {row * bbk + rot for row in range(rows)}})
+    spill = FakeStripedNvmeSource(spaths, bbk, fault_plan=plan,
+                                  force_cached_fraction=0.0,
+                                  mirror="paired", writable=True)
+    want = expected_mirrored_stream(paths)
+    fails0 = _counter("nr_integrity_fail")
+    repairs0 = _counter("nr_scrub_repair")
+    try:
+        with Session() as sess:
+            # phase A: host-slab rot — the scrubber must catch and heal
+            got, total = read_all(sess, src)
+            assert got == want[:total], "scrub_heal: clean pass diverged"
+            keys = residency_cache.scrub_keys()
+            assert keys, "scrub_heal: nothing resident to corrupt"
+            key = rng.choice(keys)
+            assert flip_resident_host(key[0], key[1], key[2],
+                                      pos=rng.randrange(key[2])), \
+                "scrub_heal: resident flip missed"
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline \
+                    and _counter("nr_scrub_repair") <= repairs0:
+                time.sleep(0.02)
+            assert _counter("nr_scrub_repair") > repairs0, \
+                "scrub_heal: the scrubber never repaired the flipped slab"
+            assert _counter("nr_integrity_fail") > fails0, \
+                "scrub_heal: the flip was never detected"
+            got, total = read_all(sess, src)
+            assert got == want[:total], \
+                "scrub_heal: post-heal stream diverged"
+            # phase B: KV spill rot healed from the mirror at page-in,
+            # member-attributed — stop the background scrubber so the
+            # debit provably comes from the page-in verify
+            config.set("scrub_bytes_per_sec", 0)
+            repairs_a = _counter("nr_scrub_repair")
+            pool = KvBlockPool(sess, spill, block_bytes=bbk, ram_blocks=2,
+                               hbm_blocks=0)
+
+            def pat(i: int) -> bytes:
+                return bytes([(i * 7 + 1) % 256]) * bbk
+
+            for i in range(6):
+                pool.append("chaos", pat(i))
+            for i in range(6):
+                assert pool.read("chaos", i) == pat(i), \
+                    f"scrub_heal: KV block {i} diverged after heal"
+            assert _counter("nr_scrub_repair") > repairs_a, \
+                "scrub_heal: no spill block was ever mirror-healed"
+            assert sess._member_health.state(0) is HealthState.QUARANTINED, \
+                f"scrub_heal: rotten member 0 ended " \
+                f"{sess._member_health.state(0)}, wanted QUARANTINED"
+            assert_transitions_legal(sess, "scrub_heal")
+            pool.close()
+    finally:
+        src.close()
+        spill.close()
+        config.set("cache_bytes", 0)
+        residency_cache.configure()
+    return "scrub_heal"
+
+
 def scenario_cache_churn(rng: random.Random, dirpath: str) -> str:
     """Seeded residency-tier churn racing a fail-stop (ISSUE 9): with
     capacity far below the table, repeated whole-stream reads fill and
@@ -752,7 +855,8 @@ def scenario_ckpt_crash(rng: random.Random, dirpath: str) -> str:
 
 SCENARIOS = (scenario_fail_stop, scenario_flaky, scenario_slow_hedge,
              scenario_corrupt_once, scenario_rejoin,
-             scenario_native_degraded, scenario_cache_churn)
+             scenario_native_degraded, scenario_cache_churn,
+             scenario_scrub_heal)
 
 SCENARIOS_WRITE = (scenario_write_failstop, scenario_write_enospc,
                    scenario_write_torn_mirror, scenario_ckpt_crash)

@@ -91,6 +91,19 @@ class FaultPlan:
       byte flipped ON DISK after the covering write lands (fsynced, so
       O_DIRECT read-back sees it): a torn/misdirected write for the
       ``write_verify`` read-back oracle.  One-shot per offset.
+
+    Resident-corruption tier (ISSUE 16) — seeded bit-rot for the
+    integrity domain's scrub/heal oracles:
+
+    * ``corrupt_member_offsets`` — ``{member: {absolute offsets}}``; one
+      byte at each listed offset of that MEMBER's backing file is flipped
+      on disk after a covering write lands (one-shot, `_tear_landed`
+      mechanics).  Unlike ``torn_write_offsets`` it is member-scoped, so
+      a mirrored KV spill rots exactly one leg and the scrubber must heal
+      the primary from the surviving mirror while debiting the rotten
+      member's health machine.  Host-slab and HBM-extent rot have no
+      on-disk representation — seed those with
+      :func:`flip_resident_host` / :func:`flip_resident_hbm`.
     """
 
     fail_offsets: Set[int] = field(default_factory=set)   # file_off -> EIO
@@ -112,6 +125,7 @@ class FaultPlan:
     write_failstop_after: int = 0                # ...from this write count
     write_rejoin_after: Optional[int] = None     # ...healing at this count
     torn_write_offsets: Set[int] = field(default_factory=set)  # flip after landing
+    corrupt_member_offsets: dict = field(default_factory=dict)  # member -> {offsets}
     slow_write_member: Optional[int] = None  # member whose writes stall
     slow_write_s: float = 0.0                # the extra write latency
     _count: int = 0
@@ -167,6 +181,18 @@ class FaultPlan:
                if file_off <= off < file_off + length]
         for off in hit:
             self.torn_write_offsets.discard(off)
+        return hit
+
+    def take_member_corrupt(self, member: Optional[int], file_off: int,
+                            length: int) -> list:
+        """Pop-and-return this MEMBER's seeded-rot offsets a landed write
+        covers (resident-corruption tier, ISSUE 16)."""
+        offs = self.corrupt_member_offsets.get(member)
+        if not offs:
+            return []
+        hit = [off for off in offs if file_off <= off < file_off + length]
+        for off in hit:
+            offs.discard(off)
         return hit
 
     def check(self, file_off: int, length: int,
@@ -230,6 +256,37 @@ def _tear_landed(member_obj, plan: FaultPlan, file_off: int,
     os.fsync(fd)
 
 
+def _rot_landed(member_obj, plan: FaultPlan, member: Optional[int],
+                file_off: int, length: int) -> None:
+    """Member-scoped on-disk bit-rot (resident-corruption tier, ISSUE 16):
+    flip the listed byte of THIS member's backing file after a covering
+    write lands, one-shot, same fsync discipline as `_tear_landed` — the
+    seeded rot model for KV spill blocks whose mirror leg stays clean."""
+    hit = plan.take_member_corrupt(member, file_off, length)
+    if not hit:
+        return
+    fd = member_obj.fd_buffered
+    for off in hit:
+        b = os.pread(fd, 1, off)
+        os.pwrite(fd, bytes([b[0] ^ 0xFF]), off)
+    os.fsync(fd)
+
+
+def flip_resident_host(skey, base: int, length: int, pos: int = 0) -> bool:
+    """Seed bit-rot in a resident HOST ARC slab (no disk representation:
+    the flip happens in the pinned mmap itself).  Returns False when the
+    extent is not resident."""
+    from ..cache import residency_cache
+    return residency_cache._flip_resident_byte(skey, base, length, pos)
+
+
+def flip_resident_hbm(skey, base: int, length: int, pos: int = 0) -> bool:
+    """Seed bit-rot in a resident HBM extent (device array swapped for a
+    corrupted copy).  Returns False when the extent is not resident."""
+    from ..serving.hbm_tier import hbm_tier
+    return hbm_tier._flip_resident_byte(skey, base, length, pos)
+
+
 class FakeNvmeSource(PlainSource):
     """Loopback 'NVMe device': a plain file plus injected latency/faults.
 
@@ -262,11 +319,13 @@ class FakeNvmeSource(PlainSource):
         self.fault_plan.check_write(file_off, len(src), member=member)
         super().write_member_direct(member, file_off, src)
         _tear_landed(self._m, self.fault_plan, file_off, len(src))
+        _rot_landed(self._m, self.fault_plan, member, file_off, len(src))
 
     def write_member_buffered(self, member: int, file_off: int, src: memoryview) -> None:
         self.fault_plan.check_write(file_off, len(src), member=member)
         super().write_member_buffered(member, file_off, src)
         _tear_landed(self._m, self.fault_plan, file_off, len(src))
+        _rot_landed(self._m, self.fault_plan, member, file_off, len(src))
 
     def cached_fraction(self, offset: int, length: int) -> float:
         if self.force_cached_fraction is not None:
@@ -322,12 +381,16 @@ class FakeStripedNvmeSource(StripedSource):
         super().write_member_direct(member, file_off, src)
         _tear_landed(self.members[member], self.fault_plan,
                      file_off, len(src))
+        _rot_landed(self.members[member], self.fault_plan, member,
+                    file_off, len(src))
 
     def write_member_buffered(self, member: int, file_off: int, src: memoryview) -> None:
         self.fault_plan.check_write(file_off, len(src), member=member)
         super().write_member_buffered(member, file_off, src)
         _tear_landed(self.members[member], self.fault_plan,
                      file_off, len(src))
+        _rot_landed(self.members[member], self.fault_plan, member,
+                    file_off, len(src))
 
     def cached_fraction(self, offset: int, length: int) -> float:
         if self.force_cached_fraction is not None:

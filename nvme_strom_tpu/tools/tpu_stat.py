@@ -66,6 +66,26 @@ def _hist_delta(cur: dict, prev: dict):
     return [a - b for a, b in zip(ch, ph)]
 
 
+_warned_unpinned = False
+
+
+def _warn_unpinned(c: dict) -> None:
+    """Operator warning (once per invocation in interval mode) when the
+    ARC cache is running with UNPINNED slabs: mlock(2) failed under
+    RLIMIT_MEMLOCK so the "pinned RAM" tier is silently swappable and a
+    cold read can stall on swap-in (ISSUE 16 satellite — the old code
+    ignored the mlock return entirely)."""
+    global _warned_unpinned
+    if _warned_unpinned:
+        return
+    if c.get("nr_cache_mlock_fail") or c.get("cache_unpinned_bytes"):
+        _warned_unpinned = True
+        print(f"WARNING: residency cache running UNPINNED "
+              f"(mlock failed {c.get('nr_cache_mlock_fail', 0)}x, "
+              f"{c.get('cache_unpinned_bytes', 0) / 1048576:.1f}MB "
+              f"swappable) — raise RLIMIT_MEMLOCK or set memlock_budget")
+
+
 def _row(cur_snap: dict, prev_snap: dict, verbose: bool) -> str:
     cur = cur_snap.get("counters", {})
     prev = prev_snap.get("counters", {})
@@ -409,6 +429,23 @@ def main(argv=None) -> int:
                       f"verify-fail {c.get('nr_write_verify_fail', 0)}  "
                       f"resync-pending "
                       f"{c.get('resync_pending_bytes', 0) / 1048576:.1f}MB")
+            # integrity scoreboard (ISSUE 16): resident checksum verifies
+            # against detected mismatches, scrubber progress, and the
+            # heal ledger — repairs tracking fails means the mirror/SSD
+            # legs are keeping up with resident rot; scrub-fail above
+            # zero means data was lost with no surviving good copy
+            if (c.get("nr_integrity_verify") or c.get("nr_scrub_extent")
+                    or c.get("nr_pressure_shed")
+                    or c.get("nr_pressure_passthrough")):
+                print(f"integrity: verify {c.get('nr_integrity_verify', 0)}  "
+                      f"fail {c.get('nr_integrity_fail', 0)}  "
+                      f"scrubbed "
+                      f"{c.get('bytes_scrubbed', 0) / 1048576:.1f}MB  "
+                      f"repair {c.get('nr_scrub_repair', 0)}  "
+                      f"scrub-fail {c.get('nr_scrub_fail', 0)}  "
+                      f"shed {c.get('nr_pressure_shed', 0)}  "
+                      f"passthrough {c.get('nr_pressure_passthrough', 0)}")
+            _warn_unpinned(c)
             # write-amplification of the recovery/staging stack: every
             # byte the pipeline touched (staging hop + verify re-reads +
             # duplicated hedge legs) over every byte delivered — 1.0 is
@@ -458,6 +495,8 @@ def main(argv=None) -> int:
             if n % 20 == 0:
                 print(_header(args.verbose), flush=True)
             print(_row(snap, prev, args.verbose), flush=True)
+            if args.verbose:
+                _warn_unpinned(snap.get("counters", {}))
             prev = snap
             n += 1
     except KeyboardInterrupt:

@@ -108,6 +108,10 @@ EVENT_SCHEMA: Dict[str, str] = {
     # paging over the HBM residency tier
     "weight_stream": "span",   # one layer span: submit -> crc -> adopt
     "kv_page": "span",         # one KV block crossing a tier boundary
+    # resident-data integrity domain (ISSUE 16)
+    "scrub": "span",           # one resident extent verified (tier in args)
+    "repair": "span",          # corrupt resident healed (SSD/mirror re-fill)
+    "pressure_shed": "instant",  # resident shed under memlock/HBM pressure
 }
 
 
@@ -522,7 +526,7 @@ _PROM_GAUGES = ("cur_dma_count", "max_dma_count", "h2d_depth_reached",
                 "occ_integral_ns", "occ_busy_ns", "cache_resident_bytes",
                 "resync_pending_bytes", "daemon_sessions",
                 "qos_queue_depth", "hbm_resident_bytes",
-                "coldstart_bytes_per_sec")
+                "coldstart_bytes_per_sec", "cache_unpinned_bytes")
 
 
 def render_prometheus(payload: dict) -> str:
@@ -544,9 +548,13 @@ def render_prometheus(payload: dict) -> str:
     for k in sorted(counters):
         if "debug" in k or k.startswith("nr_landing_") \
                 or k.startswith("nr_cache_") \
+                or k.startswith("nr_integrity_") \
+                or k.startswith("nr_scrub_") \
+                or k.startswith("nr_pressure_") \
                 or k in ("nr_mirror_write", "nr_write_retry",
                          "nr_resync_extent", "nr_write_verify_fail"):
-            continue    # landing/cache/write counters render as labeled series
+            continue    # landing/cache/write/integrity counters render
+            #             as labeled series
         mtype = "gauge" if k in _PROM_GAUGES else "counter"
         emit(_prom_name(k if k in _PROM_GAUGES else k + "_total"),
              mtype, counters[k])
@@ -569,11 +577,26 @@ def render_prometheus(payload: dict) -> str:
     # residency-tier attribution (ISSUE 9): one series per cache op, so
     # dashboards can plot hit ratio and churn against resident bytes
     ops = [(op, counters.get(f"nr_cache_{op}", 0))
-           for op in ("hit", "miss", "fill", "evict", "invalidate")]
+           for op in ("hit", "miss", "fill", "evict", "invalidate",
+                      "mlock_fail")]
     if any(v for _, v in ops):
         out.append("# TYPE strom_tpu_cache_ops_total counter")
         for op, v in ops:
             out.append(f'strom_tpu_cache_ops_total{{op="{op}"}} {v}')
+    # resident-integrity attribution (ISSUE 16): verify/scrub/repair and
+    # the pressure degradations as one labeled family, so dashboards can
+    # plot detection vs healing vs capacity shed
+    iops = [("verify", counters.get("nr_integrity_verify", 0)),
+            ("fail", counters.get("nr_integrity_fail", 0)),
+            ("scrub", counters.get("nr_scrub_extent", 0)),
+            ("repair", counters.get("nr_scrub_repair", 0)),
+            ("scrub_fail", counters.get("nr_scrub_fail", 0)),
+            ("shed", counters.get("nr_pressure_shed", 0)),
+            ("passthrough", counters.get("nr_pressure_passthrough", 0))]
+    if any(v for _, v in iops):
+        out.append("# TYPE strom_tpu_integrity_ops_total counter")
+        for op, v in iops:
+            out.append(f'strom_tpu_integrity_ops_total{{op="{op}"}} {v}')
     # write-ladder attribution (ISSUE 11): mirror fan-out, transient
     # retries, resync replays and read-back verification failures as one
     # labeled family, so dashboards can plot write-path degradation

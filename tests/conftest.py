@@ -61,3 +61,6 @@ def _reset_config():
     # device arrays across tests otherwise); restore turns it back off
     from nvme_strom_tpu.serving.hbm_tier import hbm_tier
     hbm_tier.configure()
+    # the integrity domain caches the integrity mode at configure() time
+    from nvme_strom_tpu.integrity import domain
+    domain.configure()

@@ -1,0 +1,462 @@
+"""Resident-data integrity-domain tests (ISSUE 16, `integrity` marker).
+
+Covers the checksummed residency hierarchy end to end on the CPU
+engine: per-tier corruption detection (host ARC slab, HBM extent, KV
+spill block), transition verification (corrupt promote refused, corrupt
+demote never poisons the host tier), stale-under-lease semantics, the
+background scrubber's rate limiting, mirror self-healing of rotted
+spill blocks with member-attributed health debits, and the
+pressure-driven degradations: mlock-failure fail-open, memlock-budget
+shed (bulk QoS class first) and fill pass-through instead of ENOMEM.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import time
+import weakref
+
+import pytest
+
+from nvme_strom_tpu.api import StromError
+from nvme_strom_tpu.cache import ResidencyCache, residency_cache
+from nvme_strom_tpu.config import config
+from nvme_strom_tpu.integrity import domain, request_shed
+from nvme_strom_tpu.serving.hbm_tier import hbm_tier
+from nvme_strom_tpu.stats import stats
+from nvme_strom_tpu.testing import (FakeStripedNvmeSource, FaultPlan,
+                                    flip_resident_host)
+
+pytestmark = pytest.mark.integrity
+
+CHUNK = 64 << 10
+BB = 16 << 10            # KV block size
+
+
+def _counters():
+    return dict(stats.snapshot(reset_max=False).counters)
+
+
+def _cache_on(nbytes=64 << 20, mode="always"):
+    config.set("integrity", mode)
+    domain.configure()
+    config.set("cache_bytes", nbytes)
+    residency_cache.clear()
+    residency_cache.configure()
+
+
+def _pat(i: int, n: int = CHUNK) -> bytes:
+    return bytes([(i * 11 + 3) % 256]) * n
+
+
+# -- configuration and the off mode ------------------------------------------
+
+def test_integrity_var_validation():
+    for mode in ("off", "transitions", "always"):
+        config.set("integrity", mode)
+    with pytest.raises(Exception):
+        config.set("integrity", "paranoid")
+    with pytest.raises(Exception):
+        config.set("scrub_bytes_per_sec", -1)
+    with pytest.raises(Exception):
+        config.set("memlock_budget", -1)
+
+
+def test_integrity_off_is_zero_overhead():
+    """Under ``integrity=off`` entries carry no checksum and nothing is
+    ever verified — the default build pays one branch."""
+    _cache_on(mode="off")
+    before = _counters()
+    assert domain.checksum(b"abc") is None
+    assert residency_cache.fill(("#off",), 0, CHUNK, _pat(0))
+    lease = residency_cache.lookup(("#off",), 0, CHUNK)
+    out = bytearray(CHUNK)
+    assert lease.copy_into(out) and bytes(out) == _pat(0)
+    lease.release()
+    after = _counters()
+    assert after.get("nr_integrity_verify", 0) == \
+        before.get("nr_integrity_verify", 0)
+
+
+# -- host tier ---------------------------------------------------------------
+
+def test_host_corruption_detected_on_leased_read():
+    """integrity=always: a rotted slab fails its lease read open (False,
+    no bytes) and is dropped — the next lookup misses to SSD."""
+    _cache_on()
+    skey = ("#rot",)
+    assert residency_cache.fill(skey, 0, CHUNK, _pat(1))
+    before = _counters()
+    lease = residency_cache.lookup(skey, 0, CHUNK)
+    assert lease is not None
+    assert flip_resident_host(skey, 0, CHUNK, pos=123)
+    out = bytearray(CHUNK)
+    assert lease.copy_into(out) is False
+    lease.release()
+    after = _counters()
+    assert after["nr_integrity_fail"] > before.get("nr_integrity_fail", 0)
+    assert residency_cache.lookup(skey, 0, CHUNK) is None
+
+
+def test_host_scrub_extent_drops_stale_under_lease():
+    """A scrub mismatch on a leased slab marks it stale under its lease
+    rules: the holder's copy fails open, new lookups miss."""
+    _cache_on()
+    skey = ("#scrub",)
+    assert residency_cache.fill(skey, 0, CHUNK, _pat(2))
+    key = (skey, 0, CHUNK)
+    assert key in residency_cache.scrub_keys()
+    ok, length, _src = residency_cache.scrub_extent(key)
+    assert ok is True and length == CHUNK
+    lease = residency_cache.lookup(skey, 0, CHUNK)
+    assert flip_resident_host(skey, 0, CHUNK)
+    ok, _length, _src = residency_cache.scrub_extent(key)
+    assert ok is False
+    assert residency_cache.lookup(skey, 0, CHUNK) is None
+    assert lease.copy_into(bytearray(CHUNK)) is False
+    lease.release()
+
+
+# -- HBM tier ----------------------------------------------------------------
+
+def _hbm_on(nbytes):
+    config.set("hbm_cache_bytes", nbytes)
+    hbm_tier.configure()
+
+
+def test_hbm_corrupt_promote_refused():
+    """A promote carrying a crc that does not match its bytes never
+    lands device-resident."""
+    config.set("integrity", "always")
+    domain.configure()
+    _hbm_on(4 * CHUNK)
+    skey = ("#promote",)
+    bad = domain.checksum(b"not the payload")
+    assert hbm_tier.admit(skey, 0, CHUNK, _pat(3), crc=bad) is False
+    assert hbm_tier.lookup(skey, 0, CHUNK) is None
+    assert hbm_tier.admit(skey, 0, CHUNK, _pat(3))   # crc computed: lands
+    lease = hbm_tier.lookup(skey, 0, CHUNK)
+    out = bytearray(CHUNK)
+    assert lease.copy_into(out) and bytes(out) == _pat(3)
+    lease.release()
+
+
+def test_hbm_corrupt_demote_never_poisons_host():
+    """LRU demotion verifies the D2H copy: a rotted extent is discarded
+    instead of landing in the host tier; a clean sibling demotes."""
+    from nvme_strom_tpu.testing import flip_resident_hbm
+
+    _cache_on()
+    _hbm_on(2 * CHUNK)
+    skey = ("#demote",)
+    before = _counters()
+    assert hbm_tier.admit(skey, 0 * CHUNK, CHUNK, _pat(4))
+    assert hbm_tier.admit(skey, 1 * CHUNK, CHUNK, _pat(5))
+    assert flip_resident_hbm(skey, 0, CHUNK, pos=9)
+    assert hbm_tier.admit(skey, 2 * CHUNK, CHUNK, _pat(6))  # evicts extent 0
+    assert hbm_tier.admit(skey, 3 * CHUNK, CHUNK, _pat(7))  # evicts extent 1
+    after = _counters()
+    assert after["nr_integrity_fail"] > before.get("nr_integrity_fail", 0)
+    # the rotted extent vanished; the clean one demoted to the host tier
+    assert residency_cache.lookup(skey, 0, CHUNK) is None
+    lease = residency_cache.lookup(skey, 1 * CHUNK, CHUNK)
+    assert lease is not None
+    out = bytearray(CHUNK)
+    assert lease.copy_into(out) and bytes(out) == _pat(5)
+    lease.release()
+
+
+def test_hbm_scrub_skips_leased_working_set():
+    """The scrubber never walks leased (pinned) HBM extents — dropping
+    the KV working set out from under its leases is worse than rot."""
+    config.set("integrity", "always")
+    domain.configure()
+    _hbm_on(4 * CHUNK)
+    skey = ("#pinned",)
+    assert hbm_tier.admit(skey, 0, CHUNK, _pat(8))
+    key = (skey, 0, CHUNK)
+    assert key in hbm_tier.scrub_keys()
+    lease = hbm_tier.lookup(skey, 0, CHUNK)
+    assert key not in hbm_tier.scrub_keys()
+    lease.release()
+    assert key in hbm_tier.scrub_keys()
+
+
+# -- KV spill tier -----------------------------------------------------------
+
+def _spill_paths(tmp_path, rows, n=4, tag="sp"):
+    paths = []
+    for i in range(n):
+        p = str(tmp_path / f"{tag}{i}.bin")
+        with open(p, "wb") as f:
+            f.truncate(rows * BB)
+        paths.append(p)
+    return paths
+
+
+def _kv(i):
+    return bytes([(i * 7 + 1) % 256]) * BB
+
+
+def test_kv_pageout_pagein_crc_roundtrip(tmp_path):
+    """Every page-out/page-in transition re-verifies the block crc; a
+    clean spill round-trips with verifies counted and zero failures."""
+    from nvme_strom_tpu.engine import Session
+    from nvme_strom_tpu.serving.kvcache import KvBlockPool
+
+    config.set("integrity", "always")
+    domain.configure()
+    paths = _spill_paths(tmp_path, rows=4)
+    before = _counters()
+    with Session() as sess, \
+            FakeStripedNvmeSource(paths, BB, mirror="paired", writable=True,
+                                  force_cached_fraction=0.0) as spill:
+        pool = KvBlockPool(sess, spill, block_bytes=BB, ram_blocks=2,
+                           hbm_blocks=0)
+        for i in range(6):
+            pool.append("seq", _kv(i))
+        for i in range(6):
+            assert pool.read("seq", i) == _kv(i)
+        pool.close()
+    after = _counters()
+    assert after["nr_integrity_verify"] > \
+        before.get("nr_integrity_verify", 0)
+    assert after.get("nr_integrity_fail", 0) == \
+        before.get("nr_integrity_fail", 0)
+
+
+def test_kv_spill_rot_healed_from_mirror_with_member_debit(tmp_path):
+    """A spill block whose primary leg rots on disk pages in corrupt:
+    the heal re-reads the mirror leg, rewrites the primary, debits the
+    rotten member into QUARANTINED, and the read returns clean bytes."""
+    from nvme_strom_tpu.engine import Session
+    from nvme_strom_tpu.fault import HealthState
+    from nvme_strom_tpu.serving.kvcache import KvBlockPool
+
+    config.set("integrity", "always")
+    domain.configure()
+    config.set("canary_interval_s", 0.0)
+    config.set("quarantine_after", 1)
+    config.set("quarantine_s", 60.0)
+    rows = 4
+    paths = _spill_paths(tmp_path, rows)
+    plan = FaultPlan(corrupt_member_offsets={
+        0: {r * BB + 41 for r in range(rows)}})
+    before = _counters()
+    with Session() as sess, \
+            FakeStripedNvmeSource(paths, BB, fault_plan=plan,
+                                  mirror="paired", writable=True,
+                                  force_cached_fraction=0.0) as spill:
+        pool = KvBlockPool(sess, spill, block_bytes=BB, ram_blocks=2,
+                           hbm_blocks=0)
+        for i in range(6):
+            pool.append("seq", _kv(i))
+        for i in range(6):
+            assert pool.read("seq", i) == _kv(i)
+        assert sess._member_health.state(0) is HealthState.QUARANTINED
+        pool.close()
+    after = _counters()
+    assert after["nr_scrub_repair"] > before.get("nr_scrub_repair", 0)
+
+
+def test_kv_spill_rot_without_mirror_raises_ebadmsg(tmp_path):
+    """No mirror leg to heal from: a corrupt spill block is a hard
+    EBADMSG — the one place the domain cannot fail open, because no
+    other copy of the bytes exists."""
+    from nvme_strom_tpu.engine import Session
+    from nvme_strom_tpu.serving.kvcache import KvBlockPool
+
+    config.set("integrity", "always")
+    domain.configure()
+    rows = 4
+    paths = _spill_paths(tmp_path, rows)
+    plan = FaultPlan(corrupt_member_offsets={
+        m: {r * BB + 13 for r in range(rows)} for m in range(4)})
+    before = _counters()
+    with Session() as sess, \
+            FakeStripedNvmeSource(paths, BB, fault_plan=plan, writable=True,
+                                  force_cached_fraction=0.0) as spill:
+        pool = KvBlockPool(sess, spill, block_bytes=BB, ram_blocks=2,
+                           hbm_blocks=0)
+        for i in range(6):
+            pool.append("seq", _kv(i))
+        spilled = next(i for i, b in enumerate(pool._tables["seq"])
+                       if b.tier == "ssd")
+        with pytest.raises(StromError) as e:
+            pool.read("seq", spilled)
+        assert e.value.errno == errno.EBADMSG
+        pool.close()
+    after = _counters()
+    assert after["nr_scrub_fail"] > before.get("nr_scrub_fail", 0)
+
+
+# -- background scrubber -----------------------------------------------------
+
+def test_scrubber_rate_limited(tmp_path):
+    """``scrub_bytes_per_sec`` bounds the walk: with one extent's worth
+    of budget per second, a resident set of eight extents is scrubbed a
+    couple of extents at a time, not all at once."""
+    from nvme_strom_tpu.engine import Session
+
+    _cache_on()
+    config.set("scrub_bytes_per_sec", CHUNK)
+    skey = ("#rate",)
+    with Session():
+        for i in range(8):
+            assert residency_cache.fill(skey, i * CHUNK, CHUNK, _pat(i))
+        before = _counters().get("bytes_scrubbed", 0)
+        time.sleep(0.6)
+        delta = _counters().get("bytes_scrubbed", 0) - before
+    assert delta > 0, "scrubber never ran"
+    # 0.6s at CHUNK/s plus one-extent overshoot and the 1s carry cap
+    assert delta <= 4 * CHUNK, f"scrubbed {delta} bytes in 0.6s at " \
+        f"{CHUNK} B/s — the rate limit is not binding"
+
+
+def test_scrubber_idle_when_domain_off(tmp_path):
+    from nvme_strom_tpu.engine import Session
+
+    _cache_on(mode="off")
+    config.set("scrub_bytes_per_sec", 1 << 30)
+    skey = ("#idle",)
+    with Session():
+        for i in range(4):
+            assert residency_cache.fill(skey, i * CHUNK, CHUNK, _pat(i))
+        before = _counters().get("bytes_scrubbed", 0)
+        time.sleep(0.2)
+        assert _counters().get("bytes_scrubbed", 0) == before
+
+
+# -- pressure-driven degradation ---------------------------------------------
+
+def test_mlock_failure_counted_and_fails_open(monkeypatch):
+    """mlock(2) refusal (RLIMIT_MEMLOCK) keeps the slab — unpinned,
+    counted, gauged — and the fill still serves bytes."""
+    class _NoLock:
+        def mlock(self, addr, length):
+            return -1
+
+    import nvme_strom_tpu.cache as cache_mod
+    monkeypatch.setattr(cache_mod, "_libc", _NoLock())
+    _cache_on(mode="off")
+    before = _counters()
+    assert residency_cache.fill(("#nolock",), 0, CHUNK, _pat(9))
+    after = _counters()
+    assert after["nr_cache_mlock_fail"] > \
+        before.get("nr_cache_mlock_fail", 0)
+    assert residency_cache.unpinned_bytes() >= CHUNK
+    assert after.get("cache_unpinned_bytes", 0) >= CHUNK
+    lease = residency_cache.lookup(("#nolock",), 0, CHUNK)
+    out = bytearray(CHUNK)
+    assert lease.copy_into(out) and bytes(out) == _pat(9)
+    lease.release()
+
+
+def test_memlock_budget_sheds_and_passes_through(monkeypatch):
+    """Shrinking ``memlock_budget`` under the pinned bytes sheds slabs;
+    once at the budget, further fills degrade to pass-through (False +
+    counter), never an error."""
+    monkeypatch.setattr(ResidencyCache, "_try_pin",
+                        staticmethod(lambda mm, length: True))
+    _cache_on(mode="off")
+    skey = ("#budget",)
+    for i in range(4):
+        assert residency_cache.fill(skey, i * CHUNK, CHUNK, _pat(i))
+    assert residency_cache.pinned_bytes() == 4 * CHUNK
+    before = _counters()
+    config.set("memlock_budget", CHUNK)
+    residency_cache.configure()
+    assert residency_cache.pinned_bytes() <= CHUNK
+    after = _counters()
+    assert after["nr_pressure_shed"] > before.get("nr_pressure_shed", 0)
+    # at the budget: the next fill is refused and counted, not raised
+    assert residency_cache.fill(skey, 8 * CHUNK, CHUNK, _pat(8)) is False
+    final = _counters()
+    assert final["nr_pressure_passthrough"] > \
+        after.get("nr_pressure_passthrough", 0)
+
+
+def test_pressure_shed_orders_bulk_before_latency(tmp_path):
+    """KV pressure shed follows the PR 12 QoS classes: bulk sequences
+    demote to SSD before latency ones."""
+    from nvme_strom_tpu.engine import Session
+    from nvme_strom_tpu.serving.kvcache import KvBlockPool
+
+    config.set("integrity", "transitions")
+    domain.configure()
+    paths = _spill_paths(tmp_path, rows=4)
+    with Session() as sess, \
+            FakeStripedNvmeSource(paths, BB, mirror="paired", writable=True,
+                                  force_cached_fraction=0.0) as spill:
+        pool = KvBlockPool(sess, spill, block_bytes=BB, ram_blocks=4,
+                           hbm_blocks=0)
+        for i in range(2):
+            pool.append("lat", _kv(i), qos_class="latency")
+        for i in range(2):
+            pool.append("blk", _kv(i + 2), qos_class="bulk")
+        before = _counters()
+        shed = pool.shed(BB)
+        assert shed >= BB
+        assert any(b.tier == "ssd" for b in pool._tables["blk"]), \
+            "no bulk block was shed"
+        assert all(b.tier != "ssd" for b in pool._tables["lat"]), \
+            "a latency block shed before the bulk class was drained"
+        after = _counters()
+        assert after["nr_pressure_shed"] > before.get("nr_pressure_shed", 0)
+        # the shed blocks still read back (paged in on demand)
+        for i in range(2):
+            assert pool.read("blk", i) == _kv(i + 2)
+        pool.close()
+
+
+def test_request_shed_registry_never_raises():
+    """The pressure registry sheds across registered pools and swallows
+    a broken pool instead of surfacing new errors on the reader path."""
+    from nvme_strom_tpu.integrity import register_pool
+
+    class _Broken:
+        def shed(self, nbytes, *, reason="memlock"):
+            raise RuntimeError("boom")
+
+    class _Good:
+        def __init__(self):
+            self.asked = 0
+
+        def shed(self, nbytes, *, reason="memlock"):
+            self.asked += nbytes
+            return nbytes
+
+    broken, good = _Broken(), _Good()
+    register_pool(broken)
+    register_pool(good)
+    assert request_shed(4096) >= 4096
+    assert good.asked >= 4096
+
+
+def test_scrub_refill_source_gone_counts_fail():
+    """A corrupt host slab whose source has been closed (weakref dead or
+    source closed) cannot be healed: the scrubber counts a scrub fail
+    and the entry stays dropped — never served corrupt."""
+    from nvme_strom_tpu.engine import Session
+
+    _cache_on()
+    config.set("scrub_bytes_per_sec", 1 << 30)
+    skey = ("#gone",)
+
+    class _Closed:
+        closed = True
+        size = 0
+
+    src = _Closed()
+    with Session():
+        assert residency_cache.fill(skey, 0, CHUNK, _pat(1),
+                                    source_ref=weakref.ref(src))
+        before = _counters().get("nr_scrub_fail", 0)
+        assert flip_resident_host(skey, 0, CHUNK)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                _counters().get("nr_scrub_fail", 0) <= before:
+            time.sleep(0.02)
+        assert _counters().get("nr_scrub_fail", 0) > before
+    assert residency_cache.lookup(skey, 0, CHUNK) is None
