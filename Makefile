@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke probe-loop lint-strom sanitize sanitize-smoke clean
+.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -159,6 +159,18 @@ scrub-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.scrub_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py -q -m integrity
 
+# Multichip gate (ISSUE 17): sharded loading over 1/2/4 virtual hosts
+# on the latency-bound synthetic must scale aggregate GB/s >= 1.6x at
+# 2 hosts and >= 2.8x at 4 (every page one serialized latency-bearing
+# request per host session), the gathered array must equal the file
+# bytes at every host count, and the 2-host sharded cold-start wall
+# must be <= 0.6x single-host.  Journals to MULTICHIP_SCALING.jsonl;
+# the `multihost` pytest marker rides along.  Override
+# STROM_MULTICHIP_GATE_RATIO2 / _RATIO4 / _COLD_RATIO / _ROUNDS.
+multichip-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.multichip_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_shardload.py -q -m multihost
+
 # stromlint (ISSUE 10): the project-invariant static checker — lock
 # discipline, buffer lifetimes, native-ABI drift against csrc/strom_tpu.h,
 # stats/trace surface completeness, config hygiene.  Zero unsuppressed
@@ -191,7 +203,7 @@ sanitize-smoke:
 # then tier-1 tests plus the perf smokes, the seeded member-survival
 # schedules, the trace-overhead, landing and cache gates, and the
 # short sanitizer pass.
-check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

@@ -128,6 +128,38 @@ for _ in range(3):   # best-of-3 (shared-host disk noise)
 print(f"GBPS={{best/(1<<30):.3f}}")
 """
 
+_MULTIHOST = _COMMON + """
+# multi-host sharded load (ISSUE 17): per-host engine sessions read the
+# ownership-split chunk grid concurrently and the landed shards
+# redistribute over the mesh ring — the row is END-TO-END aggregate
+# GB/s including the on-fabric move, the number the multichip gate
+# holds scaling ratios on
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+from nvme_strom_tpu.engine import PlainSource
+from nvme_strom_tpu.parallel.mesh import make_scan_mesh
+from nvme_strom_tpu.parallel.shardload import load_pages_multihost
+from nvme_strom_tpu.scan.heap import PAGE_SIZE
+path = {path!r}
+make_test_file(path, size) if not (os.path.exists(path) and os.path.getsize(path) == size) else None
+mesh = make_scan_mesh(sp=1)
+n_dev = mesh.shape["dp"]
+hosts = {hosts}
+if n_dev % hosts or (size // PAGE_SIZE) % n_dev:
+    print(f"SKIP={{n_dev}} devices cannot host-shard {{hosts}} ways")
+    raise SystemExit(0)
+best = 0.0
+for _ in range(3):   # round 1 also absorbs the redistribute compile
+    drop_page_cache(path)
+    with PlainSource(path) as src:
+        t0 = time.monotonic()
+        out = load_pages_multihost(src, mesh, hosts=hosts)
+        out.block_until_ready()
+        best = max(best, size / (time.monotonic() - t0))
+print(f"GBPS={{best/(1<<30):.3f}}")
+"""
+
 _SCAN = _COMMON + """
 import jax
 from nvme_strom_tpu.scan.heap import HeapSchema, build_heap_file, PAGE_SIZE
@@ -597,6 +629,8 @@ def main() -> int:
          {"STROM_TPU_QUEUE_DEPTH": "32"}),
         ("raid0_4x", "4-member RAID-0 -> pinned RAM",
          _RAID0.format(size=size, path=base), None),
+        ("multihost_2x", "2-host sharded load + on-fabric redistribute",
+         _MULTIHOST.format(size=size, path=base + ".bin", hosts=2), None),
         ("scan_filter", "heap scan -> HBM + pallas filter",
          _SCAN.format(size=size, path=base), None),
         ("filter_pallas_chip", "on-chip pallas filter kernel",

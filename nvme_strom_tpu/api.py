@@ -347,6 +347,16 @@ STAT_FIELDS: Tuple[str, ...] = (
     "nr_pressure_shed",       # residents shed under memlock/HBM pressure
     "nr_pressure_passthrough",  # fills refused under pressure (reads pass
     #                           through to SSD instead of ENOMEM)
+    # multi-host scale-out (ISSUE 17): sharded loading + on-fabric moves
+    "nr_shard_load",          # per-host local shard reads completed
+    "bytes_shard_load",       # bytes read through per-host shard sessions
+    "nr_ici_permute",         # ring-permute rotation steps executed
+    "bytes_ici",              # bytes moved device-to-device over the ring
+    "nr_shard_wait",          # per-shard completion fan-in waits observed
+    "clk_shard_wait",         # total submit->completion wait (straggler
+    #                           attribution; per-shard histogram in export)
+    "nr_kv_migrate",          # KV chains migrated to a peer host's pool
+    "nr_kv_migrate_fail",     # migrations rolled back (peer append failed)
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

@@ -91,6 +91,11 @@ def _check_io_backend(val: str, _cfg: "Config") -> None:
         raise ConfigError(f"io_backend must be auto|io_uring|threadpool|python, got {val!r}")
 
 
+def _check_ici_permute(val: str, _cfg: "Config") -> None:
+    if val not in ("auto", "pallas", "xla"):
+        raise ConfigError(f"ici_permute must be auto|pallas|xla, got {val!r}")
+
+
 def _check_h2d_path(val: str, _cfg: "Config") -> None:
     if val not in ("auto", "plain", "pinned_host"):
         raise ConfigError(f"h2d_path must be auto|plain|pinned_host, "
@@ -498,6 +503,33 @@ class Config:
                      "N+1's SSD reads land in its own LandingBuffer "
                      "while layer N's buffers are adopted as device "
                      "arrays (double-buffered default)"))
+        # multi-host scale-out (ISSUE 17): sharded SSD loading + on-fabric
+        # shard movement
+        reg(Var("shard_hosts", 0, "int", minval=0, maxval=4096,
+                help="virtual/physical host count the sharded loading "
+                     "paths plan ownership for: each host's engine "
+                     "session reads only the extent shards its local "
+                     "NVMe set holds (member % shard_hosts, "
+                     "stripe.host_of) before the on-fabric "
+                     "redistribution.  0 (default) = single-host "
+                     "planning unless a call site passes hosts "
+                     "explicitly"))
+        reg(Var("ici_permute", "auto", "str",
+                validate=_check_ici_permute,
+                help="transport for the device-to-device ring permute "
+                     "that redistributes shards after a multi-host "
+                     "load: 'pallas' = semaphore-paired async remote "
+                     "DMA (pltpu.make_async_remote_copy) on HBM-resident "
+                     "blocks, 'xla' = jax.lax.ppermute (the only "
+                     "transport off-TPU, and the byte oracle for the "
+                     "pallas lane), 'auto' = pallas iff the backend is "
+                     "TPU"))
+        reg(Var("kv_migrate", True, "bool",
+                help="allow cross-host KV-block migration: a hot host "
+                     "sheds whole sequence chains to a cold peer pool "
+                     "over the remote-copy lane (KvBlockPool.migrate/"
+                     "shed_to_peer); off refuses with EOPNOTSUPP so a "
+                     "fleet can pin sequences to their home host"))
         # flight recorder + end-to-end task tracing (PR 7)
         reg(Var("trace_policy", "off", "str",
                 help="per-task span tracing into the flight recorder: "

@@ -76,6 +76,7 @@ from .stripe import StripeMap
 __all__ = [
     "check_file", "Source", "PlainSource", "SegmentedSource", "StripedSource",
     "DmaBuffer", "Session", "Request", "plan_requests", "open_source",
+    "plan_shard_ownership",
 ]
 
 PAGE_SIZE = mmap.PAGESIZE
@@ -1053,6 +1054,52 @@ def read_chunk_ids(sess: "Session", source: Source,
     return reorder_chunks(
         np.frombuffer(buf_view[:len(ids) * chunk_size], np.uint8),
         chunk_size, res.chunk_ids, ids)
+
+
+def plan_shard_ownership(source: Source, chunk_ids: Sequence[int],
+                         chunk_size: int, n_hosts: int
+                         ) -> Dict[int, List[int]]:
+    """Partition a chunk list by host ownership for the multi-host
+    sharded loader (ISSUE 17): host -> the chunks whose first extent
+    lives on a member that host's local NVMe set holds, under the
+    :func:`..stripe.host_of` member%n_hosts map.  Each host then submits
+    ONLY its own list through its own engine session, so a striped
+    deployment divides the file across per-host device queues the way
+    the reference divides it across one host's md-RAID-0 members
+    (`kmod/nvme_strom.c:823-910`).
+
+    Single-member (plain/segmented-to-one-fd) sources have no placement
+    to follow, so the split degrades to contiguous near-equal chunk
+    ranges — still disjoint and exhaustive, which is all the gather
+    step needs.  Every input chunk lands in exactly one host's list;
+    hosts owning no member of a narrow stripe get empty lists.
+    """
+    from .stripe import host_of
+    n_hosts = max(int(n_hosts), 1)
+    ids = [int(c) for c in chunk_ids]
+    owned: Dict[int, List[int]] = {h: [] for h in range(n_hosts)}
+    n_members = len(source.member_fds())
+    if n_members < 2 or n_hosts < 2:
+        if n_hosts < 2:
+            owned[0] = ids
+            return owned
+        # contiguous near-equal ranges: host h takes ids[h*q+...:...]
+        q, r = divmod(len(ids), n_hosts)
+        pos = 0
+        for h in range(n_hosts):
+            take = q + (1 if h < r else 0)
+            owned[h] = ids[pos:pos + take]
+            pos += take
+        return owned
+    for cid in ids:
+        off = cid * chunk_size
+        length = min(chunk_size, max(source.size - off, 0))
+        if length <= 0:
+            owned[host_of(0, n_hosts)].append(cid)
+            continue
+        member = source.extents(off, length)[0].member
+        owned[host_of(member, n_hosts)].append(cid)
+    return owned
 
 
 # ---------------------------------------------------------------------------

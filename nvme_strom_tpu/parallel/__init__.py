@@ -1,6 +1,8 @@
 from .dscan import make_distributed_scan_step, shard_pages
 from .mesh import make_scan_mesh, pages_sharding
-from .ring import make_ring_multi_query_scan
+from .ring import (make_ring_multi_query_scan, permute_backend,
+                   ring_all_gather, ring_permute_step)
+from .shardload import load_pages_multihost, shard_ownership
 from .sort import make_distributed_distinct, make_distributed_sort
 from .stream import (ShardedBatchStream, distributed_scan_filter,
                      load_pages_sharded)
@@ -8,5 +10,6 @@ from .stream import (ShardedBatchStream, distributed_scan_filter,
 __all__ = ["make_distributed_scan_step", "shard_pages", "make_scan_mesh",
            "pages_sharding", "make_ring_multi_query_scan",
            "make_distributed_sort", "make_distributed_distinct",
-           "load_pages_sharded",
+           "load_pages_sharded", "load_pages_multihost", "shard_ownership",
+           "permute_backend", "ring_permute_step", "ring_all_gather",
            "ShardedBatchStream", "distributed_scan_filter"]

@@ -30,11 +30,151 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ._compat import shard_map
+from ..config import config
 from ..ops.filter_xla import DEFAULT_SCHEMA, decode_pages
 from ..scan.heap import HeapSchema
 from .mesh import make_scan_mesh
 
-__all__ = ["make_ring_multi_query_scan", "ring_scan_source"]
+__all__ = ["make_ring_multi_query_scan", "ring_scan_source",
+           "permute_backend", "ring_permute_step", "ring_all_gather"]
+
+
+def _mark_varying(x, axis: str):
+    """Mark *x* as axis-varying so scan carries type-match a rotating
+    (varying) block.  jax grew ``pcast`` (newest), then ``pvary``; on
+    versions with neither the carry types already unify without an
+    explicit annotation, so identity is the correct fallback."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Generalized ring permute (ISSUE 17): one rotation step usable inside any
+# shard_map'ed body.  Two transports behind one call:
+#
+# * ``pallas`` — a Pallas kernel built on ``pltpu.make_async_remote_copy``
+#   (SNIPPETS.md [2] shape): src/dst refs live in TPUMemorySpace.ANY (HBM —
+#   the landing buffers the sharded loader adopts are HBM-resident), a
+#   paired send/recv DMA-semaphore pledge fences the device-to-device copy,
+#   and the neighbour is addressed by LOGICAL device id computed from the
+#   mesh axis index — the transfer rides ICI without bouncing through the
+#   host exchange path.
+# * ``xla`` — ``jax.lax.ppermute``, the collective XLA lowers to the same
+#   ICI rotation on TPU and to a mesh copy on the CPU virtual mesh; it is
+#   the correctness oracle the pallas path must match and the only
+#   transport a non-TPU backend can run.
+#
+# ``config ici_permute`` picks: ``auto`` (pallas on a TPU backend, xla
+# elsewhere), or pin either for A/B and tests.
+# ---------------------------------------------------------------------------
+
+def permute_backend(backend: Optional[str] = None) -> str:
+    """Resolve the ring-permute transport: explicit *backend* wins, else
+    ``config ici_permute`` (``auto`` = pallas iff running on TPU)."""
+    b = backend or str(config.get("ici_permute"))
+    if b == "auto":
+        b = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if b not in ("pallas", "xla"):
+        raise ValueError(f"ici_permute backend {b!r} (want pallas|xla|auto)")
+    return b
+
+
+def _pallas_permute_step(block, axis: str, ring: int):
+    """One +1 ring rotation as semaphore-paired async remote DMA."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(src_ref, dst_ref, send_sem, recv_sem):
+        # neighbour by LOGICAL id from this device's own axis position:
+        # the kernel is mesh-shape generic, nothing is baked in
+        me = jax.lax.axis_index(axis)
+        copy = pltpu.make_async_remote_copy(
+            src_ref=src_ref, dst_ref=dst_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=jax.lax.rem(me + 1, ring),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        copy.start()
+        copy.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+    )
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
+        grid_spec=grid_spec)(block)
+
+
+def ring_permute_step(block, *, axis: str, ring: int,
+                      backend: Optional[str] = None):
+    """Rotate *block* one step (+1) around the *axis* ring; call from
+    INSIDE a shard_map'ed body.  The two transports are byte-equivalent;
+    only the lane differs (Pallas remote DMA vs the XLA collective)."""
+    if permute_backend(backend) == "pallas":
+        return _pallas_permute_step(block, axis, ring)
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+    return jax.lax.ppermute(block, axis, perm)
+
+
+#: compiled ring programs keyed by (mesh, axis, shape, dtype, transport).
+#: The sharded loader and the cold-start handshake call per batch; a
+#: fresh closure per call would defeat jax's jit cache and pay a full
+#: retrace each time — on the latency-bound gate the retrace would cost
+#: more than the I/O being measured.  Meshes hash by value, so
+#: same-shape calls across Mesh instances share one program.
+_ring_jit_cache: dict = {}
+
+
+def ring_all_gather(arr, mesh: Mesh, *, axis: str = "dp",
+                    backend: Optional[str] = None):
+    """All-gather an ``P(axis, ...)``-sharded global array by ring
+    rotation: after ``ring-1`` permute steps every device has placed
+    every shard, so the result is fully replicated.  This is the
+    on-fabric gather lane the sharded cold-start ends with — shards
+    move device-to-device over ICI (pallas) or the ppermute collective
+    (xla), never through host exchange.  Returns the gathered array
+    (leading axis = ring * shard_rows), replicated over *axis*."""
+    ring = mesh.shape[axis]
+    backend = permute_backend(backend)
+    key = ("gather", mesh, axis, tuple(arr.shape), str(arr.dtype), backend)
+    cached = _ring_jit_cache.get(key)
+    if cached is not None:
+        return cached(arr)
+
+    def _local(x):
+        rows = x.shape[0]
+        me = jax.lax.axis_index(axis)
+        out = jnp.zeros((ring * rows,) + x.shape[1:], x.dtype)
+
+        def body(carry, step):
+            block, out = carry
+            # after s rotations the resident block originated at
+            # (me - s) mod ring — place it at that shard's row range
+            src = jax.lax.rem(me - step + ring, ring)
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, block, src * rows, axis=0)
+            block = ring_permute_step(block, axis=axis, ring=ring,
+                                      backend=backend)
+            return (block, out), None
+
+        (block, out), _ = jax.lax.scan(
+            body, (x, _mark_varying(out, axis)),
+            jnp.arange(ring, dtype=jnp.int32))
+        return out
+
+    n_spec = (None,) * (arr.ndim - 1)
+    fn = jax.jit(shard_map(
+        _local, mesh=mesh,
+        in_specs=P(axis, *n_spec),
+        out_specs=P(*((None,) + n_spec)),
+        check_rep=False))
+    _ring_jit_cache[key] = fn
+    return fn(arr)
 
 
 def make_ring_multi_query_scan(devices: Optional[Sequence[jax.Device]] = None,
@@ -73,15 +213,9 @@ def make_ring_multi_query_scan(devices: Optional[Sequence[jax.Device]] = None,
 
         # accumulators are per-device state: mark them dp-varying so the
         # scan carry types match the rotating (varying) block
-        if hasattr(jax.lax, "pcast"):
-            def mark(x):
-                return jax.lax.pcast(x, "dp", to="varying")
-        else:  # older jax
-            def mark(x):
-                return jax.lax.pvary(x, "dp")
         init = (pages_u8,
-                mark(jnp.int32(0)),
-                mark(jnp.zeros((n_cols,), jnp.int32)))
+                _mark_varying(jnp.int32(0), "dp"),
+                _mark_varying(jnp.zeros((n_cols,), jnp.int32), "dp"))
         (block, count, sums), _ = jax.lax.scan(body, init, None, length=ring)
         # leading axis 1: shard_map concatenates over the mesh into (dp,...)
         return {"count": count[None], "sums": sums[None]}

@@ -17,6 +17,7 @@ the full table.
 from __future__ import annotations
 
 import errno as _errno
+import time
 from typing import List, Optional
 
 import jax
@@ -27,6 +28,8 @@ from ..api import StromError
 from ..engine import Session, Source, reorder_chunks
 from ..hbm.staging import safe_device_put
 from ..scan.heap import PAGE_SIZE
+from ..stats import stats
+from ..trace import recorder
 
 __all__ = ["load_pages_sharded", "ShardedBatchStream", "distributed_scan_filter"]
 
@@ -142,11 +145,24 @@ class ShardedBatchStream:
             handle, _buf = self._bufs[k][ring]
             res = self.session.memcpy_ssd2ram(
                 self.source, handle, list(range(r0, r1)), PAGE_SIZE)
-            tasks.append((dev, res))
+            # submit timestamp rides with the task: the fan-in loop below
+            # turns it into the per-shard wait distribution
+            tasks.append((dev, res, time.monotonic_ns()))
         return ring, tasks
 
     def _collect(self, ring, tasks) -> jax.Array:
         shards: List[Optional[jax.Array]] = [None] * len(tasks)
+
+        def account(k) -> None:
+            # straggler attribution (ISSUE 17): the batch is gated on its
+            # SLOWEST shard, so record each shard's submit->completion
+            # wait where the aggregate histogram can't smear it — one
+            # log2-ns histogram per mesh shard plus a flight-recorder span
+            t1 = time.monotonic_ns()
+            stats.shard_wait(k, t1 - tasks[k][2])
+            if recorder.active:
+                recorder.span("shard_wait", tasks[k][2], t1,
+                              args={"shard": k})
 
         def place(k, done) -> None:
             _handle, buf = self._bufs[k][ring]
@@ -173,12 +189,15 @@ class ShardedBatchStream:
                     if e.errno == _errno.ETIMEDOUT:
                         continue
                     raise
+                account(k)
                 place(k, done)
                 remaining.remove(k)
                 progressed = True
             if remaining and not progressed:
                 k = remaining.pop(0)
-                place(k, self.session.memcpy_wait(tasks[k][1].dma_task_id))
+                done = self.session.memcpy_wait(tasks[k][1].dma_task_id)
+                account(k)
+                place(k, done)
         arr = jax.make_array_from_single_device_arrays(
             self._shape, self.sharding, shards)
         self._fence[ring] = arr

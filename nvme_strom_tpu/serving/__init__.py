@@ -20,6 +20,7 @@ Three legs, layered strictly on the existing machinery:
 
 from .hbm_tier import HbmLease, HbmResidencyTier, hbm_tier
 from .kvcache import KvBlockPool
-from .weights import StreamedModel, stream_weights
+from .weights import StreamedModel, stream_weights, stream_weights_sharded
 
-__all__ = ["HbmLease", "HbmResidencyTier", "hbm_tier"]
+__all__ = ["HbmLease", "HbmResidencyTier", "hbm_tier", "KvBlockPool",
+           "StreamedModel", "stream_weights", "stream_weights_sharded"]

@@ -269,6 +269,97 @@ class KvBlockPool:
                     self._ssd_free.append(blk.slot)
                 self._lru.pop(blk.gid, None)
 
+    def migrate(self, seq, peer: "KvBlockPool", *,
+                release: bool = True) -> int:
+        """Move sequence *seq*'s whole chain into *peer*'s pool (the
+        cross-host KV migration lane, ISSUE 17: on a multi-host serving
+        mesh each host runs its own pool over its own local spill, and a
+        hot host sheds chains to a cold peer instead of thrashing its
+        own tiers).
+
+        All-or-nothing: blocks are copied out through the read path (so
+        spilled blocks page in via the fault ladder) while the SOURCE
+        chain stays intact, then appended to the peer in order with the
+        sequence's QoS class preserved.  A mid-migration peer failure
+        rolls the peer back (``peer.release``) and raises — the source
+        is untouched and still SSD-resumable, so a crashed destination
+        host loses nothing.  Only after the peer holds every block is
+        the source chain released (``release=False`` keeps it, e.g. for
+        a read-only replica).  Returns the bytes migrated."""
+        if not bool(config.get("kv_migrate")):
+            raise StromError(_errno.EOPNOTSUPP,
+                             "cross-host KV migration disabled (kv_migrate)")
+        if peer is self:
+            raise StromError(_errno.EINVAL,
+                             "cannot migrate a sequence onto its own pool")
+        if self.block_bytes > peer.block_bytes:
+            raise StromError(
+                _errno.EINVAL,
+                f"peer blocks ({peer.block_bytes}B) smaller than "
+                f"ours ({self.block_bytes}B)")
+        t0 = time.monotonic_ns()
+        with self._lock:
+            self._check_open()
+            if seq not in self._tables:
+                raise StromError(_errno.ENOENT, f"no sequence {seq!r}")
+            qos = self._classes.get(seq,
+                                    str(config.get("qos_default_class")))
+            n = len(self._tables[seq])
+        if peer.blocks(seq):
+            raise StromError(_errno.EEXIST,
+                             f"peer already holds sequence {seq!r}")
+        # copy-out happens under OUR lock per block; peer.append runs
+        # under the PEER's lock only — never both at once, so two pools
+        # migrating toward each other cannot deadlock
+        try:
+            for i in range(n):
+                peer.append(seq, self.read(seq, i), qos_class=qos)
+        except BaseException:
+            stats.add("nr_kv_migrate_fail")
+            try:
+                peer.release(seq)
+            except Exception:  # noqa: BLE001 - rollback is best-effort
+                pass
+            raise
+        if release:
+            self.release(seq)
+        stats.add("nr_kv_migrate")
+        if _trace.active:
+            _trace.span("kv_migrate", t0, time.monotonic_ns(),
+                        length=n * self.block_bytes,
+                        args={"blocks": n, "class": qos,
+                              "released": release})
+        return n * self.block_bytes
+
+    def shed_to_peer(self, peer: "KvBlockPool", nbytes: int, *,
+                     reason: str = "pressure") -> int:
+        """Hot-host pressure relief over the fabric: migrate whole
+        chains to a cold peer until ~*nbytes* have moved, bulk-class
+        sequences first (the :data:`_SHED_ORDER` ladder — latency
+        chains keep their local placement longest).  Chains the peer
+        cannot take (full tiers, duplicate key) are skipped, never
+        raised: like :meth:`shed`, this sheds what it can."""
+        with self._lock:
+            if self._closed:
+                return 0
+            seqs = sorted(
+                self._tables,
+                key=lambda s: _SHED_ORDER.get(
+                    self._classes.get(s, "normal"), 1))
+        shed = 0
+        for seq in seqs:
+            if shed >= nbytes:
+                break
+            try:
+                moved = self.migrate(seq, peer)
+            except StromError:
+                continue
+            shed += moved
+            if _trace.active:
+                _trace.instant("pressure_shed", length=moved,
+                               args={"tier": "kv-peer", "reason": reason})
+        return shed
+
     def close(self) -> None:
         with self._lock:
             if self._closed:

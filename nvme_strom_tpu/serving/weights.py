@@ -30,9 +30,10 @@ from __future__ import annotations
 
 import errno as _errno
 import re
+import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -41,7 +42,7 @@ from ..config import config
 from ..stats import stats
 from ..trace import recorder as _trace
 
-__all__ = ["StreamedModel", "stream_weights"]
+__all__ = ["StreamedModel", "stream_weights", "stream_weights_sharded"]
 
 _ALIGN = 4096
 #: layer index from a leaf key: "...layers.12...", "...layer_3...",
@@ -152,32 +153,22 @@ def _plan_layers(meta: dict) -> List[_Layer]:
     return layers
 
 
-def stream_weights(path: str, *, session=None, source=None, device=None,
-                   verify: bool = True, depth: Optional[int] = None,
-                   chunk_size: int = _ALIGN) -> StreamedModel:
-    """Cold-start a model: stream checkpoint *path* layer-ordered into
-    donated HBM weight buffers, ``depth`` layers in flight
-    (``weight_stream_depth`` default).  ``verify`` recomputes each
-    leaf's crc32c against the manifest before adoption (PR 11; leaves
-    without a stored checksum are skipped).  *source* overrides the
-    file source (the coldstart gate injects a latency-bound fake)."""
-    import jax
-    from ..data.checkpoint import checkpoint_info
-    from ..engine import Session, open_source
+def _stream_layer_subset(path: str, layers: List[_Layer], *, sess, src,
+                         dev, verify: bool, depth: int, chunk_size: int,
+                         host: Optional[int] = None) -> None:
+    """The layer-pipelined submit→verify→adopt loop over one subset of
+    spans: ``depth`` layers in flight through ONE session, each retired
+    layer crc-verified against the manifest and adopted via the PR 8
+    landing ladder.  Shared verbatim between the single-host streamer
+    (subset = every layer) and each host thread of the sharded
+    cold-start (subset = that host's round-robin slice) — the pipeline
+    is the invariant, only the span ownership differs.  Fills
+    ``ly.handle`` per layer; on failure drains ITS in-flight reads and
+    unmaps ITS adoptions, then re-raises."""
     from ..hbm.registry import LandingBuffer, registry
     from ..scan.heap import crc32c as _crc
 
-    meta = checkpoint_info(path)
-    layers = _plan_layers(meta)
-    depth = depth or int(config.get("weight_stream_depth"))
-    own_sess = session is None
-    sess = session or Session()
-    own_src = source is None
-    src = source or open_source(path)
-    dev = device or jax.local_devices()[0]
-    total = sum(ly.nbytes for ly in layers)
     inflight: deque = deque()   # (layer, landing, task_id, t_submit)
-    t0 = time.monotonic_ns()
 
     def _retire() -> None:
         ly, landing, task, ts = inflight.popleft()
@@ -207,11 +198,12 @@ def stream_weights(path: str, *, session=None, source=None, device=None,
             landing.release()
             raise
         if _trace.active:
+            args = {"layer": ly.index, "label": ly.label,
+                    "leaves": len(ly.leaves)}
+            if host is not None:
+                args["host"] = host
             _trace.span("weight_stream", ts, time.monotonic_ns(),
-                        offset=ly.base, length=ly.nbytes,
-                        args={"layer": ly.index,
-                              "label": ly.label,
-                              "leaves": len(ly.leaves)})
+                        offset=ly.base, length=ly.nbytes, args=args)
 
     try:
         for ly in layers:
@@ -232,6 +224,7 @@ def stream_weights(path: str, *, session=None, source=None, device=None,
             _retire()
     except BaseException:
         # drain whatever is still in flight, then unwind the adoptions
+        from ..hbm.registry import registry
         while inflight:
             ly, landing, task, _ = inflight.popleft()
             try:
@@ -247,11 +240,170 @@ def stream_weights(path: str, *, session=None, source=None, device=None,
                     pass
                 ly.handle = 0
         raise
+
+
+def stream_weights(path: str, *, session=None, source=None, device=None,
+                   verify: bool = True, depth: Optional[int] = None,
+                   chunk_size: int = _ALIGN) -> StreamedModel:
+    """Cold-start a model: stream checkpoint *path* layer-ordered into
+    donated HBM weight buffers, ``depth`` layers in flight
+    (``weight_stream_depth`` default).  ``verify`` recomputes each
+    leaf's crc32c against the manifest before adoption (PR 11; leaves
+    without a stored checksum are skipped).  *source* overrides the
+    file source (the coldstart gate injects a latency-bound fake)."""
+    import jax
+    from ..data.checkpoint import checkpoint_info
+    from ..engine import Session, open_source
+
+    meta = checkpoint_info(path)
+    layers = _plan_layers(meta)
+    depth = depth or int(config.get("weight_stream_depth"))
+    own_sess = session is None
+    sess = session or Session()
+    own_src = source is None
+    src = source or open_source(path)
+    dev = device or jax.local_devices()[0]
+    total = sum(ly.nbytes for ly in layers)
+    t0 = time.monotonic_ns()
+    try:
+        _stream_layer_subset(path, layers, sess=sess, src=src, dev=dev,
+                             verify=verify, depth=depth,
+                             chunk_size=chunk_size)
     finally:
         if own_src:
             src.close()
         if own_sess:
             sess.close()
+    elapsed = max(time.monotonic_ns() - t0, 1)
+    stats.gauge_set("coldstart_bytes_per_sec",
+                    int(total * 1_000_000_000 / elapsed))
+    return StreamedModel(path, layers)
+
+
+def _digest_handshake(layers: List[_Layer], hosts: int,
+                      backend: Optional[str]) -> None:
+    """The on-fabric end of the sharded cold-start: every host
+    contributes a digest row covering ITS layers (span base ^ length,
+    +1 so a zero-offset layer still registers) and the rows all-gather
+    around the hosts ring (:func:`..parallel.ring.ring_all_gather` —
+    Pallas remote DMA on TPU, the ppermute collective elsewhere).  Each
+    host then checks the summed gathered rows against the full
+    manifest-derived expectation: a host that adopted nothing, or a
+    layer nobody streamed, fails the handshake BEFORE the model is
+    handed to serving.  On a real mesh this is also where the weight
+    shards themselves all-gather; the digest rides the same lane and
+    the same accounting (``nr_ici_permute``/``bytes_ici``)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from ..parallel.ring import permute_backend, ring_all_gather
+
+    if len(jax.local_devices()) < hosts:
+        return                  # no fabric to cross (single-device CI)
+    mesh = Mesh(np.array(jax.local_devices()[:hosts]), ("hosts",))
+    n = len(layers)
+    rows = np.zeros((hosts, n), np.int64)
+    for ly in layers:
+        rows[ly.index % hosts, ly.index] = (ly.base ^ ly.nbytes) + 1
+    arr = jax.device_put(rows, NamedSharding(mesh, P("hosts", None)))
+    ts = time.monotonic_ns()
+    gathered = ring_all_gather(arr, mesh, axis="hosts", backend=backend)
+    got = np.asarray(gathered).sum(axis=0)
+    moved = hosts * hosts * n * rows.itemsize
+    stats.add("nr_ici_permute", hosts)
+    stats.add("bytes_ici", moved)
+    if _trace.active:
+        _trace.span("ici_permute", ts, time.monotonic_ns(), length=moved,
+                    args={"steps": hosts, "ring": hosts,
+                          "backend": permute_backend(backend),
+                          "hosts": hosts, "gather": True,
+                          "what": "weight_digest"})
+    want = np.array([(ly.base ^ ly.nbytes) + 1 for ly in layers], np.int64)
+    if not np.array_equal(got, want):
+        missing = [int(i) for i in np.nonzero(got != want)[0]]
+        raise StromError(_errno.EIO,
+                         f"sharded cold-start handshake failed: layer "
+                         f"digests {missing} missing or wrong")
+
+
+def stream_weights_sharded(path: str, *, hosts: Optional[int] = None,
+                           source_factory: Optional[Callable[[int], object]]
+                           = None,
+                           verify: bool = True, depth: Optional[int] = None,
+                           chunk_size: int = _ALIGN, device=None,
+                           backend: Optional[str] = None) -> StreamedModel:
+    """Sharded cold-start (ISSUE 17): split the checkpoint's layer spans
+    round-robin across *hosts* (``shard_hosts`` default), stream each
+    subset through that host's OWN session + source concurrently — the
+    per-layer verify/adopt pipeline is byte-for-byte the single-host
+    one (:func:`_stream_layer_subset`) — then run the on-fabric
+    all-gather digest handshake so no host serves before every layer
+    has landed somewhere.  Each host's spans adopt onto that host's
+    device, so the landing is per-host HBM.  Wall time divides by the
+    host count when the stream is latency-bound (per-host submission
+    windows run in parallel), which is what the multichip gate holds
+    the line on.  ``source_factory(h)`` opens host *h*'s local view of
+    the checkpoint (the gate injects latency-bound fakes); default is
+    ``open_source(path)`` per host."""
+    import jax
+    from ..data.checkpoint import checkpoint_info
+    from ..engine import Session, open_source
+    from ..hbm.registry import registry
+
+    hosts = int(hosts or config.get("shard_hosts") or 1)
+    if hosts < 1:
+        raise StromError(_errno.EINVAL, f"bad host count {hosts}")
+    meta = checkpoint_info(path)
+    layers = _plan_layers(meta)
+    depth = depth or int(config.get("weight_stream_depth"))
+    total = sum(ly.nbytes for ly in layers)
+    n_dev = len(jax.local_devices())
+    subsets = [[ly for ly in layers if ly.index % hosts == h]
+               for h in range(hosts)]
+    errors: List[BaseException] = []
+    t0 = time.monotonic_ns()
+
+    def _run(h: int) -> None:
+        sess = Session()
+        src = source_factory(h) if source_factory else open_source(path)
+        dev = device or jax.local_devices()[h % n_dev]
+        try:
+            _stream_layer_subset(path, subsets[h], sess=sess, src=src,
+                                 dev=dev, verify=verify, depth=depth,
+                                 chunk_size=chunk_size, host=h)
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            errors.append(e)
+        finally:
+            src.close()
+            sess.close()
+
+    if hosts == 1:
+        _run(0)
+    else:
+        threads = [threading.Thread(target=_run, args=(h,),
+                                    name=f"strom-coldstart-{h}")
+                   for h in range(hosts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _unwind() -> None:
+        for ly in layers:
+            if ly.handle:
+                try:
+                    registry.unmap(ly.handle, timeout=5.0)
+                except StromError:
+                    pass
+                ly.handle = 0
+
+    if errors:
+        _unwind()
+        raise errors[0]
+    try:
+        _digest_handshake(layers, hosts, backend)
+    except BaseException:
+        _unwind()
+        raise
     elapsed = max(time.monotonic_ns() - t0, 1)
     stats.gauge_set("coldstart_bytes_per_sec",
                     int(total * 1_000_000_000 / elapsed))

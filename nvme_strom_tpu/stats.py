@@ -156,6 +156,12 @@ class StatRegistry:
         # reject/throttle counts, and a log2-ns queue-wait histogram.
         # tenant -> dict; shape documented at tenant_snapshot().
         self._tenants: dict = {}
+        # per-shard completion fan-in wait histograms (ISSUE 17): mesh
+        # shard index -> log2-ns buckets of submit->completion wait.  A
+        # straggler device/host shows up as one shard's distribution
+        # sitting a regime above its peers — the aggregate clk_shard_wait
+        # hides exactly that.
+        self._shard_hist: dict = {}
 
     def enabled(self) -> bool:
         return bool(config.get("stat_info"))
@@ -306,6 +312,36 @@ class StatRegistry:
                 d["state"] = st
                 d["state_s"] = round(now - since, 3)
             return out
+
+    def shard_wait(self, shard: int, ns: int) -> None:
+        """Account one shard's submit->completion wait (fan-in observer,
+        ISSUE 17): bumps the ``nr_/clk_shard_wait`` pair and the shard's
+        own log2-ns histogram for straggler attribution."""
+        if not self.enabled():
+            return
+        b = min(max(int(ns), 1).bit_length() - 1, LAT_HIST_BUCKETS - 1)
+        with self._lock:
+            self._c["nr_shard_wait"] += 1
+            self._c["clk_shard_wait"] += ns
+            h = self._shard_hist.setdefault(int(shard),
+                                            [0] * LAT_HIST_BUCKETS)
+            h[b] += 1
+
+    def shard_snapshot(self) -> dict:
+        """{shard: {"n", "p50_ns", "p95_ns"}} from the per-shard wait
+        histograms (percentile keys only when the histogram has mass)."""
+        with self._lock:
+            hists = {k: list(h) for k, h in sorted(self._shard_hist.items())}
+        out = {}
+        for k, h in hists.items():
+            d = {"n": sum(h)}
+            p50, p95, _ = hist_percentiles(h)
+            if p50 is not None:
+                d["p50_ns"] = p50
+            if p95 is not None:
+                d["p95_ns"] = p95
+            out[k] = d
+        return out
 
     def _tenant(self, tenant: str) -> dict:
         # caller holds self._lock
@@ -507,7 +543,8 @@ class StatRegistry:
                    "version": snap.version, "counters": snap.counters,
                    "members": self.member_snapshot(),
                    "lat_hist": self.lat_hist_snapshot(),
-                   "tenants": self.tenant_snapshot()}
+                   "tenants": self.tenant_snapshot(),
+                   "shards": self.shard_snapshot()}
         try:
             # mkstemp: O_EXCL private temp (no symlink following in shared
             # /tmp), then atomic replace

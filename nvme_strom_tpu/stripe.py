@@ -21,7 +21,7 @@ from dataclasses import dataclass
 from typing import List, Sequence, Tuple
 
 __all__ = ["StripeZone", "StripeMap", "StripeExtent", "lane_of",
-           "lane_members"]
+           "lane_members", "host_of", "host_members"]
 
 SECTOR = 512
 
@@ -43,6 +43,27 @@ def lane_members(lane: int, n_members: int, nlanes: int) -> List[int]:
     if lane < 0 or lane >= nlanes:
         return []
     return list(range(lane, n_members, nlanes))
+
+
+def host_of(member: int, n_hosts: int) -> int:
+    """Host whose local NVMe set holds stripe *member* — the single
+    definition of the member->host ownership map the multi-host sharded
+    loader plans against (ISSUE 17).  Same round-robin shape as
+    :func:`lane_of`: deploying a 2H-member stripe over H hosts puts
+    members {h, h+H} on host h, so every host's local chunk grid is a
+    regular sub-stripe and the per-host read load is balanced whatever
+    the stripe width."""
+    return member % max(n_hosts, 1)
+
+
+def host_members(host: int, n_members: int, n_hosts: int) -> List[int]:
+    """Members locally resident on *host* under the member % n_hosts
+    ownership map (the inverse of :func:`host_of`); empty for a host
+    index beyond the host count."""
+    n_hosts = max(n_hosts, 1)
+    if host < 0 or host >= n_hosts:
+        return []
+    return list(range(host, n_members, n_hosts))
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,218 @@
+"""Multichip gate (ISSUE 17, ``make multichip-gate``).
+
+Holds the multi-host scale-out tentpole's contracts on the virtual
+8-device mesh with deterministic injected NVMe latency:
+
+* **Aggregate scaling** — :func:`..parallel.shardload.load_pages_multihost`
+  over 1/2/4 virtual hosts on a latency-bound synthetic must scale
+  aggregate GB/s by at least ``STROM_MULTICHIP_GATE_RATIO2`` (default
+  1.6x) at 2 hosts and ``STROM_MULTICHIP_GATE_RATIO4`` (default 2.8x)
+  at 4.  Every page is exactly one latency-bearing request
+  (``dma_max_size`` = page, coalescing off) serialized per session
+  (``queue_depth`` = 1), so the wall is the per-host submission window
+  and the ratio measures the added hosts, not I/O luck.
+* **Gathered-bytes identity** — the ``gather=True`` (cold-start shape)
+  result must equal the file bytes exactly, every host count.
+* **Sharded cold-start** — :func:`..serving.weights.stream_weights_sharded`
+  at 2 hosts must finish in at most ``STROM_MULTICHIP_GATE_COLD_RATIO``
+  (default 0.6) of the single-host wall at equal injected latency, and
+  land a byte-identical model both ways.
+
+Results journal to ``MULTICHIP_SCALING.jsonl`` (one JSON line per run)
+for trend scrapes.  Runs in ``make multichip-gate`` (wired into
+``make check``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+# the gate runs standalone (no conftest): force the virtual mesh before
+# anything imports jax
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+RATIO_2H = float(os.environ.get("STROM_MULTICHIP_GATE_RATIO2", "1.6"))
+RATIO_4H = float(os.environ.get("STROM_MULTICHIP_GATE_RATIO4", "2.8"))
+COLD_RATIO = float(os.environ.get("STROM_MULTICHIP_GATE_COLD_RATIO", "0.6"))
+ROUNDS = int(os.environ.get("STROM_MULTICHIP_GATE_ROUNDS", "3"))
+
+#: 64 pages x 6ms: one injected latency per page, ~384ms single-host
+#: floor — high enough that the fixed per-run cost (redistribute
+#: execute, numpy staging) is noise against the scaling being measured,
+#: short enough to ride in every `make check`.  The cold-start leg uses
+#: a higher per-layer latency for the same reason: 12 layers is a short
+#: stream, so the latency has to dwarf crc/adopt/handshake overhead.
+_N_PAGES = 64
+_LAT_S = 0.006
+_COLD_LAT_S = 0.016
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+_JOURNAL = os.path.join(_REPO, "MULTICHIP_SCALING.jsonl")
+
+
+def _leg_load_scaling(dirpath: str) -> dict:
+    import numpy as np
+
+    from ..config import config
+    from ..engine import PlainSource
+    from ..parallel.mesh import make_scan_mesh
+    from ..parallel.shardload import load_pages_multihost
+    from ..scan.heap import PAGE_SIZE
+    from . import FakeNvmeSource, FaultPlan
+
+    rng = np.random.default_rng(17)
+    path = os.path.join(dirpath, "shards.dat")
+    data = rng.integers(0, 256, _N_PAGES * PAGE_SIZE,
+                        dtype=np.uint8).tobytes()
+    with open(path, "wb") as f:
+        f.write(data)
+
+    # a page == a request == one injected latency, serialized per host
+    # session: the wall is then ceil(pages/hosts) * latency and the
+    # aggregate GB/s ratio is the host count, which is what the fabric
+    # buys on a real mesh where every host owns its own NVMe queues
+    config.set("queue_depth", 1)
+    config.set("dma_max_size", PAGE_SIZE)
+    config.set("coalesce_limit", 0)
+
+    mesh = make_scan_mesh(sp=1)
+    n_dev = mesh.shape["dp"]
+    host_counts = [h for h in (1, 2, 4) if n_dev % h == 0]
+
+    def factory(h: int):
+        return FakeNvmeSource(path,
+                              fault_plan=FaultPlan(latency_s=_LAT_S),
+                              force_cached_fraction=0.0)
+
+    gbps = {}
+    with PlainSource(path) as plan_src:
+        for hosts in host_counts:
+            # warm pass: compiles the redistribution + gather programs
+            # for this host count's shapes AND holds the identity line
+            out = load_pages_multihost(plan_src, mesh, hosts=hosts,
+                                       source_factory=factory, gather=True)
+            got = np.asarray(out).tobytes()
+            assert got == data, \
+                f"hosts={hosts}: gathered bytes diverge from the file"
+            walls = []
+            for _ in range(ROUNDS):
+                t0 = time.perf_counter()
+                out = load_pages_multihost(plan_src, mesh, hosts=hosts,
+                                           source_factory=factory)
+                out.block_until_ready()
+                walls.append(time.perf_counter() - t0)
+            gbps[hosts] = len(data) / statistics.median(walls) / 1e9
+
+    r2 = gbps.get(2, 0) / gbps[1] if 2 in gbps else None
+    r4 = gbps.get(4, 0) / gbps[1] if 4 in gbps else None
+    if r2 is not None:
+        assert r2 >= RATIO_2H, \
+            f"2-host aggregate only {r2:.2f}x single-host " \
+            f"(limit {RATIO_2H}x; {gbps[1]:.4f} -> {gbps[2]:.4f} GB/s)"
+    if r4 is not None:
+        assert r4 >= RATIO_4H, \
+            f"4-host aggregate only {r4:.2f}x single-host " \
+            f"(limit {RATIO_4H}x; {gbps[1]:.4f} -> {gbps[4]:.4f} GB/s)"
+    print(f"multichip-gate load leg ok: aggregate "
+          f"{' '.join(f'{h}h={g:.4f}GB/s' for h, g in sorted(gbps.items()))}"
+          f" (2h {r2:.2f}x, 4h {r4:.2f}x; {ROUNDS} rounds, "
+          f"{_N_PAGES} pages @ {_LAT_S * 1e3:.0f}ms/req), "
+          f"gathered bytes identical at every host count")
+    return {"gbps": {str(h): g for h, g in gbps.items()},
+            "ratio2": r2, "ratio4": r4,
+            "pages": _N_PAGES, "lat_ms": _LAT_S * 1e3}
+
+
+def _leg_sharded_coldstart(dirpath: str) -> dict:
+    from ..config import config
+    from ..serving.weights import stream_weights_sharded
+    from . import FakeNvmeSource, FaultPlan
+    from .coldstart_gate import _LAYER_BYTES, _check_tree, _make_checkpoint
+
+    path, tree = _make_checkpoint(dirpath)
+    # one request (one latency) per layer on every host, streamed
+    # depth-1 so the per-host wall is its layer count times the latency
+    # — the 2-host win is then pure shard-parallelism, not pipelining
+    # (the coldstart gate already holds the pipelining line)
+    config.set("dma_max_size", _LAYER_BYTES)
+
+    def factory(h: int):
+        return FakeNvmeSource(path,
+                              fault_plan=FaultPlan(latency_s=_COLD_LAT_S),
+                              force_cached_fraction=0.0)
+
+    walls = {}
+    for hosts in (1, 2):
+        # warm pass compiles the digest-handshake all-gather for this
+        # ring shape and holds byte identity on both host counts
+        model = stream_weights_sharded(path, hosts=hosts,
+                                       source_factory=factory, depth=1)
+        try:
+            _check_tree(model, tree)
+        finally:
+            model.close()
+        ts = []
+        for _ in range(ROUNDS):
+            t0 = time.perf_counter()
+            m = stream_weights_sharded(path, hosts=hosts,
+                                       source_factory=factory, depth=1)
+            ts.append(time.perf_counter() - t0)
+            m.close()
+        walls[hosts] = statistics.median(ts)
+
+    ratio = walls[2] / walls[1] if walls[1] > 0 else float("inf")
+    assert ratio <= COLD_RATIO, \
+        f"2-host sharded cold-start took {ratio:.2f}x the single-host " \
+        f"wall (limit {COLD_RATIO}x; 1h {walls[1] * 1e3:.0f}ms " \
+        f"2h {walls[2] * 1e3:.0f}ms)"
+    print(f"multichip-gate coldstart leg ok: 2-host wall {ratio:.2f}x "
+          f"single-host (1h {walls[1] * 1e3:.0f}ms, "
+          f"2h {walls[2] * 1e3:.0f}ms, {ROUNDS} rounds), "
+          f"model byte-identical both ways")
+    return {"wall_1h_ms": walls[1] * 1e3, "wall_2h_ms": walls[2] * 1e3,
+            "cold_ratio": ratio}
+
+
+def _journal(record: dict) -> None:
+    try:
+        with open(_JOURNAL, "a") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+    except OSError as e:  # read-only checkout: the gate still gates
+        print(f"multichip-gate: journal skipped ({e})")
+
+
+def main() -> int:
+    from ..config import config
+    from ..trace import recorder
+
+    snap = config.snapshot()
+    record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    try:
+        with tempfile.TemporaryDirectory(prefix="strom_multichip_gate_") \
+                as d:
+            record.update(_leg_load_scaling(d))
+            config.restore(snap)
+            record.update(_leg_sharded_coldstart(d))
+    except AssertionError as e:
+        print(f"multichip-gate FAIL: {e}")
+        return 1
+    finally:
+        config.restore(snap)
+        recorder.configure()
+    _journal(record)
+    print("multichip-gate ok: aggregate GB/s scales with virtual hosts, "
+          "gathered bytes identical, sharded cold-start beats single-host")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

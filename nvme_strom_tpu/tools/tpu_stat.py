@@ -415,6 +415,22 @@ def main(argv=None) -> int:
                       f"coldstart "
                       f"{c.get('coldstart_bytes_per_sec', 0) / 1048576:.0f}"
                       f"MB/s")
+            # multi-host scoreboard (ISSUE 17): host-sharded read volume,
+            # on-fabric shard movement, and KV migration outcomes — ICI
+            # bytes far above shard-load bytes means the redistribution
+            # is re-rotating padding (ragged ownership), migrate-fail
+            # above zero means a peer host died mid-handoff and its
+            # chains rolled back to the source
+            if (c.get("nr_shard_load") or c.get("nr_ici_permute")
+                    or c.get("nr_kv_migrate")
+                    or c.get("nr_kv_migrate_fail")):
+                print(f"multihost: shard-loads {c.get('nr_shard_load', 0)}  "
+                      f"({c.get('bytes_shard_load', 0) / 1048576:.1f}MB)  "
+                      f"ici-permutes {c.get('nr_ici_permute', 0)}  "
+                      f"ici-bytes "
+                      f"{c.get('bytes_ici', 0) / 1048576:.1f}MB  "
+                      f"kv-migrate {c.get('nr_kv_migrate', 0)}  "
+                      f"fail {c.get('nr_kv_migrate_fail', 0)}")
             # write-ladder scoreboard (ISSUE 11): mirror fan-out volume,
             # transient write retries, resync replay progress and
             # read-back verification failures — pending bytes above zero
@@ -482,6 +498,18 @@ def main(argv=None) -> int:
                       f"  {show_avg(v['clk_ns'], v['nreq'])} "
                       f"{_pshow(v.get('p50_ns'))} {_pshow(v.get('p95_ns'))} "
                       f"{occ} {health}")
+        if args.verbose and snap.get("shards"):
+            # per-shard completion-wait fan-in (ISSUE 17 satellite): how
+            # long the sharded batch stream waited on each device shard's
+            # DMA after submit — one shard's p95 far above its siblings
+            # at similar counts IS the straggler host/SSD; fix that
+            # member before adding hosts
+            print("per-shard wait:")
+            print("  shard   waits  p50      p95")
+            for s, v in sorted(snap["shards"].items(),
+                               key=lambda kv: int(kv[0])):
+                print(f"  {int(s):>5} {v.get('n', 0):>7} "
+                      f"{_pshow(v.get('p50_ns'))} {_pshow(v.get('p95_ns'))}")
         return 0
 
     prev = snap

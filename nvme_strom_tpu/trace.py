@@ -112,6 +112,11 @@ EVENT_SCHEMA: Dict[str, str] = {
     "scrub": "span",           # one resident extent verified (tier in args)
     "repair": "span",          # corrupt resident healed (SSD/mirror re-fill)
     "pressure_shed": "instant",  # resident shed under memlock/HBM pressure
+    # multi-host scale-out (ISSUE 17)
+    "shard_load": "span",      # one host's local owned-chunk read window
+    "ici_permute": "span",     # on-fabric ring redistribution/gather window
+    "shard_wait": "span",      # one shard's submit->completion fan-in wait
+    "kv_migrate": "span",      # one KV chain's cross-host migration
 }
 
 

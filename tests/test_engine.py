@@ -475,6 +475,9 @@ def test_avg_dma_size_reflects_merging(tmp_data_file):
     """8 contiguous 64KB chunks with a 256KB cap must average 256KB/request."""
     config.set("cache_arbitration", False)
     config.set("dma_max_size", "256k")
+    # the coalesce second pass (default 8MB) would merge all 8 chunks
+    # into ONE submission; this test pins the classic per-cap merging
+    config.set("coalesce_limit", 0)
     before = stats.snapshot()
     with PlainSource(tmp_data_file) as src:
         _run_copy(src, list(range(8)))
