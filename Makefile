@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate probe-loop lint-strom sanitize sanitize-smoke clean
+.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -171,6 +171,21 @@ multichip-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.multichip_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_shardload.py -q -m multihost
 
+# Self-driving data-path gate (ISSUE 18): from deliberately bad static
+# knobs (submit_window=2, 256K request cap) on the latency-injected
+# synthetic, the online controller must converge to >= 1.5x the static
+# throughput within 20 epochs with byte identity throughout and a
+# settled knob trajectory (no step reversals in the last 5 epochs); a
+# seeded mid-run member fail-stop must freeze tuning with no throughput
+# cliff beyond the degraded floor; the strided-scan readahead leg must
+# reach >= 0.5 cache hit ratio under its token-bucket byte budget; and
+# readahead=off must move no counters.  The `autotune` pytest marker
+# rides along.  Override STROM_AUTOTUNE_RATIO / STROM_AUTOTUNE_EPOCHS /
+# STROM_AUTOTUNE_DEGRADED_X / STROM_RA_HIT_RATIO.
+autotune-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.autotune_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q -m autotune
+
 # stromlint (ISSUE 10): the project-invariant static checker — lock
 # discipline, buffer lifetimes, native-ABI drift against csrc/strom_tpu.h,
 # stats/trace surface completeness, config hygiene.  Zero unsuppressed
@@ -203,7 +218,7 @@ sanitize-smoke:
 # then tier-1 tests plus the perf smokes, the seeded member-survival
 # schedules, the trace-overhead, landing and cache gates, and the
 # short sanitizer pass.
-check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

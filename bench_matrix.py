@@ -128,6 +128,70 @@ for _ in range(3):   # best-of-3 (shared-host disk noise)
 print(f"GBPS={{best/(1<<30):.3f}}")
 """
 
+_AUTOTUNE_AB = _COMMON + """
+# online-autotuner A/B (ISSUE 18): deliberately bad statics
+# (submit_window=2, 256K request cap) vs the controller tuning the same
+# workload live, on the latency-injected 2-member striped fake — the
+# row is latency-bound by construction, so it is deterministic on any
+# disk and independent of BENCH_SIZE_MB.  Journals one JSON line per
+# run to AUTOTUNE_AB.jsonl; GBPS reports the CONVERGED tuned rate.
+import json, statistics, tempfile
+from nvme_strom_tpu import Session, config
+from nvme_strom_tpu.testing import FakeStripedNvmeSource, FaultPlan
+from nvme_strom_tpu.testing import make_test_file as _mk
+CH = 64 << 10
+n = 64
+snap = config.snapshot()
+with tempfile.TemporaryDirectory(prefix="strom_autotune_ab_") as d:
+    paths = []
+    for i in range(2):
+        p = os.path.join(d, f"m{{i}}.bin")
+        _mk(p, n // 2 * CH)
+        paths.append(p)
+    for k, v in (("io_backend", "python"), ("submit_window", 2),
+                 ("member_queue_depth", 2), ("dma_max_size", 256 << 10),
+                 ("cache_bytes", 0), ("cache_arbitration", False),
+                 ("hedge_policy", "off"), ("autotune", False)):
+        config.set(k, v)
+    def passes(sess, src, rounds, tuner=None):
+        h, buf = sess.alloc_dma_buffer(n * CH)
+        out = []
+        try:
+            for _ in range(rounds):
+                t0 = time.monotonic()
+                r = sess.memcpy_ssd2ram(src, h, list(range(n)), CH)
+                sess.memcpy_wait(r.dma_task_id, timeout=120)
+                out.append(time.monotonic() - t0)
+                if tuner is not None:
+                    tuner.step_epoch()
+        finally:
+            sess.unmap_buffer(h)
+        return out
+    src = FakeStripedNvmeSource(paths, CH,
+                                fault_plan=FaultPlan(latency_s=0.02),
+                                force_cached_fraction=0.0)
+    try:
+        with Session() as sess:
+            static = statistics.median(passes(sess, src, 4))
+        config.set("autotune", True)
+        with Session() as sess:
+            sess._tuner.stop()     # drive epochs synchronously
+            epochs = passes(sess, src, 20, tuner=sess._tuner)
+        conv = statistics.median(epochs[-5:])
+    finally:
+        src.close()
+        config.restore(snap)
+row = {{"row": "autotune_convergence", "static_s": round(static, 4),
+        "converged_s": round(conv, 4),
+        "speedup": round(static / conv, 2), "epochs": len(epochs),
+        "bytes": n * CH,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}}
+with open(os.path.join({repo!r}, "AUTOTUNE_AB.jsonl"), "a") as f:
+    f.write(json.dumps(row) + "\\n")
+print("autotune A/B:", row["speedup"], "x static")
+print(f"GBPS={{n * CH / conv / (1<<30):.3f}}")
+"""
+
 _MULTIHOST = _COMMON + """
 # multi-host sharded load (ISSUE 17): per-host engine sessions read the
 # ownership-split chunk grid concurrently and the landed shards
@@ -631,6 +695,8 @@ def main() -> int:
          _RAID0.format(size=size, path=base), None),
         ("multihost_2x", "2-host sharded load + on-fabric redistribute",
          _MULTIHOST.format(size=size, path=base + ".bin", hosts=2), None),
+        ("autotune_convergence", "online autotuner vs bad statics (A/B)",
+         _AUTOTUNE_AB.format(size=size, repo=REPO), None),
         ("scan_filter", "heap scan -> HBM + pallas filter",
          _SCAN.format(size=size, path=base), None),
         ("filter_pallas_chip", "on-chip pallas filter kernel",

@@ -12,6 +12,14 @@ README.md or deploy/README.md so operators can discover it.
 must exist in the :mod:`errno` module, and the set's class token must be
 a member of the ``ErrorClass`` enum (so classification and taxonomy can
 never drift apart).
+
+``config.bounds`` (the config-bounds rule, ISSUE 18) — every numeric
+(int/size/float) Var read by the online autotuner (a literal
+``config.get`` site in ``autotune.py``) must declare BOTH ``minval``
+and ``maxval``: the controller takes its hard clamp range from the
+Var's declared bounds, so an unbounded controlled knob is a knob the
+hill-climb may walk to absurdity.  bool/str vars are exempt (they gate
+behavior; the climber never steps them).
 """
 
 from __future__ import annotations
@@ -77,6 +85,53 @@ def _check_vars(project: Project, findings: List[Finding]) -> None:
                 f"{'/'.join(sorted(project.doc_texts))}"))
 
 
+def _autotune_reads(project: Project) -> Set[str]:
+    """Var names read via a literal ``config.get("...")`` inside the
+    controller module — the knobs whose declared bounds are load-bearing."""
+    got: Set[str] = set()
+    for src, tree in project.iter_trees():
+        if not src.relpath.endswith("autotune.py"):
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get" and node.args):
+                name = _str_const(node.args[0])
+                if name is not None:
+                    got.add(name)
+    return got
+
+
+def _check_bounds(project: Project, findings: List[Finding]) -> None:
+    controlled = _autotune_reads(project)
+    if not controlled:
+        return
+    for src, tree in project.iter_trees():
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "Var" and node.args):
+                continue
+            name = _str_const(node.args[0])
+            if name is None or name not in controlled:
+                continue
+            kind = _str_const(node.args[2]) if len(node.args) > 2 else None
+            if kind not in ("int", "size", "float"):
+                continue
+            declared = {kw.arg for kw in node.keywords
+                        if not (isinstance(kw.value, ast.Constant)
+                                and kw.value.value is None)}
+            missing = [b for b in ("minval", "maxval")
+                       if b not in declared]
+            if missing:
+                findings.append(Finding(
+                    src.relpath, node.lineno, "config.bounds",
+                    f"config var '{name}' is read by the autotune "
+                    f"controller but declares no {'/'.join(missing)} — "
+                    f"the climber clamps to declared bounds, so this "
+                    f"knob is unbounded"))
+
+
 def _error_class_members(project: Project) -> Set[str]:
     for _src, tree in project.iter_trees():
         for node in ast.walk(tree):
@@ -119,5 +174,6 @@ def _check_errnos(project: Project, findings: List[Finding]) -> None:
 def run(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     _check_vars(project, findings)
+    _check_bounds(project, findings)
     _check_errnos(project, findings)
     return findings

@@ -357,6 +357,14 @@ STAT_FIELDS: Tuple[str, ...] = (
     #                           attribution; per-shard histogram in export)
     "nr_kv_migrate",          # KV chains migrated to a peer host's pool
     "nr_kv_migrate_fail",     # migrations rolled back (peer append failed)
+    # self-driving data path (ISSUE 18): autotune controller + readahead
+    "nr_autotune_step",       # accepted knob movements (per family step)
+    "nr_autotune_revert",     # probes stepped back (no gain / p99 regress)
+    "nr_autotune_freeze",     # epochs frozen for the health machine
+    "nr_readahead_fill",      # speculative fills completed
+    "nr_readahead_hit",       # first demand touch of a speculative slab
+    "nr_readahead_skip",      # predictions dropped (budget/alloc pressure)
+    "bytes_readahead",        # bytes prefetched into the residency tier
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

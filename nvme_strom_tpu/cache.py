@@ -59,7 +59,7 @@ except OSError:  # pragma: no cover
 
 class _Entry:
     __slots__ = ("key", "mm", "view", "length", "logical_length", "refs",
-                 "stale", "crc", "source_ref", "pinned")
+                 "stale", "crc", "source_ref", "pinned", "spec")
 
     def __init__(self, key, mm, length: int,
                  logical_length: int = 0, crc=None, source_ref=None) -> None:
@@ -79,6 +79,10 @@ class _Entry:
         self.crc = crc
         self.source_ref = source_ref
         self.pinned = False
+        # readahead provenance (ISSUE 18): speculative fills carry
+        # spec=True until the first demand touch, keeping ARC's ghost
+        # lists and target pointer blind to speculation
+        self.spec = False
 
     def free(self) -> None:
         try:
@@ -260,8 +264,16 @@ class ResidencyCache:
         key = (skey, base, length)
         hot = False
         with self._lock:
-            e = self._t1.pop(key, None)
-            if e is not None:
+            e = self._t1.get(key)
+            if e is not None and e.spec and not e.stale:
+                # first DEMAND touch of a speculative fill becomes a
+                # plain first touch: clear the provenance tag and stay
+                # in t1, so readahead can never fake frequency (ISSUE 18)
+                e.spec = False
+                self._t1.move_to_end(key)
+                stats.add("nr_readahead_hit")
+            elif e is not None:
+                self._t1.pop(key)
                 self._t2[key] = e  # second touch: promote to frequency
                 hot = True
             else:
@@ -272,7 +284,8 @@ class ResidencyCache:
                 return None
             e.refs += 1
         lease = CacheLease(self, e)
-        if hot and self.promote_hook is not None:
+        if hot and self.promote_hook is not None:  # never fires on a
+            # still-speculative slab: spec entries take the t1 path above
             # the t1→t2 transition IS the hotness signal: hand the bytes
             # up to the HBM tier outside our lock (the hook may device_put,
             # and its eviction demotes back through fill(), which relocks).
@@ -292,6 +305,17 @@ class ResidencyCache:
                 pass
         return lease
 
+    def peek(self, skey: tuple, base: int, length: int) -> bool:
+        """Residency probe with NO ARC side effects — the readahead
+        issue loop's dedup check (a prefetch decision is not an access
+        and must not train recency)."""
+        if not self.active:
+            return False
+        key = (skey, base, length)
+        with self._lock:
+            e = self._t1.get(key) or self._t2.get(key)
+            return e is not None and not e.stale
+
     def _release(self, e: _Entry) -> None:
         with self._lock:
             e.refs -= 1
@@ -302,7 +326,8 @@ class ResidencyCache:
     # -- fill side ----------------------------------------------------
 
     def fill(self, skey: tuple, base: int, length: int, data, *,
-             logical_length: int = 0, source_ref=None) -> bool:
+             logical_length: int = 0, source_ref=None,
+             speculative: bool = False) -> bool:
         """Install healed bytes for an extent.  Returns True when the
         extent is now resident (skipped when the tier is off, the
         extent exceeds capacity, every candidate victim is pinned, or
@@ -310,7 +335,10 @@ class ResidencyCache:
         ``logical_length`` — logical bytes this extent serves when it
         holds a compressed representation (defaults to *length*);
         ``source_ref`` — weakref to the source, kept so the scrubber can
-        heal a rotted slab through the fault ladder."""
+        heal a rotted slab through the fault ladder;
+        ``speculative`` — readahead provenance (ISSUE 18): the fill
+        neither trains the ARC ghost lists nor refreshes an existing
+        entry, and the slab stays tagged until its first demand hit."""
         if not self.active or length <= 0:
             return False
         key = (skey, base, length)
@@ -322,8 +350,9 @@ class ResidencyCache:
             e = self._t1.get(key) or self._t2.get(key)
             if e is not None:
                 # already resident (a racing task filled it); refresh
-                # the bytes unless a reader is mid-copy on the slab
-                if not e.refs:
+                # the bytes unless a reader is mid-copy on the slab —
+                # a speculative refill never touches demand state
+                if not e.refs and not speculative:
                     e.view[:length] = data
                     e.crc = crc
                     if source_ref is not None:
@@ -335,9 +364,11 @@ class ResidencyCache:
                 # through to SSD — degraded, never ENOMEM (ISSUE 16)
                 stats.add("nr_pressure_passthrough")
                 return False
-            # ghost hits steer the recency/frequency balance
-            in_b1 = key in self._b1
-            in_b2 = key in self._b2
+            # ghost hits steer the recency/frequency balance — but a
+            # prefetch is not a demand re-reference, so speculation
+            # must not move the target pointer or consume a ghost
+            in_b1 = not speculative and key in self._b1
+            in_b2 = not speculative and key in self._b2
             if in_b1:
                 self._b1_bytes -= self._b1.pop(key)
                 self._p = min(cap, self._p + length)
@@ -352,6 +383,7 @@ class ResidencyCache:
             except (OSError, ValueError):  # pragma: no cover
                 return False
             e = _Entry(key, mm, length, logical_length, crc, source_ref)
+            e.spec = speculative
             e.pinned = self._try_pin(mm, length)
             if e.pinned:
                 self._pinned_bytes += length
@@ -413,12 +445,16 @@ class ResidencyCache:
                 e.free()
                 self._bytes -= e.length
                 self._unaccount_pin(e)
-                ghost[key] = e.length
-                if ghost is self._b1:
-                    self._b1_bytes += e.length
-                else:
-                    self._b2_bytes += e.length
-                self._trim_ghosts()
+                if not e.spec:
+                    # an untouched speculative slab leaves no ghost:
+                    # its later demand miss must read as a cold miss,
+                    # not a capacity signal (ISSUE 18)
+                    ghost[key] = e.length
+                    if ghost is self._b1:
+                        self._b1_bytes += e.length
+                    else:
+                        self._b2_bytes += e.length
+                    self._trim_ghosts()
                 stats.add("nr_cache_evict")
                 stats.gauge_set("cache_resident_bytes", self._bytes)
                 if _trace.active:

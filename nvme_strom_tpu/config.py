@@ -352,7 +352,7 @@ class Config:
                      "member's own p99 with hedge_ms as the floor; first "
                      "completion wins, the loser is discarded",
                 validate=_check_hedge_policy))
-        reg(Var("hedge_ms", 20.0, "float", minval=0.0,
+        reg(Var("hedge_ms", 20.0, "float", minval=0.0, maxval=60000.0,
                 help="hedge latch for hedge_policy=fixed, and the latch "
                      "floor for hedge_policy=p99"))
         reg(Var("mirror", "none", "str",
@@ -618,6 +618,39 @@ class Config:
                      "together with pushdown_h2d_gbps this decides "
                      "host-vs-chip expansion, so tests can force either "
                      "decision deterministically"))
+        # self-driving data path (ISSUE 18): online controller + readahead
+        reg(Var("autotune", False, "bool",
+                help="per-session online controller: each epoch it samples "
+                     "the per-member latency histograms and occupancy "
+                     "deltas and hill-climbs the effective submit window, "
+                     "per-member chunk cap and hedge latch (plus lane "
+                     "count at engine-rebuild boundaries) inside each "
+                     "var's declared min/max bounds, stepping back on p99 "
+                     "regression and freezing while the health machine "
+                     "has a member off HEALTHY.  off = the static knobs "
+                     "and the PR 4/5 adaptive sizer behave bit-for-bit "
+                     "as before, at one predicted branch per read"))
+        reg(Var("autotune_interval_ms", 250.0, "float", minval=10.0,
+                maxval=60000.0,
+                help="controller epoch length: how often the autotune "
+                     "loop samples sensor deltas and takes one "
+                     "hill-climb step (also the readahead predictor's "
+                     "issue cadence)"))
+        reg(Var("readahead", False, "bool",
+                help="trace-driven predictive readahead: a per-source "
+                     "predictor watches recent submit spans (stride and "
+                     "extent-successor detection) and issues bounded "
+                     "prefetch fills into the residency tier through the "
+                     "normal fault ladder.  Requires cache_bytes > 0; "
+                     "speculative fills are provenance-tagged so ARC "
+                     "ghost lists never train on speculation"))
+        reg(Var("readahead_budget_mb_s", 64.0, "float", minval=0.0,
+                maxval=65536.0,
+                help="token-bucket budget for prefetch fills in MB/s so "
+                     "readahead can never starve demand reads; a predicted "
+                     "extent whose bytes exceed the bucket is skipped "
+                     "(counted nr_readahead_skip), never queued (0 = "
+                     "predict but issue nothing)"))
 
     # -- layered loading ---------------------------------------------------
     def _load_file(self) -> None:

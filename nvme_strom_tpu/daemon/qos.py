@@ -92,12 +92,13 @@ class WorkItem:
                  "buf_handle", "chunk_ids", "chunk_size", "dest_offset",
                  "nbytes", "enqueue_ns", "dispatch_ns", "done", "result",
                  "error", "cancelled", "trace_tid", "source", "kv",
-                 "submit_id")
+                 "submit_id", "speculative")
 
     def __init__(self, *, session_id: int, tenant: str, task_id: int,
                  source_handle: int, buf_handle: int, chunk_ids: List[int],
                  chunk_size: int, dest_offset: int = 0,
-                 kv: Optional[tuple] = None, submit_id: Optional[str] = None):
+                 kv: Optional[tuple] = None, submit_id: Optional[str] = None,
+                 speculative: bool = False):
         self.session_id = session_id
         self.tenant = tenant
         self.task_id = task_id
@@ -117,6 +118,7 @@ class WorkItem:
         self.source = None      # server attaches the resolved source object
         self.kv = kv            # (op, args) for KV-pool items, else None
         self.submit_id = submit_id  # client idempotency key, else None
+        self.speculative = bool(speculative)  # readahead fill (ISSUE 18)
 
 
 class _Tenant:
@@ -195,6 +197,19 @@ class QosScheduler:
             t = self._tenants.get(item.tenant)
             if t is None:
                 raise KeyError(f"unregistered tenant {item.tenant!r}")
+            if item.speculative:
+                # readahead rides the bulk class (ISSUE 18): speculative
+                # fills re-attribute to a shadow "<tenant>#ra" tenant so
+                # strict-class dispatch drains every demand read first
+                # and the tenant's own shaping/accounting stays clean
+                shadow = item.tenant + "#ra"
+                st = self._tenants.get(shadow)
+                if st is None:
+                    st = self._tenants[shadow] = _Tenant(
+                        shadow, "bulk", t.weight,
+                        TokenBucket(t.bucket.rate, t.bucket.burst))
+                item.tenant = shadow
+                t = st
             t.queue.append(item)
             if len(t.queue) == 1:
                 self._active[t.qos_class].append(t.name)

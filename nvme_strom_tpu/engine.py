@@ -51,6 +51,7 @@ from .log import pr_info, pr_warn
 from .eligibility import probe_backing
 from .stats import stats
 from .trace import recorder as _trace
+from .autotune import AutoTuner
 from .cache import residency_cache as _rcache
 from .serving.hbm_tier import hbm_tier as _hbm_tier
 from .integrity import domain as _integrity, Scrubber as _Scrubber
@@ -1225,12 +1226,23 @@ class Session:
         # scrub_bytes_per_sec (re-read each tick, canary-style); idles on
         # one Event wait per tick while disabled
         self._scrubber = _Scrubber(self)
+        # self-driving data path (ISSUE 18): the per-session controller.
+        # `autotune`/`readahead` are read at its construction (configure()
+        # convention); hot paths test `self._tuner.enabled`/`.ra_active`
+        # — one predicted branch each when off.  It also hosts the PR 4/5
+        # adaptive chunk sizers as its chunk-cap policy, so there is
+        # exactly one writer of the effective cap; the alias below keeps
+        # the sizer dict reachable under its historical name (tests,
+        # _fold_native_stats).  The thread starts at the end of __init__,
+        # once the engine/backend choice is final.
+        self._tuner = AutoTuner(self)
         # adaptive chunk sizing (PR 4, per-member since PR 5): one sizer
         # per stripe member so the effective request cap converges per
         # DEVICE — a slow member shrinks its own merges without throttling
         # healthy siblings.  Created lazily on the first adaptive memcpy;
         # single-file sources live under member 0.
-        self._chunk_sizers: Dict[int, AdaptiveChunkSizer] = {}
+        self._chunk_sizers: Dict[int, AdaptiveChunkSizer] = \
+            self._tuner.chunk_sizers
         # lane scale-out (PR 5): the engine starts single-lane and is
         # rebuilt with one queue pair per stripe member at the first
         # striped submit (one-shot); swapped-out engines stay alive until
@@ -1298,6 +1310,7 @@ class Session:
             # per-lane native event ring: device submit->complete windows
             # are MEASURED by the engine and drained into the recorder
             self._native.trace_enable(True)
+        self._tuner.start()
         pr_info("session open: backend=%s workers=%d",
                 self.backend_name, nworkers)
 
@@ -1749,14 +1762,14 @@ class Session:
             # ladder (retry/hedge/mirror/checksum re-read), so a
             # degraded member still populates the tier via its
             # surviving legs — and a latched failure never fills
-            skey, fills, fdest, lscale, src_ref = task.cache_fill
+            skey, fills, fdest, lscale, src_ref, spec = task.cache_fill
             task.cache_fill = None
             for base, length, doff in fills:
                 tf0 = time.monotonic_ns()
                 if _rcache.fill(skey, base, length,
                                 fdest[doff:doff + length],
                                 logical_length=int(length * lscale),
-                                source_ref=src_ref) \
+                                source_ref=src_ref, speculative=spec) \
                         and _trace.active and task.trace_id:
                     _trace.span("cache_fill", tf0, time.monotonic_ns(),
                                 tid=task.trace_id, offset=base,
@@ -1791,7 +1804,8 @@ class Session:
     def memcpy_ssd2ram(self, source: Source, buf_handle: int,
                        chunk_ids: Sequence[int], chunk_size: int, *,
                        dest_offset: int = 0,
-                       wb_buffer: Optional[memoryview] = None) -> MemCopyResult:
+                       wb_buffer: Optional[memoryview] = None,
+                       speculative: bool = False) -> MemCopyResult:
         """MEMCPY_SSD2RAM/SSD2GPU submit path.
 
         Plans + submits asynchronously, returning a :class:`MemCopyResult`
@@ -1802,7 +1816,13 @@ class Session:
         exactly the SSD2GPU contract where the caller performs the
         RAM->device copy itself (kmod/nvme_strom.c:1647-1663); otherwise they
         are copied straight into the destination (SSD2RAM behaviour,
-        :1926-1934)."""
+        :1926-1934).
+
+        ``speculative`` marks a readahead prefetch (ISSUE 18): the task
+        skips the residency-tier hit split (a prefetch of resident data
+        has nothing to do), does not train the readahead predictor, and
+        its wait-time cache fills carry provenance so ARC's ghost lists
+        stay blind to speculation."""
         t0 = time.monotonic_ns()
         if self._closed:
             raise StromError(_errno.EBADF, "session closed")
@@ -1835,13 +1855,19 @@ class Session:
                 if length <= 0:
                     raise StromError(_errno.EINVAL, f"chunk {cid} beyond EOF")
                 spans_all.append((base, length))
+            if self._tuner.ra_active and not speculative:
+                # readahead training tap (ISSUE 18): every demand span
+                # feeds the per-source predictor — including spans the
+                # hit split below serves entirely from cache, so a
+                # cache-warm stream keeps its pattern model current
+                self._tuner.observe_submit(source, chunk_size, chunk_ids)
             # --- residency-tier split (ISSUE 9) ---------------------------
             # hits take a pinned lease and are served by memcpy below —
             # no submission, no mincore probe; only the misses go on to
             # page-cache arbitration and the member lanes
             skey = None
             miss_ids, spans = chunk_ids, spans_all
-            if _rcache.active or _hbm_tier.active:
+            if (_rcache.active or _hbm_tier.active) and not speculative:
                 skey = _rcache.source_key(source)
                 miss_ids, spans = [], []
                 nr_hbm = 0
@@ -1872,6 +1898,11 @@ class Session:
                     stats.add("nr_cache_miss", len(miss_ids))
                 if not _rcache.active:
                     skey = None  # no host tier: nothing to fill at wait
+            elif _rcache.active:
+                # speculative prefetch (ISSUE 18): no hit split — the
+                # issue loop already peeked residency — but the misses
+                # must still fill the host tier at wait time
+                skey = _rcache.source_key(source)
 
             # --- cache arbitration (write-back vs direct) -----------------
             threshold = config.get("cache_threshold")
@@ -1921,6 +1952,12 @@ class Session:
                 # targets so FAILED members are re-probed in background
                 self._canary_sources.add(source)
             dma_max = int(config.get("dma_max_size"))
+            if self._tuner.enabled:
+                # effective-knob indirection (ISSUE 18): with the
+                # controller on, the tuned per-member cap owns the
+                # request split/merge size on both paths (still inside
+                # dma_max_size's declared bounds)
+                dma_max = self._tuner.dma_cap(dma_max)
             # coalescing beyond dma_max is the native-queue saturation
             # lever; the pool path keeps classic per-extent planning so
             # fault injection and the retry ladder see every extent
@@ -1934,6 +1971,8 @@ class Session:
                     climit = self._adaptive_cap(dma_max, climit)
             verify = bool(config.get("checksum_verify"))
             window = max(int(config.get("submit_window")), 1)
+            if self._tuner.enabled:
+                window = max(self._tuner.submit_window(window), 1)
             entries = [(cid, i) for i, cid in enumerate(direct_ids)]
             fds = source.member_fds() if use_native else None
             # degraded-mode striping on the native path (PR 6): extents of
@@ -2119,7 +2158,7 @@ class Session:
                                   dest_offset + i * chunk_size))
                 task.cache_fill = (skey, fills, dest,
                                    getattr(source, "logical_scale", 1.0),
-                                   _weakref.ref(source))
+                                   _weakref.ref(source), speculative)
         except BaseException:
             while cache_hits:  # leases not yet served: unpin them
                 cache_hits.pop()[3].release()
@@ -2677,6 +2716,12 @@ class Session:
                 done = True
         if not done and not r.dest_segs:
             hd = health.hedge_delay_s(r.member)
+            if hd is not None and self._tuner.enabled:
+                # effective-knob indirection (ISSUE 18): the tuned
+                # per-member latch replaces the static hedge_ms floor;
+                # the policy decision (None = hedging off) stays with
+                # the health machine
+                hd = self._tuner.hedge_delay(r.member, hd)
             if hd is not None and len(getattr(source, "members", ())) > 1:
                 done = self._read_hedged(task, source, r, piece, hd, mirror)
         attempt = 0
@@ -3027,11 +3072,32 @@ class Session:
 
     def _adaptive_cap(self, floor: int, limit: int, member: int = 0) -> int:
         """Current effective coalescing cap from *member*'s adaptive sizer
-        (created lazily; recreated when the config bounds change)."""
-        szr = self._chunk_sizers.get(member)
-        if szr is None or szr.floor != floor or szr.limit != limit:
-            szr = self._chunk_sizers[member] = AdaptiveChunkSizer(floor, limit)
-        return szr.effective
+        (created lazily; recreated when the config bounds change).
+        Delegates to the controller (ISSUE 18) — the single writer of
+        the effective cap; with ``autotune=off`` the tuner passes the
+        static bounds through and this is the PR 4/5 behavior verbatim."""
+        return self._tuner.chunk_cap(floor, limit, member)
+
+    def _retire_member_pool(self, member: int) -> None:
+        """Knob application (ISSUE 18): drop a member's executor lane so
+        the next submit recreates it at the tuned width.  Queued work
+        keeps running on the old pool's threads; shutdown(wait=False)
+        just stops it accepting new work."""
+        with self._lane_lock:
+            pool = self._member_pools.pop(member, None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def _autotune_scale_lanes(self, want: int) -> None:
+        """Engine-rebuild boundary (ISSUE 18): when the tuned window has
+        outgrown the native lane count, rebuild the engine with more
+        queue pairs (capped at 16, like _ensure_member_lanes).  No-op on
+        the Python path or when already wide enough."""
+        want = max(1, min(int(want), 16))
+        with self._lane_lock:
+            if self._native is None or self._native.nlanes() >= want:
+                return
+            self._scale_out_lanes(want, len(self._members_used) or 1)
 
     # -- lane scale-out (PR 5) ---------------------------------------------
     def _ensure_member_lanes(self, source: Source) -> None:
@@ -3159,8 +3225,13 @@ class Session:
                 if pool is None:
                     width = int(config.get("member_queue_depth")) \
                         or int(config.get("queue_depth"))
+                    width = max(1, min(width, 8))
+                    if self._tuner.enabled:
+                        # tuned submit window doubles as the member's
+                        # lane width — the real concurrency bound here
+                        width = self._tuner.pool_width(member, width)
                     pool = ThreadPoolExecutor(
-                        max_workers=max(1, min(width, 8)),
+                        max_workers=width,
                         thread_name_prefix=f"strom-io-m{member}")
                     self._member_pools[member] = pool
         return pool
@@ -3289,6 +3360,7 @@ class Session:
         self._canary_stop.set()
         self._canary.join(timeout=2.0)
         self._scrubber.stop()
+        self._tuner.stop()
         self._pool.shutdown(wait=True)
         if self._canary_buf is not None:
             try:

@@ -548,6 +548,19 @@ class MemberHealthMachine:
                 return 0.0
             return max(0.0, time.monotonic() - rec.since)
 
+    def unhealthy_members(self) -> List[Tuple[int, str]]:
+        """Members off plain HEALTHY, with their state names — the
+        autotune freeze predicate (ISSUE 18): the controller suspends
+        probing whenever the fault ladder owns any part of the stripe."""
+        now = time.monotonic()
+        out: List[Tuple[int, str]] = []
+        with self._lock:
+            for m, rec in self._m.items():
+                self._expire(m, rec, now)
+                if rec.state is not HealthState.HEALTHY:
+                    out.append((m, rec.state.value))
+        return out
+
     def canary_candidates(self) -> List[int]:
         """Members the background prober should touch: FAILED (detect
         recovery) and REJOINING (advance warmup without client traffic).

@@ -147,6 +147,11 @@ class StatRegistry:
         # entered_monotonic).  Written on every transition by
         # fault.MemberHealthMachine; tpu_stat renders state + time-in-state.
         self._member_state: dict = {}
+        # applied-knob gauges (ISSUE 18): member -> {"knob_window",
+        # "knob_cap", "knob_hedge_ms", "knob_step", "knob_freeze"}.
+        # Written by the autotune controller each epoch; surfaced in
+        # member_snapshot()/tpu_stat -v as the live operating point.
+        self._member_knobs: dict = {}
         # last cur_dma_count transition timestamp for the occupancy
         # integral (0 = no transition seen yet)
         self._occ_last_ns = 0
@@ -234,6 +239,26 @@ class StatRegistry:
             for i, v in enumerate(deltas[:LAT_HIST_BUCKETS]):
                 h[i] += v
 
+    def member_hist_snapshot(self) -> dict:
+        """{member: [64 buckets]} copy of the per-member latency
+        histograms — the autotune controller's per-member p99 sensor
+        (epoch deltas of these, not absolutes)."""
+        with self._lock:
+            return {m: list(h) for m, h in self._member_hist.items()}
+
+    def member_knobs(self, member: int, *, window=None, cap=None,
+                     hedge_ms=None, step=None, freeze=None) -> None:
+        """Publish the controller's applied knob values for a member
+        (ISSUE 18); None leaves a field untouched so partial updates
+        compose."""
+        with self._lock:
+            d = self._member_knobs.setdefault(member, {})
+            for k, v in (("knob_window", window), ("knob_cap", cap),
+                         ("knob_hedge_ms", hedge_ms), ("knob_step", step),
+                         ("knob_freeze", freeze)):
+                if v is not None:
+                    d[k] = v
+
     def member_occ_add(self, member: int, integral_ns: int,
                        busy_ns: int) -> None:
         """Fold a per-member queue-occupancy delta: mean in-flight depth
@@ -311,6 +336,9 @@ class StatRegistry:
                 d = out.setdefault(k, {"nreq": 0, "bytes": 0, "clk_ns": 0})
                 d["state"] = st
                 d["state_s"] = round(now - since, 3)
+            for k, knobs in self._member_knobs.items():
+                d = out.setdefault(k, {"nreq": 0, "bytes": 0, "clk_ns": 0})
+                d.update(knobs)
             return out
 
     def shard_wait(self, shard: int, ns: int) -> None:

@@ -1,0 +1,346 @@
+"""Self-driving data-path gate (ISSUE 18, ``make autotune-gate``).
+
+Holds the controller's contracts on deterministic synthetics:
+
+* **Convergence** — from deliberately bad static knobs (submit_window=2,
+  256K request cap) on a latency-injected loopback fake, the controller
+  must reach >= ``STROM_AUTOTUNE_RATIO`` (default 1.5x) the static
+  throughput within ``STROM_AUTOTUNE_EPOCHS`` (default 20) epochs, stay
+  byte-identical throughout, and SETTLE: no step reversals in the last
+  5 epochs (the hysteresis contract).
+* **Health freeze** — a seeded mid-run member fail-stop freezes tuning
+  (``nr_autotune_freeze`` > 0, no knob steps while frozen) while reads
+  keep serving byte-identically from the mirror, inside the
+  degraded-mode floor (no cliff beyond ``STROM_AUTOTUNE_DEGRADED_X``).
+* **Readahead** — a strided scan reaches cache hit ratio >=
+  ``STROM_RA_HIT_RATIO`` (default 0.5) where a cold scan gets ~0; with
+  a deliberately tiny budget the token bucket SKIPS predictions and
+  prefetched bytes never exceed rate*elapsed + burst.
+* **Off is off** — ``readahead=off`` leaves every readahead counter at
+  zero and the scan's cache numbers exactly at their cold values.
+
+Runs in ``make autotune-gate`` (wired into ``make check``).
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+RATIO = float(os.environ.get("STROM_AUTOTUNE_RATIO", "1.5"))
+EPOCHS = int(os.environ.get("STROM_AUTOTUNE_EPOCHS", "20"))
+DEGRADED_X = float(os.environ.get("STROM_AUTOTUNE_DEGRADED_X", "15.0"))
+HIT_RATIO = float(os.environ.get("STROM_RA_HIT_RATIO", "0.5"))
+
+CHUNK = 64 << 10
+
+
+def _counter(name: str) -> int:
+    from ..stats import stats
+    return stats.snapshot(reset_max=False).counters.get(name, 0)
+
+
+def _read_pass(sess, src, chunk_ids) -> bytes:
+    handle, buf = sess.alloc_dma_buffer(len(chunk_ids) * CHUNK)
+    try:
+        res = sess.memcpy_ssd2ram(src, handle, list(chunk_ids), CHUNK)
+        sess.memcpy_wait(res.dma_task_id, timeout=120.0)
+        return bytes(buf.view()[:len(chunk_ids) * CHUNK])
+    finally:
+        sess.unmap_buffer(handle)
+
+
+def _bad_statics(config) -> None:
+    """The deliberately bad defaults the ISSUE prescribes: a planning
+    window of 2 and a 256K request cap on a device whose injected
+    latency is per REQUEST, so small windows and small requests both
+    multiply the latency bill."""
+    config.set("io_backend", "python")   # fake latency rides the pool path
+    config.set("submit_window", 2)
+    config.set("member_queue_depth", 2)
+    config.set("dma_max_size", 256 << 10)
+    config.set("cache_bytes", 0)
+    config.set("cache_arbitration", False)
+    config.set("hedge_policy", "off")
+    config.set("readahead", False)
+
+
+def _leg_convergence(dirpath: str) -> None:
+    """Controller >= RATIO x static within EPOCHS epochs, byte identity
+    every pass, no step reversals in the last 5 epochs."""
+    from ..config import config
+    from ..engine import Session
+    from . import FakeStripedNvmeSource, FaultPlan, make_test_file
+
+    # 2-member stripe: member pools are the concurrency the window knob
+    # drives (single-member fakes ride the global task pool instead),
+    # and the per-REQUEST injected latency makes both levers count —
+    # wider windows widen the pools AND merge more chunks per request
+    nchunks, lat = 64, 0.02
+    paths = []
+    for i in range(2):
+        p = os.path.join(dirpath, f"conv{i}.bin")
+        make_test_file(p, nchunks // 2 * CHUNK)
+        paths.append(p)
+    _bad_statics(config)
+    expect = None
+
+    def one_pass(sess, src) -> float:
+        nonlocal expect
+        t0 = time.perf_counter()
+        got = _read_pass(sess, src, range(nchunks))
+        el = time.perf_counter() - t0
+        if expect is None:
+            expect = got
+        assert got == expect, "bytes diverged during tuning"
+        return el
+
+    config.set("autotune", False)
+    src = FakeStripedNvmeSource(paths, CHUNK,
+                                fault_plan=FaultPlan(latency_s=lat),
+                                force_cached_fraction=0.0)
+    try:
+        with Session() as sess:
+            static = [one_pass(sess, src) for _ in range(4)]
+        config.set("autotune", True)
+        epochs = []
+        with Session() as sess:
+            sess._tuner.stop()   # gate drives epochs synchronously
+            for _ in range(EPOCHS):
+                epochs.append(one_pass(sess, src))
+                sess._tuner.step_epoch()
+            history = sess._tuner._climber.history
+    finally:
+        src.close()
+        config.set("autotune", False)
+    s_med = statistics.median(static)
+    conv = statistics.median(epochs[-5:])
+    ratio = s_med / conv if conv > 0 else float("inf")
+    tail_reverts = sum(1 for epoch in history[-5:]
+                       for (kind, *_rest) in epoch if kind == "revert")
+    assert ratio >= RATIO, \
+        f"converged only {ratio:.2f}x static (limit {RATIO}x; static " \
+        f"{s_med * 1e3:.0f}ms converged {conv * 1e3:.0f}ms)"
+    assert tail_reverts == 0, \
+        f"knob trajectory did not settle: {tail_reverts} reversal(s) " \
+        f"in the last 5 epochs"
+    print(f"autotune-gate convergence leg ok: {ratio:.1f}x static "
+          f"(static {s_med * 1e3:.0f}ms -> converged {conv * 1e3:.0f}ms, "
+          f"{len(epochs)} epochs, settled)")
+
+
+def _leg_health_freeze(dirpath: str) -> None:
+    """Mid-run member fail-stop: tuning freezes, mirror keeps serving
+    identical bytes, no cliff beyond the degraded-mode floor."""
+    from ..config import config
+    from ..engine import Session
+    from . import FakeStripedNvmeSource, FaultPlan, make_test_file
+
+    nchunks, lat = 32, 0.003
+    paths = []
+    for i in range(2):
+        p = os.path.join(dirpath, f"frz{i}.bin")
+        # paired mirror: logical capacity is ONE member's worth
+        make_test_file(p, nchunks * CHUNK)
+        paths.append(p)
+    _bad_statics(config)
+    config.set("autotune", True)
+    config.set("quarantine_after", 2)
+    config.set("quarantine_s", 60.0)
+    plan = FaultPlan(latency_s=lat)
+    src = FakeStripedNvmeSource(paths, CHUNK, fault_plan=plan,
+                                force_cached_fraction=0.0, mirror="paired")
+    try:
+        with Session() as sess:
+            sess._tuner.stop()   # gate drives epochs synchronously
+            reference = _read_pass(sess, src, range(nchunks))
+            healthy = []
+            for _ in range(6):
+                t0 = time.perf_counter()
+                got = _read_pass(sess, src, range(nchunks))
+                healthy.append(time.perf_counter() - t0)
+                assert got == reference, "bytes diverged while healthy"
+                sess._tuner.step_epoch()
+            # seed the fail-stop: from here every member-0 read (direct
+            # AND buffered — the device is gone) fails; the ladder must
+            # serve from the paired mirror
+            plan.failstop_member = 0
+            plan.failstop_after = 0
+            freeze0 = _counter("nr_autotune_freeze")
+            nhist = len(sess._tuner._climber.history)
+            degraded = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                got = _read_pass(sess, src, range(nchunks))
+                degraded.append(time.perf_counter() - t0)
+                assert got == reference, "bytes diverged after fail-stop"
+                sess._tuner.step_epoch()
+            frozen = _counter("nr_autotune_freeze") - freeze0
+            # quarantine lands during the first degraded pass (debits >=
+            # quarantine_after immediately), so NO post-failure epoch may
+            # take a knob step
+            frozen_steps = sum(
+                1 for epoch in sess._tuner._climber.history[nhist:]
+                for (k, *_r) in epoch if k == "step")
+            reason = sess._tuner.freeze_reason
+    finally:
+        src.close()
+        config.set("autotune", False)
+    floor = statistics.median(healthy) * DEGRADED_X
+    worst = max(degraded[1:])  # first degraded pass pays the detection
+    assert frozen > 0, "fail-stop never froze the controller"
+    assert frozen_steps == 0, \
+        f"{frozen_steps} knob step(s) taken in frozen epochs"
+    assert worst <= floor, \
+        f"degraded pass {worst * 1e3:.0f}ms beyond the floor " \
+        f"({floor * 1e3:.0f}ms = {DEGRADED_X}x healthy median)"
+    print(f"autotune-gate freeze leg ok: {frozen} frozen epoch(s) "
+          f"({reason or 'recovered'}), mirror served identical bytes, "
+          f"worst degraded pass {worst * 1e3:.0f}ms <= floor")
+
+
+def _strided_scan(sess, src, tuner, nchunks: int, span: int,
+                  expect: bytes) -> None:
+    """Demand-read the file as sequential *span*-chunk strides, ticking
+    the readahead loop after each span (the controller thread's job in
+    production; synchronous here for determinism)."""
+    for first in range(0, nchunks, span):
+        ids = range(first, first + span)
+        got = _read_pass(sess, src, ids)
+        assert got == expect[first * CHUNK:(first + span) * CHUNK], \
+            f"bytes diverged at span {first}"
+        tuner.step_epoch()
+
+
+def _leg_readahead(dirpath: str) -> None:
+    """Strided scan: hit ratio >= HIT_RATIO hot vs ~0 cold; a tiny
+    budget skips predictions and bounds prefetched bytes."""
+    from ..cache import residency_cache
+    from ..config import config
+    from ..engine import Session
+    from . import FakeNvmeSource, FaultPlan, make_test_file
+    from .fake import expected_bytes
+
+    nchunks, span, lat = 64, 4, 0.002
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, "ra.bin")
+    make_test_file(path, size)
+    expect = expected_bytes(0, size)
+    _bad_statics(config)
+    config.set("cache_bytes", 64 << 20)
+    config.set("readahead", True)
+    config.set("readahead_budget_mb_s", 64.0)
+    residency_cache.configure()
+    src = FakeNvmeSource(path, fault_plan=FaultPlan(latency_s=lat),
+                         force_cached_fraction=0.0)
+    h0, m0 = _counter("nr_cache_hit"), _counter("nr_cache_miss")
+    try:
+        with Session() as sess:
+            sess._tuner.stop()   # gate drives the issue loop synchronously
+            _strided_scan(sess, src, sess._tuner, nchunks, span, expect)
+        hits = _counter("nr_cache_hit") - h0
+        misses = _counter("nr_cache_miss") - m0
+        ratio = hits / max(hits + misses, 1)
+        assert ratio >= HIT_RATIO, \
+            f"strided scan hit ratio {ratio:.2f} < {HIT_RATIO} " \
+            f"({hits} hits / {misses} misses)"
+        # budget ceiling: rerun cold with a starved bucket — the loop
+        # must SKIP (never block) and stay under rate*elapsed + burst
+        residency_cache.clear()
+        config.set("readahead_budget_mb_s", 2.0)
+        b0 = _counter("bytes_readahead")
+        s0 = _counter("nr_readahead_skip")
+        t0 = time.perf_counter()
+        with Session() as sess:
+            sess._tuner.stop()
+            burst = sess._tuner._bucket.burst
+            _strided_scan(sess, src, sess._tuner, nchunks, span, expect)
+        elapsed = time.perf_counter() - t0
+        spent = _counter("bytes_readahead") - b0
+        ceiling = 2.0 * (1 << 20) * elapsed + burst
+        assert spent <= ceiling, \
+            f"prefetch spent {spent} bytes over the {ceiling:.0f} budget"
+        assert _counter("nr_readahead_skip") > s0, \
+            "starved bucket never skipped a prediction"
+    finally:
+        src.close()
+        config.set("readahead", False)
+        residency_cache.clear()
+    print(f"autotune-gate readahead leg ok: hit ratio {ratio:.2f} "
+          f"(>= {HIT_RATIO}), budget held ({spent} bytes <= "
+          f"{ceiling:.0f} over {elapsed:.1f}s)")
+
+
+def _leg_off_is_off(dirpath: str) -> None:
+    """readahead=off: zero readahead counters and the strided scan's
+    cache numbers stay exactly cold (no hits, one fill per chunk)."""
+    from ..cache import residency_cache
+    from ..config import config
+    from ..engine import Session
+    from . import FakeNvmeSource, make_test_file
+    from .fake import expected_bytes
+
+    nchunks, span = 32, 4
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, "off.bin")
+    make_test_file(path, size)
+    expect = expected_bytes(0, size)
+    _bad_statics(config)
+    config.set("cache_bytes", 64 << 20)
+    config.set("readahead", False)
+    config.set("autotune", False)
+    residency_cache.configure()
+    residency_cache.clear()
+    before = {n: _counter(n) for n in
+              ("nr_readahead_fill", "nr_readahead_hit", "nr_readahead_skip",
+               "bytes_readahead", "nr_cache_hit", "nr_cache_fill")}
+    src = FakeNvmeSource(path, force_cached_fraction=0.0)
+    try:
+        with Session() as sess:
+            assert not sess._tuner.active, "controller armed while off"
+            _strided_scan(sess, src, sess._tuner, nchunks, span, expect)
+    finally:
+        src.close()
+        residency_cache.clear()
+    for n in ("nr_readahead_fill", "nr_readahead_hit", "nr_readahead_skip",
+              "bytes_readahead"):
+        delta = _counter(n) - before[n]
+        assert delta == 0, f"readahead=off still moved {n} by {delta}"
+    hits = _counter("nr_cache_hit") - before["nr_cache_hit"]
+    fills = _counter("nr_cache_fill") - before["nr_cache_fill"]
+    assert hits == 0, f"off scan saw {hits} cache hits (expected cold)"
+    assert fills == nchunks, \
+        f"off scan filled {fills} extents (expected {nchunks})"
+    print(f"autotune-gate off leg ok: zero readahead counters, cold "
+          f"scan numbers unchanged ({fills} fills, 0 hits)")
+
+
+def main() -> int:
+    from ..cache import residency_cache
+    from ..config import config
+
+    snap = config.snapshot()
+    try:
+        with tempfile.TemporaryDirectory(prefix="strom_autotune_") as d:
+            _leg_convergence(d)
+            _leg_health_freeze(d)
+            _leg_readahead(d)
+            _leg_off_is_off(d)
+    except AssertionError as e:
+        print(f"autotune-gate FAIL: {e}")
+        return 1
+    finally:
+        config.restore(snap)
+        residency_cache.clear()
+        residency_cache.configure()
+    print("autotune-gate ok: controller converges and settles, freezes "
+          "for the health machine, readahead hits under budget, off is "
+          "off")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -431,6 +431,24 @@ def main(argv=None) -> int:
                       f"{c.get('bytes_ici', 0) / 1048576:.1f}MB  "
                       f"kv-migrate {c.get('nr_kv_migrate', 0)}  "
                       f"fail {c.get('nr_kv_migrate_fail', 0)}")
+            # self-driving scoreboard (ISSUE 18): controller decisions
+            # (steps vs reverts tells whether the response surface is
+            # still being climbed or the trajectory has settled; freezes
+            # mean the health machine owned the stripe) plus readahead
+            # effectiveness — fills that never become hits are wasted
+            # budget, skips mean the token bucket is the binding limit
+            if (c.get("nr_autotune_step") or c.get("nr_autotune_revert")
+                    or c.get("nr_autotune_freeze")
+                    or c.get("nr_readahead_fill")
+                    or c.get("nr_readahead_skip")):
+                print(f"autotune: steps {c.get('nr_autotune_step', 0)}  "
+                      f"reverts {c.get('nr_autotune_revert', 0)}  "
+                      f"freezes {c.get('nr_autotune_freeze', 0)}  "
+                      f"ra-fill {c.get('nr_readahead_fill', 0)}  "
+                      f"ra-hit {c.get('nr_readahead_hit', 0)}  "
+                      f"ra-skip {c.get('nr_readahead_skip', 0)}  "
+                      f"ra-bytes "
+                      f"{c.get('bytes_readahead', 0) / 1048576:.1f}MB")
             # write-ladder scoreboard (ISSUE 11): mirror fan-out volume,
             # transient write retries, resync replay progress and
             # read-back verification failures — pending bytes above zero
@@ -498,6 +516,25 @@ def main(argv=None) -> int:
                       f"  {show_avg(v['clk_ns'], v['nreq'])} "
                       f"{_pshow(v.get('p50_ns'))} {_pshow(v.get('p95_ns'))} "
                       f"{occ} {health}")
+            # applied-knob view (ISSUE 18): what the controller is
+            # actually running each member at right now — divergence
+            # between members means per-member climbs hit different
+            # bounds; a freeze reason names the member that owns it
+            knobs = {m: v for m, v in snap["members"].items()
+                     if v.get("knob_window") is not None}
+            if knobs:
+                print("autotune knobs:")
+                print("  member  window   cap        hedge-ms  last-step")
+                for m, v in sorted(knobs.items(), key=lambda kv: int(kv[0])):
+                    hedge = v.get("knob_hedge_ms")
+                    print(f"  {int(m):>6} {int(v['knob_window']):>7} "
+                          f"{int(v.get('knob_cap', 0)):>10} "
+                          f"{hedge if hedge is not None else '--':>9} "
+                          f" {v.get('knob_step') or '--'}")
+                reasons = {v.get("knob_freeze") for v in knobs.values()
+                           if v.get("knob_freeze")}
+                for r in sorted(reasons):
+                    print(f"  FROZEN: {r}")
         if args.verbose and snap.get("shards"):
             # per-shard completion-wait fan-in (ISSUE 17 satellite): how
             # long the sharded batch stream waited on each device shard's

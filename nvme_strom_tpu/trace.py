@@ -117,6 +117,10 @@ EVENT_SCHEMA: Dict[str, str] = {
     "ici_permute": "span",     # on-fabric ring redistribution/gather window
     "shard_wait": "span",      # one shard's submit->completion fan-in wait
     "kv_migrate": "span",      # one KV chain's cross-host migration
+    # self-driving data path (ISSUE 18)
+    "autotune_step": "instant",  # one controller decision (step/revert/
+    #                              freeze; knob + per-member values in args)
+    "readahead_fill": "span",  # one speculative fill: predict -> resident
 }
 
 
@@ -556,8 +560,11 @@ def render_prometheus(payload: dict) -> str:
                 or k.startswith("nr_integrity_") \
                 or k.startswith("nr_scrub_") \
                 or k.startswith("nr_pressure_") \
+                or k.startswith("nr_autotune_") \
+                or k.startswith("nr_readahead_") \
                 or k in ("nr_mirror_write", "nr_write_retry",
-                         "nr_resync_extent", "nr_write_verify_fail"):
+                         "nr_resync_extent", "nr_write_verify_fail",
+                         "bytes_readahead"):
             continue    # landing/cache/write/integrity counters render
             #             as labeled series
         mtype = "gauge" if k in _PROM_GAUGES else "counter"
@@ -613,6 +620,23 @@ def render_prometheus(payload: dict) -> str:
         out.append("# TYPE strom_tpu_write_ops_total counter")
         for op, v in wops:
             out.append(f'strom_tpu_write_ops_total{{op="{op}"}} {v}')
+    # self-driving data path (ISSUE 18): controller decisions and the
+    # speculative-fill funnel as labeled families, so dashboards can
+    # plot tuning activity and prefetch accuracy (hit/fill) vs waste
+    aops = [(op, counters.get(f"nr_autotune_{op}", 0))
+            for op in ("step", "revert", "freeze")]
+    if any(v for _, v in aops):
+        out.append("# TYPE strom_tpu_autotune_ops_total counter")
+        for op, v in aops:
+            out.append(f'strom_tpu_autotune_ops_total{{op="{op}"}} {v}')
+    rops = [(op, counters.get(f"nr_readahead_{op}", 0))
+            for op in ("fill", "hit", "skip")]
+    if any(v for _, v in rops):
+        out.append("# TYPE strom_tpu_readahead_ops_total counter")
+        for op, v in rops:
+            out.append(f'strom_tpu_readahead_ops_total{{op="{op}"}} {v}')
+        emit("strom_tpu_readahead_bytes_total", "counter",
+             counters.get("bytes_readahead", 0))
     ratio = bytes_touched_ratio(counters)
     if ratio is not None:
         emit("strom_tpu_bytes_touched_per_byte_delivered", "gauge",
@@ -623,7 +647,10 @@ def render_prometheus(payload: dict) -> str:
             ("strom_tpu_member_bytes_total", "bytes", "counter"),
             ("strom_tpu_member_busy_ns_total", "clk_ns", "counter"),
             ("strom_tpu_member_errors_total", "errors", "counter"),
-            ("strom_tpu_member_quarantines_total", "quarantines", "counter")):
+            ("strom_tpu_member_quarantines_total", "quarantines", "counter"),
+            ("strom_tpu_member_knob_window", "knob_window", "gauge"),
+            ("strom_tpu_member_knob_cap_bytes", "knob_cap", "gauge"),
+            ("strom_tpu_member_knob_hedge_ms", "knob_hedge_ms", "gauge")):
         rows = [(m, d[key]) for m, d in sorted(members.items(),
                                                key=lambda kv: int(kv[0]))
                 if key in d]

@@ -472,6 +472,29 @@ class TestConfig:
         found = confcheck.run(proj(files, docs={"README.md": "other text"}))
         assert rules_of(found) == ["config.undocumented"]
 
+    def test_config_bounds_fires_for_unbounded_controlled_knob(self):
+        files = {
+            "pkg/config.py": 'Var("tuned_knob", 4, "int", minval=1)\n',
+            "pkg/autotune.py": 'x = config.get("tuned_knob")\n',
+        }
+        found = confcheck.run(proj(files, docs={"README.md": "tuned_knob"}))
+        assert rules_of(found) == ["config.bounds"]
+        assert "maxval" in found[0].message
+
+    def test_config_bounds_clean_when_declared_or_exempt(self):
+        files = {
+            "pkg/config.py": (
+                'Var("tuned_knob", 4, "int", minval=1, maxval=64)\n'
+                'Var("gate_knob", False, "bool")\n'
+                'Var("free_knob", 9, "int")\n'  # not autotune-read: exempt
+            ),
+            "pkg/autotune.py": ('x = config.get("tuned_knob")\n'
+                                'y = config.get("gate_knob")\n'),
+            "pkg/engine.py": 'z = config.get("free_knob")\n',
+        }
+        docs = {"README.md": "tuned_knob gate_knob free_knob"}
+        assert confcheck.run(proj(files, docs=docs)) == []
+
     def test_errno_taxonomy(self):
         src = """
             import errno
