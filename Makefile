@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate probe-loop lint-strom sanitize sanitize-smoke clean
+.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate passthru-gate probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -186,6 +186,18 @@ autotune-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.autotune_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q -m autotune
 
+# Raw-passthrough gate (ISSUE 19): on the deterministic URING_CMD
+# emulator, a fragmented + partially-ineligible layout must read
+# byte-identical through the mixed passthrough/O_DIRECT split, a seeded
+# mirrored-member fail-stop must fall off the passthrough lane with
+# every exit counted, engine_backend pinned to uring/threadpool must
+# move the same bytes with zero passthrough counters, and the
+# submit-overhead A/B row must journal to PASSTHRU_AB.jsonl.  The
+# `passthru` pytest marker rides along.
+passthru-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.passthru_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_passthru.py -q -m passthru
+
 # stromlint (ISSUE 10): the project-invariant static checker — lock
 # discipline, buffer lifetimes, native-ABI drift against csrc/strom_tpu.h,
 # stats/trace surface completeness, config hygiene.  Zero unsuppressed
@@ -218,7 +230,7 @@ sanitize-smoke:
 # then tier-1 tests plus the perf smokes, the seeded member-survival
 # schedules, the trace-overhead, landing and cache gates, and the
 # short sanitizer pass.
-check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate passthru-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

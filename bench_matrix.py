@@ -192,6 +192,23 @@ print("autotune A/B:", row["speedup"], "x static")
 print(f"GBPS={{n * CH / conv / (1<<30):.3f}}")
 """
 
+_PASSTHRU_AB = _COMMON + """
+# raw-passthrough submit overhead A/B (ISSUE 19): per-request cost of
+# the resolved-SLBA raw command lane vs the O_DIRECT lane over the same
+# extents, on the deterministic URING_CMD emulator — measures the
+# submit-path machinery the raw rung deletes (per-request fd/alignment
+# bounce, VFS dispatch), so it is disk-independent and runs on hosts
+# with no NVMe char device.  Journals one JSON line per run to
+# PASSTHRU_AB.jsonl (the same row `make passthru-gate` asserts on);
+# GBPS reports the passthrough lane's per-request service rate.
+import tempfile
+from nvme_strom_tpu.testing.passthru_gate import ab_submit_overhead
+with tempfile.TemporaryDirectory(prefix="strom_passthru_ab_") as d:
+    row = ab_submit_overhead(d)
+print("passthru A/B:", row["reduction"], "x O_DIRECT per-request cost")
+print(f"GBPS={{row['req_bytes'] / row['passthru_ns_per_req'] * 1e9 / (1<<30):.3f}}")
+"""
+
 _MULTIHOST = _COMMON + """
 # multi-host sharded load (ISSUE 17): per-host engine sessions read the
 # ownership-split chunk grid concurrently and the landed shards
@@ -697,6 +714,8 @@ def main() -> int:
          _MULTIHOST.format(size=size, path=base + ".bin", hosts=2), None),
         ("autotune_convergence", "online autotuner vs bad statics (A/B)",
          _AUTOTUNE_AB.format(size=size, repo=REPO), None),
+        ("passthru_submit_overhead", "raw NVMe cmd vs O_DIRECT submit (A/B)",
+         _PASSTHRU_AB.format(size=size), None),
         ("scan_filter", "heap scan -> HBM + pallas filter",
          _SCAN.format(size=size, path=base), None),
         ("filter_pallas_chip", "on-chip pallas filter kernel",
@@ -799,7 +818,10 @@ def _write_matrix(size_mb: int, results: dict, captured_at: dict) -> str:
                   if raw and k not in ("raw_seq_read", "raw_seq_write",
                                        "ram2ssd_seq", "ctas_write",
                                        "ckpt_save", "scan_heavy_serial",
-                                       "scan_heavy_workers4")
+                                       "scan_heavy_workers4",
+                                       # per-request latency A/B on the
+                                       # emulator, not a throughput row
+                                       "passthru_submit_overhead")
                   and not k.endswith("_chip")}
     if raww and "ram2ssd_seq" in results:
         # the write leg's denominator is the raw WRITE bandwidth

@@ -133,7 +133,9 @@ int main(int argc, char** argv) {
   // below the old 1-syscall-per-SQE discipline.  8 reqs/task over 4
   // members/rings = 4 enters/task ideal (0.5/req); resubmits and window
   // flushes add some, so assert a loose 0.9.
-  if (backend == NSTPU_BACKEND_IO_URING && enters_per_req > 0.9) {
+  bool ring_backend = backend == NSTPU_BACKEND_IO_URING ||
+                      backend == NSTPU_BACKEND_NVME_PASSTHRU;
+  if (ring_backend && enters_per_req > 0.9) {
     fprintf(stderr, "FAIL: enters/req=%.3f (batching regressed)\n",
             enters_per_req);
     nstpu_engine_destroy(eng);
@@ -142,13 +144,47 @@ int main(int argc, char** argv) {
   nstpu_engine_destroy(eng);
   if (failures.load()) return 1;
 
-  // failover phase (PR 1): NSTPU_DISABLE_URING makes io_uring setup fail,
-  // so an AUTO engine must come up on the threadpool and still serve I/O —
-  // the graceful-degradation contract the Python engine's backend fallback
+  // failover phase (PR 19): with passthrough disabled (or, equivalently, no
+  // char device) an AUTO engine must land on io_uring — the MIDDLE rung —
+  // never fall straight through to the threadpool.  Only assert when this
+  // host demonstrably has a working io_uring (the main phase came up on a
+  // ring backend); the refusal reason must say "disabled", not "no device".
+  if (ring_backend) {
+    setenv("NSTPU_DISABLE_PASSTHRU", "1", 1);
+    uint64_t peng = nstpu_engine_create2(NSTPU_BACKEND_AUTO, 32, 4);
+    unsetenv("NSTPU_DISABLE_PASSTHRU");
+    if (!peng) {
+      fprintf(stderr, "FAIL: AUTO engine create with passthru disabled\n");
+      return 1;
+    }
+    int pbackend = nstpu_engine_backend(peng);
+    int preason = nstpu_engine_passthru_reason(peng);
+    nstpu_engine_destroy(peng);
+    if (pbackend != NSTPU_BACKEND_IO_URING) {
+      fprintf(stderr,
+              "FAIL: passthru-disabled AUTO should land on io_uring, "
+              "got backend=%d\n",
+              pbackend);
+      return 1;
+    }
+    if (preason != NSTPU_PASSTHRU_EDISABLED) {
+      fprintf(stderr, "FAIL: expected EDISABLED refusal reason, got %d\n",
+              preason);
+      return 1;
+    }
+    printf("failover: AUTO with NSTPU_DISABLE_PASSTHRU -> io_uring OK\n");
+  }
+
+  // failover phase (PR 1, extended PR 19): NSTPU_DISABLE_URING makes
+  // io_uring setup fail — and with passthrough ALSO disabled the whole
+  // ladder must still bottom out on the threadpool and serve I/O — the
+  // graceful-degradation contract the Python engine's backend fallback
   // relies on, exercised under the same sanitizer build
+  setenv("NSTPU_DISABLE_PASSTHRU", "1", 1);
   setenv("NSTPU_DISABLE_URING", "1", 1);
   uint64_t feng = nstpu_engine_create2(NSTPU_BACKEND_AUTO, 32, 4);
   unsetenv("NSTPU_DISABLE_URING");
+  unsetenv("NSTPU_DISABLE_PASSTHRU");
   if (!feng) {
     fprintf(stderr, "FAIL: AUTO engine create with uring disabled\n");
     return 1;
@@ -185,6 +221,8 @@ int main(int argc, char** argv) {
       return 1;
     }
   }
-  printf("failover: AUTO with NSTPU_DISABLE_URING -> threadpool OK\n");
+  printf(
+      "failover: AUTO with NSTPU_DISABLE_PASSTHRU+NSTPU_DISABLE_URING -> "
+      "threadpool OK\n");
   return 0;
 }

@@ -16,6 +16,7 @@
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -24,6 +25,8 @@
 #include <fcntl.h>
 #include <linux/io_uring.h>
 #include <pthread.h>
+#include <stdlib.h>
+#include <sys/ioctl.h>
 #include <sys/mman.h>
 #include <sys/syscall.h>
 #include <sys/uio.h>
@@ -84,6 +87,105 @@ static_assert(sizeof(nstpu_rsrc_register) == sizeof(io_uring_rsrc_register),
 #define IORING_RSRC_REGISTER_SPARSE (1U << 0)
 #endif
 
+// ---------------------------------------------------------------------------
+// NVMe passthrough UAPI mirrors (API v4).  Build-image headers may predate
+// io_uring command passthrough entirely (5.19), so every constant and
+// struct the submit path needs is mirrored locally with the layout pinned
+// by static_assert — same discipline as nstpu_rsrc_register above.  The
+// running kernel decides actual support at probe time.
+// ---------------------------------------------------------------------------
+
+#ifndef IORING_SETUP_SQE128
+#define IORING_SETUP_SQE128 (1U << 10)  // 128-byte SQEs (passthru cmds)
+#endif
+#ifndef IORING_SETUP_CQE32
+#define IORING_SETUP_CQE32 (1U << 11)   // 32-byte CQEs (cmd result space)
+#endif
+#ifndef IORING_REGISTER_PROBE
+#define IORING_REGISTER_PROBE 8
+#endif
+// IORING_OP_URING_CMD slot (stable since 5.19); old headers lack the enum
+#define NSTPU_IORING_OP_URING_CMD 46
+#define NSTPU_IO_URING_OP_SUPPORTED (1U << 0)
+
+// io_uring_probe mirror (header may predate it): 16-byte header + ops
+struct nstpu_uring_probe_op {
+  uint8_t op;
+  uint8_t resv;
+  uint16_t flags;
+  uint32_t resv2;
+};
+struct nstpu_uring_probe {
+  uint8_t last_op;
+  uint8_t ops_len;
+  uint16_t resv;
+  uint32_t resv2[3];
+  nstpu_uring_probe_op ops[64];
+};
+static_assert(sizeof(nstpu_uring_probe) == 16 + 64 * 8,
+              "io_uring_probe mirror layout drifted");
+
+// struct nvme_uring_cmd (linux/nvme_ioctl.h): the 72-byte raw command the
+// kernel copies from sqe->cmd — the userspace mirror of the reference's
+// raw READ command build (kmod/nvme_strom.c:1518-1589).
+struct nstpu_nvme_uring_cmd {
+  uint8_t opcode;
+  uint8_t flags;
+  uint16_t rsvd1;
+  uint32_t nsid;
+  uint32_t cdw2;
+  uint32_t cdw3;
+  uint64_t metadata;
+  uint64_t addr;
+  uint32_t metadata_len;
+  uint32_t data_len;
+  uint32_t cdw10;  // SLBA low
+  uint32_t cdw11;  // SLBA high
+  uint32_t cdw12;  // NLB - 1 (0-based block count)
+  uint32_t cdw13;
+  uint32_t cdw14;
+  uint32_t cdw15;
+  uint32_t timeout_ms;
+  uint32_t rsvd2;
+};
+static_assert(sizeof(nstpu_nvme_uring_cmd) == 72,
+              "nvme_uring_cmd mirror layout drifted");
+
+// struct nvme_passthru_cmd (same wire layout, `result` in the last word)
+// for the synchronous identify-namespace admin ioctl at probe time.
+struct nstpu_nvme_passthru_cmd {
+  uint8_t opcode;
+  uint8_t flags;
+  uint16_t rsvd1;
+  uint32_t nsid;
+  uint32_t cdw2;
+  uint32_t cdw3;
+  uint64_t metadata;
+  uint64_t addr;
+  uint32_t metadata_len;
+  uint32_t data_len;
+  uint32_t cdw10;
+  uint32_t cdw11;
+  uint32_t cdw12;
+  uint32_t cdw13;
+  uint32_t cdw14;
+  uint32_t cdw15;
+  uint32_t timeout_ms;
+  uint32_t result;
+};
+static_assert(sizeof(nstpu_nvme_passthru_cmd) == 72,
+              "nvme_passthru_cmd mirror layout drifted");
+
+// _IO('N', 0x40) / _IOWR('N', 0x41, nvme_admin_cmd) / _IOWR('N', 0x80,
+// nvme_uring_cmd) — precomputed so no <linux/nvme_ioctl.h> is needed
+#define NSTPU_NVME_IOCTL_ID 0x4E40u
+#define NSTPU_NVME_IOCTL_ADMIN_CMD 0xC0484E41u
+#define NSTPU_NVME_URING_CMD_IO 0xC0484E80u
+#define NSTPU_NVME_CMD_READ 0x02  // NVM command set READ opcode
+// sqe->cmd offset: the passthru command block starts at byte 48 of the
+// 128-byte SQE (old headers have no `cmd` member to name it by)
+#define NSTPU_SQE_CMD_OFFSET 48
+
 struct Uring {
   int fd = -1;
   unsigned sq_entries = 0, cq_entries = 0;
@@ -104,17 +206,26 @@ struct Uring {
   unsigned* cq_mask = nullptr;
   io_uring_cqe* cqes = nullptr;
   bool single_mmap = false;
+  // Stride shifts: passthru rings use 128-byte SQEs (the extra 80 bytes hold
+  // the raw nvme_uring_cmd) and 32-byte CQEs, selected by init(entries, true).
+  // All indexed access goes through get_sqe()/cqe_at() so both geometries
+  // share one code path.
+  unsigned sqe_shift = 6;  // 64B default, 7 for SQE128
+  unsigned cqe_shift = 4;  // 16B default, 5 for CQE32
 
-  bool init(unsigned entries) {
+  bool init(unsigned entries, bool big = false) {
     struct io_uring_params p;
     memset(&p, 0, sizeof p);
+    if (big) p.flags |= IORING_SETUP_SQE128 | IORING_SETUP_CQE32;
+    sqe_shift = big ? 7 : 6;
+    cqe_shift = big ? 5 : 4;
     fd = sys_io_uring_setup(entries, &p);
     if (fd < 0) return false;
     sq_entries = p.sq_entries;
     cq_entries = p.cq_entries;
     single_mmap = p.features & IORING_FEAT_SINGLE_MMAP;
     sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
-    cq_ring_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    cq_ring_sz = p.cq_off.cqes + ((size_t)p.cq_entries << cqe_shift);
     if (single_mmap) sq_ring_sz = cq_ring_sz = std::max(sq_ring_sz, cq_ring_sz);
     sq_ring = mmap(nullptr, sq_ring_sz, PROT_READ | PROT_WRITE,
                    MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
@@ -124,7 +235,7 @@ struct Uring {
                   : mmap(nullptr, cq_ring_sz, PROT_READ | PROT_WRITE,
                          MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
     if (cq_ring == MAP_FAILED) return fail();
-    sqes_sz = p.sq_entries * sizeof(io_uring_sqe);
+    sqes_sz = (size_t)p.sq_entries << sqe_shift;
     sqes = (io_uring_sqe*)mmap(nullptr, sqes_sz, PROT_READ | PROT_WRITE,
                                MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES);
     if (sqes == MAP_FAILED) return fail();
@@ -162,10 +273,15 @@ struct Uring {
     unsigned head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
     unsigned tail = *sq_tail;
     if (tail - head >= sq_entries) return nullptr;  // SQ full
-    io_uring_sqe* sqe = &sqes[tail & *sq_mask];
-    memset(sqe, 0, sizeof *sqe);
+    io_uring_sqe* sqe =
+        (io_uring_sqe*)((char*)sqes + ((size_t)(tail & *sq_mask) << sqe_shift));
+    memset(sqe, 0, (size_t)1 << sqe_shift);
     sq_array[tail & *sq_mask] = tail & *sq_mask;
     return sqe;
+  }
+  // CQE at ring index (stride-aware; idx already masked by the caller)
+  io_uring_cqe* cqe_at(unsigned idx) const {
+    return (io_uring_cqe*)((char*)cqes + ((size_t)idx << cqe_shift));
   }
   void advance_sq() {
     __atomic_store_n(sq_tail, *sq_tail + 1, __ATOMIC_RELEASE);
@@ -206,6 +322,9 @@ struct ReqCtx {
   uint64_t t_start;   // submit timestamp for per-member busy time
   uint8_t ring_idx = 0;    // which ring owns this request's window slot
   int16_t fixed_idx = -1;  // registered-buffer slot, resolved pre-queue
+  // NSTPU_REQ_PASSTHRU: file_off is a DEVICE byte offset; queued as a raw
+  // NVMe READ via IORING_OP_URING_CMD against the engine's char-dev fd
+  bool passthru = false;
   // publication fence: submitter->reaper handoff otherwise flows through the
   // kernel ring, which TSAN cannot see; store-release before queueing, and
   // load-acquire on pickup, makes the happens-before edge explicit
@@ -252,6 +371,16 @@ struct RingCtx {
 struct Engine {
   int backend = NSTPU_BACKEND_THREADPOOL;
   unsigned depth = 32;
+  // passthrough state (API v4): char-dev fd + geometry when the top rung
+  // of the ladder won; otherwise passthru_reason says which probe rung
+  // refused (0 = active, negative NSTPU_PASSTHRU_*)
+  struct PtState {
+    int dev_fd = -1;
+    uint32_t nsid = 0;
+    unsigned lba_shift = 9;
+  } pt;
+  int passthru_reason = NSTPU_PASSTHRU_ENODEV;
+  std::string pt_dev;  // device path requested at create (may be empty)
   std::atomic<uint64_t> ctr[NSTPU_CTR__COUNT];
   // per-member request/byte/busy-ns counters (part_stat_add analog,
   // kmod/nvme_strom.c:1101-1123)
@@ -393,13 +522,94 @@ struct Engine {
     unsigned head = __atomic_load_n(ring.cq_head, __ATOMIC_RELAXED);
     unsigned tail = __atomic_load_n(ring.cq_tail, __ATOMIC_ACQUIRE);
     if (head == tail) return false;
-    int res = ring.cqes[head & *ring.cq_mask].res;
+    int res = ring.cqe_at(head & *ring.cq_mask)->res;
     __atomic_store_n(ring.cq_head, head + 1, __ATOMIC_RELEASE);
     return res != -EINVAL && res != -EOPNOTSUPP;
   }
   bool probe_ops(Uring& ring) {
     return probe_one_op(ring, IORING_OP_READ) &&
            probe_one_op(ring, IORING_OP_WRITE);
+  }
+
+  // ---- NVMe char-device passthrough probe (API v4) -----------------------
+  // The userspace analog of the reference taking the raw NVMe queue
+  // (kmod/nvme_strom.c:1518-1589): verify, at engine create, that
+  //  (1) the char device opens,
+  //  (2) it answers NVME_IOCTL_ID (it really is an NVMe ns node),
+  //  (3) the kernel supports IORING_OP_URING_CMD (io_uring_probe),
+  //  (4) identify-namespace yields a sane LBA format.
+  // Returns the LBA shift (>= 0) on success, or a negative
+  // NSTPU_PASSTHRU_* refusal reason — the ladder records which rung
+  // refused so "why is passthru off" is answerable from counters.
+  static int passthru_probe_dev(const char* dev, PtState* out) {
+    const char* no_pt = getenv("NSTPU_DISABLE_PASSTHRU");
+    if (no_pt && *no_pt && *no_pt != '0') return NSTPU_PASSTHRU_EDISABLED;
+    if (!dev || !*dev) return NSTPU_PASSTHRU_ENODEV;
+    int fd = open(dev, O_RDONLY);
+    if (fd < 0) return NSTPU_PASSTHRU_ENODEV;
+    int nsid = ioctl(fd, NSTPU_NVME_IOCTL_ID);
+    if (nsid <= 0) {
+      close(fd);
+      return NSTPU_PASSTHRU_ENODEV;
+    }
+    // kernel-side URING_CMD support: a throwaway big ring + opcode probe
+    {
+      Uring probe_ring;
+      if (!probe_ring.init(4, /*big=*/true)) {
+        close(fd);
+        return NSTPU_PASSTHRU_ENOURING;
+      }
+      auto* pr = (nstpu_uring_probe*)calloc(1, sizeof(nstpu_uring_probe));
+      bool cmd_ok = false;
+      if (pr &&
+          sys_io_uring_register(probe_ring.fd, IORING_REGISTER_PROBE, pr,
+                                64) == 0)
+        cmd_ok = pr->last_op >= NSTPU_IORING_OP_URING_CMD &&
+                 (pr->ops[NSTPU_IORING_OP_URING_CMD].flags &
+                  NSTPU_IO_URING_OP_SUPPORTED);
+      free(pr);
+      probe_ring.destroy();
+      if (!cmd_ok) {
+        close(fd);
+        return NSTPU_PASSTHRU_ENOCMD;
+      }
+    }
+    // identify-namespace (admin opcode 0x06, CNS 0): flbas selects the
+    // active LBA format; lbads is its log2 data size.  4K-aligned buffer —
+    // the admin path DMAs into it.
+    void* idbuf = nullptr;
+    if (posix_memalign(&idbuf, 4096, 4096) != 0 || !idbuf) {
+      close(fd);
+      return NSTPU_PASSTHRU_ELBAFMT;
+    }
+    memset(idbuf, 0, 4096);
+    nstpu_nvme_passthru_cmd cmd;
+    memset(&cmd, 0, sizeof cmd);
+    cmd.opcode = 0x06;  // identify
+    cmd.nsid = (uint32_t)nsid;
+    cmd.addr = (uint64_t)idbuf;
+    cmd.data_len = 4096;
+    cmd.cdw10 = 0;  // CNS 0: identify namespace
+    int rc = ioctl(fd, NSTPU_NVME_IOCTL_ADMIN_CMD, &cmd);
+    unsigned lba_shift = 0;
+    if (rc == 0) {
+      auto* id = (const uint8_t*)idbuf;
+      unsigned fmt = id[26] & 0xF;  // flbas low nibble
+      lba_shift = id[128 + 4 * fmt + 2];
+    }
+    free(idbuf);
+    if (rc != 0 || lba_shift < 9 || lba_shift > 16) {
+      close(fd);
+      return NSTPU_PASSTHRU_ELBAFMT;
+    }
+    if (out) {
+      out->dev_fd = fd;
+      out->nsid = (uint32_t)nsid;
+      out->lba_shift = lba_shift;
+    } else {
+      close(fd);
+    }
+    return (int)lba_shift;
   }
 
   // ring count when the caller does not fix one (nstpu_engine_create /
@@ -442,6 +652,62 @@ struct Engine {
     bool uring_disabled = no_uring && *no_uring && *no_uring != '0';
     if (uring_disabled && want_backend == NSTPU_BACKEND_IO_URING)
       return false;
+    // Top rung (API v4): raw NVMe passthrough over the char device.  Only
+    // attempted when a device path is known; every refusal keeps its reason
+    // in passthru_reason so the binding can count WHY the ladder fell.
+    passthru_reason = NSTPU_PASSTHRU_EDISABLED;  // explicit lower backend
+    if (want_backend == NSTPU_BACKEND_AUTO ||
+        want_backend == NSTPU_BACKEND_NVME_PASSTHRU) {
+      const char* dev = !pt_dev.empty() ? pt_dev.c_str()
+                                        : getenv("NSTPU_PASSTHRU_DEV");
+      int pr = passthru_probe_dev(dev, &pt);
+      if (pr >= 0) {
+        // big rings: SQE128 carries the 72-byte nvme_uring_cmd inline
+        unsigned nr = nrings_want ? nrings_want : want_rings();
+        bool ok = true;
+        for (unsigned i = 0; i < nr; i++) {
+          auto* rx = new RingCtx();
+          if (!rx->ring.init(depth, /*big=*/true)) {
+            delete rx;
+            ok = !rings.empty();
+            break;
+          }
+          rings.push_back(rx);
+        }
+        // passthru rings still serve plain READ/WRITE (continuations,
+        // non-eligible extents never reach here, but probe_ops keeps the
+        // same "opcodes actually work" guarantee as the uring rung)
+        if (ok && !rings.empty() && probe_ops(rings[0]->ring)) {
+          backend = NSTPU_BACKEND_NVME_PASSTHRU;
+          depth = rings[0]->ring.sq_entries;
+          passthru_reason = 0;
+          fixed_ok = true;
+          for (auto* rx : rings) {
+            struct nstpu_rsrc_register rr;
+            memset(&rr, 0, sizeof rr);
+            rr.nr = kFixedSlots;
+            rr.flags = IORING_RSRC_REGISTER_SPARSE;
+            if (sys_io_uring_register(rx->ring.fd, IORING_REGISTER_BUFFERS2,
+                                      &rr, sizeof rr) != 0)
+              fixed_ok = false;
+          }
+          for (auto* rx : rings)
+            rx->reaper = std::thread([this, rx] { reap_loop(rx); });
+          return true;
+        }
+        for (auto* rx : rings) {
+          rx->ring.destroy();
+          delete rx;
+        }
+        rings.clear();
+        close(pt.dev_fd);
+        pt.dev_fd = -1;
+        passthru_reason = NSTPU_PASSTHRU_ENOURING;
+      } else {
+        passthru_reason = pr;
+      }
+      if (want_backend == NSTPU_BACKEND_NVME_PASSTHRU) return false;
+    }
     if (!uring_disabled &&
         (want_backend == NSTPU_BACKEND_AUTO ||
          want_backend == NSTPU_BACKEND_IO_URING)) {
@@ -498,9 +764,14 @@ struct Engine {
     return true;
   }
 
+  bool ring_backend() const {
+    return backend == NSTPU_BACKEND_IO_URING ||
+           backend == NSTPU_BACKEND_NVME_PASSTHRU;
+  }
+
   void shutdown() {
     if (stopping.exchange(true)) return;
-    if (backend == NSTPU_BACKEND_IO_URING) {
+    if (ring_backend()) {
       for (auto* rx : rings) {
         {  // poke the reaper with a NOP so its GETEVENTS wait returns
           std::lock_guard<std::mutex> lk(rx->sq_m);
@@ -523,6 +794,10 @@ struct Engine {
         for (auto& w : rx->workers)
           if (w.joinable()) w.join();
       }
+    }
+    if (pt.dev_fd >= 0) {
+      close(pt.dev_fd);
+      pt.dev_fd = -1;
     }
   }
 
@@ -629,6 +904,9 @@ struct Engine {
   // with any sq_m.
   void resolve_fixed(ReqCtx* rc) {
     rc->fixed_idx = -1;
+    // passthru commands carry the raw destination pointer in the NVMe
+    // command itself; fixed-buffer slots only apply to READ/WRITE opcodes
+    if (rc->passthru) return;
     if (!fixed_ok) return;
     std::lock_guard<std::mutex> lk(fixed_m);
     for (unsigned i = 0; i < kFixedSlots; i++) {
@@ -649,6 +927,32 @@ struct Engine {
   bool queue_sqe_locked(RingCtx& rx, ReqCtx* rc) {
     io_uring_sqe* sqe = rx.ring.get_sqe();
     if (!sqe) return false;
+    if (rc->passthru) {
+      // raw NVMe READ via IORING_OP_URING_CMD — the userspace mirror of
+      // the reference building the command itself (kmod/nvme_strom.c:
+      // 1518-1589): SLBA/NLB from the blockmap-resolved device offset,
+      // data pointer straight at the destination.  file_off is a DEVICE
+      // byte offset here (LBA-multiple, pre-validated in submit()).
+      sqe->opcode = NSTPU_IORING_OP_URING_CMD;
+      sqe->fd = pt.dev_fd;
+      // sqe->off unions with cmd_op (u32 at byte 8) + __pad1; the 64-bit
+      // store sets cmd_op and zeroes the pad in one go
+      sqe->off = NSTPU_NVME_URING_CMD_IO;
+      auto* cmd =
+          (nstpu_nvme_uring_cmd*)((char*)sqe + NSTPU_SQE_CMD_OFFSET);
+      uint64_t slba = rc->file_off >> pt.lba_shift;
+      cmd->opcode = NSTPU_NVME_CMD_READ;
+      cmd->nsid = pt.nsid;
+      cmd->addr = (uint64_t)rc->dest;
+      cmd->data_len = (uint32_t)rc->remaining;
+      cmd->cdw10 = (uint32_t)slba;
+      cmd->cdw11 = (uint32_t)(slba >> 32);
+      cmd->cdw12 = (uint32_t)((rc->remaining >> pt.lba_shift) - 1);
+      sqe->user_data = (uint64_t)rc;
+      rc->published.store(1, std::memory_order_release);
+      rx.ring.advance_sq();
+      return true;
+    }
     if (rc->fixed_idx >= 0) {
       // destination inside a registered buffer -> fixed opcode: the pages
       // are already pinned + translated, no per-request get_user_pages
@@ -682,13 +986,21 @@ struct Engine {
         continue;
       }
       while (head != tail) {
-        io_uring_cqe* cqe = &ring.cqes[head & *ring.cq_mask];
+        io_uring_cqe* cqe = ring.cqe_at(head & *ring.cq_mask);
         auto* rc = (ReqCtx*)cqe->user_data;
         int res = cqe->res;
         head++;
         __atomic_store_n(ring.cq_head, head, __ATOMIC_RELEASE);
         if (!rc) continue;  // shutdown NOP
         rc->published.load(std::memory_order_acquire);
+        if (rc->passthru) {
+          // passthru CQE semantics: res is the NVMe command status mapped
+          // by the kernel — 0 = the whole command completed, < 0 = -errno.
+          // Never a byte count, never short: no continuation path.
+          finish_req(rc, res < 0 ? -res : 0);
+          tail = __atomic_load_n(ring.cq_tail, __ATOMIC_ACQUIRE);
+          continue;
+        }
         if (res == -EFAULT && rc->fixed_idx >= 0) {
           // registered-buffer slot churned between resolve_fixed and the
           // kernel's execution (buf_unregister no longer shares a lock
@@ -823,9 +1135,22 @@ struct Engine {
   int64_t submit(void* dest_base, const nstpu_req* reqs, int32_t nreq) {
     if (stopping.load()) return -ESHUTDOWN;
     if (nreq <= 0 || !reqs) return -EINVAL;
+    // NSTPU_REQ_PASSTHRU contract check up front — the whole submit is
+    // refused before any task exists, so a planner bug never half-runs:
+    // flagged requests are read-only, need the passthru backend active,
+    // and file_off/len must be LBA multiples (the command encodes whole
+    // blocks; a misaligned span would silently read the wrong bytes)
+    for (int32_t i = 0; i < nreq; i++) {
+      if (!(reqs[i].flags & NSTPU_REQ_PASSTHRU)) continue;
+      if (backend != NSTPU_BACKEND_NVME_PASSTHRU) return -EINVAL;
+      uint64_t lba_mask = ((uint64_t)1 << pt.lba_shift) - 1;
+      if ((reqs[i].flags & NSTPU_REQ_WRITE) || reqs[i].len == 0 ||
+          (reqs[i].file_off & lba_mask) || (reqs[i].len & lba_mask))
+        return -EINVAL;
+    }
     Task* t = create_task();
     uint64_t t0 = now_ns();
-    bool uring = backend == NSTPU_BACKEND_IO_URING;
+    bool uring = ring_backend();
     // per-ring SQE batches, flushed on window pressure and at the end
     std::vector<std::vector<ReqCtx*>> batches(uring ? rings.size() : 0);
     auto flush_all = [&] {
@@ -845,6 +1170,7 @@ struct Engine {
                             (uint8_t)member,
                             reqs[i].len,
                             now_ns()};
+      rc->passthru = (reqs[i].flags & NSTPU_REQ_PASSTHRU) != 0;
       task_get(t);
       bool shut = false;
       {
@@ -904,6 +1230,8 @@ struct Engine {
         ctr[NSTPU_CTR_TOTAL_WRITE_LENGTH].fetch_add(
             reqs[i].len, std::memory_order_relaxed);
       }
+      if (rc->passthru)
+        ctr[NSTPU_CTR_NR_PASSTHRU_DMA].fetch_add(1, std::memory_order_relaxed);
       if (uring) {
         resolve_fixed(rc);
         batches[rc->ring_idx].push_back(rc);
@@ -1060,7 +1388,7 @@ struct Engine {
   }
 
   int buf_register(void* base, uint64_t len) {
-    if (backend != NSTPU_BACKEND_IO_URING || !fixed_ok) return -ENOSYS;
+    if (!ring_backend() || !fixed_ok) return -ENOSYS;
     if (!base || !len) return -EINVAL;
     std::lock_guard<std::mutex> lk(fixed_m);
     int slot = -1;
@@ -1086,7 +1414,7 @@ struct Engine {
   }
 
   int buf_unregister(int32_t slot) {
-    if (backend != NSTPU_BACKEND_IO_URING || !fixed_ok) return -ENOSYS;
+    if (!ring_backend() || !fixed_ok) return -ENOSYS;
     if (slot < 0 || slot >= (int32_t)kFixedSlots) return -EINVAL;
     std::lock_guard<std::mutex> lk(fixed_m);
     if (fixed[slot].len == 0) return -ENOENT;
@@ -1135,7 +1463,7 @@ const char* nstpu_signature(void) {
 #define NSTPU_BUILD_TS __DATE__ " " __TIME__
 #endif
   return "strom_tpu native engine api " /* api version stringized below */
-         "v3, built " NSTPU_BUILD_TS
+         "v4, built " NSTPU_BUILD_TS
 #ifdef __clang__
          ", clang"
 #elif defined(__GNUC__)
@@ -1144,9 +1472,11 @@ const char* nstpu_signature(void) {
       ;
 }
 
-uint64_t nstpu_engine_create2(int backend, int queue_depth, int nrings) {
+uint64_t nstpu_engine_create3(int backend, int queue_depth, int nrings,
+                              const char* passthru_dev) {
   auto* e = new Engine();
   if (nrings > 0) e->nrings_want = std::min(nrings, 16);
+  if (passthru_dev && *passthru_dev) e->pt_dev = passthru_dev;
   if (!e->init(backend, queue_depth)) {
     delete e;
     return 0;
@@ -1157,8 +1487,24 @@ uint64_t nstpu_engine_create2(int backend, int queue_depth, int nrings) {
   return h;
 }
 
+uint64_t nstpu_engine_create2(int backend, int queue_depth, int nrings) {
+  return nstpu_engine_create3(backend, queue_depth, nrings, nullptr);
+}
+
 uint64_t nstpu_engine_create(int backend, int queue_depth) {
   return nstpu_engine_create2(backend, queue_depth, 0);
+}
+
+int nstpu_passthru_probe(const char* dev_path) {
+  // standalone capability probe (strom_check's blockmap/passthru row):
+  // same ladder as engine create, no engine state left behind
+  return Engine::passthru_probe_dev(dev_path, nullptr);
+}
+
+int nstpu_engine_passthru_reason(uint64_t engine) {
+  Engine* e = lookup(engine);
+  if (!e) return -ENOENT;
+  return e->backend == NSTPU_BACKEND_NVME_PASSTHRU ? 0 : e->passthru_reason;
 }
 
 void nstpu_engine_destroy(uint64_t engine) {

@@ -27,12 +27,16 @@
 extern "C" {
 #endif
 
-#define NSTPU_API_VERSION 3
+#define NSTPU_API_VERSION 4
 
-/* backends */
-#define NSTPU_BACKEND_AUTO       0
-#define NSTPU_BACKEND_IO_URING   1
-#define NSTPU_BACKEND_THREADPOOL 2
+/* backends — a failover ladder, top to bottom: raw NVMe passthrough
+ * (IORING_OP_URING_CMD on the char device, the userspace analog of the
+ * reference's raw command build, kmod/nvme_strom.c:1518-1589), io_uring
+ * on block fds, pread thread pool. */
+#define NSTPU_BACKEND_AUTO          0
+#define NSTPU_BACKEND_IO_URING      1
+#define NSTPU_BACKEND_THREADPOOL    2
+#define NSTPU_BACKEND_NVME_PASSTHRU 3
 
 /* counter indices for nstpu_engine_stats(); order is ABI.
  * Mirrors the reference's count+clock pairs (kmod/nvme_strom.c:83-106). */
@@ -59,6 +63,8 @@ enum {
                                  * transitions: mean queue occupancy over
                                  * an interval is d(integral)/d(busy) */
   NSTPU_CTR_OCC_BUSY_NS,        /* elapsed ns with in_flight > 0 */
+  NSTPU_CTR_NR_PASSTHRU_DMA,    /* requests submitted as raw NVMe READ
+                                 * commands over IORING_OP_URING_CMD */
   NSTPU_CTR__COUNT
 };
 
@@ -69,6 +75,25 @@ enum {
 
 /* request flags */
 #define NSTPU_REQ_WRITE 0x1   /* buffer -> file instead of file -> buffer */
+/* NSTPU_REQ_PASSTHRU: fd is IGNORED (the engine's probed char-device fd is
+ * used), file_off is a DEVICE byte offset (blockmap-resolved: LBA <<
+ * lba_shift) and both file_off and len must be LBA-multiple — the request
+ * is submitted as a raw NVMe READ over IORING_OP_URING_CMD.  Only valid on
+ * the NVME_PASSTHRU backend; misaligned or wrong-backend passthru requests
+ * fail the whole submit with -EINVAL (a device offset must never be
+ * reinterpreted as a file offset). */
+#define NSTPU_REQ_PASSTHRU 0x2
+
+/* nstpu_passthru_probe() refusal reasons (negative), mirrored by the
+ * Python bindings into per-reason fallback counters.  >= 0 means usable
+ * and is the namespace's LBA shift (lba_size = 1 << shift). */
+#define NSTPU_PASSTHRU_EDISABLED -1  /* NSTPU_DISABLE_PASSTHRU env set */
+#define NSTPU_PASSTHRU_ENODEV    -2  /* char device absent / unopenable /
+                                      * not an NVMe namespace node */
+#define NSTPU_PASSTHRU_ENOURING  -3  /* SQE128|CQE32 ring setup failed */
+#define NSTPU_PASSTHRU_ENOCMD    -4  /* IORING_OP_URING_CMD unsupported */
+#define NSTPU_PASSTHRU_ELBAFMT   -5  /* identify-namespace / LBA format
+                                      * rejected (metadata or odd lbads) */
 
 /* stripe-member attribution rides in flags bits 8..15 (index within the
  * striped source, clamped to NSTPU_MAX_MEMBERS-1); per-member counters
@@ -108,6 +133,14 @@ typedef struct nstpu_req {
  * 4x32-deep rings measured ~30% below 1x32 on a one-disk RAID-0). */
 uint64_t nstpu_engine_create(int backend, int queue_depth);
 uint64_t nstpu_engine_create2(int backend, int queue_depth, int nrings);
+/* nstpu_engine_create3 (API v4) additionally names the NVMe character
+ * device (/dev/ngXnY) for the passthrough ladder rung.  passthru_dev ==
+ * NULL falls back to env NSTPU_PASSTHRU_DEV; with neither, AUTO skips
+ * straight to io_uring (reason NSTPU_PASSTHRU_ENODEV retained).  An
+ * explicit NSTPU_BACKEND_NVME_PASSTHRU request fails (returns 0) when the
+ * probe refuses, like an explicit IO_URING under NSTPU_DISABLE_URING. */
+uint64_t nstpu_engine_create3(int backend, int queue_depth, int nrings,
+                              const char* passthru_dev);
 void     nstpu_engine_destroy(uint64_t engine);
 int      nstpu_engine_backend(uint64_t engine);     /* NSTPU_BACKEND_* or -errno */
 int      nstpu_engine_version(void);
@@ -236,6 +269,20 @@ int      nstpu_engine_trace(uint64_t engine, int enable);
  * ring drops its oldest events (seq gaps reveal the loss). */
 int      nstpu_engine_trace_drain(uint64_t engine, nstpu_trace_event* out,
                                   int32_t cap);
+
+/* -- raw NVMe passthrough (API v4) --------------------------------------
+ * Capability probe for one NVMe namespace char device: open + NVME_IOCTL_ID
+ * + an SQE128|CQE32 ring + io_uring_probe(URING_CMD) + identify-namespace
+ * LBA format — the engine-create ladder runs exactly this.  Returns the
+ * LBA shift (>= 9) when passthrough is usable, or a negative
+ * NSTPU_PASSTHRU_* refusal reason.  Never touches engine state. */
+int      nstpu_passthru_probe(const char* dev_path);
+
+/* Why the passthrough rung was (or was not) taken for this engine:
+ * 0 = NVME_PASSTHRU is the active backend; negative NSTPU_PASSTHRU_*
+ * reason = the ladder fell through to io_uring/threadpool; -ENOENT = bad
+ * handle.  The bindings count the reason into per-reason fallback stats. */
+int      nstpu_engine_passthru_reason(uint64_t engine);
 
 #ifdef __cplusplus
 }

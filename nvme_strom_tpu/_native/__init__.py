@@ -23,13 +23,23 @@ _SO = os.path.join(_HERE, "libstrom_tpu.so")
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "csrc")
 
 BACKEND_AUTO, BACKEND_IO_URING, BACKEND_THREADPOOL = 0, 1, 2
-_BACKEND_NAMES = {BACKEND_IO_URING: "io_uring", BACKEND_THREADPOOL: "threadpool"}
+BACKEND_NVME_PASSTHRU = 3
+_BACKEND_NAMES = {BACKEND_AUTO: "auto",
+                  BACKEND_IO_URING: "io_uring",
+                  BACKEND_THREADPOOL: "threadpool",
+                  BACKEND_NVME_PASSTHRU: "nvme_passthru"}
+
+#: nstpu_passthru_probe() / nstpu_engine_passthru_reason() refusal
+#: reasons (negative), keyed by the counter suffix Session uses to count
+#: why the ladder fell (NSTPU_PASSTHRU_* in csrc/strom_tpu.h)
+PASSTHRU_REASONS = {-1: "disabled", -2: "nodev", -3: "nouring",
+                    -4: "nouringcmd", -5: "lbafmt"}
 
 #: NSTPU_API_VERSION — the header contract these bindings mirror.  A
 #: loaded .so reporting a different nstpu_engine_version() is a stale
 #: build (strom_check diagnoses this at startup; stromlint's abi.drift
 #: rule keeps the constant itself honest against csrc/strom_tpu.h).
-API_VERSION = 3
+API_VERSION = 4
 
 # counter order must match enum NSTPU_CTR_* in csrc/strom_tpu.h
 NATIVE_COUNTERS = (
@@ -51,6 +61,9 @@ NATIVE_COUNTERS = (
     # omits the missing tail, so the binding stays compatible both ways.
     "occ_integral_ns",
     "occ_busy_ns",
+    # appended in API v4 (PR 19): requests submitted as raw NVMe READ
+    # commands over the io_uring passthrough rung
+    "nr_passthru_dma",
 )
 
 #: log2-ns latency histogram depth — must match kNstpuLatBuckets in
@@ -58,6 +71,7 @@ NATIVE_COUNTERS = (
 LAT_HIST_BUCKETS = 64
 
 REQ_WRITE = 0x1        # NSTPU_REQ_WRITE
+REQ_PASSTHRU = 0x2     # NSTPU_REQ_PASSTHRU: file_off is a DEVICE byte offset
 REQ_MEMBER_SHIFT = 8   # NSTPU_REQ_MEMBER_SHIFT
 MAX_MEMBERS = 64       # NSTPU_MAX_MEMBERS
 
@@ -169,6 +183,15 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_uint64, ctypes.POINTER(_TraceEvent), ctypes.c_int32]
         except AttributeError:  # pragma: no cover - older .so
             pass
+        try:  # API v4: NVMe passthrough rung
+            lib.nstpu_engine_create3.restype = ctypes.c_uint64
+            lib.nstpu_engine_create3.argtypes = [ctypes.c_int, ctypes.c_int,
+                                                 ctypes.c_int,
+                                                 ctypes.c_char_p]
+            lib.nstpu_passthru_probe.argtypes = [ctypes.c_char_p]
+            lib.nstpu_engine_passthru_reason.argtypes = [ctypes.c_uint64]
+        except AttributeError:  # pragma: no cover - older .so
+            pass
         _lib = lib
         return _lib
 
@@ -189,6 +212,20 @@ def native_api_version() -> Optional[int]:
         return None
 
 
+def passthru_probe(dev_path: Optional[str]) -> Optional[int]:
+    """Capability-probe one NVMe char device for the passthrough rung.
+
+    Returns the device's LBA shift (>= 9) when every rung of the probe
+    passes, a negative ``NSTPU_PASSTHRU_*`` refusal reason when it does
+    not (see :data:`PASSTHRU_REASONS`), or None when the .so is missing
+    or predates API v4."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "nstpu_passthru_probe"):
+        return None
+    dev = dev_path.encode() if dev_path else None
+    return int(lib.nstpu_passthru_probe(dev))
+
+
 def native_signature() -> Optional[str]:
     """Build signature of the loaded .so (the /proc/nvme-strom
     version-read analog), or None when the native engine is unavailable."""
@@ -205,14 +242,21 @@ class NativeEngine:
     """One native engine instance (the 'loaded kernel module' analog)."""
 
     def __init__(self, backend: str = "auto", queue_depth: int = 32,
-                 rings: int = 0):
+                 rings: int = 0, passthru_dev: Optional[str] = None):
         lib = _load()
         if lib is None:
             raise StromError(38, "native engine unavailable (libstrom_tpu.so)")  # ENOSYS
         want = {"auto": BACKEND_AUTO, "io_uring": BACKEND_IO_URING,
-                "threadpool": BACKEND_THREADPOOL}[backend]
+                "threadpool": BACKEND_THREADPOOL,
+                "nvme_passthru": BACKEND_NVME_PASSTHRU}[backend]
         self._lib = lib
-        if rings > 0 and hasattr(lib, "nstpu_engine_create2"):
+        if hasattr(lib, "nstpu_engine_create3") and (
+                passthru_dev or want in (BACKEND_AUTO,
+                                         BACKEND_NVME_PASSTHRU)):
+            self._h = lib.nstpu_engine_create3(
+                want, queue_depth, rings,
+                passthru_dev.encode() if passthru_dev else None)
+        elif rings > 0 and hasattr(lib, "nstpu_engine_create2"):
             self._h = lib.nstpu_engine_create2(want, queue_depth, rings)
         else:
             self._h = lib.nstpu_engine_create(want, queue_depth)
@@ -230,20 +274,25 @@ class NativeEngine:
     def submit(self, dest_addr: int,
                reqs: Sequence[Tuple[int, int, int, int]], *,
                write: bool = False,
-               members: Optional[Sequence[int]] = None) -> int:
+               members: Optional[Sequence[int]] = None,
+               passthru: Optional[Sequence[bool]] = None) -> int:
         """Submit one task of (fd, file_off, len, dest_off) requests.
 
         ``write=True`` reverses direction for the whole task: the buffer
         span at dest_off is WRITTEN to the fd (the GIL-free RAM2SSD leg
         the read-only reference lacked).  ``members[i]`` attributes request
-        *i* to a stripe member for per-member accounting."""
+        *i* to a stripe member for per-member accounting.  ``passthru[i]``
+        marks request *i* as a raw NVMe READ: its file_off is a DEVICE
+        byte offset (blockmap-resolved) and its fd is ignored — only valid
+        on the nvme_passthru backend, refused whole-submit otherwise."""
         arr = (_Req * len(reqs))()
         base_flags = REQ_WRITE if write else 0
         for i, (fd, off, ln, doff) in enumerate(reqs):
             arr[i].fd = fd
             m = members[i] if members is not None else 0
-            arr[i].flags = base_flags | (min(max(m, 0), MAX_MEMBERS - 1)
-                                         << REQ_MEMBER_SHIFT)
+            pt = REQ_PASSTHRU if (passthru is not None and passthru[i]) else 0
+            arr[i].flags = base_flags | pt | (min(max(m, 0), MAX_MEMBERS - 1)
+                                              << REQ_MEMBER_SHIFT)
             arr[i].file_off = off
             arr[i].len = ln
             arr[i].dest_off = doff
@@ -268,6 +317,14 @@ class NativeEngine:
     def buf_unregister(self, slot: int) -> None:
         if hasattr(self._lib, "nstpu_buf_unregister") and self._h:
             self._lib.nstpu_buf_unregister(self._h, slot)
+
+    def passthru_reason(self) -> Optional[int]:
+        """Why the passthrough rung is (in)active: 0 when nvme_passthru IS
+        the backend, a negative ``NSTPU_PASSTHRU_*`` refusal reason when
+        the ladder fell past it, or None on a pre-v4 .so."""
+        if not hasattr(self._lib, "nstpu_engine_passthru_reason"):
+            return None
+        return int(self._lib.nstpu_engine_passthru_reason(self._h))
 
     def nlanes(self) -> int:
         """Lane (queue-pair) count of this engine, 1 on an older .so."""

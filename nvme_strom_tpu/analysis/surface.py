@@ -6,7 +6,8 @@ be renderable by ``tpu_stat`` and the Prometheus surface
 (``surface.stat-render``, ``surface.prom-render``); every trace event kind
 emitted anywhere must appear in the recorder schema with the right kind,
 schema entries must not go stale, and ``*_begin``/``*_end`` span kinds
-must pair (``surface.trace-*``).
+must pair (``surface.trace-*``); every ``NSTPU_BACKEND_*`` rung in the
+native header must appear in both backend legends (``surface.backend``).
 
 Anchors are discovered by content: the file assigning ``STAT_FIELDS`` is
 the stats contract, the file defining ``render_prometheus`` is the prom
@@ -20,6 +21,7 @@ literal coverage.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import Finding, Project, SourceFile
@@ -180,6 +182,55 @@ def _check_renderers(project: Project, fields: Set[str],
         break
 
 
+# -- engine backend legend -------------------------------------------------
+
+def _assigned_literals(tree: ast.AST, name: str) -> Optional[Set[str]]:
+    """String literals under the value assigned to ``name`` (module
+    scope), or None when no such assignment exists."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            tgts, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgts, value = [node.target], node.value
+        else:
+            continue
+        for t in tgts:
+            if isinstance(t, ast.Name) and t.id == name:
+                return _string_constants(value)
+    return None
+
+
+def _check_backends(project: Project, findings: List[Finding]) -> None:
+    """Rule ``surface.backend``: every ``NSTPU_BACKEND_*`` rung declared
+    in the native header must be rendered by the observability surface —
+    its lowercased name in ``_BACKEND_NAMES`` (the ctypes legend feeding
+    ``backend_name`` and hence the stats export) AND in tpu_stat's
+    ``_BACKENDS`` legend.  A new failover rung cannot ship invisible."""
+    if not project.header_text:
+        return
+    rungs = {m.group(1).lower() for m in re.finditer(
+        r"#define\s+NSTPU_BACKEND_(\w+)\b", project.header_text)}
+    if not rungs:
+        return
+    for suffix, legend in (("_native/__init__.py", "_BACKEND_NAMES"),
+                           ("tools/tpu_stat.py", "_BACKENDS")):
+        src = project.file(suffix)
+        if src is None:
+            continue
+        lits = _assigned_literals(src.tree, legend)
+        if lits is None:
+            findings.append(Finding(
+                src.relpath, 1, "surface.backend",
+                f"no {legend} legend found for the NSTPU_BACKEND_* enum "
+                f"({project.header_path})"))
+            continue
+        for rung in sorted(rungs - lits):
+            findings.append(Finding(
+                src.relpath, 1, "surface.backend",
+                f"backend rung '{rung}' (NSTPU_BACKEND_{rung.upper()}, "
+                f"{project.header_path}) missing from {legend}"))
+
+
 # -- trace schema ----------------------------------------------------------
 
 def _collect_schema(project: Project
@@ -267,5 +318,6 @@ def run(project: Project) -> List[Finding]:
     if src is not None:
         _check_mutators(project, fields, findings)
         _check_renderers(project, fields, findings)
+    _check_backends(project, findings)
     _check_trace(project, findings)
     return findings

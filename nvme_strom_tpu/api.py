@@ -365,6 +365,20 @@ STAT_FIELDS: Tuple[str, ...] = (
     "nr_readahead_hit",       # first demand touch of a speculative slab
     "nr_readahead_skip",      # predictions dropped (budget/alloc pressure)
     "bytes_readahead",        # bytes prefetched into the residency tier
+    # raw NVMe passthrough (PR 19): URING_CMD lane + blockmap resolution
+    "nr_passthru_dma",        # requests served as raw NVMe READ commands
+    "bytes_passthru",         # bytes routed onto the passthrough lane
+    "nr_passthru_refused_extent",  # spans refused per-extent (hole,
+    #                           ineligible flags, unaligned, no path)
+    "nr_passthru_fallback",   # resolved extents served OFF the lane
+    #                           (ladder rung, hedge win, create failure)
+    "nr_passthru_refusal_disabled",   # rung refused: NSTPU_DISABLE_PASSTHRU
+    "nr_passthru_refusal_nodev",      # rung refused: no NVMe char device
+    "nr_passthru_refusal_nouring",    # rung refused: io_uring unavailable
+    "nr_passthru_refusal_nouringcmd",  # rung refused: no URING_CMD opcode
+    "nr_passthru_refusal_lbafmt",     # rung refused: unusable LBA format
+    "nr_blockmap_resolve",    # real FIEMAP walks (cache misses)
+    "nr_blockmap_invalidate",  # cached file->LBA maps dropped by writes
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

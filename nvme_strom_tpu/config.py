@@ -91,6 +91,12 @@ def _check_io_backend(val: str, _cfg: "Config") -> None:
         raise ConfigError(f"io_backend must be auto|io_uring|threadpool|python, got {val!r}")
 
 
+def _check_engine_backend(val: str, _cfg: "Config") -> None:
+    if val not in ("auto", "passthru", "uring", "threadpool"):
+        raise ConfigError(
+            f"engine_backend must be auto|passthru|uring|threadpool, got {val!r}")
+
+
 def _check_ici_permute(val: str, _cfg: "Config") -> None:
     if val not in ("auto", "pallas", "xla"):
         raise ConfigError(f"ici_permute must be auto|pallas|xla, got {val!r}")
@@ -220,6 +226,18 @@ class Config:
         reg(Var("io_backend", "auto", "str",
                 help="'auto' | 'io_uring' | 'threadpool' | 'python'",
                 validate=_check_io_backend))
+        reg(Var("engine_backend", "auto", "str",
+                help="native engine failover ladder position: 'auto' "
+                     "tries nvme_passthru -> io_uring -> threadpool, "
+                     "'passthru' demands the raw NVMe rung (session "
+                     "falls back with the refusal counted when the host "
+                     "cannot), 'uring'/'threadpool' skip the passthru "
+                     "probe entirely — bit-for-bit the pre-v4 path",
+                validate=_check_engine_backend))
+        reg(Var("passthru_dev_glob", "/dev/ng*n*", "str",
+                help="glob for the NVMe character device the passthrough "
+                     "rung probes (first match wins; env "
+                     "NSTPU_PASSTHRU_DEV overrides with an exact path)"))
         reg(Var("queue_depth", 32, "int", minval=1, maxval=4096,
                 help="io_uring submission queue depth / outstanding requests"))
         reg(Var("engine_rings", 0, "int", minval=0, maxval=16,

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 from .api import (BufferInfo, DmaTaskState, ErrorClass, FileInfo, FsKind,
                   MemCopyResult, StromError)
 from .config import config
+from . import blockmap
 from .fault import (DirtyExtentJournal, HealthState, MemberHealthMachine,
                     RetryPolicy)
 from .log import pr_info, pr_warn
@@ -854,6 +855,10 @@ class Request:
     # span scatters into these (dest_off, length) segments — dest_off above
     # is then the first segment's offset and length the span total
     dest_segs: Tuple[Tuple[int, int], ...] = ()
+    # NVMe passthrough lane (PR 19): blockmap-resolved DEVICE byte offset
+    # when this request rides the raw-command path; None rides O_DIRECT.
+    # Set only by the plan-time per-extent split, never by plan_requests.
+    passthru_off: Optional[int] = None
 
 
 def plan_requests(source: Source, chunk_entries: Sequence[Tuple[int, int]],
@@ -1114,7 +1119,7 @@ class DmaTask:
     __slots__ = ("task_id", "state", "errno_", "errmsg", "pending", "frozen",
                  "result", "t_submit", "buf_handle", "deadline", "expired",
                  "verify_src", "verify_dest", "verify_reqs", "trace_id",
-                 "cache_fill", "cache_invalidate", "write_verify")
+                 "cache_fill", "cache_invalidate", "write_verify", "passthru")
 
     def __init__(self, task_id: int, deadline_s: float = 0.0):
         self.task_id = task_id
@@ -1147,6 +1152,62 @@ class DmaTask:
         # write_verify (ISSUE 11): (sink, reqs, src view) for the wait-time
         # read-back crc32c check on retired write tasks
         self.write_verify: Optional[tuple] = None
+        # NVMe passthrough channel (PR 19): set when this task carries
+        # blockmap-resolved requests; the pool's direct leg serves their
+        # passthru_off through it, falling back down the fault ladder
+        self.passthru = None
+
+
+def _resolve_passthru_dev() -> Optional[str]:
+    """NVMe char device for the passthrough rung: exact path from env
+    NSTPU_PASSTHRU_DEV, else the first match of config passthru_dev_glob
+    (absent on CI hosts — the ladder then refuses with reason 'nodev')."""
+    dev = os.environ.get("NSTPU_PASSTHRU_DEV")
+    if dev:
+        return dev
+    import glob as _glob
+    matches = sorted(_glob.glob(str(config.get("passthru_dev_glob"))))
+    return matches[0] if matches else None
+
+
+def _member_path(source, member: int) -> Optional[str]:
+    """Filesystem path of one stripe member, or None when the source has
+    no path-bearing member (RAM fakes) — blockmap needs a real path."""
+    members = getattr(source, "members", None)
+    if members:
+        if 0 <= member < len(members):
+            p = getattr(members[member], "path", None)
+            return str(p) if p else None
+        return None
+    m = getattr(source, "_m", None)
+    p = getattr(m, "path", None) if m is not None and member == 0 else None
+    return str(p) if p else None
+
+
+class _NativePassthruChannel:
+    """Channel marker for the REAL passthrough rung: requests carrying a
+    blockmap-resolved ``passthru_off`` are flagged NSTPU_REQ_PASSTHRU on
+    the native submit and become URING_CMD NVMe READs in the engine
+    (csrc/strom_engine.cc); ``pool_ok=False`` because the Python pool has
+    no char-device access — its fallback legs use plain O_DIRECT."""
+
+    pool_ok = False
+    native = True
+
+    def __init__(self, lba_shift: int):
+        self.lba_shift = lba_shift
+        self.lba_size = 1 << lba_shift
+
+
+def _passthru_left_lane(task, r) -> None:
+    """A blockmap-resolved extent is being served OFF the passthrough
+    lane (mirror/buffered recovery rung, or a hedge win): count the lane
+    exit so the lane's effectiveness stays observable."""
+    stats.add("nr_passthru_fallback")
+    if _trace.active and task.trace_id:
+        _trace.instant("passthru_fallback", tid=task.trace_id,
+                       member=r.member, offset=r.file_off,
+                       length=r.length, args={"reason": "ladder"})
 
 
 class Session:
@@ -1260,6 +1321,8 @@ class Session:
         self._watchdog.start()
         # native engine: the GIL-free executor for planned request batches
         self._native = None
+        self._passthru_dev: Optional[str] = None
+        self._pt_channel: Optional[_NativePassthruChannel] = None
         want = io_backend or config.get("io_backend")
         fallback_ok = bool(config.get("io_fallback"))
         if want != "python":
@@ -1273,17 +1336,46 @@ class Session:
                     rings = int(os.environ.get("NSTPU_RINGS", ""))
                 except ValueError:
                     rings = int(config.get("engine_rings"))
+                # engine_backend (PR 19) picks the rung when the legacy
+                # io_backend var left the choice to the ladder; an explicit
+                # io_backend=io_uring/threadpool keeps its pre-v4 meaning
+                # (no passthru probe at all — bit-for-bit the old path)
+                eng_backend = config.get("engine_backend")
+                if want in ("io_uring", "threadpool"):
+                    native_want = want
+                else:
+                    native_want = {"auto": "auto",
+                                   "passthru": "nvme_passthru",
+                                   "uring": "io_uring",
+                                   "threadpool": "threadpool"}[eng_backend]
+                if native_want in ("auto", "nvme_passthru"):
+                    self._passthru_dev = _resolve_passthru_dev()
                 try:
                     self._native = _nat.NativeEngine(
-                        want if want in ("io_uring", "threadpool") else "auto",
-                        config.get("queue_depth"), rings=rings)
-                except StromError as e:
-                    # degrade one tier at a time: io_uring setup failure
-                    # falls back to the native threadpool, a dead native
-                    # engine falls back to the Python pool (io_fallback
-                    # gates both; explicit non-auto without fallback
-                    # keeps the old fail-fast contract)
-                    if want == "io_uring" and fallback_ok:
+                        native_want, config.get("queue_depth"), rings=rings,
+                        passthru_dev=self._passthru_dev)
+                except (StromError, KeyError) as e:
+                    # degrade one tier at a time: a refused passthru rung
+                    # falls back to the AUTO ladder (refusal counted), an
+                    # io_uring setup failure falls back to the native
+                    # threadpool, a dead native engine falls back to the
+                    # Python pool (io_fallback gates all; explicit
+                    # non-auto without fallback keeps fail-fast)
+                    if native_want == "nvme_passthru" and fallback_ok:
+                        stats.add("nr_passthru_fallback")
+                        if _trace.active:
+                            _trace.instant("passthru_fallback",
+                                           args={"reason": "create_failed"})
+                        pr_warn("nvme passthru backend refused (%s); "
+                                "falling back down the ladder", e)
+                        try:
+                            self._native = _nat.NativeEngine(
+                                "auto", config.get("queue_depth"),
+                                rings=rings,
+                                passthru_dev=self._passthru_dev)
+                        except StromError:
+                            pass
+                    elif want == "io_uring" and fallback_ok:
                         stats.add("nr_backend_fallback")
                         pr_warn("io_uring setup failed (%s); falling back "
                                 "to threadpool backend", e)
@@ -1296,6 +1388,8 @@ class Session:
                     if self._native is None and want != "auto" \
                             and not fallback_ok:
                         raise
+                if self._native is not None:
+                    self._count_passthru_reason(_nat, native_want)
             elif want != "auto":
                 if not fallback_ok:
                     raise StromError(
@@ -1306,6 +1400,7 @@ class Session:
                         "falling back to python path", want)
         self.backend_name = (self._native.backend_name if self._native
                              else "python")
+        stats.set_backend(self.backend_name)
         if _trace.active and self._native is not None:
             # per-lane native event ring: device submit->complete windows
             # are MEASURED by the engine and drained into the recorder
@@ -1313,6 +1408,92 @@ class Session:
         self._tuner.start()
         pr_info("session open: backend=%s workers=%d",
                 self.backend_name, nworkers)
+
+    # -- NVMe passthrough lane (PR 19) -------------------------------------
+    def _count_passthru_reason(self, nat, native_want: str) -> None:
+        """Resolve how the engine ladder's passthrough rung landed.  A
+        live rung gets the native channel (requests are then flagged
+        through URING_CMD lanes); a refusal on a ladder that INCLUDED the
+        rung is counted per reason.  Ladders that never had the rung
+        (explicit io_uring/threadpool) count NOTHING — the
+        zero-passthru-counters guarantee of engine_backend=uring|threadpool."""
+        if native_want not in ("auto", "nvme_passthru"):
+            return
+        reason = self._native.passthru_reason()
+        if reason is None:       # pre-v4 library: the rung does not exist
+            return
+        if reason == 0:
+            # second probe for the LBA geometry the split math needs; the
+            # engine already validated the format, so a failure here only
+            # means "no split", never wrong SLBA math
+            shift = None
+            if self._passthru_dev:
+                probed = nat.passthru_probe(self._passthru_dev)
+                if isinstance(probed, int) and probed >= 9:
+                    shift = probed
+            if shift is not None:
+                self._pt_channel = _NativePassthruChannel(shift)
+            return
+        name = nat.PASSTHRU_REASONS.get(reason, "nodev")
+        stats.add("nr_passthru_refusal_" + name)
+        if _trace.active:
+            _trace.instant("passthru_fallback", args={"reason": name})
+
+    def _passthru_channel(self, source):
+        """The passthrough channel a task on ``source`` splits through:
+        None when engine_backend pins a lower rung (zero-counters
+        guarantee: off = bit-for-bit today's path), else the source's own
+        channel (the CI emulator attaches one), else the native channel
+        when the engine came up on the passthrough rung."""
+        if config.get("engine_backend") in ("uring", "threadpool"):
+            return None
+        chan = getattr(source, "passthru_channel", None)
+        if chan is not None:
+            return chan
+        return self._pt_channel
+
+    def _passthru_split(self, task: DmaTask, source: Source,
+                        reqs: List[Request], chan,
+                        mirror_remap: Dict[int, int]) -> List[Request]:
+        """Split planned requests onto the passthrough lane (the PR 9
+        hit/miss split, per extent): each plain direct request whose span
+        blockmap-resolves to LBA-aligned device ranges becomes one
+        sub-request per physical extent carrying ``passthru_off``;
+        everything else — buffered tails, vectored stripe merges,
+        mirror-remapped members, unresolvable/ineligible spans — rides
+        the O_DIRECT lanes of the SAME task untouched."""
+        out: List[Request] = []
+        lba = chan.lba_size
+        for r in reqs:
+            if r.buffered or r.dest_segs or r.passthru_off is not None \
+                    or r.member in mirror_remap:
+                out.append(r)
+                continue
+            path = _member_path(source, r.member)
+            runs = blockmap.resolve_split(path, r.file_off, r.length, lba) \
+                if path is not None else [(r.file_off, r.length, None)]
+            if all(dev is None for (_f, _l, dev) in runs):
+                stats.add("nr_passthru_refused_extent")
+                if _trace.active and task.trace_id:
+                    _trace.instant("passthru_refuse", tid=task.trace_id,
+                                   member=r.member, offset=r.file_off,
+                                   length=r.length)
+                out.append(r)
+                continue
+            for foff, ln, dev_off in runs:
+                doff = r.dest_off + (foff - r.file_off)
+                if dev_off is None:
+                    stats.add("nr_passthru_refused_extent")
+                    if _trace.active and task.trace_id:
+                        _trace.instant("passthru_refuse",
+                                       tid=task.trace_id, member=r.member,
+                                       offset=foff, length=ln)
+                else:
+                    stats.add("bytes_passthru", ln)
+                out.append(Request(member=r.member, file_off=foff,
+                                   length=ln, dest_off=doff,
+                                   passthru_off=dev_off))
+        return out
 
     # -- buffer registry (MAP/UNMAP/LIST/INFO analogs) ---------------------
     def alloc_dma_buffer(self, length: int, *, numa_node: int = -1) -> Tuple[int, DmaBuffer]:
@@ -1987,6 +2168,19 @@ class Session:
                         if mir is not None and \
                                 not self._member_health.routes_away(mir):
                             mirror_remap[m] = mir
+            # NVMe passthrough split (PR 19): one channel per task; each
+            # planned window then splits per extent below.  The channel
+            # must match the executing path — native tasks need the real
+            # URING_CMD rung, pool tasks need a pool-capable (emulator)
+            # channel with Python-side command service.
+            pt_chan = self._passthru_channel(source) if direct_ids else None
+            if pt_chan is not None:
+                pt_ok = getattr(pt_chan, "native", False) if use_native \
+                    else getattr(pt_chan, "pool_ok", False)
+                if not pt_ok:
+                    pt_chan = None
+            if pt_chan is not None:
+                task.passthru = pt_chan
             native_failed = False
             for w in range(0, len(entries), window):
                 tp0 = time.monotonic_ns()
@@ -1999,12 +2193,16 @@ class Session:
                                 tid=task.trace_id,
                                 args={"window": w // window,
                                       "requests": len(reqs)})
+                if pt_chan is not None:
+                    reqs = self._passthru_split(task, source, reqs,
+                                                pt_chan, mirror_remap)
                 if not use_native or native_failed:
                     self._submit_pool_requests(task, source, reqs, dest)
                     continue
                 native_reqs = []
                 native_members = []
                 native_rs = []
+                native_pt = []
                 for r in reqs:
                     if r.buffered or fds[r.member] < 0:
                         # misaligned tails: synchronous buffered copy, like
@@ -2042,6 +2240,7 @@ class Session:
                             native_reqs.append((fds[m_eff], foff, lseg,
                                                 dseg))
                             native_members.append(m_eff)
+                            native_pt.append(False)
                             foff += lseg
                         native_rs.append(r)
                     else:
@@ -2054,8 +2253,17 @@ class Session:
                                     member=r.member, offset=r.file_off,
                                     length=r.length,
                                     args={"mirror": m_eff})
-                        native_reqs.append((fds[m_eff], r.file_off,
-                                            r.length, r.dest_off))
+                        if r.passthru_off is not None:
+                            # raw-command lane: the engine reads the char
+                            # device at the blockmap-resolved offset; the
+                            # member fd rides along for bookkeeping only
+                            native_reqs.append((fds[m_eff], r.passthru_off,
+                                                r.length, r.dest_off))
+                            native_pt.append(True)
+                        else:
+                            native_reqs.append((fds[m_eff], r.file_off,
+                                                r.length, r.dest_off))
+                            native_pt.append(False)
                         native_members.append(m_eff)
                         native_rs.append(r)
                 if not native_reqs:
@@ -2068,8 +2276,13 @@ class Session:
                     # swap self._native, and the wait must run against
                     # the engine that accepted the batch
                     nat = self._native
-                    nid = nat.submit(addr, native_reqs,
-                                     members=native_members)
+                    if any(native_pt):
+                        nid = nat.submit(addr, native_reqs,
+                                         members=native_members,
+                                         passthru=native_pt)
+                    else:
+                        nid = nat.submit(addr, native_reqs,
+                                         members=native_members)
                     if _trace.active and task.trace_id:
                         _trace.instant(
                             "native_submit", tid=task.trace_id,
@@ -2212,6 +2425,11 @@ class Session:
         src = self._get_buffer(buf_handle, need=src_offset + n * chunk_size)
         task = self._create_task()
         try:
+            # passthrough coherency (PR 19): a write-back may relocate
+            # extents (CoW filesystems); drop the cached file->LBA maps at
+            # the same site the resident cache invalidates, so the next
+            # passthrough split re-resolves against post-write reality
+            blockmap.invalidate_source(sink)
             if _rcache.active:
                 # write-back coherency (ISSUE 9): drop resident extents
                 # the write touches before any byte moves, and again at
@@ -2659,14 +2877,30 @@ class Session:
                     foff += len(v)
         else:
             piece = dest[r.dest_off:r.dest_off + r.length]
+            # passthrough lane (PR 19): a blockmap-resolved sub-request's
+            # direct leg issues the raw NVMe READ through the task's
+            # channel; every recovery rung below (mirror, buffered) leaves
+            # the lane and counts the exit — the ladder itself is UNCHANGED
+            pt = task.passthru if (r.passthru_off is not None and
+                                   getattr(task.passthru, "pool_ok", False)) \
+                else None
 
-            def _direct() -> None:
-                source.read_member_direct(r.member, r.file_off, piece)
+            if pt is not None:
+                def _direct() -> None:
+                    pt.read(r.member, r.file_off, r.passthru_off, piece)
+                    stats.add("nr_passthru_dma")
+            else:
+                def _direct() -> None:
+                    source.read_member_direct(r.member, r.file_off, piece)
 
             def _mirror_read() -> None:
+                if pt is not None:
+                    _passthru_left_lane(task, r)
                 source.read_member_direct(mirror, r.file_off, piece)
 
             def _buffered() -> None:
+                if pt is not None:
+                    _passthru_left_lane(task, r)
                 source.read_member_buffered(r.member, r.file_off, piece)
 
         fallback_ok = bool(config.get("io_fallback"))
@@ -2798,6 +3032,12 @@ class Session:
         fallback_ok = bool(config.get("io_fallback"))
         if not use_mirror and not fallback_ok:
             return False
+        # passthrough lane (PR 19): the primary leg of a resolved
+        # sub-request stays on the raw-command path; the hedge leg is by
+        # construction off-lane (mirror/buffered), so its win is an exit
+        pt = task.passthru if (r.passthru_off is not None and
+                               getattr(task.passthru, "pool_ok", False)) \
+            else None
         lock = threading.Lock()
         won = threading.Event()            # a winner has landed in dest
         hedge_settled = threading.Event()  # the hedge leg has exited
@@ -2859,6 +3099,8 @@ class Session:
                     stats.add("nr_mirror_read")
                 if _finish("hedge", scratch):
                     stats.add("nr_hedge_won")
+                    if pt is not None:
+                        _passthru_left_lane(task, r)
                     if _trace.active and task.trace_id:
                         _trace.span("hedge_won", th0, time.monotonic_ns(),
                                     tid=task.trace_id, member=r.member,
@@ -2886,7 +3128,12 @@ class Session:
             try:
                 while True:
                     try:
-                        source.read_member_direct(r.member, r.file_off, mv)
+                        if pt is not None:
+                            pt.read(r.member, r.file_off, r.passthru_off, mv)
+                            stats.add("nr_passthru_dma")
+                        else:
+                            source.read_member_direct(r.member, r.file_off,
+                                                      mv)
                         health.record_success(r.member)
                         break
                     except (StromError, OSError) as e:

@@ -167,6 +167,10 @@ class StatRegistry:
         # sitting a regime above its peers — the aggregate clk_shard_wait
         # hides exactly that.
         self._shard_hist: dict = {}
+        # resolved engine backend name (PR 19): which rung of the
+        # passthru->io_uring->threadpool ladder this process landed on;
+        # set once per Session, surfaced by the export and tpu_stat
+        self._backend = ""
 
     def enabled(self) -> bool:
         return bool(config.get("stat_info"))
@@ -176,6 +180,16 @@ class StatRegistry:
             return
         with self._lock:
             self._c[name] += delta
+
+    def set_backend(self, name: str) -> None:
+        """Record the resolved engine backend (the ladder rung the session
+        landed on).  Not a counter: a plain string surfaced verbatim."""
+        with self._lock:
+            self._backend = str(name)
+
+    def backend(self) -> str:
+        with self._lock:
+            return self._backend
 
     def count_clock(self, name: str, ns: int, n: int = 1) -> None:
         """Bump an ``nr_<name>``/``clk_<name>`` pair."""
@@ -569,6 +583,7 @@ class StatRegistry:
         snap = self.snapshot(debug=True, reset_max=True)
         payload = {"timestamp_ns": snap.timestamp_ns, "pid": os.getpid(),
                    "version": snap.version, "counters": snap.counters,
+                   "backend": self.backend(),
                    "members": self.member_snapshot(),
                    "lat_hist": self.lat_hist_snapshot(),
                    "tenants": self.tenant_snapshot(),

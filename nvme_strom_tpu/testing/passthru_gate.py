@@ -1,0 +1,324 @@
+"""NVMe passthrough gate (ISSUE 19, ``make passthru-gate``).
+
+Holds the raw-command data path's contracts on the deterministic
+in-process emulator (no NVMe char device needed):
+
+* **Byte identity across the split** — a deliberately fragmented file
+  with ineligible (UNWRITTEN / INLINE) ranges reads byte-identical
+  through the mixed passthrough + O_DIRECT plan, with BOTH lanes
+  provably exercised (``nr_passthru_dma`` > 0 AND
+  ``nr_passthru_refused_extent`` > 0).  A filesystem — or an extent
+  map — that lies is caught here, not trusted (deploy checklist
+  item 23).
+* **Identity under fail-stop** — a seeded fail-stop of a mirrored
+  member fires on the passthrough lane and the ladder's mirror rung
+  serves the same bytes, with every lane exit counted
+  (``nr_passthru_fallback`` > 0): passthrough never weakens the fault
+  ladder.
+* **Zero counters when disabled** — ``engine_backend='uring'`` (or
+  ``'threadpool'``) with an emulator attached moves not one byte and
+  bumps not one passthrough counter: the pinned ladder is bit-for-bit
+  the pre-v4 path.
+* **Submit overhead A/B** — per-request service cost on the
+  passthrough lane (resolved SLBA, one raw command, no VFS alignment
+  machinery) vs the O_DIRECT lane on the same bytes; one JSON line per
+  run journaled to ``PASSTHRU_AB.jsonl`` (the ``passthru_submit_overhead``
+  row of bench_matrix.py reuses :func:`ab_submit_overhead`).
+
+Runs in `make passthru-gate` (wired into `make check`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+CHUNK = 64 << 10
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# FIEMAP flag values (blockmap's ABI constants, restated for layouts)
+_UNWRITTEN = 0x800
+_INLINE = 0x200
+
+
+def _journal_path() -> str:
+    return os.environ.get("STROM_PASSTHRU_AB",
+                          os.path.join(_REPO, "PASSTHRU_AB.jsonl"))
+
+
+def _read_pass(sess, src, nchunks: int, chunk: int = CHUNK) -> bytes:
+    handle, buf = sess.alloc_dma_buffer(nchunks * chunk)
+    try:
+        res = sess.memcpy_ssd2ram(src, handle,
+                                  list(range(nchunks)), chunk)
+        sess.memcpy_wait(res.dma_task_id, timeout=120.0)
+        return bytes(buf.view()[:nchunks * chunk])
+    finally:
+        sess.unmap_buffer(handle)
+
+
+def _delta(before, after, key: str) -> int:
+    return after.get(key, 0) - before.get(key, 0)
+
+
+def _base_config(config) -> None:
+    config.set("cache_bytes", 0)
+    config.set("cache_arbitration", False)
+    config.set("dma_max_size", CHUNK)
+    config.set("hedge_policy", "off")
+    config.set("autotune", False)
+
+
+def _leg_split_identity(dirpath: str) -> None:
+    """Fragmented + partially-ineligible layout: mixed-lane plan, bytes
+    identical to the O_DIRECT-only read AND to the generator oracle."""
+    from ..config import config
+    from ..engine import Session
+    from ..stats import stats
+    from . import FakeNvmeSource, make_test_file
+    from .fake import expected_bytes
+    from .passthru_emu import PassthruEmulator
+
+    nchunks = 8
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, "split.bin")
+    make_test_file(path, size)
+    _base_config(config)
+    emu = PassthruEmulator(os.path.join(dirpath, "split.img"))
+    # fragment into 4 gapped physical runs; poke an UNWRITTEN hole into
+    # chunk 1 and an INLINE tail into chunk 5 — both must ride O_DIRECT
+    emu.provision(path, frag=4,
+                  ineligible=((CHUNK, 4096, _UNWRITTEN),
+                              (5 * CHUNK + 512, 8192, _INLINE)))
+    before = stats.snapshot(reset_max=False).counters
+    try:
+        ref_src = FakeNvmeSource(path, force_cached_fraction=0.0)
+        try:
+            with Session() as sess:
+                got_odirect = _read_pass(sess, ref_src, nchunks)
+        finally:
+            ref_src.close()
+        src = FakeNvmeSource(path, force_cached_fraction=0.0)
+        emu.attach(src)
+        try:
+            with Session() as sess:
+                got_passthru = _read_pass(sess, src, nchunks)
+        finally:
+            src.close()
+    finally:
+        emu.close()
+    after = stats.snapshot(reset_max=False).counters
+    want = expected_bytes(0, size)
+    assert got_odirect == want, "O_DIRECT reference pass diverged"
+    assert got_passthru == want, \
+        "passthrough split pass diverged from the oracle"
+    dma = _delta(before, after, "nr_passthru_dma")
+    refused = _delta(before, after, "nr_passthru_refused_extent")
+    moved = _delta(before, after, "bytes_passthru")
+    assert dma > 0, "split leg never issued a passthrough command"
+    assert refused > 0, \
+        "split leg never refused an ineligible extent (layout not mixed?)"
+    assert 0 < moved < size, \
+        f"passthrough moved {moved} of {size} bytes: the split is not mixed"
+    print(f"passthru-gate split leg ok: {dma} commands, "
+          f"{moved >> 10}KB passthrough / {size >> 10}KB total, "
+          f"{refused} refused extent(s), bytes identical")
+
+
+def _leg_failstop_mirror(dirpath: str) -> None:
+    """Seeded fail-stop of a mirrored member under passthrough: the
+    ladder's mirror rung answers, bytes stay identical, lane exits are
+    counted."""
+    from ..config import config
+    from ..engine import Session
+    from ..stats import stats
+    from . import FakeStripedNvmeSource, FaultPlan, make_test_file
+    from .chaos import STRIPE, expected_mirrored_stream, read_all
+    from .passthru_emu import PassthruEmulator
+
+    _base_config(config)
+    config.set("io_retries", 0)
+    member = 256 << 10
+    paths = []
+    import shutil
+    for k in range(2):
+        p = os.path.join(dirpath, f"fs{2 * k}.bin")
+        make_test_file(p, member, seed=300 + k)
+        q = os.path.join(dirpath, f"fs{2 * k + 1}.bin")
+        shutil.copyfile(p, q)
+        paths += [p, q]
+    emu = PassthruEmulator(os.path.join(dirpath, "fs.img"))
+    for p in paths:
+        emu.provision(p, frag=2)
+    plan = FaultPlan(failstop_member=0, failstop_after=0)
+    before = stats.snapshot(reset_max=False).counters
+    try:
+        src = FakeStripedNvmeSource(paths, STRIPE, fault_plan=plan,
+                                    force_cached_fraction=0.0,
+                                    mirror="paired")
+        emu.attach(src)
+        try:
+            with Session() as sess:
+                got, total = read_all(sess, src, chunk=CHUNK)
+        finally:
+            src.close()
+    finally:
+        emu.close()
+    after = stats.snapshot(reset_max=False).counters
+    assert got == expected_mirrored_stream(paths)[:total], \
+        "bytes diverged through the fail-stop + mirror fallback"
+    fell = _delta(before, after, "nr_passthru_fallback")
+    mirrored = _delta(before, after, "nr_mirror_read")
+    served = _delta(before, after, "nr_passthru_dma")
+    assert fell > 0, \
+        "fail-stop never exited the passthrough lane (fallback uncounted)"
+    assert mirrored > 0, "mirror rung never served the fail-stopped member"
+    assert served > 0, "healthy members never rode passthrough"
+    print(f"passthru-gate fail-stop leg ok: {fell} lane exit(s), "
+          f"{mirrored} mirror read(s), {served} passthrough command(s), "
+          f"bytes identical")
+
+
+def _leg_disabled_zero_counters(dirpath: str) -> None:
+    """engine_backend pinned below the passthru rung: emulator attached,
+    bytes identical, every passthrough counter stays exactly zero."""
+    from ..config import config
+    from ..engine import Session
+    from ..stats import stats
+    from . import FakeNvmeSource, make_test_file
+    from .fake import expected_bytes
+    from .passthru_emu import PassthruEmulator
+
+    nchunks = 4
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, "off.bin")
+    make_test_file(path, size)
+    _base_config(config)
+    emu = PassthruEmulator(os.path.join(dirpath, "off.img"))
+    emu.provision(path, frag=2)
+    for pinned in ("uring", "threadpool"):
+        config.set("engine_backend", pinned)
+        before = stats.snapshot(reset_max=False).counters
+        src = FakeNvmeSource(path, force_cached_fraction=0.0)
+        emu.attach(src)
+        try:
+            with Session() as sess:
+                got = _read_pass(sess, src, nchunks)
+        finally:
+            src.close()
+        after = stats.snapshot(reset_max=False).counters
+        assert got == expected_bytes(0, size), \
+            f"bytes diverged with engine_backend={pinned!r}"
+        dirty = {k: _delta(before, after, k) for k in after
+                 if (k.startswith("nr_passthru") or k == "bytes_passthru")
+                 and _delta(before, after, k)}
+        assert not dirty, \
+            f"engine_backend={pinned!r} still touched passthrough: {dirty}"
+        assert emu.commands_served == 0, \
+            f"emulator served {emu.commands_served} commands while disabled"
+    emu.close()
+    print("passthru-gate disabled leg ok: uring/threadpool pins move the "
+          "same bytes with zero passthrough counters")
+
+
+def ab_submit_overhead(dirpath: str, *, nreqs: int = 256,
+                       rounds: int = 5) -> dict:
+    """Per-request submit+service cost, passthrough lane vs O_DIRECT lane,
+    over the same resolved extents (emulator-backed; deterministic on any
+    host).  The passthrough side issues the pre-resolved raw command —
+    no per-request fd/alignment machinery — which is exactly the
+    submit-path work the raw rung deletes.  Returns the journal row."""
+    import statistics
+
+    from . import FakeNvmeSource, make_test_file
+    from .fake import expected_bytes
+    from .. import blockmap
+    from .passthru_emu import PassthruEmulator
+
+    req = 4 << 10
+    size = nreqs * req
+    path = os.path.join(dirpath, "ab.bin")
+    make_test_file(path, size)
+    emu = PassthruEmulator(os.path.join(dirpath, "ab.img"))
+    emu.provision(path, frag=1)
+    src = FakeNvmeSource(path, force_cached_fraction=0.0)
+    chan = emu.attach(src)
+    import mmap
+    buf = mmap.mmap(-1, req)   # page-aligned: O_DIRECT-legal on both lanes
+    mv = memoryview(buf)
+    # resolve once up front: the lane's steady state (generation-cached)
+    runs = blockmap.resolve_split(path, 0, size, emu.lba_size)
+    plan = []
+    for fo, ln, dev in runs:
+        if dev is None:
+            continue
+        for i in range(0, ln, req):
+            plan.append((fo + i, dev + i))
+    assert len(plan) == nreqs, f"A/B plan resolved {len(plan)}/{nreqs} reqs"
+    pt_s, od_s = [], []
+    try:
+        for _ in range(rounds):
+            t0 = time.perf_counter_ns()
+            for fo, dev in plan:
+                chan.read(0, fo, dev, mv)
+            pt_s.append(time.perf_counter_ns() - t0)
+            assert bytes(mv) == expected_bytes(size - req, req)
+            t0 = time.perf_counter_ns()
+            for fo, _dev in plan:
+                src.read_member_direct(0, fo, mv)
+            od_s.append(time.perf_counter_ns() - t0)
+            assert bytes(mv) == expected_bytes(size - req, req)
+    finally:
+        mv.release()
+        buf.close()
+        src.close()
+        emu.close()
+    pt_ns = statistics.median(pt_s) / nreqs
+    od_ns = statistics.median(od_s) / nreqs
+    row = {"row": "passthru_submit_overhead",
+           "passthru_ns_per_req": round(pt_ns),
+           "odirect_ns_per_req": round(od_ns),
+           "reduction": round(od_ns / pt_ns, 2) if pt_ns else 0.0,
+           "reqs": nreqs, "req_bytes": req, "rounds": rounds,
+           "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    with open(_journal_path(), "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return row
+
+
+def _leg_ab(dirpath: str) -> None:
+    row = ab_submit_overhead(dirpath)
+    assert row["passthru_ns_per_req"] < row["odirect_ns_per_req"], \
+        f"passthrough submit path is not cheaper: {row}"
+    print(f"passthru-gate A/B leg ok: {row['passthru_ns_per_req']}ns/req "
+          f"passthrough vs {row['odirect_ns_per_req']}ns/req O_DIRECT "
+          f"({row['reduction']}x, journaled to PASSTHRU_AB.jsonl)")
+
+
+def main() -> int:
+    from ..config import config
+
+    snap = config.snapshot()
+    try:
+        with tempfile.TemporaryDirectory(prefix="strom_passthru_") as d:
+            _leg_split_identity(d)
+            _leg_failstop_mirror(d)
+            _leg_disabled_zero_counters(d)
+            _leg_ab(d)
+    except AssertionError as e:
+        print(f"passthru-gate FAIL: {e}")
+        return 1
+    finally:
+        config.restore(snap)
+    print("passthru-gate ok: mixed split identical, fail-stop falls back "
+          "counted, pinned ladders stay passthrough-free, submit A/B "
+          "journaled")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

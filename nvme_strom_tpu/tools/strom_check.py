@@ -285,6 +285,53 @@ def check_backing(path: str) -> bool:
                           "set require_nvme_backing=on to hard-gate")
 
 
+def check_blockmap(path: str) -> bool:
+    """Passthrough readiness (PR 19): the two ingredients of the raw
+    NVMe rung — a capability-probed char device, and FIEMAP file->LBA
+    maps on *path* with their fragmentation (extents/GB) and the share
+    of bytes raw-command eligible.  Informational: a host missing either
+    simply rides the io_uring/threadpool rungs, with the refusal reason
+    counted at engine create."""
+    from .. import blockmap
+    from .._native import PASSTHRU_REASONS, passthru_probe
+    from ..engine import _resolve_passthru_dev
+    dev = _resolve_passthru_dev()
+    probe = passthru_probe(dev) if dev else None
+    if dev is None:
+        devmsg = "no char device (passthru_dev_glob)"
+    elif probe is None:
+        devmsg = f"{dev}: native lib predates passthru"
+    elif probe >= 9:
+        devmsg = f"{dev}: lba=2^{probe}"
+    else:
+        devmsg = f"{dev}: refused ({PASSTHRU_REASONS.get(probe, probe)})"
+    frag = None
+    try:
+        fd, tmp = tempfile.mkstemp(dir=path)
+        try:
+            os.write(fd, b"\0" * (1 << 20))
+            os.fsync(fd)
+            os.close(fd)
+            frag = blockmap.fragmentation(tmp)
+        finally:
+            os.unlink(tmp)
+    except OSError:
+        pass
+    if frag is None:
+        return _report("blockmap", WARN, f"FIEMAP unsupported on {path}; "
+                       f"{devmsg}",
+                       "passthrough needs file->LBA maps; extents here "
+                       "ride O_DIRECT (note: some filesystems lie in "
+                       "FIEMAP — see deploy checklist item 23)")
+    next_, total, eligible = frag
+    per_gb = next_ / max(total / 2**30, 1e-9)
+    pct = 100.0 * eligible / total if total else 0.0
+    return _report("blockmap", OK,
+                   f"FIEMAP ok on {path}: {next_} extent(s) "
+                   f"({per_gb:.0f}/GB), {pct:.0f}% bytes eligible; "
+                   f"{devmsg}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="strom_check", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -298,6 +345,7 @@ def main(argv=None) -> int:
     for fn in (check_kernel, check_io_uring,
                lambda: check_odirect(args.path),
                lambda: check_backing(args.path),
+               lambda: check_blockmap(args.path),
                check_hugepages, check_memlock, check_numa,
                check_native_signature, check_abi, check_backend_latch):
         ok = fn() and ok

@@ -27,6 +27,12 @@ import time
 
 from ..stats import hist_percentiles
 
+#: engine backend legend — every NSTPU_BACKEND_* rung of the native
+#: failover ladder, lowercased; stromlint's surface.backend rule checks
+#: this tuple (and the stats export) against csrc/strom_tpu.h so a new
+#: rung cannot ship without its observability surface
+_BACKENDS = ("auto", "io_uring", "threadpool", "nvme_passthru")
+
 
 def show_avg(clk_ns: float, count: float) -> str:
     """Adaptive-unit average latency (reference show_avg8, nvme_stat.c:28-50)."""
@@ -331,7 +337,9 @@ def main(argv=None) -> int:
 
     if args.interval is None:
         c = snap["counters"]
-        print(f"pid {snap['pid']}  version {snap['version']}")
+        backend = snap.get("backend") or "?"
+        print(f"pid {snap['pid']}  version {snap['version']}  "
+              f"backend {backend}")
         width = max(len(k) for k in c)
         for k in sorted(c):
             print(f"  {k:<{width}} {c[k]}")
@@ -449,6 +457,28 @@ def main(argv=None) -> int:
                       f"ra-skip {c.get('nr_readahead_skip', 0)}  "
                       f"ra-bytes "
                       f"{c.get('bytes_readahead', 0) / 1048576:.1f}MB")
+            # passthrough scoreboard (PR 19): raw-command lane volume vs
+            # per-extent refusals and lane exits, plus why the rung was
+            # refused at engine create when it was — many refused extents
+            # on a live rung means a fragmented/CoW layout (see deploy
+            # checklist item 23), a nonzero refusal reason names the
+            # capability this host is missing
+            refusals = {k[len("nr_passthru_refusal_"):]: c[k]
+                        for k in c if k.startswith("nr_passthru_refusal_")
+                        and c[k]}
+            if (c.get("nr_passthru_dma") or c.get("bytes_passthru")
+                    or c.get("nr_passthru_refused_extent")
+                    or c.get("nr_passthru_fallback") or refusals):
+                why = ("  refused-rung " +
+                       ",".join(f"{k}:{v}" for k, v in sorted(
+                           refusals.items()))) if refusals else ""
+                print(f"passthru: cmds {c.get('nr_passthru_dma', 0)}  "
+                      f"bytes "
+                      f"{c.get('bytes_passthru', 0) / 1048576:.1f}MB  "
+                      f"refused-extents "
+                      f"{c.get('nr_passthru_refused_extent', 0)}  "
+                      f"lane-exits {c.get('nr_passthru_fallback', 0)}"
+                      f"{why}")
             # write-ladder scoreboard (ISSUE 11): mirror fan-out volume,
             # transient write retries, resync replay progress and
             # read-back verification failures — pending bytes above zero

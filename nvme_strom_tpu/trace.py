@@ -121,6 +121,11 @@ EVENT_SCHEMA: Dict[str, str] = {
     "autotune_step": "instant",  # one controller decision (step/revert/
     #                              freeze; knob + per-member values in args)
     "readahead_fill": "span",  # one speculative fill: predict -> resident
+    # raw NVMe passthrough (PR 19)
+    "passthru_refuse": "instant",    # span refused per-extent at plan time
+    "passthru_fallback": "instant",  # resolved extent left the lane, or
+    #                                  the whole rung was refused (reason
+    #                                  in args)
 }
 
 
