@@ -1,4 +1,4 @@
-.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate passthru-gate probe-loop lint-strom sanitize sanitize-smoke clean
+.PHONY: all native tsan stress stress-faults chaos chaos-write test check bench-smoke bench-stripe trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate passthru-gate tier-gate probe-loop lint-strom sanitize sanitize-smoke clean
 
 all: native
 
@@ -109,6 +109,17 @@ landing-gate:
 cache-gate:
 	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.cache_gate
 	JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q -m cache
+
+# Unified-tiering gate (ISSUE 20): one placement/migration engine over
+# HBM -> pinned RAM -> SSD.  On the latency-injected thrash config (a
+# seeded-shuffle working set at ~0.8x the combined capacity) the unified
+# space must beat the split-tier baseline >= 1.3x, bytes must stay
+# identical under promotion/demotion churn, and demand faults must keep
+# filling through a mirror leg after a mid-run member fail-stop.
+# Override STROM_TIER_GATE_RATIO.
+tier-gate:
+	JAX_PLATFORMS=cpu python -m nvme_strom_tpu.testing.tier_gate
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tiering.py -q -m tiering
 
 # Compute-pushdown gate (ISSUE 14): on the latency-injected compressible
 # synthetic the packed scan's effective logical GB/s must beat the
@@ -230,7 +241,7 @@ sanitize-smoke:
 # then tier-1 tests plus the perf smokes, the seeded member-survival
 # schedules, the trace-overhead, landing and cache gates, and the
 # short sanitizer pass.
-check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate passthru-gate
+check: lint-strom sanitize-smoke bench-smoke bench-stripe chaos chaos-write trace-gate landing-gate cache-gate tier-gate qos-gate pushdown-gate coldstart-gate scrub-gate kvpage-smoke multichip-gate autotune-gate passthru-gate
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow"
 
 # In-round device-capture daemon (VERDICT r3 #1): probes the TPU tunnel on

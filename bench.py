@@ -83,6 +83,15 @@ baseline that pays an SSD page-in per read, verifies every block
 byte-identical — including one seeded chaos pass that fail-stops a
 mirror member mid-run — and journals to KVPAGE_AB.jsonl.  The
 cold-start counterpart gate is ``make coldstart-gate``.
+
+Unified-tiering A/B (ISSUE 20): ``python bench.py --tiering`` runs a
+mixed workload — a mirrored-stripe scan, a hot weight set and a paging
+KV pool sharing ONE extent hierarchy — against the same consumers over
+isolated tiers (``tier_unified=0``), sized so only the pooled
+C_ram + C_hbm capacity holds the combined working set.  Bytes are
+verified against the deterministic patterns (including a seeded
+mid-run mirror fail-stop) and medians journal to TIER_AB.jsonl.  The
+deterministic gate is ``make tier-gate``.
 """
 
 import fcntl
@@ -1071,6 +1080,201 @@ print("ROW=" + json.dumps(row))
 """
 
 
+_TIERING_CODE = """
+import json, os, random, statistics, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from nvme_strom_tpu import Session, config, stats
+from nvme_strom_tpu.engine import reorder_chunks
+from nvme_strom_tpu.serving import KvBlockPool
+from nvme_strom_tpu.tiering import extent_space
+from nvme_strom_tpu.testing import (FakeNvmeSource, FakeStripedNvmeSource,
+                                    FaultPlan)
+from nvme_strom_tpu.testing.chaos import (make_mirrored_members,
+                                          expected_mirrored_stream)
+from nvme_strom_tpu.testing.fake import make_test_file, expected_bytes
+
+dirpath = os.environ["TIER_BENCH_DIR"]
+rounds = int(os.environ.get("TIER_BENCH_ROUNDS", "3"))
+CHUNK = 64 << 10
+STRIPE = 64 << 10
+scan_chunks = int(os.environ.get("TIER_BENCH_SCAN_CHUNKS", "8"))
+wt_chunks = int(os.environ.get("TIER_BENCH_WEIGHT_CHUNKS", "5"))
+LAT = 0.002      # per-request SSD latency; resident hits never pay it
+KV_LAT = 0.0005  # KV spill latency: both legs page the same block set,
+#                  so this is common-mode cost -- keep it from drowning
+#                  the scan/weight-side placement difference
+bb = 16 << 10
+kv_blocks = 16
+
+# one mixed workload -- a mirrored-stripe scan, a hot weight set and a
+# paging KV pool -- SHARING one hierarchy (tier_unified=1) vs the same
+# three consumers over isolated tiers (tier_unified=0: no promotion,
+# HBM evictions drop).  Combined working set ~= 0.8 x (C_ram + C_hbm)
+# net of the KV pool's HBM pins, so only the pooled capacity holds it
+# and the RAM tier alone thrashes.  One seeded visit order per pass,
+# shared by both legs.
+rng = random.Random(17)
+scan_orders, wt_orders, kv_orders = [], [], []
+for _ in range(rounds + 2):     # +2 untimed warmup orders: the first
+    # fills (first touch), the second promotes (second touch + yield-up),
+    # so the timed rounds measure steady-state placement
+    o = list(range(scan_chunks)); rng.shuffle(o); scan_orders.append(o)
+    o = list(range(wt_chunks)); rng.shuffle(o); wt_orders.append(o)
+    o = list(range(kv_blocks)); rng.shuffle(o); kv_orders.append(o)
+
+
+def kv_pattern(i):
+    return bytes([(i * 7 + 1) % 256]) * bb
+
+
+def make_kv_spill(tag):
+    paths = []
+    for i in range(4):
+        p = os.path.join(dirpath, "spill_%s_%d.bin" % (tag, i))
+        with open(p, "wb") as f:
+            f.truncate(kv_blocks * bb)
+        paths.append(p)
+    return FakeStripedNvmeSource(paths, bb, mirror="paired", writable=True,
+                                 force_cached_fraction=0.0)
+
+
+def scan_pass(sess, src, order, nchunks, want):
+    total = len(order) * CHUNK
+    handle, buf = sess.alloc_dma_buffer(total)
+    try:
+        res = sess.memcpy_ssd2ram(src, handle, list(order), CHUNK)
+        sess.memcpy_wait(res.dma_task_id, timeout=120.0)
+        host = reorder_chunks(np.frombuffer(buf.view()[:total], np.uint8),
+                              CHUNK, res.chunk_ids, sorted(order))
+        return 0 if bytes(host) == want else 1
+    finally:
+        sess.unmap_buffer(handle)
+
+
+def run_leg(tag, unified):
+    config.set("tier_ram_bytes", 8 * CHUNK)
+    config.set("tier_hbm_bytes", 8 * CHUNK)
+    config.set("tier_unified", unified)
+    config.set("cache_arbitration", False)
+    config.set("dma_max_size", CHUNK)
+    mpaths = make_mirrored_members(dirpath, size=scan_chunks * CHUNK // 2,
+                                   tag="sc_%s" % tag)
+    wpath = os.path.join(dirpath, "weights_%s.bin" % tag)
+    make_test_file(wpath, wt_chunks * CHUNK)
+    scan_want = expected_mirrored_stream(mpaths)[:scan_chunks * CHUNK]
+    wt_want = expected_bytes(0, wt_chunks * CHUNK)
+    plan = FaultPlan(latency_s=LAT)
+    scan_src = FakeStripedNvmeSource(mpaths, STRIPE, fault_plan=plan,
+                                     force_cached_fraction=0.0,
+                                     mirror="paired")
+    wt_src = FakeNvmeSource(wpath, fault_plan=FaultPlan(latency_s=LAT),
+                            force_cached_fraction=0.0)
+    times, bad = [], 0
+    try:
+        with Session() as sess:
+            with make_kv_spill(tag) as spill:
+                pool = KvBlockPool(sess, spill, block_bytes=bb,
+                                   ram_blocks=4, hbm_blocks=4)
+                for i in range(kv_blocks):
+                    pool.append("seq", kv_pattern(i))
+                spill.fault_plan = FaultPlan(latency_s=KV_LAT)
+
+                def mixed_pass(r):
+                    nbad = scan_pass(sess, scan_src, scan_orders[r],
+                                     scan_chunks, scan_want)
+                    nbad += scan_pass(sess, wt_src, wt_orders[r],
+                                      wt_chunks, wt_want)
+                    for i in kv_orders[r]:
+                        if pool.read("seq", i) != kv_pattern(i):
+                            nbad += 1
+                    return nbad
+
+                bad += mixed_pass(rounds)          # untimed warmup x2
+                bad += mixed_pass(rounds + 1)
+                for r in range(rounds):
+                    t0 = time.monotonic()
+                    bad += mixed_pass(r)
+                    times.append(time.monotonic() - t0)
+                chaos_bad = 0
+                if tag == "unified":
+                    # seeded chaos: scan member 0 fail-stops mid-run;
+                    # demand faults must keep filling through its twin
+                    scan_src.fault_plan = FaultPlan(latency_s=LAT,
+                                                    failstop_member=0,
+                                                    failstop_after=0)
+                    chaos_bad = mixed_pass(0)
+                pool.close()
+    finally:
+        scan_src.close()
+        wt_src.close()
+        extent_space.clear_tiers()
+    mb = (scan_chunks + wt_chunks) * CHUNK / (1 << 20) + \
+        kv_blocks * bb / (1 << 20)
+    return mb / statistics.median(times), bad, chaos_bad
+
+
+b = dict(stats.snapshot(reset_max=False).counters)
+unified_mbps, bad_u, chaos_bad = run_leg("unified", True)
+a = dict(stats.snapshot(reset_max=False).counters)
+split_mbps, bad_s, _ = run_leg("split", False)
+
+row = {"unified": round(unified_mbps, 3), "split": round(split_mbps, 3),
+       "unit": "MB/s",
+       "speedup": round(unified_mbps / split_mbps, 3) if split_mbps else None,
+       "identical": (bad_u + bad_s) == 0,
+       "chaos_identical": chaos_bad == 0}
+for k in ("nr_tier_hbm_promote", "nr_tier_hbm_demote", "nr_tier_ram_fault",
+          "nr_tier_ram_demote", "nr_tier_ram_shed"):
+    row[k] = a.get(k, 0) - b.get(k, 0)
+print("ROW=" + json.dumps(row))
+"""
+
+
+def _tiering_ab() -> int:
+    """``bench.py --tiering``: mixed-workload A/B over the unified
+    extent space (ISSUE 20).  A mirrored-stripe scan, a hot weight set
+    and a paging KV pool share ONE hierarchy sized so only the pooled
+    C_ram + C_hbm capacity holds the combined working set; the baseline
+    reruns the same seeded visit orders with ``tier_unified=0`` (three
+    isolated tiers: no promotion, HBM evictions drop).  Every byte is
+    checked against the deterministic patterns — including one seeded
+    chaos pass that fail-stops a scan mirror member mid-run — and the
+    medians journal to TIER_AB.jsonl.  The deterministic gate is
+    ``make tier-gate``."""
+    import tempfile
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
+    _lock = hold_bench_lock("bench.py --tiering")
+    env = _env()
+    env.setdefault("TIER_BENCH_ROUNDS", "1" if smoke else "3")
+    env.setdefault("TIER_BENCH_SCAN_CHUNKS", "6" if smoke else "8")
+    env.setdefault("TIER_BENCH_WEIGHT_CHUNKS", "4" if smoke else "5")
+    with tempfile.TemporaryDirectory(prefix="strom_tier_") as d:
+        env["TIER_BENCH_DIR"] = d
+        out = subprocess.run([sys.executable, "-c", _TIERING_CODE],
+                             capture_output=True, text=True, cwd=REPO,
+                             env=env, timeout=1800)
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout + out.stderr)
+        raise RuntimeError("tiering A/B run failed")
+    m = re.search(r"ROW=(\{.*\})", out.stdout)
+    row = {"metric": "tiering_ab_MBps", **json.loads(m.group(1))}
+    entry = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **row}
+    try:
+        with open(os.path.join(REPO, "TIER_AB.jsonl"), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        sys.stderr.write(f"bench: could not journal tiering A/B: {e}\n")
+    if not (row["identical"] and row["chaos_identical"]):
+        sys.stderr.write("bench: tiering A/B identity check FAILED\n")
+        print(json.dumps(row))
+        return 1
+    print(json.dumps(row))
+    return 0
+
+
 def _kvpage_ab() -> int:
     """``bench.py --kvpage``: KV-cache paging A/B on a paired-mirror
     spill with injected per-request SSD latency.  The tiered leg runs
@@ -1316,6 +1520,8 @@ def main() -> int:
         return _pushdown_ab()
     if "--kvpage" in sys.argv[1:]:
         return _kvpage_ab()
+    if "--tiering" in sys.argv[1:]:
+        return _tiering_ab()
     smoke = os.environ.get("BENCH_SMOKE") == "1" or "--smoke" in sys.argv[1:]
     size_mb = 64 if smoke else int(os.environ.get("BENCH_SIZE_MB", "128"))
     path = os.environ.get("BENCH_FILE", f"/tmp/strom_tpu_bench_{size_mb}.bin")

@@ -13,7 +13,7 @@ nvme_strom_tpu.analysis``, or ``make lint-strom``; it is gated in
 
 from __future__ import annotations
 
-from . import abi, buffers, confcheck, locks, surface
+from . import abi, buffers, confcheck, locks, surface, tiers
 from .core import (Baseline, BaselineError, Finding, Project,
                    apply_baseline, format_finding, load_baseline)
 
@@ -24,6 +24,7 @@ RULE_MODULES = {
     "abi": abi,
     "surface": surface,
     "config": confcheck,
+    "tiers": tiers,
 }
 
 __all__ = [

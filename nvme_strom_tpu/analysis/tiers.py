@@ -1,0 +1,85 @@
+"""Rule family ``tiers``: the unified extent space owns tier movement.
+
+``tiers.lease`` — ISSUE 20 collapsed ``CacheLease``/``HbmLease``/KV block
+pins into one refcounted :class:`~nvme_strom_tpu.tiering.TierLease` and
+moved all placement/migration/invalidation behind
+``tiering.extent_space``.  Code outside the engine (``tiering.py``) and
+its two policy plugins (``cache.py``, ``serving/hbm_tier.py``) must not:
+
+* name the legacy lease classes (``CacheLease``, ``HbmLease``) — new
+  consumers take a ``TierLease`` from ``extent_space`` and must not
+  depend on which tier produced it;
+* drive a tier's movement/invalidation internals directly
+  (``lookup``/``fill``/``admit``/``drop``/``yield_up``/
+  ``invalidate_extents``/``invalidate_paths``/``promote_hook``/
+  ``device_tier`` on ``residency_cache``/``hbm_tier``) — that bypasses
+  the one migration engine and its counters/instants.
+
+Read-only surfaces (``active``, ``peek``, ``resident_*``, ``scrub_*``,
+``clear``, ``configure``, ``source_key``, accounting getters) stay open:
+gates, the autotuner and the scrubber observe tiers without moving data.
+Existing violations ride the ``stromlint.baseline`` ratchet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, Project
+
+__all__ = ["run"]
+
+#: modules allowed to touch tier internals: the engine and its plugins
+_ALLOWED = {
+    "nvme_strom_tpu/tiering.py",
+    "nvme_strom_tpu/cache.py",
+    "nvme_strom_tpu/serving/hbm_tier.py",
+}
+
+#: legacy per-tier lease types (now thin aliases of TierLease)
+_LEGACY_LEASES = {"CacheLease", "HbmLease"}
+
+#: receivers that are tier singletons (canonical + conventional aliases)
+_TIER_RECEIVERS = {"residency_cache", "hbm_tier", "_rcache", "_hbm_tier",
+                   "rc", "ht"}
+
+#: attributes that move bytes or invalidate — extent_space's job
+_MOVEMENT_ATTRS = {"lookup", "fill", "admit", "drop", "yield_up",
+                   "invalidate_extents", "invalidate_paths",
+                   "promote_hook", "device_tier"}
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src, tree in project.iter_trees():
+        if src.relpath in _ALLOWED:
+            continue
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Name) and node.id in _LEGACY_LEASES:
+                hit = (f"legacy lease type '{node.id}' referenced; take "
+                       f"a TierLease from tiering.extent_space instead")
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    if alias.name in _LEGACY_LEASES:
+                        hit = (f"legacy lease type '{alias.name}' "
+                               f"imported; take a TierLease from "
+                               f"tiering.extent_space instead")
+                        break
+            elif (isinstance(node, ast.Attribute)
+                    and node.attr in _MOVEMENT_ATTRS
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in _TIER_RECEIVERS):
+                hit = (f"direct tier internal "
+                       f"'{node.value.id}.{node.attr}' outside the "
+                       f"unified engine; route through "
+                       f"tiering.extent_space")
+            if hit is None:
+                continue
+            line = getattr(node, "lineno", 1)
+            if src.is_suppressed(line, "tiers.lease"):
+                continue
+            findings.append(Finding(src.relpath, line, "tiers.lease", hit))
+    findings.sort(key=Finding.sort_key)
+    return findings

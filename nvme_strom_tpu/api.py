@@ -379,6 +379,17 @@ STAT_FIELDS: Tuple[str, ...] = (
     "nr_passthru_refusal_lbafmt",     # rung refused: unusable LBA format
     "nr_blockmap_resolve",    # real FIEMAP walks (cache misses)
     "nr_blockmap_invalidate",  # cached file->LBA maps dropped by writes
+    # unified extent address space (ISSUE 20): one placement/migration
+    # engine across HBM -> pinned RAM -> SSD (tiering.extent_space)
+    "nr_tier_hbm_promote",    # extents second-touch promoted RAM -> HBM
+    #                           (exclusive migration: RAM copy yielded up)
+    "nr_tier_hbm_demote",     # HBM capacity victims demoted into RAM
+    "nr_tier_ram_fault",      # demand faults filled SSD -> RAM (cache
+    #                           fills + KV block page-ins; speculative
+    #                           readahead fills deliberately excluded)
+    "nr_tier_ram_demote",     # RAM victims dropped to the SSD-backed
+    #                           tier (ARC capacity eviction)
+    "nr_tier_ram_shed",       # RAM residents shed under memlock pressure
     "nr_debug1", "clk_debug1",
     "nr_debug2", "clk_debug2",
     "nr_debug3", "clk_debug3",

@@ -47,6 +47,7 @@ from .config import config
 from .stats import stats
 from .trace import recorder as _trace
 from .integrity import domain as _integrity
+from .tiering import TierLease, extent_space, source_key as _source_key
 
 __all__ = ["ResidencyCache", "CacheLease", "residency_cache"]
 
@@ -59,7 +60,7 @@ except OSError:  # pragma: no cover
 
 class _Entry:
     __slots__ = ("key", "mm", "view", "length", "logical_length", "refs",
-                 "stale", "crc", "source_ref", "pinned", "spec")
+                 "stale", "crc", "source_ref", "pinned", "spec", "detached")
 
     def __init__(self, key, mm, length: int,
                  logical_length: int = 0, crc=None, source_ref=None) -> None:
@@ -83,6 +84,11 @@ class _Entry:
         # spec=True until the first demand touch, keeping ARC's ghost
         # lists and target pointer blind to speculation
         self.spec = False
+        # exclusive migration (ISSUE 20): an entry surrendered to the
+        # tier above while a lease still pins it — NOT stale (the
+        # promoted bytes are identical, the reader's copy stays valid),
+        # but gone from the maps and freed at the last release
+        self.detached = False
 
     def free(self) -> None:
         try:
@@ -95,52 +101,13 @@ class _Entry:
             pass
 
 
-class CacheLease:
-    """Refcounted pin on a resident slab.
+class CacheLease(TierLease):
+    """Refcounted pin on a RAM-resident slab: the unified
+    :class:`..tiering.TierLease` holder contract, kept under its
+    pre-unification name for the RAM tier (stromlint's ``tiers.lease``
+    rule ratchets new call sites onto the shared type)."""
 
-    Taken under the cache lock by :meth:`ResidencyCache.lookup`; the
-    holder copies out with :meth:`copy_into` and must :meth:`release`
-    (eviction skips the entry and invalidation only marks it stale
-    while the lease is live).
-    """
-
-    __slots__ = ("_cache", "_entry", "_released")
-
-    def __init__(self, cache: "ResidencyCache", entry: _Entry) -> None:
-        self._cache = cache
-        self._entry = entry
-        self._released = False
-
-    @property
-    def length(self) -> int:
-        return self._entry.length
-
-    @property
-    def stale(self) -> bool:
-        return self._entry.stale
-
-    def copy_into(self, dest) -> bool:
-        """Copy the slab into *dest* (a writable buffer no longer than
-        the extent).  Returns False — and copies nothing — when the
-        entry was invalidated after the lookup; the caller re-reads."""
-        e = self._entry
-        if e.stale:
-            return False
-        if _integrity.verify_reads and \
-                not _integrity.verify(e.view[:e.length], e.crc):
-            # integrity=always: a rotted slab is dropped under its lease
-            # rules (stale while we pin it) and the caller falls back to
-            # SSD — fail-open, never EBADMSG from a cached copy
-            self._cache._drop_corrupt(e)
-            return False
-        n = len(dest)
-        dest[:] = e.view[:n]
-        return not e.stale
-
-    def release(self) -> None:
-        if not self._released:
-            self._released = True
-            self._cache._release(self._entry)
+    __slots__ = ()
 
 
 class ResidencyCache:
@@ -148,14 +115,12 @@ class ResidencyCache:
 
     def __init__(self) -> None:
         self.active = False
-        # device-tier hooks (serving.hbm_tier registers these): the ARC
-        # second-touch transition promotes the extent's bytes UP into
-        # HBM, and every invalidation is forwarded so the device tier
-        # can never serve stale bytes a host-side write dropped here.
-        # Both are None until the HBM tier is configured on — the
-        # one-branch-when-off contract holds for the device leg too.
+        # placement-engine hook (tiering.extent_space arms it): the ARC
+        # second-touch transition hands the extent's bytes UP the
+        # hierarchy.  None until the space rewires with the HBM tier on
+        # and unified — the one-branch-when-off contract holds for the
+        # promotion leg too.
         self.promote_hook = None
-        self.device_tier = None
         self._lock = threading.Lock()
         self._cap = 0
         self._p = 0  # adaptive target for t1 (recency), in bytes
@@ -176,11 +141,12 @@ class ResidencyCache:
     # -- configuration ------------------------------------------------
 
     def configure(self) -> None:
-        """Re-read ``cache_bytes`` (0 disables the tier and frees it) and
-        ``memlock_budget``; shrinking the budget below the bytes already
-        pinned sheds slabs — bulk-class KV chains first, via the pressure
-        registry — instead of ever surfacing ENOMEM to a reader."""
-        cap = int(config.get("cache_bytes"))
+        """Re-read ``tier_ram_bytes`` (0 disables the tier and frees it;
+        ``cache_bytes`` aliases it) and ``memlock_budget``; shrinking the
+        budget below the bytes already pinned sheds slabs — bulk-class KV
+        chains first, via the pressure registry — instead of ever
+        surfacing ENOMEM to a reader."""
+        cap = int(config.get("tier_ram_bytes"))
         budget = int(config.get("memlock_budget"))
         excess = 0
         with self._lock:
@@ -229,29 +195,9 @@ class ResidencyCache:
         stats.gauge_set("cache_resident_bytes", 0)
         stats.gauge_set("cache_unpinned_bytes", 0)
 
-    # -- identity -----------------------------------------------------
+    # -- identity (one identity across the unified space) -------------
 
-    @staticmethod
-    def source_key(source) -> tuple:
-        """Stable identity for a source: the tuple of its members' real
-        paths (works for plain, segmented and striped sources, and the
-        loopback fakes, which subclass them)."""
-        # representation tags (e.g. a packed .cpk sidecar's
-        # "#repr=cpk"/"#gen=..." pair) extend the identity so a
-        # re-encoded file can never alias a stale cached extent; tags
-        # start with '#' and thus never collide with real paths
-        extra = tuple(getattr(source, "cache_key_extra", ()) or ())
-        members = getattr(source, "members", None)
-        if members:
-            try:
-                return tuple(os.path.realpath(m.path)
-                             for m in members) + extra
-            except AttributeError:
-                pass
-        path = getattr(source, "path", None)
-        if isinstance(path, str):
-            return (os.path.realpath(path),) + extra
-        return ("<anon:%d>" % id(source),) + extra
+    source_key = staticmethod(_source_key)
 
     # -- read side ----------------------------------------------------
 
@@ -319,9 +265,13 @@ class ResidencyCache:
     def _release(self, e: _Entry) -> None:
         with self._lock:
             e.refs -= 1
-            if e.refs <= 0 and e.stale:
-                # dropped from the lists while pinned; free it now
+            if e.refs <= 0 and (e.stale or e.detached):
+                # dropped (or migrated up) while pinned; free it now
                 e.free()
+
+    def _lease_view(self, e: _Entry):
+        """TierLease owner hook: the slab bytes as a host view."""
+        return e.view
 
     # -- fill side ----------------------------------------------------
 
@@ -401,7 +351,48 @@ class ResidencyCache:
             stats.gauge_set("cache_resident_bytes", self._bytes)
         # (the engine emits the `cache_fill` span with the task's trace
         # id; evict/invalidate have no task context and instant here)
+        if in_b1 and self.promote_hook is not None:
+            # a b1-ghost refault IS a second touch: the extent was
+            # evicted from recency before its re-reference, so under
+            # capacity pressure it would thrash in RAM forever — hand
+            # it up instead (outside our lock, same contract as the
+            # lookup-time hook).  Only b1: yield_up and HBM demotion
+            # ghost into b2, so promoting b2 refills would ping-pong
+            # an extent between the tiers.
+            try:
+                self.promote_hook(skey, base, length, bytes(data),
+                                  crc=crc, source_ref=source_ref)
+            except Exception:  # noqa: BLE001 - promotion is best-effort
+                pass
         return True
+
+    def yield_up(self, skey: tuple, base: int, length: int) -> bool:
+        """Exclusive migration (ISSUE 20): the extent was promoted into
+        the tier above — surrender the RAM copy so HBM + RAM pool their
+        capacity instead of double-caching.  The key ghosts into b2 (a
+        later demotion re-enters as frequency, which it is); a live
+        lease keeps the detached slab readable until its last release,
+        never stale — the promoted bytes are identical."""
+        key = (skey, base, length)
+        with self._lock:
+            for od in (self._t1, self._t2):
+                e = od.get(key)
+                if e is None:
+                    continue
+                del od[key]
+                self._bytes -= e.length
+                self._unaccount_pin(e)
+                if not e.spec:
+                    self._b2[key] = e.length
+                    self._b2_bytes += e.length
+                    self._trim_ghosts()
+                if e.refs:
+                    e.detached = True
+                else:
+                    e.free()
+                stats.gauge_set("cache_resident_bytes", self._bytes)
+                return True
+        return False
 
     @staticmethod
     def _try_pin(mm, length: int) -> bool:
@@ -456,6 +447,10 @@ class ResidencyCache:
                         self._b2_bytes += e.length
                     self._trim_ghosts()
                 stats.add("nr_cache_evict")
+                # in the unified space a RAM eviction IS the demotion to
+                # the SSD-backed tier: the data's next copy comes from
+                # the file through the fault ladder
+                stats.add("nr_tier_ram_demote")
                 stats.gauge_set("cache_resident_bytes", self._bytes)
                 if _trace.active:
                     _trace.instant("cache_evict", offset=e.key[1],
@@ -485,6 +480,7 @@ class ResidencyCache:
                 self._bytes -= e.length
                 self._pinned_bytes -= e.length
                 stats.add("nr_pressure_shed")
+                stats.add("nr_tier_ram_shed")
                 stats.gauge_set("cache_resident_bytes", self._bytes)
                 if _trace.active:
                     _trace.instant("pressure_shed", offset=key[1],
@@ -509,19 +505,16 @@ class ResidencyCache:
 
     def invalidate_extents(self, skey: tuple,
                            extents: Sequence[Tuple[int, int]]) -> int:
-        """Drop every resident extent the write touches.  Same-key
+        """Drop every RAM-resident extent the write touches.  Same-key
         entries are matched by byte overlap; entries under a different
         key that shares a file are dropped wholesale (offsets do not
-        map across framings).  Returns the number dropped."""
-        fwd = 0
-        if self.device_tier is not None:
-            # the device tier drops its copies regardless of whether the
-            # host tier is even on (it checks its own active flag)
-            fwd = self.device_tier.invalidate_extents(skey, extents)
+        map across framings).  Returns the number dropped.  The write
+        ladder invalidates through ``extent_space``, which fans the one
+        contract out over every tier — this is the RAM leg."""
         if not self.active:
-            return fwd
+            return 0
         pathset = set(skey)
-        dropped = fwd
+        dropped = 0
         with self._lock:
             for od in (self._t1, self._t2):
                 for key in list(od):
@@ -534,26 +527,24 @@ class ResidencyCache:
                         continue
                     self._drop_locked(od, key)
                     dropped += 1
-        self._note_invalidated(dropped - fwd, extents)
+        self._note_invalidated(dropped, extents)
         return dropped
 
     def invalidate_paths(self, paths: Sequence[str]) -> int:
-        """Drop every resident extent over any of *paths* (used by the
-        checkpoint savers after an atomic rename installs new bytes)."""
-        fwd = 0
-        if self.device_tier is not None:
-            fwd = self.device_tier.invalidate_paths(paths)
+        """Drop every RAM-resident extent over any of *paths* (the
+        checkpoint savers invalidate through ``extent_space`` after an
+        atomic rename installs new bytes)."""
         if not self.active:
-            return fwd
+            return 0
         want = {os.path.realpath(p) for p in paths}
-        dropped = fwd
+        dropped = 0
         with self._lock:
             for od in (self._t1, self._t2):
                 for key in list(od):
                     if want & set(key[0]):
                         self._drop_locked(od, key)
                         dropped += 1
-        self._note_invalidated(dropped - fwd, [])
+        self._note_invalidated(dropped, [])
         return dropped
 
     def _drop_locked(self, od, key) -> None:
@@ -611,8 +602,8 @@ class ResidencyCache:
                     if od.get(key) is e:
                         self._drop_locked(od, key)
                         break
-            elif e.stale and e.refs <= 0:
-                e.free()  # invalidated under the scrub pin
+            elif (e.stale or e.detached) and e.refs <= 0:
+                e.free()  # invalidated/migrated under the scrub pin
         return ok, e.length, src
 
     def _flip_resident_byte(self, skey: tuple, base: int, length: int,
@@ -670,5 +661,9 @@ class ResidencyCache:
 
 
 #: process-wide tier; ``configure()`` is called at Session construction
-#: and by tests after flipping ``cache_bytes``
+#: and by tests after flipping ``cache_bytes``/``tier_ram_bytes``
 residency_cache = ResidencyCache()
+
+#: the unified extent space owns every transition in and out of this
+#: tier (promotion, demotion, demand faults, invalidation fan-out)
+extent_space.register_tier("ram", residency_cache)

@@ -60,6 +60,11 @@ class Var:
     maxval: Optional[float] = None
     help: str = ""
     validate: Optional[Callable[[Any, "Config"], None]] = None
+    #: back-compat alias: get/set on this name transparently resolve to
+    #: the named canonical var (one stored value, two names).  The alias
+    #: re-declares kind and bounds so surfaces that introspect the Var
+    #: (the autotuner's clamp range, describe()) see the same contract.
+    alias_of: Optional[str] = None
 
     def parse(self, raw: Any) -> Any:
         if self.kind == "bool":
@@ -195,8 +200,12 @@ class Config:
         with self._lock:
             if var.name in self._vars:
                 raise ConfigError(f"duplicate config var {var.name}")
+            if var.alias_of is not None and var.alias_of not in self._vars:
+                raise ConfigError(f"alias {var.name} targets unknown "
+                                  f"var {var.alias_of}")
             self._vars[var.name] = var
-            self._values[var.name] = var.parse(var.default) if var.kind != "str" else var.default
+            if var.alias_of is None:  # aliases store no value of their own
+                self._values[var.name] = var.parse(var.default) if var.kind != "str" else var.default
 
     def _register_builtins(self) -> None:
         reg = self.register
@@ -453,33 +462,59 @@ class Config:
                      "(kmod/nvme_strom.c:1639-1663 analog)"))
         reg(Var("cache_threshold", 0.5, "float", minval=0.0, maxval=1.0,
                 help="cached-page fraction above which a chunk takes the write-back path"))
-        reg(Var("cache_bytes", 0, "size", minval=0,
-                help="capacity of the owned cross-query residency tier "
-                     "(pinned-host-RAM extent slabs with ARC eviction, "
-                     "cache.residency_cache): hits are served by memcpy "
-                     "with no engine submission and no mincore probe, "
-                     "misses fill slabs at wait time after the fault "
-                     "ladder heals them.  0 (default) disables the tier "
-                     "entirely — one branch per task.  Read at Session "
-                     "construction (residency_cache.configure())"))
+        # unified extent address space (ISSUE 20): one capacity Var per
+        # tier, with the pre-unification names kept as transparent
+        # aliases (one stored value, two names — see MIGRATION.md)
+        reg(Var("tier_ram_bytes", 0, "size", minval=0, maxval=1 << 50,
+                help="capacity of the RAM tier of the unified extent "
+                     "space (pinned-host-RAM extent slabs with ARC "
+                     "eviction, cache.residency_cache): hits are served "
+                     "by memcpy with no engine submission and no "
+                     "mincore probe, misses demand-fault slabs in at "
+                     "wait time after the fault ladder heals them, "
+                     "HBM-tier victims demote into this tier.  0 "
+                     "(default) disables the tier entirely — one branch "
+                     "per task.  Read at Session construction "
+                     "(tiering.extent_space.configure())"))
+        reg(Var("cache_bytes", 0, "size", minval=0, maxval=1 << 50,
+                alias_of="tier_ram_bytes",
+                help="alias of tier_ram_bytes (pre-unification name)"))
         # LLM serving: HBM residency tier + weight streaming + KV paging
         # (ISSUE 15)
-        reg(Var("hbm_cache_bytes", 0, "size", minval=0,
-                help="capacity of the device-side HBM residency tier "
-                     "(serving.hbm_tier): extents the host ARC tier "
-                     "touches twice are promoted into device-resident "
-                     "buffers and served with no host memcpy at all; "
-                     "eviction demotes the bytes back into the host "
-                     "tier.  0 (default) disables the tier entirely — "
-                     "one branch per task.  Read at Session "
-                     "construction (hbm_tier.configure())"))
-        reg(Var("kv_block_bytes", 64 << 10, "size", minval=4 << 10,
+        reg(Var("tier_hbm_bytes", 0, "size", minval=0, maxval=1 << 50,
+                help="capacity of the HBM tier of the unified extent "
+                     "space (serving.hbm_tier): extents the RAM tier "
+                     "touches twice migrate up into device-resident "
+                     "buffers (exclusive under tier_unified — the RAM "
+                     "copy is surrendered) and are served with no host "
+                     "memcpy at all; eviction demotes the bytes back "
+                     "into the RAM tier.  0 (default) disables the "
+                     "tier entirely — one branch per task.  Read at "
+                     "Session construction "
+                     "(tiering.extent_space.configure())"))
+        reg(Var("hbm_cache_bytes", 0, "size", minval=0, maxval=1 << 50,
+                alias_of="tier_hbm_bytes",
+                help="alias of tier_hbm_bytes (pre-unification name)"))
+        reg(Var("tier_kv_block_bytes", 64 << 10, "size", minval=4 << 10,
                 maxval=16 << 20,
                 help="KV-cache page size for serving.kvcache block "
                      "pools: the unit of HBM pinning, RAM slotting and "
                      "SSD spill I/O (power of two; it is the pool's "
                      "chunk grid on the spill source)",
                 validate=_check_pow2))
+        reg(Var("kv_block_bytes", 64 << 10, "size", minval=4 << 10,
+                maxval=16 << 20, alias_of="tier_kv_block_bytes",
+                help="alias of tier_kv_block_bytes (pre-unification "
+                     "name)"))
+        reg(Var("tier_unified", True, "bool",
+                help="one placement/migration engine across HBM → "
+                     "pinned RAM → SSD (tiering.extent_space): second-"
+                     "touch promotion migrates extents up EXCLUSIVELY "
+                     "(the RAM copy is surrendered, so the tiers pool "
+                     "capacity), HBM victims demote down into RAM.  "
+                     "false reverts to three isolated tiers — no "
+                     "promotion, evictions drop — the A/B baseline "
+                     "bench.py --tiering measures against"))
         # resident-data integrity domain (ISSUE 16): checksummed tiers,
         # background scrub, pressure-driven degradation
         reg(Var("integrity", "off", "str",
@@ -696,13 +731,17 @@ class Config:
         with self._lock:
             if name not in self._vars:
                 raise ConfigError(f"unknown config var {name}")
-            return self._values[name]
+            alias = self._vars[name].alias_of
+            return self._values[alias or name]
 
     def set(self, name: str, raw: Any) -> None:
         with self._lock:
             if name not in self._vars:
                 raise ConfigError(f"unknown config var {name}")
             var = self._vars[name]
+            if var.alias_of is not None:
+                name = var.alias_of  # one stored value, two names
+                var = self._vars[name]
             val = var.parse(raw)
             old = self._values[name]
             self._values[name] = val
@@ -710,7 +749,7 @@ class Config:
                 # cross-variable invariants can be broken by *either* side
                 # changing, so every validator re-runs on any set
                 for v in self._vars.values():
-                    if v.validate is not None:
+                    if v.validate is not None and v.alias_of is None:
                         v.validate(self._values[v.name], self)
             except ConfigError:
                 self._values[name] = old
@@ -729,10 +768,11 @@ class Config:
         with self._lock:
             old = dict(self._values)
             self._values.update({k: v for k, v in snapshot.items()
-                                 if k in self._vars})
+                                 if k in self._vars
+                                 and self._vars[k].alias_of is None})
             try:
                 for v in self._vars.values():
-                    if v.validate is not None:
+                    if v.validate is not None and v.alias_of is None:
                         v.validate(self._values[v.name], self)
             except ConfigError:
                 self._values = old

@@ -41,7 +41,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..api import StromError
-from ..cache import residency_cache
+from ..tiering import extent_space
 from ..engine import Session, open_source, read_chunk_ids
 from ..hbm.staging import default_device, safe_device_put
 from ..scan.heap import crc32c as _leaf_crc, crc32c_update as _leaf_crc_update
@@ -172,7 +172,7 @@ def save_checkpoint(path: str, tree: Any, *, direct: bool = False,
         os.replace(tmp, path)
         # the rename just installed new bytes under the old identity:
         # drop any residency-tier extents over this path (ISSUE 9)
-        residency_cache.invalidate_paths([path])
+        extent_space.invalidate_paths([path])
         try:
             dirfd = os.open(directory, os.O_RDONLY)
             try:
@@ -396,7 +396,7 @@ def save_checkpoint_sharded(path: str, tree: Any) -> Dict:
         barrier("installed")
         # every process drops its own residency-tier extents over the
         # freshly installed bytes (the cache is process-local)
-        residency_cache.invalidate_paths([path])
+        extent_space.invalidate_paths([path])
     except BaseException:
         if pid0:
             try:

@@ -53,8 +53,7 @@ from .eligibility import probe_backing
 from .stats import stats
 from .trace import recorder as _trace
 from .autotune import AutoTuner
-from .cache import residency_cache as _rcache
-from .serving.hbm_tier import hbm_tier as _hbm_tier
+from .tiering import extent_space as _tiers
 from .integrity import domain as _integrity, Scrubber as _Scrubber
 from . import numa as _numa
 
@@ -1238,13 +1237,11 @@ class Session:
         # flight recorder (PR 7): trace_policy is read here, once — event
         # sites then cost one `_trace.active` branch when tracing is off
         _trace.configure()
-        # residency cache (ISSUE 9): same contract — cache_bytes is read
-        # here and hit/miss sites cost one `_rcache.active` branch when off
-        _rcache.configure()
-        # HBM residency tier (ISSUE 15): the device leg above the host
-        # tier — hbm_cache_bytes read here, one `_hbm_tier.active` branch
-        # per task when off
-        _hbm_tier.configure()
+        # unified extent space (ISSUE 20): one configure for the whole
+        # capacity hierarchy — tier_ram_bytes/tier_hbm_bytes are read
+        # here and every tier transition is rewired; hit/miss sites then
+        # cost one `_tiers.lookup_active` branch when all tiers are off
+        _tiers.configure()
         # resident-data integrity domain (ISSUE 16): `integrity` is read
         # here; fill/verify sites cost one `_integrity.active` branch off
         _integrity.configure()
@@ -1938,19 +1935,19 @@ class Session:
                 self._verify_request_checksums(task.verify_src, r,
                                                task.verify_dest)
         if task.cache_fill is not None:
-            # residency-cache fills run HERE, on the retired task: the
+            # demand-fault fills run HERE, on the retired task: the
             # destination bytes have been healed by the full fault
             # ladder (retry/hedge/mirror/checksum re-read), so a
-            # degraded member still populates the tier via its
+            # degraded member still populates the hierarchy via its
             # surviving legs — and a latched failure never fills
             skey, fills, fdest, lscale, src_ref, spec = task.cache_fill
             task.cache_fill = None
             for base, length, doff in fills:
                 tf0 = time.monotonic_ns()
-                if _rcache.fill(skey, base, length,
-                                fdest[doff:doff + length],
-                                logical_length=int(length * lscale),
-                                source_ref=src_ref, speculative=spec) \
+                if _tiers.fault_fill(skey, base, length,
+                                     fdest[doff:doff + length],
+                                     logical_length=int(length * lscale),
+                                     source_ref=src_ref, speculative=spec) \
                         and _trace.active and task.trace_id:
                     _trace.span("cache_fill", tf0, time.monotonic_ns(),
                                 tid=task.trace_id, offset=base,
@@ -1961,7 +1958,7 @@ class Session:
             # from pre-write bytes between submit and completion
             skey, extents = task.cache_invalidate
             task.cache_invalidate = None
-            _rcache.invalidate_extents(skey, extents)
+            _tiers.invalidate_extents(skey, extents)
         if task.write_verify is not None:
             # write_verify (ISSUE 11): read each retired write leg back
             # and compare crc32c against the submitted bytes — a torn or
@@ -2048,22 +2045,21 @@ class Session:
             # page-cache arbitration and the member lanes
             skey = None
             miss_ids, spans = chunk_ids, spans_all
-            if (_rcache.active or _hbm_tier.active) and not speculative:
-                skey = _rcache.source_key(source)
+            if _tiers.lookup_active and not speculative:
+                skey = _tiers.source_key(source)
                 miss_ids, spans = [], []
                 nr_hbm = 0
                 for cid, (base, length) in zip(chunk_ids, spans_all):
-                    # the DEVICE tier outranks the host tier (ISSUE 15):
-                    # an HBM-resident extent costs one device→dest copy
-                    # and never touches a host slab
-                    lease = _hbm_tier.lookup(skey, base, length) \
-                        if _hbm_tier.active else None
-                    hbm = lease is not None
-                    if hbm:
-                        nr_hbm += 1
-                    elif _rcache.active:
-                        lease = _rcache.lookup(skey, base, length)
-                    if lease is not None:
+                    # ONE top-down lookup over the unified space
+                    # (ISSUE 20): the HBM tier outranks RAM — a device-
+                    # resident extent costs one device→dest copy and
+                    # never touches a host slab
+                    hit = _tiers.lookup(skey, base, length)
+                    if hit is not None:
+                        lease, tname = hit
+                        hbm = tname == "hbm"
+                        if hbm:
+                            nr_hbm += 1
                         cache_hits.append((cid, base, length, lease, hbm))
                     else:
                         miss_ids.append(cid)
@@ -2077,13 +2073,13 @@ class Session:
                               sum(h[2] for h in cache_hits))
                 if miss_ids:
                     stats.add("nr_cache_miss", len(miss_ids))
-                if not _rcache.active:
-                    skey = None  # no host tier: nothing to fill at wait
-            elif _rcache.active:
+                if not _tiers.fill_active:
+                    skey = None  # no RAM tier: nothing to fill at wait
+            elif _tiers.fill_active:
                 # speculative prefetch (ISSUE 18): no hit split — the
                 # issue loop already peeked residency — but the misses
-                # must still fill the host tier at wait time
-                skey = _rcache.source_key(source)
+                # must still demand-fault into the RAM tier at wait time
+                skey = _tiers.source_key(source)
 
             # --- cache arbitration (write-back vs direct) -----------------
             threshold = config.get("cache_threshold")
@@ -2430,15 +2426,17 @@ class Session:
             # the same site the resident cache invalidates, so the next
             # passthrough split re-resolves against post-write reality
             blockmap.invalidate_source(sink)
-            if _rcache.active:
-                # write-back coherency (ISSUE 9): drop resident extents
-                # the write touches before any byte moves, and again at
-                # wait time (task.cache_invalidate) in case a racing
-                # read re-filled from pre-write bytes mid-flight
-                wkey = _rcache.source_key(sink)
+            if _tiers.lookup_active:
+                # write-back coherency (ISSUE 9): ONE invalidation
+                # contract over the whole hierarchy (ISSUE 20) — drop
+                # every tier's resident extents the write touches before
+                # any byte moves, and again at wait time
+                # (task.cache_invalidate) in case a racing read
+                # re-filled from pre-write bytes mid-flight
+                wkey = _tiers.source_key(sink)
                 extents = [(cid * chunk_size, chunk_size)
                            for cid in chunk_ids]
-                _rcache.invalidate_extents(wkey, extents)
+                _tiers.invalidate_extents(wkey, extents)
                 task.cache_invalidate = (wkey, extents)
             with stats.stage("setup_prps"):
                 reqs = plan_requests(sink, [(cid, i) for i, cid in enumerate(chunk_ids)],

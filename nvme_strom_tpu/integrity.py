@@ -190,27 +190,30 @@ class Scrubber:
             self._carry = min(budget - done, rate)
 
     def _scrub_round(self, budget: int) -> int:
-        done = self._scrub_host(budget)
-        if done < budget and not self._stop.is_set():
-            done += self._scrub_hbm(budget - done)
+        # unified walk (ISSUE 20): one loop over every registered tier of
+        # the extent space, bottom-up (ram before hbm) so a healed host
+        # extent is in place before the device tier re-admits
+        from .tiering import extent_space
+        done = 0
+        for name, tier in extent_space.scrub_tiers():
+            if done >= budget or self._stop.is_set():
+                break
+            done += self._scrub_tier(name, tier, budget - done)
         if done < budget and not self._stop.is_set():
             done += self._scrub_pools(budget - done)
         return done
 
-    # -- host ARC tier ------------------------------------------------------
-    def _scrub_host(self, budget: int) -> int:
-        from .cache import residency_cache as rc
-        if not rc.active:
-            return 0
+    # -- resident tiers (unified extent space) -------------------------------
+    def _scrub_tier(self, name: str, tier, budget: int) -> int:
         scanned = 0
-        for key in _rotate(rc.scrub_keys(), self._cursor.get("ram")):
+        for key in _rotate(tier.scrub_keys(), self._cursor.get(name)):
             if scanned >= budget or self._stop.is_set():
                 break
-            res = rc.scrub_extent(key)
+            res = tier.scrub_extent(key)
             if res is None:
                 continue
             ok, length, source_ref = res
-            self._cursor["ram"] = key
+            self._cursor[name] = key
             scanned += length
             t0 = time.monotonic_ns()
             stats.add("nr_scrub_extent")
@@ -218,41 +221,16 @@ class Scrubber:
             if _trace.active:
                 _trace.span("scrub", t0, time.monotonic_ns(),
                             offset=key[1], length=length,
-                            args={"tier": "ram", "ok": ok})
+                            args={"tier": name, "ok": ok})
             if not ok:
-                self._heal(key, source_ref, tier="ram")
-        return scanned
-
-    # -- HBM tier -----------------------------------------------------------
-    def _scrub_hbm(self, budget: int) -> int:
-        from .serving.hbm_tier import hbm_tier as ht
-        if not ht.active:
-            return 0
-        scanned = 0
-        for key in _rotate(ht.scrub_keys(), self._cursor.get("hbm")):
-            if scanned >= budget or self._stop.is_set():
-                break
-            res = ht.scrub_extent(key)
-            if res is None:
-                continue
-            ok, length, source_ref = res
-            self._cursor["hbm"] = key
-            scanned += length
-            t0 = time.monotonic_ns()
-            stats.add("nr_scrub_extent")
-            stats.add("bytes_scrubbed", length)
-            if _trace.active:
-                _trace.span("scrub", t0, time.monotonic_ns(),
-                            offset=key[1], length=length,
-                            args={"tier": "hbm", "ok": ok})
-            if not ok:
-                healed = self._heal(key, source_ref, tier="hbm")
-                # re-promote the healed bytes so the extent stays
-                # device-resident (the host tier already re-filled)
-                if healed is not None:
-                    ht.admit(key[0], key[1], key[2], healed,
-                             crc=domain.checksum(healed),
-                             source_ref=source_ref)
+                healed = self._heal(key, source_ref, tier=name)
+                # re-promote healed device bytes so the extent stays
+                # HBM-resident (the host tier already re-filled via the
+                # fault ladder's cache_fill hook)
+                if healed is not None and name == "hbm":
+                    tier.admit(key[0], key[1], key[2], healed,
+                               crc=domain.checksum(healed),
+                               source_ref=source_ref)
         return scanned
 
     # -- KV spill tier ------------------------------------------------------

@@ -1610,23 +1610,24 @@ class Query:
         # cache-aware planning (ISSUE 9): report the residency tier's
         # expected hit ratio for this table — at 1.0 the scan is served
         # entirely from pinned slabs and skips engine submission
-        from ..cache import residency_cache
-        from ..serving.hbm_tier import hbm_tier
+        from ..tiering import extent_space
         ratio = 0.0
         hbm_ratio = 0.0
-        if (residency_cache.active or hbm_tier.active) and size:
+        if extent_space.lookup_active and size:
             if isinstance(self.source, (list, tuple)):
                 cpaths = list(self.source)
             elif path is not None:
                 cpaths = [path]
             else:
                 cpaths = []
-            if residency_cache.active:
-                ratio = residency_cache.resident_fraction(cpaths, size)
-            # device tier (ISSUE 15): the engine consults HBM FIRST, so
-            # its expected hit share surfaces separately — those chunks
-            # cost one device->dest memcpy, not even a host-slab touch
-            hbm_ratio = hbm_tier.resident_fraction(cpaths, size)
+            # unified residency surface (ISSUE 20): one dict of
+            # per-tier expected hit fractions — the engine consults
+            # HBM FIRST, so its share surfaces separately; those
+            # chunks cost one device->dest memcpy, not even a
+            # host-slab touch
+            fr = extent_space.resident_fraction(cpaths, size)
+            ratio = fr.get("ram", 0.0)
+            hbm_ratio = fr.get("hbm", 0.0)
         if hbm_ratio > 0:
             reason += (f"; hbm tier holds ~{hbm_ratio:.0%} of the table "
                        f"(device hits, checked before the host tier)")

@@ -13,15 +13,17 @@ arXiv:2406.02039) with HBM as the top tier.
 The contract deliberately mirrors ``cache.py``:
 
 * **Keying** — identical: ``(source_key, base, length)`` exact-extent.
-* **Leases** — :meth:`lookup` returns a refcounted :class:`HbmLease`;
-  eviction skips pinned entries, invalidation marks them stale, stale
-  entries are never served and free at the last release.  The KV pool
-  pins its HBM working set through exactly these leases.
-* **Coherency** — the host cache forwards every
-  ``invalidate_extents``/``invalidate_paths`` here (outside its lock),
-  so every existing write-path/checkpoint invalidation site covers the
+* **Leases** — :meth:`lookup` returns a refcounted :class:`HbmLease`
+  (the unified :class:`..tiering.TierLease` contract); eviction skips
+  pinned entries, invalidation marks them stale, stale entries are
+  never served and free at the last release.  The KV pool pins its HBM
+  working set through ``extent_space.pin``, which hands out exactly
+  these leases.
+* **Coherency** — the unified extent space fans every
+  ``invalidate_extents``/``invalidate_paths`` out over all tiers, so
+  every existing write-path/checkpoint invalidation site covers the
   device tier with no new call sites.
-* **one-branch-when-off** — ``configure()`` reads ``hbm_cache_bytes``
+* **one-branch-when-off** — ``configure()`` reads ``tier_hbm_bytes``
   once; hot paths check the plain ``active`` attribute.
 
 Eviction is byte-weighted LRU (not ARC): admission is already
@@ -40,8 +42,8 @@ import numpy as np
 from ..config import config
 from ..stats import stats
 from ..trace import recorder as _trace
-from ..cache import ResidencyCache, residency_cache
 from ..integrity import domain as _integrity
+from ..tiering import TierLease, extent_space, source_key as _source_key
 
 __all__ = ["HbmLease", "HbmResidencyTier", "hbm_tier"]
 
@@ -64,57 +66,14 @@ class _Entry:
         self.source_ref = source_ref
 
 
-class HbmLease:
-    """Refcounted pin on an HBM-resident extent.
+class HbmLease(TierLease):
+    """Refcounted pin on an HBM-resident extent: the unified
+    :class:`..tiering.TierLease` holder contract under its
+    pre-unification name.  ``device_array()`` hands zero-copy consumers
+    — the KV pool's pinned working set — the device-resident bytes
+    without ever leaving the device."""
 
-    Same holder contract as :class:`..cache.CacheLease`; additionally
-    exposes the device array itself (:meth:`device_array`) so zero-copy
-    consumers — the KV pool's pinned working set — can hand the bytes
-    to compute without ever leaving the device.
-    """
-
-    __slots__ = ("_tier", "_entry", "_released")
-
-    def __init__(self, tier: "HbmResidencyTier", entry: _Entry) -> None:
-        self._tier = tier
-        self._entry = entry
-        self._released = False
-
-    @property
-    def length(self) -> int:
-        return self._entry.length
-
-    @property
-    def stale(self) -> bool:
-        return self._entry.stale
-
-    def device_array(self):
-        """The extent as its device-resident uint8 array (no copy), or
-        None when the entry was invalidated after the lookup."""
-        e = self._entry
-        return None if e.stale else e.array
-
-    def copy_into(self, dest) -> bool:
-        """Device→dest copy.  Returns False — and copies nothing — when
-        the entry went stale after the lookup; the caller re-reads."""
-        e = self._entry
-        if e.stale:
-            return False
-        host = memoryview(np.asarray(e.array).data)
-        if _integrity.verify_reads and \
-                not _integrity.verify(host[:e.length], e.crc):
-            # integrity=always: a rotted device extent is dropped under
-            # its lease rules and the caller falls back to SSD
-            self._tier._drop_corrupt(e)
-            return False
-        n = len(dest)
-        dest[:] = host[:n]
-        return not e.stale
-
-    def release(self) -> None:
-        if not self._released:
-            self._released = True
-            self._tier._release(self._entry)
+    __slots__ = ()
 
 
 class HbmResidencyTier:
@@ -131,9 +90,10 @@ class HbmResidencyTier:
     # -- configuration ------------------------------------------------
 
     def configure(self) -> None:
-        """Re-read ``hbm_cache_bytes``; 0 disables the tier, frees it,
-        and (re)arms the host tier's promotion hook."""
-        cap = int(config.get("hbm_cache_bytes"))
+        """Re-read ``tier_hbm_bytes`` (``hbm_cache_bytes`` aliases it);
+        0 disables the tier, frees it, and rewires the extent space's
+        inter-tier transitions (the RAM tier's promotion hook)."""
+        cap = int(config.get("tier_hbm_bytes"))
         demoted = []
         with self._lock:
             self._cap = cap
@@ -147,11 +107,10 @@ class HbmResidencyTier:
                         break
                     demoted.append(d)
         self._demote_to_host(demoted)
-        # the host ARC tier promotes its second-touch extents here and
-        # forwards every invalidation; registration is idempotent and
-        # the promote hook is None when the tier is off (one branch)
-        residency_cache.promote_hook = self.admit if self.active else None
-        residency_cache.device_tier = self
+        # ONE placement engine: the extent space arms the RAM tier's
+        # second-touch promotion hook iff this tier is on and the space
+        # is unified — one branch when either is off
+        extent_space.rewire()
 
     def clear(self) -> None:
         with self._lock:
@@ -170,9 +129,9 @@ class HbmResidencyTier:
         stats.gauge_set("hbm_resident_bytes", 0)
         return demoted
 
-    # -- identity (shared with the host tier) -------------------------
+    # -- identity (one identity across the unified space) -------------
 
-    source_key = staticmethod(ResidencyCache.source_key)
+    source_key = staticmethod(_source_key)
 
     # -- read side ----------------------------------------------------
 
@@ -199,6 +158,14 @@ class HbmResidencyTier:
                 drop = True
         if drop:
             self._free_entry(e)
+
+    def _lease_view(self, e: _Entry):
+        """TierLease owner hook: the extent's bytes as a host view (one
+        D2H copy), or None when the backend revoked the array."""
+        try:
+            return memoryview(np.asarray(e.array).data)
+        except Exception:  # pragma: no cover - revoked backend
+            return None
 
     # -- fill / promotion side -----------------------------------------
 
@@ -298,14 +265,12 @@ class HbmResidencyTier:
             return None
 
     def _demote_to_host(self, demoted) -> None:
-        """Demoted extents re-enter the host ARC tier: capacity
-        pressure moves data down the hierarchy instead of dropping it
-        (a failed host fill just means a future SSD re-read)."""
-        for key, data, source_ref in demoted:
-            if data is not None:
-                skey, base, length = key
-                residency_cache.fill(skey, base, length, data,
-                                     source_ref=source_ref)
+        """Demoted extents move DOWN through the unified space: capacity
+        pressure migrates data into the RAM tier instead of dropping it
+        (a failed fill just means a future SSD re-read).  In split mode
+        (``tier_unified=false``) the space drops them — isolated tiers
+        do not migrate."""
+        extent_space.demote_from_hbm(demoted)
 
     def _free_entry(self, e: _Entry) -> None:
         self._unmap(e.handle)
@@ -500,6 +465,11 @@ class HbmResidencyTier:
 
 
 #: process-wide device tier; ``configure()`` is called at Session
-#: construction (alongside residency_cache.configure()) and by tests
-#: after flipping ``hbm_cache_bytes``
+#: construction (via extent_space.configure()) and by tests after
+#: flipping ``hbm_cache_bytes``/``tier_hbm_bytes``
 hbm_tier = HbmResidencyTier()
+
+#: the unified extent space owns every transition in and out of this
+#: tier (second-touch promotion in, demotion to the RAM tier out,
+#: invalidation fan-out, the KV pool's pins)
+extent_space.register_tier("hbm", hbm_tier)

@@ -11,11 +11,12 @@ the write path keeps legs coherent).
 
 Tier placement is exclusive — a block lives in exactly ONE of:
 
-* **HBM** — pinned into :data:`.hbm_tier.hbm_tier` through an
-  :class:`~.hbm_tier.HbmLease` (``refs>0`` makes the tier's own LRU
-  skip it; only the pool demotes its blocks, via
-  :meth:`HbmResidencyTier.drop`, which bypasses host-ARC demotion
-  because the pool owns the bytes' next home),
+* **HBM** — pinned through the unified extent space
+  (``extent_space.pin``/``unpin``, the ISSUE 20 placement engine),
+  holding a :class:`~..tiering.TierLease` whose ``refs>0`` makes the
+  HBM tier's own LRU skip it; only the pool demotes its blocks, via
+  ``unpin``, which bypasses RAM-tier demotion because the pool owns
+  the bytes' next home,
 * **pinned RAM** — a slot in one session DMA buffer (pinned +
   io_uring-fixed, so page-out/page-in are zero-staging engine copies),
 * **SSD** — a ``block_bytes``-chunk slot in the writable spill source.
@@ -46,7 +47,7 @@ from ..config import config
 from ..stats import stats
 from ..trace import recorder as _trace
 from ..integrity import domain as _integrity, register_pool
-from .hbm_tier import hbm_tier
+from ..tiering import extent_space
 
 __all__ = ["KvBlockPool"]
 
@@ -66,7 +67,7 @@ class _Block:
         self.gid = gid      # pool-global id; HBM-tier base = gid*block_bytes
         self.tier = "ram"   # "hbm" | "ram" | "ssd"
         self.slot = -1      # ram slot or ssd slot, by tier
-        self.lease = None   # HbmLease while tier == "hbm"
+        self.lease = None   # TierLease pin while tier == "hbm"
         self.crc = None     # fill-time crc32c (None under integrity=off)
 
 
@@ -100,8 +101,8 @@ class KvBlockPool:
             raise StromError(_errno.EINVAL,
                              f"spill source smaller than one {bb}B block")
         if hbm_blocks is None:
-            hbm_blocks = (int(config.get("hbm_cache_bytes")) // 2 // bb
-                          if hbm_tier.active else 0)
+            hbm_blocks = (extent_space.tier_capacity("hbm") // 2 // bb
+                          if extent_space.tier_active("hbm") else 0)
         self._hbm_budget = hbm_blocks
         self._hbm_used = 0
         self._skey = ("#kvpool:%d" % next(_pool_ids),)
@@ -243,6 +244,7 @@ class KvBlockPool:
                 self._lru[blk.gid] = blk
                 self._lru.move_to_end(blk.gid)
                 stats.add("nr_kv_pagein")
+                stats.add("nr_tier_ram_fault")  # SSD→RAM demand fault
                 if _trace.active:
                     _trace.span("kv_page", ts, time.monotonic_ns(),
                                 offset=blk.gid * self.block_bytes,
@@ -259,9 +261,9 @@ class KvBlockPool:
             self._classes.pop(seq, None)
             for blk in table:
                 if blk.tier == "hbm":
-                    blk.lease.release()
-                    hbm_tier.drop(self._skey, blk.gid * self.block_bytes,
-                                  self.block_bytes)
+                    extent_space.unpin(blk.lease, self._skey,
+                                       blk.gid * self.block_bytes,
+                                       self.block_bytes)
                     self._hbm_used -= 1
                 elif blk.tier == "ram":
                     self._ram_free.append(blk.slot)
@@ -470,6 +472,7 @@ class KvBlockPool:
         self._lru[blk.gid] = blk
         blk.tier, blk.slot = "ram", slot
         stats.add("nr_kv_pagein")
+        stats.add("nr_tier_ram_fault")  # SSD→RAM demand fault
         if _trace.active:
             _trace.span("kv_page", ts, time.monotonic_ns(),
                         offset=blk.gid * self.block_bytes,
@@ -477,20 +480,19 @@ class KvBlockPool:
                         args={"dir": "in", "block": blk.idx})
 
     def _promote(self, blk: _Block) -> None:
-        """RAM→HBM while the pool's pinned share allows; the lease pin
-        makes the tier's own LRU skip the block."""
-        if not hbm_tier.active or self._hbm_used >= self._hbm_budget:
+        """RAM→HBM while the pool's pinned share allows; the extent
+        space places and pins the block in one transition (the lease pin
+        makes the tier's own LRU skip it)."""
+        if not extent_space.tier_active("hbm") \
+                or self._hbm_used >= self._hbm_budget:
             return
         base = blk.gid * self.block_bytes
         data = self._ram_view(blk.slot)
-        # admit verifies data against the crc (promote is a transition);
+        # pin verifies data against the crc (promote is a transition);
         # a rotted RAM block simply stays in RAM, counted
-        if not hbm_tier.admit(self._skey, base, self.block_bytes, data,
-                              crc=blk.crc):
-            return
-        lease = hbm_tier.lookup(self._skey, base, self.block_bytes)
-        if lease is None:  # pragma: no cover - raced a revocation
-            hbm_tier.drop(self._skey, base, self.block_bytes)
+        lease = extent_space.pin(self._skey, base, self.block_bytes,
+                                 data, crc=blk.crc)
+        if lease is None:
             return
         self._ram_free.append(blk.slot)
         blk.tier, blk.slot, blk.lease = "hbm", -1, lease
@@ -513,10 +515,9 @@ class KvBlockPool:
                              f"KV block {blk.idx} lost to HBM revocation")
 
     def _drop_hbm(self, blk: _Block) -> None:
-        blk.lease.release()
+        extent_space.unpin(blk.lease, self._skey,
+                           blk.gid * self.block_bytes, self.block_bytes)
         blk.lease = None
-        hbm_tier.drop(self._skey, blk.gid * self.block_bytes,
-                      self.block_bytes)
         self._hbm_used -= 1
 
     # -- integrity domain (ISSUE 16) -----------------------------------

@@ -1,0 +1,222 @@
+"""Unified-tiering gate (ISSUE 20, ``make tier-gate``).
+
+Holds the tentpole's contracts on deterministic synthetics:
+
+* **Unified beats split on the thrash config** — a seeded-shuffle scan
+  over a working set sized at ~0.8x the COMBINED capacity
+  (C_ram + C_hbm) with per-chunk device latency injected.  Unified
+  (``tier_unified=1``) pools both tiers: second-touch promotion moves
+  hot extents into HBM and yields the RAM copy up, so the whole set
+  fits and steady-state passes stop paying device latency.  Split
+  (``tier_unified=0``) leaves HBM stranded (no promotion, demotions
+  drop), the set thrashes the RAM tier alone, and every pass pays.
+  The split/unified ratio must be >= ``STROM_TIER_GATE_RATIO``
+  (default 1.3x).
+* **Byte identity under migration churn** — capacities far below the
+  working set keep promotion/demotion/eviction running constantly;
+  every pass must stay byte-identical to the deterministic pattern.
+* **Fail-stop demand faults** — a striped mirrored source loses a
+  member mid-run; demand faults keep filling the tiers through the
+  surviving mirror leg and bytes stay identical.
+
+Runs in ``make tier-gate`` (wired into ``make check``).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import tempfile
+import time
+
+RATIO_LIMIT = float(os.environ.get("STROM_TIER_GATE_RATIO", "1.3"))
+PASSES = int(os.environ.get("STROM_TIER_GATE_PASSES", "3"))
+
+CHUNK = 64 << 10
+
+
+def _arm(config, *, ram_chunks: int, hbm_chunks: int, unified: bool) -> None:
+    """One deterministic tier geometry; extent_space.configure() below
+    re-reads it and re-arms the migration hooks."""
+    config.set("tier_ram_bytes", ram_chunks * CHUNK)
+    config.set("tier_hbm_bytes", hbm_chunks * CHUNK)
+    config.set("tier_kv_block_bytes", CHUNK)
+    config.set("tier_unified", unified)
+    config.set("cache_arbitration", False)
+    config.set("dma_max_size", CHUNK)   # one tier decision per chunk
+
+
+def _shuffled_pass(sess, src, order) -> bytes:
+    """Read the working set in one seeded-shuffle order; return the
+    bytes reassembled back into logical order."""
+    import numpy as np
+
+    from ..engine import reorder_chunks
+    total = len(order) * CHUNK
+    handle, buf = sess.alloc_dma_buffer(total)
+    try:
+        res = sess.memcpy_ssd2ram(src, handle, list(order), CHUNK)
+        sess.memcpy_wait(res.dma_task_id, timeout=120.0)
+        host = reorder_chunks(np.frombuffer(buf.view()[:total], np.uint8),
+                              CHUNK, res.chunk_ids, sorted(order))
+        return bytes(host)
+    finally:
+        sess.unmap_buffer(handle)
+
+
+def _timed_leg(dirpath: str, tag: str, *, unified: bool,
+               orders) -> float:
+    """Median steady-state pass time for one mode over the thrash set."""
+    import statistics
+
+    from ..config import config
+    from ..engine import Session
+    from ..tiering import extent_space
+    from . import FakeNvmeSource, FaultPlan, make_test_file
+    from .fake import expected_bytes
+
+    nchunks, lat = 13, 0.002           # ~0.8 x (8 + 8) chunk capacity
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, f"thrash-{tag}.bin")
+    make_test_file(path, size)
+    _arm(config, ram_chunks=8, hbm_chunks=8, unified=unified)
+    src = FakeNvmeSource(path, fault_plan=FaultPlan(latency_s=lat),
+                         force_cached_fraction=0.0)
+    times = []
+    try:
+        with Session() as sess:
+            for order in orders[:2]:   # warm the hierarchy
+                _shuffled_pass(sess, src, order)
+            for order in orders[2:]:
+                t0 = time.perf_counter()
+                got = _shuffled_pass(sess, src, order)
+                times.append(time.perf_counter() - t0)
+                assert got == expected_bytes(0, size), \
+                    f"{tag} leg bytes diverged"
+    finally:
+        src.close()
+        extent_space.clear_tiers()
+    return statistics.median(times)
+
+
+def _leg_thrash_ab(dirpath: str) -> None:
+    """Unified >= RATIO_LIMIT x split on the same seeded visit orders."""
+    rng = random.Random(17)
+    orders = []
+    for _ in range(2 + PASSES):
+        order = list(range(13))
+        rng.shuffle(order)
+        orders.append(order)
+    unified_t = _timed_leg(dirpath, "unified", unified=True, orders=orders)
+    split_t = _timed_leg(dirpath, "split", unified=False, orders=orders)
+    ratio = split_t / unified_t if unified_t > 0 else float("inf")
+    assert ratio >= RATIO_LIMIT, \
+        f"unified only {ratio:.2f}x split (limit {RATIO_LIMIT}x; " \
+        f"split {split_t * 1e3:.1f}ms unified {unified_t * 1e3:.1f}ms)"
+    print(f"tier-gate thrash leg ok: unified {ratio:.1f}x split "
+          f"(split {split_t * 1e3:.1f}ms, unified {unified_t * 1e3:.1f}ms, "
+          f"median of {PASSES} steady-state passes)")
+
+
+def _leg_churn_identity(dirpath: str) -> None:
+    """Capacities far below the set: promotion + demotion + eviction all
+    churn, bytes identical every pass."""
+    from ..config import config
+    from ..engine import Session
+    from ..stats import stats
+    from ..tiering import extent_space
+    from . import FakeNvmeSource, make_test_file
+    from .fake import expected_bytes
+
+    nchunks = 13
+    size = nchunks * CHUNK
+    path = os.path.join(dirpath, "churn.bin")
+    make_test_file(path, size)
+    _arm(config, ram_chunks=4, hbm_chunks=4, unified=True)
+    src = FakeNvmeSource(path, force_cached_fraction=0.0)
+    rng = random.Random(23)
+    before = stats.snapshot(reset_max=False).counters
+    try:
+        with Session() as sess:
+            for r in range(4):
+                order = list(range(nchunks))
+                rng.shuffle(order)
+                got = _shuffled_pass(sess, src, order)
+                assert got == expected_bytes(0, size), \
+                    f"bytes diverged under migration churn (pass {r})"
+    finally:
+        src.close()
+        extent_space.clear_tiers()
+    after = stats.snapshot(reset_max=False).counters
+
+    def delta(k):
+        return after.get(k, 0) - before.get(k, 0)
+
+    promoted = delta("nr_tier_hbm_promote")
+    demoted = delta("nr_tier_hbm_demote") + delta("nr_tier_ram_demote")
+    faulted = delta("nr_tier_ram_fault")
+    assert promoted > 0, "churn leg never promoted (hook not armed?)"
+    assert demoted > 0, "churn leg never demoted (capacity not binding?)"
+    assert faulted > 0, "churn leg never demand-faulted"
+    print(f"tier-gate churn leg ok: {promoted} promotions, "
+          f"{demoted} demotions, {faulted} faults, bytes identical")
+
+
+def _leg_failstop_faults(dirpath: str) -> None:
+    """A member fail-stops mid-run: demand faults fill through the
+    surviving mirror leg, tiers stay byte-identical."""
+    from ..config import config
+    from ..engine import Session
+    from ..tiering import extent_space
+    from . import FaultPlan
+    from .chaos import (STRIPE, expected_mirrored_stream,
+                        make_mirrored_members, read_all)
+    from .fake import FakeStripedNvmeSource
+
+    _arm(config, ram_chunks=8, hbm_chunks=8, unified=True)
+    paths = make_mirrored_members(dirpath, tag="tg")
+    plan = FaultPlan(failstop_member=0, failstop_after=0)
+    src = FakeStripedNvmeSource(paths, stripe_chunk_size=STRIPE,
+                                fault_plan=plan, force_cached_fraction=0.0,
+                                mirror="paired")
+    want = expected_mirrored_stream(paths)
+    try:
+        with Session() as sess:
+            got, total = read_all(sess, src)
+            assert got == want[:total], \
+                "fail-stop leg: degraded cold read diverged"
+            got, total = read_all(sess, src)
+            assert got == want[:total], \
+                "fail-stop leg: tier-served rescan diverged"
+    finally:
+        src.close()
+        extent_space.clear_tiers()
+    print("tier-gate fail-stop leg ok: member 0 dead from the first "
+          "read, mirror-leg faults byte-identical across both passes")
+
+
+def main() -> int:
+    from ..config import config
+    from ..tiering import extent_space
+
+    snap = config.snapshot()
+    try:
+        with tempfile.TemporaryDirectory(prefix="strom_tier_") as d:
+            _leg_thrash_ab(d)
+            _leg_churn_identity(d)
+            _leg_failstop_faults(d)
+    except AssertionError as e:
+        print(f"tier-gate FAIL: {e}")
+        return 1
+    finally:
+        config.restore(snap)
+        extent_space.clear_tiers()
+        extent_space.configure()
+    print("tier-gate ok: unified beats split on the thrash config, "
+          "identity holds under migration churn and member fail-stop")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

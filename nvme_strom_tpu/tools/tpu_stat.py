@@ -423,6 +423,32 @@ def main(argv=None) -> int:
                       f"coldstart "
                       f"{c.get('coldstart_bytes_per_sec', 0) / 1048576:.0f}"
                       f"MB/s")
+            # unified tiering scoreboard (ISSUE 20): the placement/
+            # migration engine's view of the whole hierarchy — per-tier
+            # resident bytes against promotion/demotion churn and the
+            # demand-fault rate, plus each tier's share of lookups.
+            # promote far above demote means the HBM tier is still
+            # filling; fault tracking the RAM hit count means the
+            # working set does not fit C_ram + C_hbm; shed above zero
+            # means memlock pressure, not capacity, is the limit
+            if (c.get("nr_tier_hbm_promote") or c.get("nr_tier_hbm_demote")
+                    or c.get("nr_tier_ram_fault")
+                    or c.get("nr_tier_ram_demote")
+                    or c.get("nr_tier_ram_shed")):
+                looks = (c.get("nr_hbm_hit", 0) + c.get("nr_cache_hit", 0)
+                         + c.get("nr_cache_miss", 0))
+                hbm_hr = c.get("nr_hbm_hit", 0) / looks if looks else 0.0
+                ram_hr = c.get("nr_cache_hit", 0) / looks if looks else 0.0
+                print(f"tiering: hbm "
+                      f"{c.get('hbm_resident_bytes', 0) / 1048576:.1f}MB "
+                      f"(hit {hbm_hr:.0%})  ram "
+                      f"{c.get('cache_resident_bytes', 0) / 1048576:.1f}MB "
+                      f"(hit {ram_hr:.0%})  "
+                      f"promote {c.get('nr_tier_hbm_promote', 0)}  "
+                      f"demote {c.get('nr_tier_hbm_demote', 0)}"
+                      f"+{c.get('nr_tier_ram_demote', 0)}  "
+                      f"fault {c.get('nr_tier_ram_fault', 0)}  "
+                      f"shed {c.get('nr_tier_ram_shed', 0)}")
             # multi-host scoreboard (ISSUE 17): host-sharded read volume,
             # on-fabric shard movement, and KV migration outcomes — ICI
             # bytes far above shard-load bytes means the redistribution

@@ -126,6 +126,10 @@ EVENT_SCHEMA: Dict[str, str] = {
     "passthru_fallback": "instant",  # resolved extent left the lane, or
     #                                  the whole rung was refused (reason
     #                                  in args)
+    # unified extent address space (ISSUE 20): migration engine edges
+    "tier_promote": "instant",   # extent moved up one tier (tier in args)
+    "tier_demote": "instant",    # extent moved down one tier (tier in args)
+    "tier_fault": "instant",     # demand fault filled the RAM tier
 }
 
 
@@ -567,6 +571,7 @@ def render_prometheus(payload: dict) -> str:
                 or k.startswith("nr_pressure_") \
                 or k.startswith("nr_autotune_") \
                 or k.startswith("nr_readahead_") \
+                or k.startswith("nr_tier_") \
                 or k in ("nr_mirror_write", "nr_write_retry",
                          "nr_resync_extent", "nr_write_verify_fail",
                          "bytes_readahead"):
@@ -642,6 +647,18 @@ def render_prometheus(payload: dict) -> str:
             out.append(f'strom_tpu_readahead_ops_total{{op="{op}"}} {v}')
         emit("strom_tpu_readahead_bytes_total", "counter",
              counters.get("bytes_readahead", 0))
+    # unified extent space (ISSUE 20): one {tier,op} family for the
+    # placement/migration engine, so dashboards can plot promotion and
+    # demotion churn per tier against the resident-bytes gauges
+    tops = [(key, counters.get(f"nr_tier_{key}", 0))
+            for key in ("hbm_promote", "hbm_demote", "ram_fault",
+                        "ram_demote", "ram_shed")]
+    if any(v for _, v in tops):
+        out.append("# TYPE strom_tpu_tier_ops_total counter")
+        for key, v in tops:
+            tier, _, op = key.partition("_")
+            out.append(
+                f'strom_tpu_tier_ops_total{{tier="{tier}",op="{op}"}} {v}')
     ratio = bytes_touched_ratio(counters)
     if ratio is not None:
         emit("strom_tpu_bytes_touched_per_byte_delivered", "gauge",
